@@ -1,0 +1,14 @@
+"""Coarsening policies: ``transfer_operators(A) -> (P, R)`` and
+``coarse_operator(A, P, R) -> Ac`` (reference:
+amgcl/coarsening/smoothed_aggregation.hpp:130-242 for the contract)."""
+
+from amgcl_tpu.coarsening.aggregates import (
+    strength_graph, mis_aggregates, plain_aggregates, pointwise_aggregates,
+)
+from amgcl_tpu.coarsening.aggregation import Aggregation
+from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
+
+__all__ = [
+    "strength_graph", "mis_aggregates", "plain_aggregates",
+    "pointwise_aggregates", "Aggregation", "SmoothedAggregation",
+]

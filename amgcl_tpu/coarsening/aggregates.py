@@ -1,0 +1,163 @@
+"""Aggregate construction for aggregation-type coarsening.
+
+The reference builds aggregates with a greedy sequential pass
+(amgcl/coarsening/plain_aggregates.hpp:63-213) and, in the distributed case,
+with a parallel maximal-independent-set algorithm
+(amgcl/mpi/coarsening/pmis.hpp:49-1131). On TPU/host we use the MIS
+formulation everywhere: it is deterministic (priority = hashed index),
+vectorizes over all rows at once (no sequential row loop), and is exactly the
+algorithm the mesh-distributed layer shards, so serial and distributed
+coarsening agree by construction.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from amgcl_tpu.ops.csr import CSR, pointwise_matrix
+
+
+def strength_graph(A: CSR, eps_strong: float) -> sp.csr_matrix:
+    """Symmetric strong-connection graph.
+
+    Entry (i, j) is strong iff ``|a_ij|^2 > eps^2 * |a_ii * a_jj|``
+    (reference: amgcl/coarsening/plain_aggregates.hpp:122-136 — note the
+    reference squares eps_strong).
+    Returns a boolean CSR adjacency with the diagonal removed, symmetrized
+    so MIS rounds see an undirected graph."""
+    assert not A.is_block
+    m = A.to_scipy()
+    d = np.abs(A.diagonal())
+    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    strong = (np.abs(A.val) ** 2 > eps_strong ** 2 * d[rows] * d[A.col]) \
+        & (rows != A.col)
+    # copy col/ptr: eliminate_zeros() compacts the arrays in place, and they
+    # must not alias A's buffers
+    S = sp.csr_matrix((strong.astype(np.int8), A.col.copy(), A.ptr.copy()),
+                      shape=m.shape)
+    S.eliminate_zeros()
+    S = ((S + S.T) > 0).astype(np.int8)
+    S.sort_indices()
+    return S
+
+
+def _priority(n: int) -> np.ndarray:
+    """Deterministic unique pseudo-random priority per node (a seeded
+    permutation of 1..n), stabilizing MIS tie-breaks independently of row
+    order. Values are small integers, exactly representable in float64, so
+    the sparse row-max argmax-recovery trick is exact."""
+    return (np.random.RandomState(7919).permutation(n) + 1).astype(np.float64)
+
+
+def _luby_mis(S2: sp.csr_matrix, active: np.ndarray, prio: np.ndarray,
+              max_rounds: int = 1000) -> np.ndarray:
+    """Maximal independent set over S2 restricted to ``active`` nodes,
+    deterministic via unique priorities; vectorized Luby rounds."""
+    n = S2.shape[0]
+    und = active.copy()
+    in_set = np.zeros(n, dtype=bool)
+    Sb = S2.astype(np.float64)
+    for _ in range(max_rounds):
+        if not und.any():
+            break
+        p_und = np.where(und, prio, 0.0)
+        nbr_max = Sb.multiply(p_und[None, :]).max(axis=1).toarray().ravel()
+        winners = und & (prio > nbr_max)
+        in_set |= winners
+        # winners and their S2 neighborhood leave the undecided pool
+        covered = np.asarray(
+            Sb @ winners.astype(np.float64)).ravel() > 0
+        und &= ~(winners | covered)
+    return in_set
+
+
+def mis_aggregates(S: sp.csr_matrix, max_rounds: int = 1000):
+    """Aggregates from a distance-2 MIS over the strength graph.
+
+    The reference's greedy pass builds radius-2 aggregates: a seed claims its
+    strong neighbors and, tentatively, their neighbors
+    (amgcl/coarsening/plain_aggregates.hpp:162-190). The deterministic
+    parallel reformulation — the same one the distributed PMIS coarsening
+    needs (amgcl/mpi/coarsening/pmis.hpp:49-1131) — is:
+
+      1. roots = maximal independent set over S² (no two roots within
+         distance 2), via vectorized Luby rounds with hashed priorities;
+      2. distance-1 assignment: nodes strongly adjacent to a root join it
+         (unique by the S² independence);
+      3. distance-2 assignment: remaining nodes join the aggregate of their
+         highest-priority assigned neighbor.
+
+    Returns ``(agg, n_agg)``; ``agg[i] == -1`` flags isolated rows excluded
+    from the coarse space (the reference's 'removed' state)."""
+    n = S.shape[0]
+    prio = _priority(n)
+    deg = np.diff(S.indptr)
+    isolated = deg == 0
+    active = ~isolated
+
+    S2 = ((S + S @ S) > 0).astype(np.int8)
+    S2.setdiag(0)
+    S2.eliminate_zeros()
+
+    roots = _luby_mis(S2, active, prio, max_rounds)
+    root_of = np.full(n, -1, dtype=np.int64)
+    root_of[roots] = np.flatnonzero(roots)
+
+    Sb = S.astype(np.float64)
+    rows_all = np.repeat(np.arange(n), np.diff(S.indptr))
+
+    # distance-1: join the adjacent root (unique since roots are S2-independent)
+    p_root = np.where(roots, prio, 0.0)
+    nbr_root_max = Sb.multiply(p_root[None, :]).max(axis=1).toarray().ravel()
+    d1 = active & ~roots & (nbr_root_max > 0)
+    sc = p_root[S.indices]
+    match = d1[rows_all] & (sc > 0) & (sc == nbr_root_max[rows_all])
+    root_of[rows_all[match]] = S.indices[match]
+
+    # distance-2: join the highest-priority assigned neighbor's aggregate
+    assigned = root_of >= 0
+    for _ in range(2):  # second sweep catches stragglers next to stragglers
+        todo = active & ~assigned
+        if not todo.any():
+            break
+        p_asgn = np.where(assigned, prio, 0.0)
+        nbr_max = Sb.multiply(p_asgn[None, :]).max(axis=1).toarray().ravel()
+        join = todo & (nbr_max > 0)
+        sc = p_asgn[S.indices]
+        match = join[rows_all] & (sc > 0) & (sc == nbr_max[rows_all])
+        root_of[rows_all[match]] = root_of[S.indices[match]]
+        assigned = root_of >= 0
+
+    # any still-unassigned active node becomes its own aggregate (can only
+    # happen in disconnected corner cases)
+    left = active & (root_of < 0)
+    root_of[left] = np.flatnonzero(left)
+    roots = roots | left
+
+    # compress root node ids to consecutive aggregate ids
+    root_nodes = np.flatnonzero(roots)
+    agg_id = np.full(n, -1, dtype=np.int64)
+    agg_id[root_nodes] = np.arange(len(root_nodes))
+    agg = np.full(n, -1, dtype=np.int64)
+    agg[root_of >= 0] = agg_id[root_of[root_of >= 0]]
+    return agg, len(root_nodes)
+
+
+def plain_aggregates(A: CSR, eps_strong: float = 0.08):
+    """Aggregates over the scalar strength graph of A
+    (reference: amgcl/coarsening/plain_aggregates.hpp:63-213, default
+    eps_strong = 0.08)."""
+    S = strength_graph(A, eps_strong)
+    return mis_aggregates(S)
+
+
+def pointwise_aggregates(A: CSR, eps_strong: float = 0.08,
+                         block_size: int = 1):
+    """Block systems: condense to a pointwise matrix, aggregate that
+    (reference: amgcl/coarsening/pointwise_aggregates.hpp:54-197,
+    amgcl/backend/builtin.hpp:560-661)."""
+    if block_size == 1 and not A.is_block:
+        return plain_aggregates(A, eps_strong)
+    Ap = pointwise_matrix(A, block_size if not A.is_block else A.block_size[0])
+    return plain_aggregates(Ap, eps_strong)

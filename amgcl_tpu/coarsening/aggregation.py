@@ -1,0 +1,52 @@
+"""Plain (non-smoothed) aggregation coarsening with scaled Galerkin
+(reference: amgcl/coarsening/aggregation.hpp:71-160 — the coarse operator is
+over-corrected by 1/over_interp because piecewise-constant interpolation
+underestimates corrections; default over_interp = 1.5)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.coarsening.aggregates import (
+    strength_graph, mis_aggregates, pointwise_aggregates)
+from amgcl_tpu.coarsening.tentative import tentative_prolongation
+from amgcl_tpu.coarsening.galerkin import scaled_galerkin
+
+
+@dataclass
+class Aggregation:
+    eps_strong: float = 0.08
+    over_interp: float = 1.5
+    block_size: int = 1
+    nullspace: np.ndarray | None = None
+
+    def transfer_operators(self, A: CSR):
+        if A.is_block and self.nullspace is not None:
+            raise NotImplementedError(
+                "near-nullspace with block value types is not supported; "
+                "unblock the matrix first (reference: coarsening::as_scalar)")
+        scalar = A.unblock() if A.is_block else A
+        bs = A.block_size[0] if A.is_block else self.block_size
+        if bs > 1:
+            agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
+            n_pt = A.nrows if A.is_block else A.nrows // bs
+        else:
+            S = strength_graph(scalar, self.eps_strong)
+            agg, n_agg = mis_aggregates(S)
+            n_pt = scalar.nrows
+        if n_agg == 0:
+            raise ValueError("empty coarse level (all rows isolated)")
+        P, Bc = tentative_prolongation(n_pt, agg, n_agg, self.nullspace, bs)
+        R = P.transpose()
+        if A.is_block and not P.is_block:
+            P = P.to_block(bs)
+            R = R.to_block(bs)
+        self.eps_strong *= 0.5
+        self.nullspace = Bc
+        return P, R
+
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+        return scaled_galerkin(A, P, R, 1.0 / self.over_interp)

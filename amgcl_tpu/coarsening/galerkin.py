@@ -1,0 +1,17 @@
+"""Galerkin coarse operator Ac = R A P via two SpGEMMs
+(reference: amgcl/coarsening/detail/galerkin.hpp:53,
+amgcl/coarsening/detail/scaled_galerkin.hpp)."""
+
+from __future__ import annotations
+
+from amgcl_tpu.ops.csr import CSR
+
+
+def galerkin(A: CSR, P: CSR, R: CSR) -> CSR:
+    return R @ (A @ P)
+
+
+def scaled_galerkin(A: CSR, P: CSR, R: CSR, scale: float) -> CSR:
+    Ac = galerkin(A, P, R)
+    Ac.val = Ac.val * scale
+    return Ac

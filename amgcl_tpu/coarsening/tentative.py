@@ -1,0 +1,74 @@
+"""Tentative prolongation from aggregates, with optional near-nullspace.
+
+Without a user nullspace the tentative P is piecewise constant over
+aggregates; with one, each aggregate's nullspace block is orthonormalized by
+a dense QR and the R factors become the coarse-level nullspace (reference:
+amgcl/coarsening/tentative_prolongation.hpp:61-233, QR at
+amgcl/detail/qr.hpp:114-268 — here a batched numpy QR over padded
+aggregates replaces the hand-rolled Householder code).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from amgcl_tpu.ops.csr import CSR
+
+
+def tentative_prolongation(n: int, agg: np.ndarray, n_agg: int,
+                           nullspace: np.ndarray | None = None,
+                           block_size: int = 1):
+    """Build (P: CSR, coarse_nullspace or None).
+
+    agg: per-node aggregate id (block units), -1 = excluded.
+    nullspace: optional (n_scalar, nvec) near-nullspace vectors; when given,
+    P gets nvec columns per aggregate and the coarse space inherits a
+    (n_agg*nvec, nvec) nullspace."""
+    if nullspace is None:
+        rows = np.flatnonzero(agg >= 0)
+        if block_size == 1:
+            P = sp.csr_matrix(
+                (np.ones(len(rows)), (rows, agg[rows])), shape=(n, n_agg))
+            P.sort_indices()
+            return CSR.from_scipy(P), None
+        # block system without nullspace: P is identity blocks over aggregates
+        srows = (rows[:, None] * block_size + np.arange(block_size)).ravel()
+        scols = (agg[rows][:, None] * block_size + np.arange(block_size)).ravel()
+        P = sp.csr_matrix((np.ones(len(srows)), (srows, scols)),
+                          shape=(n * block_size, n_agg * block_size))
+        P.sort_indices()
+        return CSR.from_scipy(P).to_block(block_size), None
+
+    B = np.asarray(nullspace, dtype=np.float64)
+    nvec = B.shape[1]
+    ns = n * block_size  # scalar rows
+    assert B.shape[0] == ns
+    # scalar-row aggregate ids
+    sagg = np.repeat(agg, block_size)
+    order = np.argsort(sagg, kind="stable")
+    order = order[sagg[order] >= 0]
+    gagg = sagg[order]
+    counts = np.bincount(gagg, minlength=n_agg)
+    maxsz = int(counts.max()) if n_agg else 0
+    # pad each aggregate's nullspace block into a (n_agg, maxsz, nvec) batch
+    batch = np.zeros((n_agg, maxsz, nvec))
+    pos_in_agg = np.arange(len(order)) - np.repeat(
+        np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
+    batch[gagg, pos_in_agg] = B[order]
+    assert maxsz >= nvec, "aggregates smaller than the nullspace dimension"
+    Q, R = np.linalg.qr(batch)          # Q: (n_agg, maxsz, nvec)
+    # fix QR sign so diag(R) >= 0 (deterministic coarse basis)
+    sgn = np.sign(np.einsum("aii->ai", R))
+    sgn = np.where(sgn == 0, 1.0, sgn)
+    Q = Q * sgn[:, None, :]
+    R = R * sgn[:, :, None]
+    # scatter Q back into sparse P: row `order[k]`, cols agg*nvec..+nvec
+    prow = np.repeat(order, nvec)
+    pcol = (gagg[:, None] * nvec + np.arange(nvec)).ravel()
+    pval = Q[gagg, pos_in_agg].ravel()
+    P = sp.csr_matrix((pval, (prow, pcol)), shape=(ns, n_agg * nvec))
+    P.eliminate_zeros()
+    P.sort_indices()
+    Bc = R.reshape(n_agg * nvec, nvec)
+    return CSR.from_scipy(P), Bc

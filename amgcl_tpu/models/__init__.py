@@ -1,0 +1,7 @@
+"""Top-level compositions: the AMG hierarchy, make_solver bundles, and
+coupled-physics preconditioners."""
+
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver, SolverInfo
+
+__all__ = ["AMG", "AMGParams", "make_solver", "SolverInfo"]

@@ -1,0 +1,85 @@
+"""``make_solver`` — bundle a preconditioner with a Krylov solver behind one
+call, compiled as a single XLA program (reference:
+amgcl/make_solver.hpp:41-231).
+
+Mixed precision comes for free at this seam: the preconditioner hierarchy may
+live in a lower precision than the Krylov iteration (reference:
+amgcl/backend/detail/mixing.hpp:45-73, examples/mixed_precision.cpp:32-44) —
+the apply casts the residual down and the correction back up.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.solver.cg import CG
+
+
+@dataclass
+class SolverInfo:
+    iters: int
+    resid: float
+
+    def __iter__(self):  # (iters, resid) tuple-unpacking like the reference
+        yield self.iters
+        yield self.resid
+
+
+class make_solver:
+    """P+S bundle: ``solve = make_solver(A, precond=AMGParams(), solver=CG())``
+    then ``x, info = solve(rhs)``.
+
+    The system matrix used by the Krylov loop is moved to the device in
+    ``solver_dtype`` (which may differ from the preconditioner dtype)."""
+
+    def __init__(self, A, precond: Optional[AMGParams] = None,
+                 solver: Any = None, solver_dtype=None,
+                 matrix_format: str = "auto"):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        self.A_host = A
+        self.precond_params = precond or AMGParams()
+        self.solver = solver or CG()
+        self.solver_dtype = solver_dtype or self.precond_params.dtype
+        self.precond = AMG(A, self.precond_params)
+        self.A_dev = dev.to_device(A, matrix_format, self.solver_dtype)
+        self._compiled = None
+
+    def _solve_fn(self, A_dev, hier, rhs, x0):
+        pdtype = self.precond_params.dtype
+
+        def apply_precond(r):
+            z = hier.apply(r.astype(pdtype))
+            return z.astype(rhs.dtype)
+
+        x, iters, resid = self.solver.solve(A_dev, apply_precond, rhs, x0)
+        return x, iters, resid
+
+    def __call__(self, rhs, x0=None):
+        n = self.A_host.nrows * self.A_host.block_size[0]
+        if np.shape(rhs) != (n,):
+            raise ValueError(
+                "rhs has shape %s but the system has %d unknowns"
+                % (np.shape(rhs), n))
+        rhs = jnp.asarray(rhs, dtype=self.solver_dtype)
+        if x0 is not None:
+            x0 = jnp.asarray(x0, dtype=self.solver_dtype)
+        else:
+            x0 = jnp.zeros_like(rhs)
+        if self._compiled is None:
+            self._compiled = jax.jit(self._solve_fn)
+        x, iters, resid = self._compiled(self.A_dev, self.precond.hierarchy,
+                                         rhs, x0)
+        return x, SolverInfo(int(iters), float(resid))
+
+    def __repr__(self):
+        return ("make_solver\n===========\nSolver: %s\n\nPreconditioner:\n%r"
+                % (type(self.solver).__name__, self.precond))

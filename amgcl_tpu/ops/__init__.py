@@ -1,0 +1,6 @@
+"""Host build-format (CSR) and device algebra for the TPU backend."""
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device
+
+__all__ = ["CSR", "device"]

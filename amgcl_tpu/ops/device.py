@@ -1,0 +1,282 @@
+"""Device algebra: TPU-resident sparse formats and the backend primitive set.
+
+This is the TPU equivalent of the reference's backend contract — a matrix
+type, a vector type (plain jnp arrays), and a small set of parallel
+primitives that the entire solve phase is written against (reference:
+amgcl/backend/interface.hpp:189-443, amgcl/backend/cuda.hpp:60-843 for the
+accelerator-offload pattern).
+
+Formats (chosen for TPU, not translated from CSR):
+
+* :class:`DiaMatrix` — diagonal storage. SpMV is a static unrolled sum of
+  shifted element-wise multiplies: zero gathers, pure VPU work, HBM-bound.
+  Ideal for stencil-structured levels (the finest levels of most problems).
+* :class:`EllMatrix` — padded-row (ELLPACK) storage, scalar or block values.
+  SpMV is one gather of x plus a dense reduction over the padded row —
+  the general-purpose format; rows are padded to a lane-friendly width.
+* :class:`DenseMatrix` — small dense operator; SpMV is an MXU matmul. Used
+  for coarse AMG levels where density makes gathers pointless.
+
+All classes are registered JAX pytrees so they can be closed over or passed
+through ``jit``/``shard_map`` boundaries; static metadata (shapes, offsets)
+lives in the aux data so trace caching works.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+
+# Pad ELL row widths up to a multiple of this (lane friendliness / fewer
+# distinct compiled shapes across levels).
+_ELL_PAD = 4
+
+
+@register_pytree_node_class
+class DiaMatrix:
+    """Diagonal-format sparse matrix (possibly rectangular).
+
+    data[k, i] holds A[i, i + offsets[k]]; offsets are static Python ints so
+    the SpMV unrolls into a fixed sequence of shifted multiply-adds under jit.
+    """
+
+    def __init__(self, offsets, data, shape):
+        self.offsets = tuple(int(o) for o in offsets)
+        self.data = data                       # (ndiag, nrows)
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def dtype(self):
+        return self.data.dtype
+
+    def tree_flatten(self):
+        return (self.data,), (self.offsets, self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        offsets, shape = aux
+        return cls(offsets, children[0], shape)
+
+    def mv(self, x):
+        n, m = self.shape
+        lo = min(self.offsets + (0,))
+        # each diagonal d reads xp[base+d : base+d+n); pad the tail so the
+        # slice stays in range even for tall (nrows > ncols) matrices —
+        # lax.dynamic_slice would otherwise clamp and read garbage
+        base = -lo if lo < 0 else 0
+        hi = max(max(self.offsets + (0,)) + n - m, 0)
+        xp = jnp.pad(x, (base, hi))
+        y = jnp.zeros(n, dtype=jnp.result_type(self.dtype, x.dtype))
+        for k, d in enumerate(self.offsets):
+            seg = lax.dynamic_slice(xp, (base + d,), (n,))
+            y = y + self.data[k] * seg
+        return y
+
+    def bytes(self):
+        return self.data.size * self.data.dtype.itemsize
+
+
+@register_pytree_node_class
+class EllMatrix:
+    """ELLPACK matrix: cols (n, K) int32, vals (n, K) or (n, K, br, bc).
+
+    Padding entries have col == 0 and val == 0, so they contribute nothing.
+    Block values follow the BCSR convention: x is logically (mcols, bc)."""
+
+    def __init__(self, cols, vals, shape, block=(1, 1)):
+        self.cols = cols
+        self.vals = vals
+        self.shape = (int(shape[0]), int(shape[1]))   # in block units
+        self.block = (int(block[0]), int(block[1]))
+
+    @property
+    def dtype(self):
+        return self.vals.dtype
+
+    def tree_flatten(self):
+        return (self.cols, self.vals), (self.shape, self.block)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        shape, block = aux
+        return cls(children[0], children[1], shape, block)
+
+    def mv(self, x):
+        br, bc = self.block
+        if (br, bc) == (1, 1):
+            xg = jnp.take(x, self.cols, axis=0)          # (n, K)
+            return jnp.einsum("nk,nk->n", self.vals, xg,
+                              preferred_element_type=jnp.result_type(
+                                  self.dtype, x.dtype))
+        xb = x.reshape(self.shape[1], bc)
+        xg = jnp.take(xb, self.cols, axis=0)             # (n, K, bc)
+        y = jnp.einsum("nkij,nkj->ni", self.vals, xg,
+                       preferred_element_type=jnp.result_type(
+                           self.dtype, x.dtype))
+        return y.reshape(self.shape[0] * br)
+
+    def bytes(self):
+        return (self.cols.size * self.cols.dtype.itemsize
+                + self.vals.size * self.vals.dtype.itemsize)
+
+
+@register_pytree_node_class
+class DenseMatrix:
+    """Small dense operator (coarse levels); mv is an MXU matmul."""
+
+    def __init__(self, a):
+        self.a = a
+
+    @property
+    def shape(self):
+        return self.a.shape
+
+    @property
+    def dtype(self):
+        return self.a.dtype
+
+    def tree_flatten(self):
+        return (self.a,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def mv(self, x):
+        return self.a @ x
+
+    def bytes(self):
+        return self.a.size * self.a.dtype.itemsize
+
+
+# -- conversion -------------------------------------------------------------
+
+def csr_to_ell(A: CSR, dtype=jnp.float32) -> EllMatrix:
+    """Pack a host CSR/BCSR into device ELL format."""
+    nnz_row = A.row_nnz()
+    K = int(nnz_row.max()) if A.nrows else 1
+    K = max(_ELL_PAD, -(-K // _ELL_PAD) * _ELL_PAD)
+    n = A.nrows
+    cols = np.zeros((n, K), dtype=np.int32)
+    if A.is_block:
+        br, bc = A.block_size
+        vals = np.zeros((n, K, br, bc), dtype=A.val.dtype)
+    else:
+        vals = np.zeros((n, K), dtype=A.val.dtype)
+    rows = np.repeat(np.arange(n), nnz_row)
+    pos = np.arange(A.nnz) - A.ptr[rows]
+    cols[rows, pos] = A.col
+    vals[rows, pos] = A.val
+    return EllMatrix(jnp.asarray(cols), jnp.asarray(vals, dtype=dtype),
+                     A.shape, A.block_size)
+
+
+def csr_to_dia(A: CSR, dtype=jnp.float32) -> DiaMatrix:
+    """Pack a host scalar CSR into device DIA format."""
+    assert not A.is_block
+    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    d = A.col.astype(np.int64) - rows
+    offsets = np.unique(d)
+    data = np.zeros((len(offsets), A.nrows), dtype=A.val.dtype)
+    idx = np.searchsorted(offsets, d)
+    data[idx, rows] = A.val
+    return DiaMatrix(offsets.tolist(), jnp.asarray(data, dtype=dtype), A.shape)
+
+
+def dia_efficiency(A: CSR):
+    """(ndiags, fill_ratio) for the DIA packing of A — used by auto format
+    selection; fill_ratio = stored / nnz."""
+    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    offsets = np.unique(A.col.astype(np.int64) - rows)
+    nd = len(offsets)
+    fill = nd * A.nrows / max(A.nnz, 1)
+    return nd, fill
+
+
+def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
+              max_diags: int = 40, max_fill: float = 1.5,
+              dense_cutoff: int = 2048):
+    """Move a host matrix to the device in a TPU-friendly format.
+
+    ``fmt``: 'auto' | 'ell' | 'dia' | 'dense'. Auto picks DIA when the
+    matrix is banded enough (zero-gather SpMV), dense below a size cutoff,
+    ELL otherwise. This is the host→device boundary of the setup phase
+    (reference: amgcl/amg.hpp:356-364 `copy_matrix`)."""
+    if fmt == "dense" or (fmt == "auto" and not A.is_block
+                          and max(A.shape) <= dense_cutoff
+                          and A.nnz > 0.02 * A.shape[0] * A.shape[1]):
+        return DenseMatrix(jnp.asarray(A.to_dense(), dtype=dtype))
+    if fmt == "dia":
+        return csr_to_dia(A, dtype)
+    if fmt == "auto" and not A.is_block:
+        nd, fill = dia_efficiency(A)
+        if nd <= max_diags and fill <= max_fill:
+            return csr_to_dia(A, dtype)
+    return csr_to_ell(A, dtype)
+
+
+# -- backend primitives (reference: amgcl/backend/interface.hpp:253-443) ----
+
+def spmv(A, x):
+    """y = A x."""
+    return A.mv(x)
+
+
+def residual(f, A, x):
+    """r = f - A x (interface.hpp `residual`)."""
+    return f - A.mv(x)
+
+
+def axpby(a, x, b, y):
+    """y = a x + b y."""
+    return a * x + b * y
+
+
+def axpbypcz(a, x, b, y, c, z):
+    """z = a x + b y + c z."""
+    return a * x + b * y + c * z
+
+
+def vmul(a, x, y, b, z):
+    """z = a x∘y + b z (element-wise product, interface.hpp `vmul`)."""
+    return a * x * y + b * z
+
+
+def inner_product(x, y):
+    """Conjugated dot product; the seam the distributed layer swaps for a
+    psum-reduced version (reference: solver/detail/default_inner_product.hpp,
+    mpi/inner_product.hpp:45-67)."""
+    return jnp.vdot(x, y)
+
+
+def norm(x):
+    return jnp.sqrt(jnp.abs(jnp.vdot(x, x)))
+
+
+def clear(x):
+    return jnp.zeros_like(x)
+
+
+def copy(x):
+    return x  # functional arrays: copy is identity
+
+
+def gather(x, idx):
+    return jnp.take(x, idx, axis=0)
+
+
+def scatter(y, idx, v):
+    return y.at[idx].set(v)
+
+
+def lin_comb(coefs, vecs, b, z):
+    """z = sum_i coefs[i] * vecs[i] + b z (interface.hpp lin_comb)."""
+    out = b * z
+    for c, v in zip(coefs, vecs):
+        out = out + c * v
+    return out

@@ -1,0 +1,11 @@
+"""Smoothers (relaxation). Each policy builds backend-resident state from the
+host build-matrix and exposes traceable ``apply_pre/apply_post/apply``
+(reference contract: amgcl/relaxation/spai0.hpp:49-117).
+
+States are registered pytrees so the whole hierarchy travels through ``jit``
+as one argument (no constant-baking of weights into compiled graphs)."""
+
+from amgcl_tpu.relaxation.jacobi import DampedJacobi
+from amgcl_tpu.relaxation.spai0 import Spai0
+
+__all__ = ["DampedJacobi", "Spai0"]

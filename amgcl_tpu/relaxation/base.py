@@ -1,0 +1,44 @@
+"""Common machinery for diagonal-scaling-type smoother states."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops import device as dev
+
+
+@register_pytree_node_class
+class ScaledResidualSmoother:
+    """State for smoothers of the form x += scale ∘ (f - A x), where scale is
+    a per-unknown scalar (damped Jacobi, SPAI-0) or a per-node block.
+
+    One state class covers both policies; the builder decides the scale."""
+
+    def __init__(self, scale, block=1):
+        self.scale = scale            # (n,) or (n_pt, b, b)
+        self.block = int(block)
+
+    def tree_flatten(self):
+        return (self.scale,), (self.block,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0])
+
+    def _mul(self, r):
+        if self.scale.ndim == 1:
+            return self.scale * r
+        b = self.scale.shape[-1]
+        rb = r.reshape(-1, b)
+        return jnp.einsum("nij,nj->ni", self.scale, rb).reshape(r.shape)
+
+    def apply_pre(self, A, f, x):
+        return x + self._mul(dev.residual(f, A, x))
+
+    apply_post = apply_pre
+
+    def apply(self, A, f):
+        """Single standalone application from zero initial guess
+        (as_preconditioner path, reference: relaxation/spai0.hpp:96-103)."""
+        return self._mul(f)

@@ -1,0 +1,25 @@
+"""Damped Jacobi smoother: x += ω D⁻¹ (f − A x)
+(reference: amgcl/relaxation/damped_jacobi.hpp, default damping 0.72)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.relaxation.base import ScaledResidualSmoother
+
+
+@dataclass
+class DampedJacobi:
+    damping: float = 0.72
+
+    def build(self, A: CSR, dtype=jnp.float32) -> ScaledResidualSmoother:
+        dinv = A.diagonal(invert=True)
+        if A.is_block:
+            return ScaledResidualSmoother(
+                jnp.asarray(self.damping * dinv, dtype=dtype),
+                block=A.block_size[0])
+        return ScaledResidualSmoother(
+            jnp.asarray(self.damping * dinv, dtype=dtype))

@@ -1,0 +1,11 @@
+"""Iterative (Krylov) solvers. Each solver is constructed with parameters and
+called as ``solve(A, precond, rhs, x0) -> (x, iters, resid)``, with the whole
+iteration compiled as a single ``lax.while_loop`` XLA program (reference
+contract: amgcl/solver/cg.hpp:63-252). The ``inner_product`` argument is the
+seam the distributed layer uses to globalize reductions (reference:
+amgcl/solver/detail/default_inner_product.hpp)."""
+
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.solver.direct import DenseDirectSolver
+
+__all__ = ["CG", "DenseDirectSolver"]

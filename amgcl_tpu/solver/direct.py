@@ -1,0 +1,56 @@
+"""Direct solver for the coarsest AMG level.
+
+The reference factorizes the gathered coarse matrix with a Cuthill-McKee +
+skyline LU (amgcl/solver/skyline_lu.hpp:80-311, used when the level is below
+``coarse_enough`` rows). On TPU the right shape for a <=few-thousand-row
+solve is dense: the inverse is computed once on the host in float64 and the
+per-cycle coarse solve becomes a single MXU matmul — no triangular
+dependency chains on device.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.linalg
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+
+
+@register_pytree_node_class
+class DenseDirectSolver:
+    """Coarse direct solve as y = A⁻¹ f with the inverse precomputed on host."""
+
+    def __init__(self, inv, block=1):
+        self.inv = inv
+        self.block = int(block)
+
+    def tree_flatten(self):
+        return (self.inv,), (self.block,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], aux[0])
+
+    def solve(self, f):
+        return self.inv @ f
+
+    @classmethod
+    def build(cls, A: CSR, dtype=jnp.float32) -> "DenseDirectSolver":
+        S = A.unblock() if A.is_block else A
+        dense = S.to_dense().astype(
+            np.complex128 if np.iscomplexobj(S.val) else np.float64)
+        n = dense.shape[0]
+        if n == 0:
+            return cls(jnp.zeros((0, 0), dtype=dtype))
+        # regularize the (often singular-up-to-constant) coarse operator the
+        # pragmatic way: pseudo-inverse fallback when LU is too ill-posed
+        try:
+            inv = scipy.linalg.inv(dense)
+            if not np.all(np.isfinite(inv)):
+                raise np.linalg.LinAlgError
+        except (np.linalg.LinAlgError, scipy.linalg.LinAlgError):
+            inv = np.linalg.pinv(dense)
+        return cls(jnp.asarray(inv, dtype=dtype),
+                   A.block_size[0] if A.is_block else 1)

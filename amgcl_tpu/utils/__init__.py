@@ -1,0 +1,1 @@
+"""Utilities: parameter handling, IO, profiling, sample problems."""

@@ -1,0 +1,127 @@
+"""End-to-end: AMG-preconditioned CG on the Poisson fixture.
+
+The acceptance criterion follows the reference's convergence-sweep tests:
+final relative residual below tolerance within a bounded iteration count
+(reference: tests/test_solver.hpp:120-248, assertion at :71)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.relaxation.jacobi import DampedJacobi
+from amgcl_tpu.relaxation.spai0 import Spai0
+from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
+from amgcl_tpu.coarsening.aggregation import Aggregation
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+
+def check_solution(A, rhs, x, tol=1e-6):
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < tol
+
+
+def test_hierarchy_shape():
+    A, _ = poisson3d(16)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=500))
+    assert len(amg.host_levels) >= 2
+    # coarse levels shrink fast (aggregation ratio ~> 4x in 3D)
+    sizes = [l[0].nrows for l in amg.host_levels]
+    assert all(sizes[i + 1] < sizes[i] for i in range(len(sizes) - 1))
+    assert sizes[-1] <= 500
+    r = repr(amg)
+    assert "Number of levels" in r and "unknowns" in r
+
+
+def test_amg_apply_reduces_residual():
+    A, rhs = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64))
+    f = jnp.asarray(rhs)
+    x = amg.hierarchy.apply(f)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) < 0.5 * np.linalg.norm(rhs)
+
+
+@pytest.mark.parametrize("relax", [Spai0(), DampedJacobi()])
+@pytest.mark.parametrize("coarsening_cls", [SmoothedAggregation, Aggregation])
+def test_cg_amg_poisson(relax, coarsening_cls):
+    A, rhs = poisson3d(16)
+    solve = make_solver(
+        A,
+        AMGParams(coarsening=coarsening_cls(), relax=relax,
+                  dtype=jnp.float64),
+        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    assert info.iters < 60
+    check_solution(A, rhs, x, 1e-7)
+
+
+def test_sa_cg_iteration_count_matches_reference_ballpark():
+    """Reference hits 24 iters on Poisson with SA+CG+spai0
+    (BASELINE.md shared-memory table); on the same setup we must be in the
+    same range — the hierarchy quality check."""
+    A, rhs = poisson3d(32)
+    solve = make_solver(
+        A, AMGParams(dtype=jnp.float64), CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    assert info.iters <= 40
+    check_solution(A, rhs, x, 1e-7)
+
+
+def test_w_cycle_and_sweeps():
+    A, rhs = poisson3d(12)
+    solve = make_solver(
+        A, AMGParams(dtype=jnp.float64, ncycle=2, npre=2, npost=2),
+        CG(maxiter=50, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    check_solution(A, rhs, x, 1e-7)
+
+
+def test_mixed_precision_precond():
+    """float32 hierarchy inside a float64 CG loop
+    (reference: examples/mixed_precision.cpp:32-44)."""
+    A, rhs = poisson3d(16)
+    solve = make_solver(
+        A, AMGParams(dtype=jnp.float32), CG(maxiter=200, tol=1e-8),
+        solver_dtype=jnp.float64)
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    check_solution(A, rhs, x, 1e-7)
+
+
+def test_x0_initial_guess():
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64), CG(tol=1e-8))
+    x1, info1 = solve(rhs)
+    # resolving from the solution should converge (nearly) immediately
+    x2, info2 = solve(rhs, x0=x1)
+    assert info2.iters <= 1
+
+
+def test_npre_zero_is_honored():
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, npre=0, npost=2),
+                        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_block_nullspace_unsupported():
+    from amgcl_tpu.utils.sample_problem import poisson3d_block
+    A, rhs = poisson3d_block(6, 2)
+    ns = np.ones((A.nrows * 2, 3))
+    with pytest.raises(NotImplementedError):
+        SmoothedAggregation(nullspace=ns).transfer_operators(A)
+
+
+def test_rhs_shape_check():
+    A, rhs = poisson3d(8)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64), CG())
+    with pytest.raises(ValueError, match="unknowns"):
+        solve(np.ones(len(rhs) + 1))

@@ -1,0 +1,116 @@
+"""Unit tests for the host CSR build format (setup-phase algebra)."""
+
+import numpy as np
+import scipy.sparse as sp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR, spectral_radius, pointwise_matrix
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+
+def random_csr(n, m, density=0.1, seed=0):
+    rng = np.random.RandomState(seed)
+    M = sp.random(n, m, density=density, random_state=rng, format="csr")
+    M.setdiag(rng.rand(min(n, m)) + 1.0)
+    M = sp.csr_matrix(M)
+    M.sort_indices()
+    return CSR.from_scipy(M)
+
+
+def test_roundtrip_scipy():
+    A = random_csr(40, 40)
+    B = CSR.from_scipy(A.to_scipy())
+    assert np.array_equal(A.ptr, B.ptr)
+    assert np.array_equal(A.col, B.col)
+    assert np.allclose(A.val, B.val)
+
+
+def test_transpose_matches_scipy():
+    A = random_csr(30, 50)
+    T = A.transpose()
+    assert np.allclose(T.to_dense(), A.to_dense().T)
+
+
+def test_spgemm_matches_scipy():
+    A = random_csr(30, 40, seed=1)
+    B = random_csr(40, 20, seed=2)
+    C = A @ B
+    assert np.allclose(C.to_dense(), A.to_dense() @ B.to_dense())
+
+
+def test_sum():
+    A = random_csr(25, 25, seed=3)
+    B = random_csr(25, 25, seed=4)
+    assert np.allclose((A + B).to_dense(), A.to_dense() + B.to_dense())
+
+
+def test_diagonal_and_inverse():
+    A = random_csr(20, 20, seed=5)
+    d = A.diagonal()
+    assert np.allclose(d, A.to_dense().diagonal())
+    di = A.diagonal(invert=True)
+    assert np.allclose(di[d != 0], 1.0 / d[d != 0])
+
+
+def test_block_roundtrip():
+    A = random_csr(24, 24, seed=6)
+    B = A.to_block(4)
+    assert B.is_block and B.block_size == (4, 4)
+    assert np.allclose(B.unblock().to_dense(), A.to_dense())
+
+
+def test_block_transpose():
+    A = random_csr(12, 12, seed=7).to_block(3)
+    T = A.transpose()
+    assert np.allclose(T.unblock().to_dense(), A.unblock().to_dense().T)
+
+
+def test_block_spgemm():
+    A = random_csr(12, 12, seed=8).to_block(2)
+    B = random_csr(12, 12, seed=9).to_block(2)
+    C = A @ B
+    assert C.is_block
+    assert np.allclose(C.unblock().to_dense(),
+                       A.unblock().to_dense() @ B.unblock().to_dense())
+
+
+def test_block_diagonal_inverse():
+    A = random_csr(12, 12, seed=10).to_block(3)
+    D = A.diagonal()
+    Di = A.diagonal(invert=True)
+    for k in range(4):
+        assert np.allclose(Di[k] @ D[k], np.eye(3), atol=1e-10)
+
+
+def test_spmv_block_matches_scalar():
+    A = random_csr(12, 12, seed=11)
+    x = np.random.RandomState(0).rand(12)
+    yb = A.to_block(3).spmv(x)
+    assert np.allclose(yb, A.to_scipy() @ x)
+
+
+def test_spectral_radius_poisson():
+    A, _ = poisson3d(8)
+    # D^-1 A of the Laplacian has spectral radius < 2 (and close to 2)
+    g = spectral_radius(A, power_iters=0)
+    p = spectral_radius(A, power_iters=30)
+    assert 1.0 < p <= g <= 2.5
+    assert abs(p - 2.0) < 0.2
+
+
+def test_pointwise_matrix():
+    A = random_csr(12, 12, seed=12)
+    Ap = pointwise_matrix(A, 3)
+    assert Ap.shape == (4, 4)
+    d = Ap.diagonal()
+    assert np.all(d >= 0)  # diagonal blocks keep + sign
+
+
+def test_scale_and_filter_rows():
+    A = random_csr(15, 15, seed=13)
+    d = np.arange(1, 16).astype(float)
+    S = A.scale_rows(d)
+    assert np.allclose(S.to_dense(), np.diag(d) @ A.to_dense())
+    keep = A.val > 0.5
+    F = A.filter_rows(keep)
+    assert F.nnz == int(keep.sum())
