@@ -56,7 +56,15 @@ def tentative_prolongation(n: int, agg: np.ndarray, n_agg: int,
     pos_in_agg = np.arange(len(order)) - np.repeat(
         np.concatenate([[0], np.cumsum(counts)[:-1]]), counts)
     batch[gagg, pos_in_agg] = B[order]
-    assert maxsz >= nvec, "aggregates smaller than the nullspace dimension"
+    if n_agg and int(counts.min()) < nvec:
+        # an aggregate smaller than the nullspace dimension gives a
+        # rank-deficient QR and a singular coarse basis — fail loudly (the
+        # reference avoids this by enforcing a minimum aggregate size,
+        # pointwise_aggregates min_aggregate)
+        raise ValueError(
+            "aggregate of size %d is smaller than the nullspace dimension "
+            "%d; coarsen more aggressively (larger eps_strong) or reduce "
+            "the nullspace" % (int(counts.min()), nvec))
     Q, R = np.linalg.qr(batch)          # Q: (n_agg, maxsz, nvec)
     # fix QR sign so diag(R) >= 0 (deterministic coarse basis)
     sgn = np.sign(np.einsum("aii->ai", R))

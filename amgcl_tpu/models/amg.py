@@ -142,7 +142,6 @@ class AMG:
         prm = self.prm
         import copy
         coarsening = copy.deepcopy(prm.coarsening)
-        levels = []
         host = []
         Acur = A
         while (Acur.nrows * Acur.block_size[0] > prm.coarse_enough
@@ -193,7 +192,6 @@ class AMG:
     # -- observability (reference: amgcl/amg.hpp:560-598) -------------------
 
     def __repr__(self):
-        rows0 = self.host_levels[0][0].nrows * self.host_levels[0][0].block_size[0]
         nnz0 = self.host_levels[0][0].nnz
         total_nnz = sum(l[0].nnz for l in self.host_levels)
         lines = [

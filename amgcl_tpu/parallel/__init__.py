@@ -1,0 +1,12 @@
+"""Distributed (mesh-sharded) layer.
+
+The TPU-native equivalent of the reference's MPI layer (amgcl/mpi/):
+row-block domain decomposition over a ``jax.sharding.Mesh``, halo exchange
+via ``lax.ppermute``/gathers instead of Isend/Irecv, and ``lax.psum`` inner
+products instead of MPI_Allreduce (reference:
+amgcl/mpi/distributed_matrix.hpp:316-557, amgcl/mpi/inner_product.hpp:45-67).
+"""
+
+from amgcl_tpu.parallel.mesh import make_mesh, ROWS_AXIS
+
+__all__ = ["make_mesh", "ROWS_AXIS"]

@@ -1,0 +1,110 @@
+"""Row-block distributed matrices and the halo-exchange SpMV.
+
+TPU rendition of the reference's ``distributed_matrix`` (A split into a
+local part and a remote part by column ownership, with an overlapped halo
+exchange feeding the remote SpMV — amgcl/mpi/distributed_matrix.hpp:316-557).
+On a TPU mesh the comm pattern is static at trace time: the host-side
+partitioner computes which neighbor slices each shard needs, and the device
+program exchanges them with ``lax.ppermute`` (ICI neighbor traffic), then
+runs the local SpMV — XLA overlaps the permute with the local compute the
+same way the reference overlaps Isend/Irecv with the local product.
+
+Round-1 scope: banded matrices (DIA) whose halo is a fixed-width edge
+exchange with the two ring neighbors. The general scattered-column ELL case
+(arbitrary comm pattern via all_to_all) follows the same structure and is
+layered on next.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.parallel.mesh import ROWS_AXIS
+
+
+@register_pytree_node_class
+class DistDiaMatrix:
+    """Banded matrix sharded by row blocks over the ``rows`` mesh axis.
+
+    data: (ndiag, n) global diagonal storage, sharded on the row dimension;
+    offsets static. ``halo`` = max |offset| = the edge width exchanged with
+    ring neighbors each SpMV."""
+
+    def __init__(self, offsets, data, shape):
+        self.offsets = tuple(int(o) for o in offsets)
+        self.data = data
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @property
+    def halo(self) -> int:
+        return max(max(self.offsets), -min(self.offsets), 0)
+
+    def tree_flatten(self):
+        return (self.data,), (self.offsets, self.shape)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        offsets, shape = aux
+        return cls(offsets, children[0], shape)
+
+    @classmethod
+    def from_csr(cls, A: CSR, mesh, dtype=jnp.float32) -> "DistDiaMatrix":
+        """Host CSR -> device-sharded DIA. Rows must divide the mesh size
+        (pad upstream if needed)."""
+        assert not A.is_block
+        n = A.nrows
+        nd = mesh.shape[ROWS_AXIS]
+        assert n % nd == 0, "rows must divide the mesh for round-1 DIA"
+        rows_chk = np.repeat(np.arange(n), A.row_nnz())
+        w = int(np.abs(A.col.astype(np.int64) - rows_chk).max()) if A.nnz else 0
+        if w > n // nd:
+            raise ValueError(
+                "halo width %d exceeds the shard size %d — the ring "
+                "exchange only reaches immediate neighbors; use fewer "
+                "devices or a narrower band" % (w, n // nd))
+        rows = np.repeat(np.arange(n), A.row_nnz())
+        d = A.col.astype(np.int64) - rows
+        offsets = np.unique(d)
+        data = np.zeros((len(offsets), n), dtype=A.val.dtype)
+        data[np.searchsorted(offsets, d), rows] = A.val
+        sharding = NamedSharding(mesh, P(None, ROWS_AXIS))
+        return cls(offsets.tolist(),
+                   jax.device_put(jnp.asarray(data, dtype=dtype), sharding),
+                   A.shape)
+
+    # -- the per-shard kernel (runs inside shard_map) -----------------------
+
+    def shard_mv(self, data_local, x_local):
+        """Overlapped halo SpMV on one shard: ppermute edges in, local DIA
+        product (the exchange and the interior FMAs are independent — XLA
+        schedules them concurrently, like the reference's
+        start_exchange/local-spmv/finish_exchange split)."""
+        w = self.halo
+        nloc = x_local.shape[0]
+        if w > 0:
+            nd = jax.lax.axis_size(ROWS_AXIS)
+            fwd = [(i, i + 1) for i in range(nd - 1)]
+            bwd = [(i + 1, i) for i in range(nd - 1)]
+            prev_tail = lax.ppermute(x_local[-w:], ROWS_AXIS, fwd)
+            next_head = lax.ppermute(x_local[:w], ROWS_AXIS, bwd)
+            xp = jnp.concatenate([prev_tail, x_local, next_head])
+        else:
+            xp = x_local
+        y = jnp.zeros(nloc, dtype=jnp.result_type(data_local.dtype,
+                                                  x_local.dtype))
+        for k, dofs in enumerate(self.offsets):
+            seg = lax.dynamic_slice(xp, (w + dofs,), (nloc,))
+            y = y + data_local[k] * seg
+        return y
+
+
+def dist_inner_product(x_local, y_local):
+    """Local dot + psum over the rows axis — the distributed InnerProduct
+    seam (reference: amgcl/mpi/inner_product.hpp:45-67)."""
+    return lax.psum(jnp.vdot(x_local, y_local), ROWS_AXIS)

@@ -1,0 +1,24 @@
+"""Mesh construction helpers.
+
+One logical axis ``rows`` carries the domain decomposition (the analogue of
+MPI ranks in the reference's distributed_matrix). Multi-axis meshes (rows ×
+replicas) can be layered later; the solver code only names ``rows``.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh
+
+ROWS_AXIS = "rows"
+
+
+def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
+    """A 1-D mesh over ``rows``. With ``n_devices=None`` uses all local
+    devices (the CI path: 8 virtual CPU devices via
+    --xla_force_host_platform_device_count)."""
+    if devices is None:
+        devices = jax.devices()
+    if n_devices is not None:
+        devices = devices[:n_devices]
+    return Mesh(list(devices), (ROWS_AXIS,))

@@ -1,0 +1,123 @@
+"""ILU(0) smoother, TPU-style.
+
+Construction: Chow–Patel fine-grained fixed-point sweeps (reference:
+amgcl/relaxation/ilu0_chow_patel.hpp:86-593, defaults sweeps=5). Instead of
+the reference's per-entry parallel loops, each sweep here is one restricted
+SpGEMM: (L·U) evaluated on A's sparsity pattern gives every entry's inner
+sum at once, then all L/U entries update simultaneously — the same
+fixed-point, expressed as matrix algebra (vectorized on host; the sweeps are
+embarrassingly parallel by design, Chow & Patel 2015).
+
+Application: the triangular solves are replaced by a fixed number of Jacobi
+iterations — exactly the reference's approximate ``ilu_solve`` used for GPU
+backends (amgcl/relaxation/detail/ilu_solve.hpp:44-129, default iters=2),
+which is the right trade on TPU: no dependency chains, just SpMVs.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+
+
+@register_pytree_node_class
+class ILU0State:
+    """Device factors: strict-lower L (unit diagonal implicit), strict-upper
+    U, and inverted U-diagonal; solves via damped-Jacobi sweeps."""
+
+    def __init__(self, Ls, Us, uinv, jacobi_iters=2):
+        self.Ls = Ls
+        self.Us = Us
+        self.uinv = uinv
+        self.jacobi_iters = int(jacobi_iters)
+
+    def tree_flatten(self):
+        return (self.Ls, self.Us, self.uinv), (self.jacobi_iters,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    def apply(self, A, f):
+        """z ≈ (LU)⁻¹ f. Lower solve: y = f − Ls y, iterated; upper solve:
+        x = Uinv (y − Us x), iterated."""
+        y = f
+        for _ in range(self.jacobi_iters):
+            y = f - dev.spmv(self.Ls, y)
+        x = self.uinv * y
+        for _ in range(self.jacobi_iters):
+            x = self.uinv * (y - dev.spmv(self.Us, x))
+        return x
+
+    def apply_pre(self, A, f, x):
+        return x + self.apply(A, f - dev.spmv(A, x))
+
+    apply_post = apply_pre
+
+
+@dataclass
+class ILU0:
+    sweeps: int = 5          # Chow-Patel construction sweeps
+    jacobi_iters: int = 2    # approximate triangular-solve iterations
+
+    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+        S = A.unblock() if A.is_block else A
+        m = S.to_scipy().astype(np.float64)
+        m.sort_indices()
+        n = m.shape[0]
+        rows = np.repeat(np.arange(n), np.diff(m.indptr))
+        cols = m.indices
+        lower = rows > cols
+        upper = ~lower                      # includes the diagonal
+        a = m.data
+
+        dia = np.asarray(m.diagonal())
+        dia = np.where(dia != 0, dia, 1.0)
+        # Chow-Patel init: U = upper(A); L = lower(A) scaled by U's diagonal
+        uval = np.where(upper, a, 0.0)
+        lval = np.where(lower, a / dia[cols], 0.0)
+
+        pattern = sp.csr_matrix((np.ones_like(a), cols, m.indptr), shape=m.shape)
+        for _ in range(self.sweeps):
+            L = sp.csr_matrix((lval, cols, m.indptr), shape=m.shape)
+            L = L + sp.identity(n)
+            U = sp.csr_matrix((uval, cols, m.indptr), shape=m.shape)
+            LU = (L @ U).multiply(pattern).tocsr()
+            # align LU's values with A's pattern: adding a zero matrix that
+            # carries A's full pattern yields the union pattern (== A's,
+            # since LU ⊆ A after the restriction) in canonical order
+            aligned = (sp.csr_matrix((np.zeros_like(a), cols, m.indptr),
+                                     shape=m.shape) + LU).tocsr()
+            aligned.sort_indices()
+            lu_on_a = aligned.data
+            udia = np.zeros(n)
+            du = uval[rows == cols]
+            udia[cols[rows == cols]] = du
+            udia = np.where(udia != 0, udia, 1.0)
+            # i>j: l_ij = (a_ij - [(LU)_ij - l_ij*u_jj]) / u_jj
+            new_l = (a - (lu_on_a - lval * udia[cols])) / udia[cols]
+            # i<=j: u_ij = a_ij - [(LU)_ij - u_ij]   (unit L diagonal)
+            new_u = a - (lu_on_a - uval)
+            lval = np.where(lower, new_l, 0.0)
+            uval = np.where(upper, new_u, 0.0)
+
+        udia = np.zeros(n)
+        udia[cols[rows == cols]] = uval[rows == cols]
+        udia = np.where(udia != 0, udia, 1.0)
+
+        base = CSR(m.indptr, cols, np.zeros_like(a), n)
+        Lmat = CSR(base.ptr, base.col, lval, n).filter_rows(lower)
+        strict_u = upper & (rows != cols)
+        Umat = CSR(base.ptr, base.col, uval, n).filter_rows(strict_u)
+        return ILU0State(
+            dev.to_device(Lmat, "auto", dtype),
+            dev.to_device(Umat, "auto", dtype),
+            jnp.asarray(1.0 / udia, dtype=dtype),
+            self.jacobi_iters)

@@ -26,10 +26,17 @@ class Spai0:
             G = np.zeros((A.nrows, br, br))
             np.add.at(G, rows, np.einsum("nij,nkj->nik", A.val, A.val))
             dia = A.diagonal()
-            M = np.linalg.solve(
-                np.swapaxes(G, 1, 2),  # solve M G = diaᵀ  ⇔  Gᵀ Mᵀ = dia
-                dia)
+            # guard degenerate (e.g. all-zero) block rows the way the scalar
+            # path guards denom == 0: substitute identity, zero the result
+            zero_row = np.einsum("nii->n", G) == 0
+            G[zero_row] = np.eye(br)
+            Gt = np.swapaxes(G, 1, 2)
+            try:
+                M = np.linalg.solve(Gt, dia)       # Gᵀ Mᵀ = dia
+            except np.linalg.LinAlgError:
+                M = np.einsum("nij,njk->nik", np.linalg.pinv(Gt), dia)
             M = np.swapaxes(M, 1, 2)
+            M[zero_row] = 0.0
             return ScaledResidualSmoother(jnp.asarray(M, dtype=dtype), br)
         rows = np.repeat(np.arange(A.nrows), A.row_nnz())
         denom = np.zeros(A.nrows, dtype=np.float64)

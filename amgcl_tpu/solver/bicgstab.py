@@ -1,0 +1,62 @@
+"""Preconditioned BiCGStab for non-symmetric systems, right-preconditioned
+(the reference defaults to side=right, amgcl/solver/bicgstab.hpp with
+precond_side option). Whole iteration is one ``lax.while_loop``."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+from amgcl_tpu.ops import device as dev
+
+
+@dataclass
+class BiCGStab:
+    maxiter: int = 100
+    tol: float = 1e-8
+    abstol: float = 0.0
+
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        dot = inner_product
+        x = jnp.zeros_like(rhs) if x0 is None else x0
+        r = dev.residual(rhs, A, x)
+        rhat = r
+        norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
+        scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
+        eps = jnp.maximum(self.tol * scale,
+                          jnp.asarray(self.abstol, rhs.dtype).real)
+        one = jnp.ones((), rhs.dtype)
+
+        def cond(st):
+            (x, r, p, v, rho, alpha, omega, it, res) = st
+            return (it < self.maxiter) & (res > eps)
+
+        def body(st):
+            (x, r, p, v, rho, alpha, omega, it, res) = st
+            rho_new = dot(rhat, r)
+            beta = (rho_new / jnp.where(rho == 0, 1, rho)) \
+                * (alpha / jnp.where(omega == 0, 1, omega))
+            p = r + beta * (p - omega * v)
+            phat = precond(p)
+            v = dev.spmv(A, phat)
+            denom = dot(rhat, v)
+            alpha = rho_new / jnp.where(denom == 0, 1, denom)
+            s = r - alpha * v
+            shat = precond(s)
+            t = dev.spmv(A, shat)
+            tt = dot(t, t)
+            omega = dot(t, s) / jnp.where(tt == 0, 1, tt)
+            x = x + alpha * phat + omega * shat
+            r = s - omega * t
+            res = jnp.sqrt(jnp.abs(dot(r, r)))
+            return (x, r, p, v, rho_new, alpha, omega, it + 1, res)
+
+        res0 = jnp.sqrt(jnp.abs(dot(r, r)))
+        st = (x, r, jnp.zeros_like(r), jnp.zeros_like(r),
+              one, one, one, 0, res0)
+        (x, r, p, v, rho, alpha, omega, it, res) = \
+            lax.while_loop(cond, body, st)
+        x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
+        return x, it, res / scale

@@ -1,0 +1,163 @@
+"""Restarted GMRES(m) and flexible FGMRES(m).
+
+Arnoldi with classical Gram-Schmidt (one reorthogonalization pass — CGS2,
+the right choice on TPU where the two passes are two big matmuls instead of
+j sequential dots) and Givens rotations for the least-squares update
+(reference behavior: amgcl/solver/gmres.hpp:72-322,
+amgcl/solver/detail/givens_rotations.hpp; flexible variant
+amgcl/solver/fgmres.hpp). The inner Arnoldi iteration is a
+``lax.while_loop`` whose carry holds the (m+1, n) basis; early exit on
+convergence leaves unwritten columns zero, which the masked triangular solve
+treats as inactive.
+
+GMRES is left-preconditioned (residual measured in the preconditioned norm);
+FGMRES is right-preconditioned with a per-step preconditioner space Z —
+usable with a nonstationary preconditioner.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from amgcl_tpu.ops import device as dev
+
+
+def _givens(a, b):
+    """Complex-safe Givens rotation: c real, s = phase(a)·conj(b)/h, so that
+    [c s; -conj(s) c] @ [a; b] = [phase(a)·h; 0] (LAPACK zrotg convention)."""
+    absa = jnp.abs(a)
+    h = jnp.sqrt(absa ** 2 + jnp.abs(b) ** 2)
+    h = jnp.where(h == 0, 1.0, h)
+    pha = jnp.where(absa == 0, jnp.ones_like(a),
+                    a / jnp.where(absa == 0, 1.0, absa))
+    return (absa / h).astype(a.dtype), pha * jnp.conj(b) / h
+
+
+def _arnoldi_cycle(apply_op, r0, m, eps, dot, collect_z=None):
+    """One restart cycle. apply_op(v) -> (w, z) where z is the direction to
+    accumulate into x (z == v for plain GMRES, z == M v for flexible).
+    Returns (update_dx_fn_inputs): y-coefficients, basis (V or Z), steps, res.
+    """
+    n = r0.shape[0]
+    dtype = r0.dtype
+    beta = jnp.sqrt(jnp.abs(dot(r0, r0)))
+    safe_beta = jnp.where(beta == 0, 1.0, beta)
+    V0 = jnp.zeros((m + 1, n), dtype)
+    V0 = V0.at[0].set(r0 / safe_beta)
+    Z0 = jnp.zeros((m, n), dtype)
+    R0 = jnp.eye(m, dtype=dtype)          # unwritten columns stay identity
+    g0 = jnp.zeros(m + 1, dtype).at[0].set(beta)
+    cs0 = jnp.ones(m, dtype)
+    sn0 = jnp.zeros(m, dtype)
+
+    def cond(st):
+        V, Z, R, g, cs, sn, j, res = st
+        return (j < m) & (res > eps)
+
+    def body(st):
+        V, Z, R, g, cs, sn, j, res = st
+        v = V[j]
+        w, z = apply_op(v)
+        Z = Z.at[j].set(z)
+        # CGS2: h = V w; w -= V^T h; second pass for stability
+        h1 = jnp.conj(V) @ w
+        w = w - V.T @ h1
+        h2 = jnp.conj(V) @ w
+        w = w - V.T @ h2
+        h = h1 + h2
+        hn = jnp.sqrt(jnp.abs(dot(w, w)))
+        V = V.at[j + 1].set(w / jnp.where(hn == 0, 1.0, hn))
+
+        # apply stored rotations k = 0..j-1 to h
+        def rot(k, hv):
+            a = hv[k]
+            b = hv[k + 1]
+            apply = k < j
+            c, s = cs[k], sn[k]
+            ha = jnp.where(apply, c * a + s * b, a)
+            hb = jnp.where(apply, -jnp.conj(s) * a + c * b, b)
+            return hv.at[k].set(ha).at[k + 1].set(hb)
+
+        h = h.at[j + 1].set(hn)
+        h = lax.fori_loop(0, m, rot, h)
+        c, s = _givens(h[j], h[j + 1])
+        cs = cs.at[j].set(c)
+        sn = sn.at[j].set(s)
+        rjj = c * h[j] + s * h[j + 1]
+        h = h.at[j].set(rjj).at[j + 1].set(0.0)
+        gj = g[j]
+        g = g.at[j].set(c * gj).at[j + 1].set(-jnp.conj(s) * gj)
+        # write column j of R (rows 0..j live; keep the identity placeholder
+        # in columns never reached so the masked solve stays nonsingular)
+        col = jnp.where(jnp.arange(m) <= j, h[:m], R[:, j])
+        R = R.at[:, j].set(col)
+        res = jnp.abs(g[j + 1])
+        return (V, Z, R, g, cs, sn, j + 1, res)
+
+    st = (V0, Z0, R0, g0, cs0, sn0, 0, beta)
+    V, Z, R, g, cs, sn, j, res = lax.while_loop(cond, body, st)
+    # masked triangular solve: unwritten columns have R[k,k]=1, g[k]=0
+    y = jax.scipy.linalg.solve_triangular(R, g[:m], lower=False)
+    dx = Z.T @ y
+    return dx, j, res
+
+
+@dataclass
+class GMRES:
+    """Left-preconditioned restarted GMRES(M) (reference default M=30)."""
+    M: int = 30
+    maxiter: int = 100
+    tol: float = 1e-8
+
+    flexible = False
+
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        dot = inner_product
+        x = jnp.zeros_like(rhs) if x0 is None else x0
+
+        if self.flexible:
+            def apply_op(v):
+                z = precond(v)
+                return dev.spmv(A, z), z
+
+            def resid0(x):
+                return dev.residual(rhs, A, x)
+        else:
+            def apply_op(v):
+                w = precond(dev.spmv(A, v))
+                return w, v
+
+            def resid0(x):
+                return precond(dev.residual(rhs, A, x))
+
+        # norm of (preconditioned) rhs for the relative criterion
+        bref = resid0(jnp.zeros_like(rhs))
+        norm_rhs = jnp.sqrt(jnp.abs(dot(bref, bref)))
+        scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
+        eps = self.tol * scale
+
+        def cond(st):
+            x, it, res = st
+            return (it < self.maxiter) & (res > eps)
+
+        def body(st):
+            x, it, res = st
+            r = resid0(x)
+            dx, steps, res = _arnoldi_cycle(apply_op, r, self.M, eps, dot)
+            return (x + dx, it + steps, res)
+
+        r0 = resid0(x)
+        st = (x, 0, jnp.sqrt(jnp.abs(dot(r0, r0))))
+        x, it, res = lax.while_loop(cond, body, st)
+        return x, it, res / scale
+
+
+@dataclass
+class FGMRES(GMRES):
+    """Flexible (right-preconditioned) GMRES — the preconditioner may change
+    between iterations (reference: amgcl/solver/fgmres.hpp)."""
+    flexible = True

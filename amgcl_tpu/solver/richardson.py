@@ -1,0 +1,41 @@
+"""Damped Richardson iteration: x += ω M(f − A x)
+(reference: amgcl/solver/richardson.hpp, default damping 1.0)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+from amgcl_tpu.ops import device as dev
+
+
+@dataclass
+class Richardson:
+    maxiter: int = 100
+    tol: float = 1e-8
+    damping: float = 1.0
+
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        dot = inner_product
+        x = jnp.zeros_like(rhs) if x0 is None else x0
+        norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
+        scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
+        eps = self.tol * scale
+
+        def cond(st):
+            x, it, res = st
+            return (it < self.maxiter) & (res > eps)
+
+        def body(st):
+            x, it, _ = st
+            r = dev.residual(rhs, A, x)
+            x = x + self.damping * precond(r)
+            res = jnp.sqrt(jnp.abs(dot(r, r)))
+            return (x, it + 1, res)
+
+        r0 = dev.residual(rhs, A, x)
+        st = (x, 0, jnp.sqrt(jnp.abs(dot(r0, r0))))
+        x, it, res = lax.while_loop(cond, body, st)
+        return x, it, res / scale

@@ -1,0 +1,71 @@
+"""Headline benchmark: 3D Poisson 128^3 (2,097,152 unknowns, ~14.6M nnz),
+smoothed aggregation + CG + spai0 — the reference's shared-memory benchmark
+configuration (docs/benchmarks.rst:60-79, BASELINE.json configs[0]).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
+
+Baseline: the reference's CUDA backend on a Tesla K80 solves the 150^3
+problem in 0.55 s (BASELINE.md; docs/smem_data/poisson/amgcl-cuda.txt:1).
+Scaled to 128^3 by problem size that is 0.55*(128/150)^3 = 0.342 s, the
+number a single TPU chip must beat. vs_baseline = baseline_time / our_time
+(>1 means faster than the K80 reference).
+"""
+
+import json
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+
+    n = 128
+    t0 = time.perf_counter()
+    A, rhs = poisson3d(n)
+    t_gen = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    solver = make_solver(A, AMGParams(dtype=jnp.float32),
+                         CG(maxiter=100, tol=1e-6))
+    t_setup = time.perf_counter() - t0
+
+    rhs_dev = jnp.asarray(rhs, dtype=jnp.float32)
+
+    # warmup/compile
+    x, info = solver(rhs_dev)
+    jax.block_until_ready(x)
+
+    times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        x, info = solver(rhs_dev)
+        jax.block_until_ready(x)
+        times.append(time.perf_counter() - t0)
+    t_solve = float(np.median(times))
+
+    true_res = float(np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64)))
+                     / np.linalg.norm(rhs))
+
+    baseline = 0.55 * (n / 150.0) ** 3   # K80 CUDA solve, size-scaled
+    print(json.dumps({
+        "metric": "poisson3d_128_sa_cg_spai0_solve_time",
+        "value": round(t_solve, 4),
+        "unit": "s",
+        "vs_baseline": round(baseline / t_solve, 3),
+        "iters": int(info.iters),
+        "resid": float(info.resid),
+        "true_resid": true_res,
+        "setup_s": round(t_setup, 3),
+        "gen_s": round(t_gen, 3),
+        "device": str(jax.devices()[0]),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,57 @@
+"""Distributed layer on the 8-virtual-device CPU mesh (SURVEY.md §4 lesson:
+multi-chip behavior is tested in CI, unlike the reference's untested MPI)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.utils.sample_problem import poisson3d
+from amgcl_tpu.parallel.mesh import make_mesh
+from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+from amgcl_tpu.parallel.dist_solver import dist_cg
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    assert len(jax.devices()) >= 8, "conftest must force 8 CPU devices"
+    return make_mesh(8)
+
+
+def test_dist_spmv_matches_host(mesh8):
+    A, _ = poisson3d(16)  # 4096 rows, divides 8
+    M = DistDiaMatrix.from_csr(A, mesh8, jnp.float64)
+    x = np.random.RandomState(0).rand(A.nrows)
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax import shard_map
+    fn = shard_map(M.shard_mv, mesh=mesh8,
+                   in_specs=(P(None, "rows"), P("rows")),
+                   out_specs=P("rows"), check_vma=False)
+    y = jax.jit(fn)(M.data, jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh8, P("rows"))))
+    assert np.allclose(np.asarray(y), A.spmv(x))
+
+
+def test_dist_cg_solves_poisson(mesh8):
+    A, rhs = poisson3d(16)
+    M = DistDiaMatrix.from_csr(A, mesh8, jnp.float64)
+    dinv = jnp.asarray(A.diagonal(invert=True))
+    x, iters, resid = dist_cg(M, mesh8, jnp.asarray(rhs), dinv=dinv,
+                              maxiter=500, tol=1e-8)
+    assert resid < 1e-8
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_dist_cg_matches_serial_iteration_count(mesh8):
+    """Sharding must not change the math: same iters as a 1-device mesh."""
+    A, rhs = poisson3d(8)
+    dinv = jnp.asarray(A.diagonal(invert=True))
+    M8 = DistDiaMatrix.from_csr(A, mesh8, jnp.float64)
+    _, it8, _ = dist_cg(M8, mesh8, jnp.asarray(rhs), dinv=dinv, tol=1e-8,
+                        maxiter=500)
+    mesh1 = make_mesh(1)
+    M1 = DistDiaMatrix.from_csr(A, mesh1, jnp.float64)
+    _, it1, _ = dist_cg(M1, mesh1, jnp.asarray(rhs), dinv=dinv, tol=1e-8,
+                        maxiter=500)
+    assert it8 == it1

@@ -1,0 +1,61 @@
+"""Smoother suite tests: each smoother inside the AMG-CG sweep + standalone
+(as-preconditioner-style) behavior."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.solver.bicgstab import BiCGStab
+from amgcl_tpu.relaxation.jacobi import DampedJacobi
+from amgcl_tpu.relaxation.spai0 import Spai0
+from amgcl_tpu.relaxation.chebyshev import Chebyshev
+from amgcl_tpu.relaxation.ilu0 import ILU0
+from amgcl_tpu.utils.sample_problem import poisson3d, convection_diffusion_2d
+from amgcl_tpu.ops import device as dev
+
+
+@pytest.mark.parametrize("relax", [
+    DampedJacobi(), Spai0(), Chebyshev(), ILU0(),
+])
+def test_amg_cg_with_each_smoother(relax):
+    A, rhs = poisson3d(16)
+    solve = make_solver(
+        A, AMGParams(relax=relax, dtype=jnp.float64, coarse_enough=500),
+        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8, type(relax).__name__
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_chebyshev_damps_rough_error():
+    """A smoother's job: strongly damp rough (random) error components."""
+    A, _ = poisson3d(12)
+    st = Chebyshev(degree=5).build(A, jnp.float64)
+    Ad = dev.to_device(A, "auto", jnp.float64)
+    e = np.random.RandomState(0).rand(A.nrows) - 0.5
+    r = A.spmv(e)
+    z = st.apply(Ad, jnp.asarray(r))
+    assert np.linalg.norm(e - np.asarray(z)) < 0.35 * np.linalg.norm(e)
+
+
+def test_ilu0_damps_rough_error():
+    A, _ = poisson3d(8)
+    st = ILU0(sweeps=8, jacobi_iters=4).build(A, jnp.float64)
+    Ad = dev.to_device(A, "auto", jnp.float64)
+    e = np.random.RandomState(1).rand(A.nrows) - 0.5
+    r = A.spmv(e)
+    z = st.apply(Ad, jnp.asarray(r))
+    assert np.linalg.norm(e - np.asarray(z)) < 0.5 * np.linalg.norm(e)
+
+
+def test_ilu0_bicgstab_convection():
+    A, rhs = convection_diffusion_2d(24, eps=0.05)
+    solve = make_solver(
+        A, AMGParams(relax=ILU0(), dtype=jnp.float64),
+        BiCGStab(maxiter=200, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
