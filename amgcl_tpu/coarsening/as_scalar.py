@@ -1,0 +1,38 @@
+"""``as_scalar<Base>``: build transfer operators on the unblocked (scalar)
+copy of a block matrix, then view them back as block operators — lets any
+scalar-only coarsening drive a block-valued solve phase (reference:
+amgcl/coarsening/as_scalar.hpp:46-119, paired with backend builtin_hybrid).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
+
+
+@dataclass
+class AsScalar:
+    base: Any = field(default_factory=SmoothedAggregation)
+
+    def transfer_operators(self, A: CSR):
+        bs = A.block_size[0] if A.is_block else 1
+        scalar = A.unblock() if A.is_block else A
+        if bs > 1 and hasattr(self.base, "block_size"):
+            # group whole block-nodes so the scalar coarse space tiles back
+            # into bs×bs blocks (pointwise aggregation over block nodes)
+            self.base.block_size = bs
+        P, R = self.base.transfer_operators(scalar)
+        if bs > 1:
+            if P.ncols % bs:
+                raise ValueError(
+                    "scalar coarse space (%d cols) does not tile into %dx%d "
+                    "blocks" % (P.ncols, bs, bs))
+            P = P.to_block(bs)
+            R = R.to_block(bs)
+        return P, R
+
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+        return self.base.coarse_operator(A, P, R)

@@ -1,0 +1,144 @@
+"""Classic (Ruge-Stüben) coarsening: C/F splitting + direct interpolation.
+
+The reference implements the sequential RS pass with dynamic measures
+(amgcl/coarsening/ruge_stuben.hpp:53-446, defaults eps_strong=0.25,
+do_trunc=true, eps_trunc=0.2). The TPU/host formulation here uses the PMIS
+C/F splitting (De Sterck & Yang's parallel modified independent set — the
+same deterministic-priority MIS machinery as the aggregation path), followed
+by the standard direct interpolation with sign-split scaling and truncation.
+Scalar values only, like the reference (ruge_stuben.hpp:445 static-asserts
+non-block values).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import scipy.sparse as sp
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.coarsening.aggregates import _priority
+
+
+def _strength_rs(A: CSR, eps: float):
+    """Directed RS strength: i strongly depends on j when
+    -a_ij >= eps * max_k(-a_ik); returns boolean mask per entry."""
+    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    off = rows != A.col
+    neg = np.where(off, -A.val.real, 0.0)
+    rowmax = np.zeros(A.nrows)
+    np.maximum.at(rowmax, rows, neg)
+    strong = off & (neg >= eps * np.where(rowmax > 0, rowmax, np.inf)[rows])
+    return strong, rows
+
+
+def cf_splitting_pmis(A: CSR, strong: np.ndarray, rows: np.ndarray):
+    """PMIS C/F split over the symmetrized strength graph. Returns bool
+    is_coarse. F points with no strong C neighbor are promoted to C."""
+    n = A.nrows
+    # NB: copy col/ptr — scipy mutates them in place (eliminate_zeros)
+    Ssym = sp.csr_matrix(
+        (strong.astype(np.float64), A.col.copy(), A.ptr.copy()),
+        shape=(n, n))
+    Ssym.eliminate_zeros()
+    Ssym = ((Ssym + Ssym.T) > 0).astype(np.float64)
+    # measure: number of points that strongly depend on i (column count of
+    # the directed strength graph) + deterministic jitter
+    Sdir = sp.csr_matrix(
+        (strong.astype(np.float64), A.col.copy(), A.ptr.copy()),
+        shape=(n, n))
+    lam = np.asarray(Sdir.sum(axis=0)).ravel()
+    prio = lam * n + _priority(n)          # unique measures
+
+    state = np.zeros(n, dtype=np.int8)     # 0 undecided, 1 C, 2 F
+    isolated = np.asarray(Ssym.sum(axis=1)).ravel() == 0
+    state[isolated] = 1                    # isolated rows become coarse
+    for _ in range(1000):
+        und = state == 0
+        if not und.any():
+            break
+        p_und = np.where(und, prio, 0.0)
+        nbr_max = Ssym.multiply(p_und[None, :]).max(axis=1).toarray().ravel()
+        new_c = und & (prio > nbr_max)
+        state[new_c] = 1
+        nbr_c = np.asarray(
+            Ssym @ (state == 1).astype(np.float64)).ravel() > 0
+        state[(state == 0) & nbr_c] = 2
+    # every F point must interpolate from at least one strong C neighbor
+    is_c = state == 1
+    c_nbr = np.zeros(n, dtype=bool)
+    np.logical_or.at(c_nbr, rows[strong & is_c[A.col]], True)
+    orphan = (state == 2) & ~c_nbr
+    is_c |= orphan
+    return is_c
+
+
+@dataclass
+class RugeStuben:
+    eps_strong: float = 0.25
+    do_trunc: bool = True
+    eps_trunc: float = 0.2
+
+    def transfer_operators(self, A: CSR):
+        if A.is_block:
+            raise NotImplementedError(
+                "ruge_stuben supports scalar value types only (as in the "
+                "reference, ruge_stuben.hpp:445)")
+        n = A.nrows
+        strong, rows = _strength_rs(A, self.eps_strong)
+        is_c = cf_splitting_pmis(A, strong, rows)
+        cidx = np.cumsum(is_c) - 1          # C-point -> coarse index
+        nc = int(is_c.sum())
+        if nc == 0:
+            raise ValueError("empty coarse level in RS splitting")
+
+        dia = A.diagonal()
+        # direct interpolation with sign split:
+        # w_ij = -(a_ij/a_ii) * (sum_N a^∓) / (sum_C a^∓)
+        scn = strong & is_c[A.col]          # strong C-neighbor entries
+        val = A.val.real
+        neg = np.where(rows != A.col, np.minimum(val, 0.0), 0.0)
+        pos = np.where(rows != A.col, np.maximum(val, 0.0), 0.0)
+
+        def rowsum(v, mask):
+            out = np.zeros(n)
+            np.add.at(out, rows[mask], v[mask])
+            return out
+
+        sum_all_neg = rowsum(neg, np.ones_like(strong))
+        sum_all_pos = rowsum(pos, np.ones_like(strong))
+        sum_c_neg = rowsum(neg, scn)
+        sum_c_pos = rowsum(pos, scn)
+        alpha = sum_all_neg / np.where(sum_c_neg != 0, sum_c_neg, 1.0)
+        beta = sum_all_pos / np.where(sum_c_pos != 0, sum_c_pos, 1.0)
+
+        w = np.where(val < 0, alpha[rows], beta[rows]) * \
+            (-val / np.where(dia[rows] != 0, dia[rows], 1.0))
+        keep = scn.copy()
+
+        if self.do_trunc:
+            absw = np.where(keep, np.abs(w), 0.0)
+            wmax = np.zeros(n)
+            np.maximum.at(wmax, rows, absw)
+            trunc = keep & (absw < self.eps_trunc * wmax[rows])
+            keep &= ~trunc
+            # rescale kept weights to preserve the row sums
+            tot = np.zeros(n)
+            np.add.at(tot, rows, np.where(scn, w, 0.0))
+            kept = np.zeros(n)
+            np.add.at(kept, rows, np.where(keep, w, 0.0))
+            w = w * (tot / np.where(kept != 0, kept, 1.0))[rows]
+
+        prow = np.concatenate([np.flatnonzero(is_c), rows[keep & ~is_c[rows]]])
+        pcol = np.concatenate([cidx[is_c], cidx[A.col[keep & ~is_c[rows]]]])
+        pval = np.concatenate([np.ones(nc), w[keep & ~is_c[rows]]])
+        P = sp.csr_matrix((pval, (prow, pcol)), shape=(n, nc))
+        P.sum_duplicates()
+        P.sort_indices()
+        Pc = CSR.from_scipy(P)
+        return Pc, Pc.transpose()
+
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+        from amgcl_tpu.coarsening.galerkin import galerkin
+        return galerkin(A, P, R)

@@ -25,6 +25,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops.device import csr_to_dia
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
 
 
@@ -61,22 +62,16 @@ class DistDiaMatrix:
         n = A.nrows
         nd = mesh.shape[ROWS_AXIS]
         assert n % nd == 0, "rows must divide the mesh for round-1 DIA"
-        rows_chk = np.repeat(np.arange(n), A.row_nnz())
-        w = int(np.abs(A.col.astype(np.int64) - rows_chk).max()) if A.nnz else 0
-        if w > n // nd:
+        dia = csr_to_dia(A, dtype)      # single source of the DIA packing
+        out = cls(dia.offsets, dia.data, A.shape)
+        if out.halo > n // nd:
             raise ValueError(
                 "halo width %d exceeds the shard size %d — the ring "
                 "exchange only reaches immediate neighbors; use fewer "
-                "devices or a narrower band" % (w, n // nd))
-        rows = np.repeat(np.arange(n), A.row_nnz())
-        d = A.col.astype(np.int64) - rows
-        offsets = np.unique(d)
-        data = np.zeros((len(offsets), n), dtype=A.val.dtype)
-        data[np.searchsorted(offsets, d), rows] = A.val
+                "devices or a narrower band" % (out.halo, n // nd))
         sharding = NamedSharding(mesh, P(None, ROWS_AXIS))
-        return cls(offsets.tolist(),
-                   jax.device_put(jnp.asarray(data, dtype=dtype), sharding),
-                   A.shape)
+        out.data = jax.device_put(out.data, sharding)
+        return out
 
     # -- the per-shard kernel (runs inside shard_map) -----------------------
 

@@ -1,11 +1,11 @@
-"""ILU(0) smoother, TPU-style.
+"""ILU(0) / ILU(p) smoothers, TPU-style.
 
 Construction: Chow–Patel fine-grained fixed-point sweeps (reference:
 amgcl/relaxation/ilu0_chow_patel.hpp:86-593, defaults sweeps=5). Instead of
 the reference's per-entry parallel loops, each sweep here is one restricted
-SpGEMM: (L·U) evaluated on A's sparsity pattern gives every entry's inner
-sum at once, then all L/U entries update simultaneously — the same
-fixed-point, expressed as matrix algebra (vectorized on host; the sweeps are
+SpGEMM: (L·U) evaluated on the factor pattern gives every entry's inner sum
+at once, then all L/U entries update simultaneously — the same fixed point,
+expressed as matrix algebra (vectorized on host; the sweeps are
 embarrassingly parallel by design, Chow & Patel 2015).
 
 Application: the triangular solves are replaced by a fixed number of Jacobi
@@ -62,6 +62,59 @@ class ILU0State:
     apply_post = apply_pre
 
 
+def _chow_patel_build(ptr, col, val, n, sweeps, jacobi_iters, dtype):
+    """Fixed-point ILU on the pattern given by (ptr, col); ``val`` holds A's
+    values on that pattern (structural fill-ins are zero). The per-sweep
+    inner sums come from one SpGEMM; the values are re-aligned to the factor
+    pattern by key-based gathers, which is robust to scipy pruning
+    exact-zero entries from products and sums."""
+    from amgcl_tpu.relaxation.spai1 import gather_sparse_entries
+
+    rows = np.repeat(np.arange(n), np.diff(ptr))
+    cols = col
+    lower = rows > cols
+    upper = ~lower                      # includes the diagonal
+    a = val.astype(np.float64)
+
+    dia = np.zeros(n)
+    dmask = rows == cols
+    dia[rows[dmask]] = a[dmask]
+    dia = np.where(dia != 0, dia, 1.0)
+    # Chow-Patel init: U = upper(A); L = lower(A) scaled by U's diagonal
+    uval = np.where(upper, a, 0.0)
+    lval = np.where(lower, a / dia[cols], 0.0)
+
+    for _ in range(sweeps):
+        L = sp.csr_matrix((lval, cols.copy(), ptr.copy()), shape=(n, n))
+        L = L + sp.identity(n)
+        U = sp.csr_matrix((uval, cols.copy(), ptr.copy()), shape=(n, n))
+        LU = (L @ U).tocsr()
+        lu_on_a = gather_sparse_entries(LU, rows, cols)
+        udia = np.zeros(n)
+        udia[cols[dmask]] = uval[dmask]
+        udia = np.where(udia != 0, udia, 1.0)
+        # i>j: l_ij = (a_ij - [(LU)_ij - l_ij*u_jj]) / u_jj
+        new_l = (a - (lu_on_a - lval * udia[cols])) / udia[cols]
+        # i<=j: u_ij = a_ij - [(LU)_ij - u_ij]   (unit L diagonal)
+        new_u = a - (lu_on_a - uval)
+        lval = np.where(lower, new_l, 0.0)
+        uval = np.where(upper, new_u, 0.0)
+
+    udia = np.zeros(n)
+    udia[cols[dmask]] = uval[dmask]
+    udia = np.where(udia != 0, udia, 1.0)
+
+    base = CSR(ptr, cols, np.zeros_like(a), n)
+    Lmat = CSR(base.ptr, base.col, lval, n).filter_rows(lower)
+    strict_u = upper & ~dmask
+    Umat = CSR(base.ptr, base.col, uval, n).filter_rows(strict_u)
+    return ILU0State(
+        dev.to_device(Lmat, "auto", dtype),
+        dev.to_device(Umat, "auto", dtype),
+        jnp.asarray(1.0 / udia, dtype=dtype),
+        jacobi_iters)
+
+
 @dataclass
 class ILU0:
     sweeps: int = 5          # Chow-Patel construction sweeps
@@ -71,53 +124,36 @@ class ILU0:
         S = A.unblock() if A.is_block else A
         m = S.to_scipy().astype(np.float64)
         m.sort_indices()
-        n = m.shape[0]
-        rows = np.repeat(np.arange(n), np.diff(m.indptr))
-        cols = m.indices
-        lower = rows > cols
-        upper = ~lower                      # includes the diagonal
-        a = m.data
+        return _chow_patel_build(m.indptr, m.indices, m.data, m.shape[0],
+                                 self.sweeps, self.jacobi_iters, dtype)
 
-        dia = np.asarray(m.diagonal())
-        dia = np.where(dia != 0, dia, 1.0)
-        # Chow-Patel init: U = upper(A); L = lower(A) scaled by U's diagonal
-        uval = np.where(upper, a, 0.0)
-        lval = np.where(lower, a / dia[cols], 0.0)
 
-        pattern = sp.csr_matrix((np.ones_like(a), cols, m.indptr), shape=m.shape)
-        for _ in range(self.sweeps):
-            L = sp.csr_matrix((lval, cols, m.indptr), shape=m.shape)
-            L = L + sp.identity(n)
-            U = sp.csr_matrix((uval, cols, m.indptr), shape=m.shape)
-            LU = (L @ U).multiply(pattern).tocsr()
-            # align LU's values with A's pattern: adding a zero matrix that
-            # carries A's full pattern yields the union pattern (== A's,
-            # since LU ⊆ A after the restriction) in canonical order
-            aligned = (sp.csr_matrix((np.zeros_like(a), cols, m.indptr),
-                                     shape=m.shape) + LU).tocsr()
-            aligned.sort_indices()
-            lu_on_a = aligned.data
-            udia = np.zeros(n)
-            du = uval[rows == cols]
-            udia[cols[rows == cols]] = du
-            udia = np.where(udia != 0, udia, 1.0)
-            # i>j: l_ij = (a_ij - [(LU)_ij - l_ij*u_jj]) / u_jj
-            new_l = (a - (lu_on_a - lval * udia[cols])) / udia[cols]
-            # i<=j: u_ij = a_ij - [(LU)_ij - u_ij]   (unit L diagonal)
-            new_u = a - (lu_on_a - uval)
-            lval = np.where(lower, new_l, 0.0)
-            uval = np.where(upper, new_u, 0.0)
+@dataclass
+class ILUP:
+    """ILU over the sparsity of A^(p+1): the fill pattern is widened to the
+    p-th power of A's connectivity and the same Chow-Patel fixed point runs
+    on it, with the fill-in entries entering as structural zeros (reference:
+    amgcl/relaxation/ilup.hpp)."""
+    p: int = 1
+    sweeps: int = 8
+    jacobi_iters: int = 2
 
-        udia = np.zeros(n)
-        udia[cols[rows == cols]] = uval[rows == cols]
-        udia = np.where(udia != 0, udia, 1.0)
-
-        base = CSR(m.indptr, cols, np.zeros_like(a), n)
-        Lmat = CSR(base.ptr, base.col, lval, n).filter_rows(lower)
-        strict_u = upper & (rows != cols)
-        Umat = CSR(base.ptr, base.col, uval, n).filter_rows(strict_u)
-        return ILU0State(
-            dev.to_device(Lmat, "auto", dtype),
-            dev.to_device(Umat, "auto", dtype),
-            jnp.asarray(1.0 / udia, dtype=dtype),
-            self.jacobi_iters)
+    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+        from amgcl_tpu.relaxation.spai1 import gather_sparse_entries
+        S = A.unblock() if A.is_block else A
+        m = S.to_scipy().astype(np.float64)
+        m.sort_indices()
+        # int64 path counts: immune to the int8 overflow that would silently
+        # drop entries with >=128 distance-p paths
+        pat = (m != 0).astype(np.int64)
+        pat.setdiag(1)
+        widen = pat
+        for _ in range(self.p):
+            widen = ((widen @ pat) > 0).astype(np.int64)
+        widen = widen.tocsr()
+        widen.sort_indices()
+        wrows = np.repeat(np.arange(m.shape[0]), np.diff(widen.indptr))
+        wvals = gather_sparse_entries(m, wrows, widen.indices)
+        return _chow_patel_build(widen.indptr, widen.indices, wvals,
+                                 m.shape[0], self.sweeps, self.jacobi_iters,
+                                 dtype)

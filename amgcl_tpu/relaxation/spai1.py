@@ -1,0 +1,109 @@
+"""SPAI-1: sparse approximate inverse with the sparsity pattern of A
+(reference: amgcl/relaxation/spai1.hpp:54).
+
+Row-wise least squares: for row i with pattern J_i, minimize
+``|| e_i - m_i A[J_i, :] ||``, whose normal equations are
+``(A Aᵀ)[J_i, J_i] · m_iᵀ = Aᵀ[J_i, i]``. Instead of the reference's per-row
+QR loop, all rows are solved at once: the Gram matrix B = A·Aᵀ is formed
+once, per-row blocks are gathered into a padded (n, K, K) batch, and one
+batched solve produces every m_i — the TPU-style formulation of the same
+least-squares problem.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+
+
+def gather_sparse_entries(m: sp.csr_matrix, rows: np.ndarray,
+                          cols: np.ndarray) -> np.ndarray:
+    """Vectorized lookup m[rows[k], cols[k]] (0 where absent).
+
+    A sorted CSR is globally ordered by the key row*ncols + col, so a single
+    searchsorted over that key answers every query at once."""
+    m = m.tocsr()
+    m.sort_indices()
+    ncols = m.shape[1]
+    m_rows = np.repeat(np.arange(m.shape[0], dtype=np.int64),
+                       np.diff(m.indptr))
+    key_m = m_rows * ncols + m.indices
+    key_q = rows.astype(np.int64) * ncols + cols.astype(np.int64)
+    pos = np.searchsorted(key_m, key_q)
+    pos_c = np.minimum(pos, max(len(key_m) - 1, 0))
+    valid = (pos < len(key_m)) & (key_m[pos_c] == key_q) if len(key_m) \
+        else np.zeros(len(rows), bool)
+    return np.where(valid, m.data[pos_c], 0.0)
+
+
+@register_pytree_node_class
+class Spai1State:
+    """M with A's pattern, stored as a device sparse matrix."""
+
+    def __init__(self, M):
+        self.M = M
+
+    def tree_flatten(self):
+        return (self.M,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def apply(self, A, f):
+        return dev.spmv(self.M, f)
+
+    def apply_pre(self, A, f, x):
+        return x + dev.spmv(self.M, f - dev.spmv(A, x))
+
+    apply_post = apply_pre
+
+
+@dataclass
+class Spai1:
+    def build(self, A: CSR, dtype=jnp.float32) -> Spai1State:
+        S = A.unblock() if A.is_block else A
+        m = S.to_scipy().astype(np.float64)
+        m.sort_indices()
+        n = m.shape[0]
+        nnz_row = np.diff(m.indptr)
+        K = int(nnz_row.max())
+        rows = np.repeat(np.arange(n), nnz_row)
+        pos = np.arange(m.nnz) - m.indptr[rows]
+
+        # padded pattern: J[i, k] = k-th column of row i (pad = i itself,
+        # masked out of the solve)
+        J = np.tile(np.arange(n)[:, None], (1, K))
+        valid = np.zeros((n, K), dtype=bool)
+        J[rows, pos] = m.indices
+        valid[rows, pos] = True
+
+        B = (m @ m.T).tocsr()
+        # gather G[i] = B[J_i, J_i] into (n, K, K)
+        qi = np.repeat(J, K, axis=1).ravel()          # row index of queries
+        qj = np.tile(J, (1, K)).ravel()
+        G = gather_sparse_entries(B, qi, qj).reshape(n, K, K)
+        # rhs: c[i, k] = A[J_ik, i]  (= Aᵀ entries)
+        # rhs entries A[J_ik, i] = Aᵀ[i, J_ik]
+        At = m.T.tocsr()
+        c = gather_sparse_entries(
+            At, np.repeat(np.arange(n), K), J.ravel()).reshape(n, K)
+        # mask padded slots: identity row/col with zero rhs
+        pad = ~valid
+        eye = np.eye(K)[None, :, :]
+        G = np.where(pad[:, :, None] | pad[:, None, :], eye, G)
+        c = np.where(pad, 0.0, c)
+        # diagonal ridge for safety on degenerate rows
+        G = G + 1e-12 * eye
+        mvals = np.linalg.solve(G, c[..., None])[..., 0]   # (n, K)
+
+        Mcsr = CSR(m.indptr.copy(), m.indices.copy(),
+                   mvals[rows, pos], n)
+        return Spai1State(dev.to_device(Mcsr, "auto", dtype))

@@ -37,11 +37,14 @@ def _givens(a, b):
     return (absa / h).astype(a.dtype), pha * jnp.conj(b) / h
 
 
-def _arnoldi_cycle(apply_op, r0, m, eps, dot, collect_z=None):
+def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None):
     """One restart cycle. apply_op(v) -> (w, z) where z is the direction to
     accumulate into x (z == v for plain GMRES, z == M v for flexible).
-    Returns (update_dx_fn_inputs): y-coefficients, basis (V or Z), steps, res.
-    """
+
+    ``direction(j, V)`` optionally overrides the expansion direction at step
+    j (LGMRES passes its stored corrections for the augmented tail);
+    ``n_steps`` (traced or static) caps the cycle below m.
+    Returns (dx, steps, res)."""
     n = r0.shape[0]
     dtype = r0.dtype
     beta = jnp.sqrt(jnp.abs(dot(r0, r0)))
@@ -53,14 +56,15 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, collect_z=None):
     g0 = jnp.zeros(m + 1, dtype).at[0].set(beta)
     cs0 = jnp.ones(m, dtype)
     sn0 = jnp.zeros(m, dtype)
+    cap = m if n_steps is None else n_steps
 
     def cond(st):
         V, Z, R, g, cs, sn, j, res = st
-        return (j < m) & (res > eps)
+        return (j < cap) & (res > eps)
 
     def body(st):
         V, Z, R, g, cs, sn, j, res = st
-        v = V[j]
+        v = V[j] if direction is None else direction(j, V)
         w, z = apply_op(v)
         Z = Z.at[j].set(z)
         # CGS2: h = V w; w -= V^T h; second pass for stability

@@ -1,0 +1,92 @@
+"""IDR(s): induced dimension reduction with biorthogonalization
+(van Gijzen & Sonneveld 2011 prototype; reference: amgcl/solver/idrs.hpp,
+default s=4, deterministic shadow space).
+
+The shadow space P is a fixed pseudo-random (s, n) block seeded
+deterministically (the reference seeds per-rank the same way); s is static,
+so the inner k-loop unrolls with masked slices instead of dynamic shapes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax.numpy as jnp
+from jax import lax
+
+from amgcl_tpu.ops import device as dev
+
+
+@dataclass
+class IDRs:
+    s: int = 4
+    maxiter: int = 100
+    tol: float = 1e-8
+    replacement: bool = False   # interface parity; smoothing not needed here
+
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        dot = inner_product
+        s = self.s
+        n = rhs.shape[0]
+        dtype = rhs.dtype
+        x = jnp.zeros_like(rhs) if x0 is None else x0
+
+        rng = np.random.RandomState(4321)
+        Pm = rng.randn(s, n)
+        # orthonormalize the shadow block on the host
+        Pm, _ = np.linalg.qr(Pm.T)
+        P = jnp.asarray(Pm.T, dtype=dtype)
+
+        norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
+        scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
+        eps = self.tol * scale
+
+        r0 = dev.residual(rhs, A, x)
+
+        def cond(st):
+            x, r, G, U, M, om, it, res = st
+            return (it < self.maxiter) & (res > eps)
+
+        def body(st):
+            x, r, G, U, M, om, it, res = st
+            f = jnp.conj(P) @ r                       # (s,)
+            for k in range(s):
+                # solve the lower-right (s-k) system M[k:,k:] c = f[k:],
+                # done as a masked full solve: rows/cols < k act as identity
+                mask = jnp.arange(s) >= k
+                Mk = jnp.where(mask[:, None] & mask[None, :], M,
+                               jnp.eye(s, dtype=dtype))
+                fk = jnp.where(mask, f, 0.0)
+                c = jnp.linalg.solve(Mk, fk)          # zeros for i<k
+                v = r - jnp.tensordot(c, G, axes=1)
+                v = precond(v)
+                u = om * v + jnp.tensordot(c, U, axes=1)
+                g = dev.spmv(A, u)
+                # biorthogonalize against P[0..k-1]
+                for i in range(k):
+                    al = (jnp.conj(P[i]) @ g) / M[i, i]
+                    g = g - al * G[i]
+                    u = u - al * U[i]
+                G = G.at[k].set(g)
+                U = U.at[k].set(u)
+                M = M.at[:, k].set(jnp.conj(P) @ g)
+                beta = f[k] / jnp.where(M[k, k] == 0, 1.0, M[k, k])
+                r = r - beta * G[k]
+                x = x + beta * U[k]
+                f = f - beta * M[:, k]
+            # dimension-reduction step into the next Sonneveld space
+            v = precond(r)
+            t = dev.spmv(A, v)
+            tt = dot(t, t)
+            om = dot(t, r) / jnp.where(tt == 0, 1.0, tt)
+            x = x + om * v
+            r = r - om * t
+            res = jnp.sqrt(jnp.abs(dot(r, r)))
+            return (x, r, G, U, M, om, it + s + 1, res)
+
+        st = (x, r0, jnp.zeros((s, n), dtype), jnp.zeros((s, n), dtype),
+              jnp.eye(s, dtype=dtype), jnp.ones((), dtype), 0,
+              jnp.sqrt(jnp.abs(dot(r0, r0))))
+        x, r, G, U, M, om, it, res = lax.while_loop(cond, body, st)
+        return x, it, res / scale

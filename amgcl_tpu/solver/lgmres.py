@@ -1,0 +1,73 @@
+"""LGMRES: restarted GMRES augmented with error-correction directions from
+previous restart cycles, which damps the restart stalling of plain GMRES(m)
+(reference: amgcl/solver/lgmres.hpp, defaults M=30, K=3).
+
+Reuses the Arnoldi/Givens cycle from :mod:`gmres`: the first ``M-K``
+expansion directions are the Krylov basis vectors, the last ``K`` are the
+stored outer corrections (the ``direction`` hook); the accumulated Z
+directions always hold whatever each step expanded with, so the LS update
+applies uniformly.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+from jax import lax
+
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.solver.gmres import _arnoldi_cycle
+
+
+@dataclass
+class LGMRES:
+    M: int = 30
+    K: int = 3
+    maxiter: int = 100
+    tol: float = 1e-8
+
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        dot = inner_product
+        m, K = self.M, self.K
+        mk = max(m - K, 1)
+        n = rhs.shape[0]
+        dtype = rhs.dtype
+        x = jnp.zeros_like(rhs) if x0 is None else x0
+
+        def apply_op(v):
+            return precond(dev.spmv(A, v)), v
+
+        def presid(x):
+            return precond(dev.residual(rhs, A, x))
+
+        bref = presid(jnp.zeros_like(rhs))
+        norm_rhs = jnp.sqrt(jnp.abs(dot(bref, bref)))
+        scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
+        eps = self.tol * scale
+
+        def outer_cond(st):
+            x, aug, n_aug, it, res = st
+            return (it < self.maxiter) & (res > eps)
+
+        def outer_body(st):
+            x, aug, n_aug, it, res = st
+            r = presid(x)
+
+            def direction(j, V):
+                return jnp.where(j < mk, V[jnp.minimum(j, mk - 1)],
+                                 aug[jnp.clip(j - mk, 0, K - 1)])
+
+            dx, steps, res = _arnoldi_cycle(
+                apply_op, r, m, eps, dot, direction=direction,
+                n_steps=mk + jnp.minimum(n_aug, K))
+            nrm = jnp.sqrt(jnp.abs(dot(dx, dx)))
+            aug = jnp.roll(aug, 1, axis=0).at[0].set(
+                dx / jnp.where(nrm == 0, 1.0, nrm))
+            return (x + dx, aug, jnp.minimum(n_aug + 1, K), it + steps, res)
+
+        r0 = presid(x)
+        st = (x, jnp.zeros((K, n), dtype), 0, 0,
+              jnp.sqrt(jnp.abs(dot(r0, r0))))
+        x, aug, n_aug, it, res = lax.while_loop(outer_cond, outer_body, st)
+        return x, it, res / scale

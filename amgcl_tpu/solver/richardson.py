@@ -25,17 +25,17 @@ class Richardson:
         eps = self.tol * scale
 
         def cond(st):
-            x, it, res = st
+            x, r, it, res = st
             return (it < self.maxiter) & (res > eps)
 
         def body(st):
-            x, it, _ = st
-            r = dev.residual(rhs, A, x)
+            x, r, it, _ = st
             x = x + self.damping * precond(r)
+            r = dev.residual(rhs, A, x)
             res = jnp.sqrt(jnp.abs(dot(r, r)))
-            return (x, it + 1, res)
+            return (x, r, it + 1, res)
 
         r0 = dev.residual(rhs, A, x)
-        st = (x, 0, jnp.sqrt(jnp.abs(dot(r0, r0))))
-        x, it, res = lax.while_loop(cond, body, st)
+        st = (x, r0, 0, jnp.sqrt(jnp.abs(dot(r0, r0))))
+        x, r, it, res = lax.while_loop(cond, body, st)
         return x, it, res / scale

@@ -59,3 +59,66 @@ def test_ilu0_bicgstab_convection():
         BiCGStab(maxiter=200, tol=1e-8))
     x, info = solve(rhs)
     assert info.resid < 1e-8
+
+
+def test_gauss_seidel_multicolor():
+    from amgcl_tpu.relaxation.gauss_seidel import GaussSeidel, greedy_coloring
+    A, rhs = poisson3d(12)
+    # iterated-MIS coloring stays within maxdegree+1 classes
+    color = greedy_coloring(A.to_scipy())
+    assert color.max() + 1 <= 8
+    solve = make_solver(
+        A, AMGParams(relax=GaussSeidel(), dtype=jnp.float64),
+        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_spai1():
+    from amgcl_tpu.relaxation.spai1 import Spai1
+    A, rhs = poisson3d(12)
+    solve = make_solver(
+        A, AMGParams(relax=Spai1(), dtype=jnp.float64),
+        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    # spai1 should smooth at least as well as spai0 (fewer or equal iters)
+    solve0 = make_solver(
+        A, AMGParams(relax=Spai0(), dtype=jnp.float64),
+        CG(maxiter=100, tol=1e-8))
+    _, info0 = solve0(rhs)
+    assert info.iters <= info0.iters + 2
+
+
+def test_ilup_widened_pattern():
+    from amgcl_tpu.relaxation.ilu0 import ILUP
+    A, rhs = convection_diffusion_2d(20, eps=0.05)
+    solve = make_solver(
+        A, AMGParams(relax=ILUP(p=1), dtype=jnp.float64),
+        BiCGStab(maxiter=200, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_ilup_pattern_actually_widens():
+    """Regression: scipy zero-pruning used to collapse ILUP's pattern back
+    to A's, making ILUP == ILU0 silently."""
+    from amgcl_tpu.relaxation.ilu0 import ILU0, ILUP
+    A, _ = poisson3d(6)
+    s0 = ILU0(sweeps=5).build(A, jnp.float64)
+    s1 = ILUP(p=1, sweeps=5).build(A, jnp.float64)
+    nnz0 = s0.Ls.bytes() + s0.Us.bytes()
+    nnz1 = s1.Ls.bytes() + s1.Us.bytes()
+    assert nnz1 > nnz0
+
+
+def test_ilu0_block_matrix():
+    """Regression: explicit zeros from unblock() used to crash the sweep."""
+    from amgcl_tpu.utils.sample_problem import poisson3d_block
+    A, rhs = poisson3d_block(6, 2)
+    st = ILU0().build(A, jnp.float64)
+    Ad = dev.to_device(A, "ell", jnp.float64)
+    e = np.random.RandomState(2).rand(A.nrows * 2) - 0.5
+    r = A.spmv(e)
+    z = st.apply(Ad, jnp.asarray(r))
+    assert np.linalg.norm(e - np.asarray(z)) < 0.9 * np.linalg.norm(e)

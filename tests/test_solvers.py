@@ -95,3 +95,33 @@ def test_dist_cg_compile_cache():
         dist_cg(M, mesh, jnp.asarray(rhs), maxiter=5, tol=1e-12)
     after = _compiled_dist_cg.cache_info()
     assert after.misses == before + 1 and after.hits >= 2
+
+
+def test_lgmres_bicgstabl_idrs():
+    from amgcl_tpu.solver.lgmres import LGMRES
+    from amgcl_tpu.solver.bicgstabl import BiCGStabL
+    from amgcl_tpu.solver.idrs import IDRs
+    A, rhs = convection_diffusion_2d(24, eps=0.05)
+    for s in [LGMRES(maxiter=300, tol=1e-8),
+              BiCGStabL(L=2, maxiter=200, tol=1e-8),
+              IDRs(s=4, maxiter=300, tol=1e-8)]:
+        solve = make_solver(A, AMGParams(dtype=jnp.float64,
+                                         coarse_enough=200), s)
+        x, info = solve(rhs)
+        assert info.resid < 1e-8, type(s).__name__
+        r = rhs - A.spmv(np.asarray(x))
+        assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5, \
+            type(s).__name__
+
+
+def test_lgmres_small_restart_beats_gmres_stall():
+    """Augmentation should not be slower than plain GMRES at equal M."""
+    from amgcl_tpu.solver.lgmres import LGMRES
+    A, rhs = convection_diffusion_2d(24, eps=0.02)
+    prm = dict(dtype=jnp.float64, coarse_enough=100)
+    _, ig = make_solver(A, AMGParams(**prm), GMRES(M=8, maxiter=600,
+                                                   tol=1e-8))(rhs)
+    _, il = make_solver(A, AMGParams(**prm), LGMRES(M=8, K=2, maxiter=600,
+                                                    tol=1e-8))(rhs)
+    assert il.resid < 1e-8
+    assert il.iters <= ig.iters + 8
