@@ -23,5 +23,12 @@ __version__ = "0.1.0"
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.block_solver import make_block_solver
+from amgcl_tpu.models.deflated import deflated_solver
+from amgcl_tpu.models.runtime import make_solver_from_config
+from amgcl_tpu.models.preconditioner import AsPreconditioner, \
+    DummyPreconditioner
 
-__all__ = ["CSR", "AMG", "AMGParams", "make_solver", "__version__"]
+__all__ = ["CSR", "AMG", "AMGParams", "make_solver", "make_block_solver",
+           "deflated_solver", "make_solver_from_config", "AsPreconditioner",
+           "DummyPreconditioner", "__version__"]

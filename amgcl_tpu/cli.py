@@ -1,0 +1,116 @@
+"""CLI — the counterpart of the reference's flagship ``examples/solver.cpp``:
+MatrixMarket/binary input (or a generated Poisson problem), JSON parameter
+file plus ``-p key=value`` overrides through the runtime interface, optional
+block-size dispatch and Cuthill-McKee reordering, hierarchy/iteration/timing
+report (examples/solver.cpp:377-662).
+
+    python -m amgcl_tpu.cli -A problem.mtx -f rhs.mtx -p solver.type=cg
+    python -m amgcl_tpu.cli -n 64 -p precond.relax.type=chebyshev
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+import numpy as np
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(
+        prog="amgcl_tpu", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("-A", "--matrix", help="matrix file (.mtx or .bin)")
+    ap.add_argument("-f", "--rhs", help="rhs file (defaults to ones)")
+    ap.add_argument("-n", "--size", type=int, default=0,
+                    help="generate n^3 3D Poisson problem instead of -A")
+    ap.add_argument("-P", "--params", help="JSON parameter file")
+    ap.add_argument("-p", "--prm", action="append", default=[],
+                    metavar="key=value", help="parameter override")
+    ap.add_argument("-b", "--block-size", type=int, default=1)
+    ap.add_argument("--reorder", action="store_true",
+                    help="apply Cuthill-McKee reordering")
+    ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
+    ap.add_argument("-x", "--x0", help="initial guess file")
+    args = ap.parse_args(argv)
+
+    # honor 64-bit dtype requests before any jax array is created
+    joined = " ".join(args.prm) + (open(args.params).read()
+                                   if args.params else "")
+    if "float64" in joined or "complex128" in joined:
+        import jax
+        jax.config.update("jax_enable_x64", True)
+
+    from amgcl_tpu.utils import io as aio
+    from amgcl_tpu.utils.profiler import Profiler
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    from amgcl_tpu.models.block_solver import make_block_solver
+    from amgcl_tpu.utils.adapters import Reordered
+    from amgcl_tpu.ops.csr import CSR
+
+    prof = Profiler()
+
+    with prof.scope("read"):
+        if args.size:
+            A, rhs = poisson3d(args.size)
+        elif args.matrix:
+            A = (aio.read_binary(args.matrix)
+                 if args.matrix.endswith(".bin") else aio.mm_read(args.matrix))
+            n = A.nrows * A.block_size[0]
+            if args.rhs:
+                rhs = (aio.read_binary(args.rhs)
+                       if args.rhs.endswith(".bin") else aio.mm_read(args.rhs))
+                rhs = np.asarray(rhs).ravel()
+            else:
+                rhs = np.ones(n)
+        else:
+            ap.error("either -A or -n is required")
+
+    overrides = {}
+    for kv in args.prm:
+        k, _, v = kv.partition("=")
+        overrides[k] = v
+
+    def factory(mat):
+        if args.block_size > 1:
+            from amgcl_tpu.models.runtime import (
+                _as_dict, _deep_merge, _nest, precond_params_from_dict,
+                solver_from_params)
+            cfg = _deep_merge(_as_dict(args.params), _nest(overrides))
+            return make_block_solver(
+                mat.unblock() if isinstance(mat, CSR) and mat.is_block
+                else mat, args.block_size,
+                precond_params_from_dict(cfg.get("precond", {})),
+                solver_from_params(cfg.get("solver", {})))
+        return make_solver_from_config(mat, args.params, **overrides)
+
+    with prof.scope("setup"):
+        solve = Reordered(A, factory) if args.reorder else factory(A)
+
+    x0 = None
+    if args.x0:
+        x0 = np.asarray(aio.read_binary(args.x0)
+                        if args.x0.endswith(".bin")
+                        else aio.mm_read(args.x0)).ravel()
+    with prof.scope("solve"):
+        x, info = solve(rhs, x0)
+
+    inner = getattr(solve, "solve", solve)
+    print(getattr(inner, "__repr__", lambda: "")() or "")
+    print("Iterations: %d" % info.iters)
+    print("Error:      %.6e" % info.resid)
+    print()
+    print(prof)
+
+    if args.output:
+        xa = np.asarray(x)
+        if args.output.endswith(".bin"):
+            aio.write_binary(args.output, xa)
+        else:
+            aio.mm_write(args.output, xa)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -3,5 +3,11 @@ coupled-physics preconditioners."""
 
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.models.make_solver import make_solver, SolverInfo
+from amgcl_tpu.models.block_solver import make_block_solver
+from amgcl_tpu.models.deflated import deflated_solver
+from amgcl_tpu.models.preconditioner import AsPreconditioner, \
+    DummyPreconditioner
 
-__all__ = ["AMG", "AMGParams", "make_solver", "SolverInfo"]
+__all__ = ["AMG", "AMGParams", "make_solver", "SolverInfo",
+           "make_block_solver", "deflated_solver", "AsPreconditioner",
+           "DummyPreconditioner"]

@@ -189,6 +189,10 @@ class AMG:
             dev_levels, coarse, prm.npre, prm.npost, prm.ncycle,
             prm.pre_cycles)
 
+    @property
+    def dtype(self):
+        return self.prm.dtype
+
     # -- observability (reference: amgcl/amg.hpp:560-598) -------------------
 
     def __repr__(self):

@@ -1,0 +1,91 @@
+"""Deflated solver: user-supplied deflation vectors around any
+preconditioner+solver pair (reference: amgcl/deflated_solver.hpp:41-276,
+params {nvec, vec}).
+
+Uses the A-DEF2 deflated preconditioner
+``M_defl r = P(r − A Q r) + Q r`` with ``Q = Z E⁻¹ Zᵀ``, ``E = Zᵀ A Z``
+factorized once on the host. On device the deflation terms are dense
+(n×k)·(k,) matmuls — MXU work, essentially free for small k."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver, SolverInfo
+
+
+@register_pytree_node_class
+class DeflatedHierarchy:
+    """Wraps a base hierarchy with the deflation projector."""
+
+    def __init__(self, base, Z, AZ, Einv):
+        self.base = base
+        self.Z = Z         # (n, k)
+        self.AZ = AZ       # (n, k)
+        self.Einv = Einv   # (k, k)
+
+    def tree_flatten(self):
+        return (self.base, self.Z, self.AZ, self.Einv), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def apply(self, r):
+        w = self.Einv @ (self.Z.T @ r)
+        z = self.base.apply(r - self.AZ @ w)
+        return z + self.Z @ w
+
+    @property
+    def system_matrix(self):
+        return self.base.system_matrix
+
+
+class _DeflatedPrecond:
+    def __init__(self, hierarchy, dtype):
+        self.hierarchy = hierarchy
+        self.dtype = dtype
+
+    def __repr__(self):
+        return "deflated(%d vectors)" % self.hierarchy.Z.shape[1]
+
+
+class deflated_solver:
+    """``deflated_solver(A, vec=Z, precond=..., solver=...)`` — same calling
+    surface as make_solver."""
+
+    def __init__(self, A, vec, precond: Any = None, solver: Any = None,
+                 solver_dtype=None, matrix_format: str = "auto"):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        Z = np.asarray(vec, dtype=np.float64)
+        if Z.ndim == 1:
+            Z = Z[:, None]
+        self.inner = make_solver(A, precond, solver, solver_dtype,
+                                 matrix_format)
+        dtype = self.inner.precond_dtype
+        AZ = np.stack([A.spmv(Z[:, k]) for k in range(Z.shape[1])], axis=1)
+        E = Z.T @ AZ
+        Einv = np.linalg.pinv(E)
+        # wrap without mutating a (possibly caller-owned) preconditioner:
+        # the inner make_solver gets a fresh holder for the deflated view
+        deflated = DeflatedHierarchy(
+            self.inner.precond.hierarchy,
+            jnp.asarray(Z, dtype=dtype), jnp.asarray(AZ, dtype=dtype),
+            jnp.asarray(Einv, dtype=dtype))
+        self.inner.precond = _DeflatedPrecond(deflated, dtype)
+
+    def __call__(self, rhs, x0=None):
+        return self.inner(rhs, x0)
+
+    def __repr__(self):
+        return "deflated_solver(nvec=%d)\n%r" % (
+            self.inner.precond.hierarchy.Z.shape[1], self.inner)

@@ -40,21 +40,31 @@ class make_solver:
     The system matrix used by the Krylov loop is moved to the device in
     ``solver_dtype`` (which may differ from the preconditioner dtype)."""
 
-    def __init__(self, A, precond: Optional[AMGParams] = None,
-                 solver: Any = None, solver_dtype=None,
-                 matrix_format: str = "auto"):
+    def __init__(self, A, precond: Any = None, solver: Any = None,
+                 solver_dtype=None, matrix_format: str = "auto"):
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.A_host = A
-        self.precond_params = precond or AMGParams()
+        precond = precond if precond is not None else AMGParams()
+        if isinstance(precond, AMGParams):
+            self.precond = AMG(A, precond)
+            self.precond_dtype = precond.dtype
+        elif hasattr(precond, "hierarchy"):
+            # prebuilt preconditioner (AMG, AsPreconditioner, Dummy, ...)
+            self.precond = precond
+            self.precond_dtype = getattr(precond, "dtype", None) \
+                or precond.prm.dtype
+        else:
+            raise TypeError(
+                "precond must be AMGParams or an object with .hierarchy, "
+                "got %r" % type(precond))
         self.solver = solver or CG()
-        self.solver_dtype = solver_dtype or self.precond_params.dtype
-        self.precond = AMG(A, self.precond_params)
+        self.solver_dtype = solver_dtype or self.precond_dtype
         self.A_dev = dev.to_device(A, matrix_format, self.solver_dtype)
         self._compiled = None
 
     def _solve_fn(self, A_dev, hier, rhs, x0):
-        pdtype = self.precond_params.dtype
+        pdtype = self.precond_dtype
 
         def apply_precond(r):
             z = hier.apply(r.astype(pdtype))

@@ -1,0 +1,71 @@
+"""Single-level preconditioners: any smoother as a standalone
+preconditioner, and the identity (reference:
+amgcl/relaxation/as_preconditioner.hpp:42-125,
+amgcl/preconditioner/dummy.hpp:44-105)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+
+
+@register_pytree_node_class
+class SingleLevelHierarchy:
+    """Pytree exposing the same traceable surface as the AMG hierarchy."""
+
+    def __init__(self, A, state=None):
+        self.A = A
+        self.state = state   # None = identity
+
+    def tree_flatten(self):
+        return (self.A, self.state), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def apply(self, r):
+        if self.state is None:
+            return r
+        return self.state.apply(self.A, r)
+
+    @property
+    def system_matrix(self):
+        return self.A
+
+
+class AsPreconditioner:
+    """Wrap a relaxation policy as a one-shot preconditioner."""
+
+    def __init__(self, A, relax, dtype=jnp.float32, matrix_format="auto"):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        self.A_host = A
+        self.dtype = dtype
+        A_dev = dev.to_device(A, matrix_format, dtype)
+        self.hierarchy = SingleLevelHierarchy(A_dev, relax.build(A, dtype))
+
+    def __repr__(self):
+        return "as_preconditioner(%s)" % type(self.hierarchy.state).__name__
+
+
+class DummyPreconditioner:
+    """Identity preconditioner — lets a plain Krylov run through the same
+    composition machinery (reference: amgcl/preconditioner/dummy.hpp)."""
+
+    def __init__(self, A, dtype=jnp.float32, matrix_format="auto"):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        self.A_host = A
+        self.dtype = dtype
+        self.hierarchy = SingleLevelHierarchy(
+            dev.to_device(A, matrix_format, dtype))
+
+    def __repr__(self):
+        return "dummy"

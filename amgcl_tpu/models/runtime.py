@@ -1,0 +1,206 @@
+"""Runtime (string-driven) component selection.
+
+The reference's L8: every policy is selectable by name with parameters
+flowing through a property tree with dotted paths
+(``precond.coarsening.type=smoothed_aggregation``, ``solver.tol=1e-8``) —
+amgcl/solver/runtime.hpp:60-120, amgcl/preconditioner/runtime.hpp:54-119,
+amgcl/util.hpp:103-183 (param import/export + unknown-key warnings).
+
+Here the property tree is a plain dict (nested or dotted), components are
+dataclasses, and unknown keys warn exactly like ``check_params`` does.
+JSON files are accepted wherever a dict is.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import warnings
+from typing import Any, Dict, Optional
+
+import jax.numpy as jnp
+
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.solver.bicgstab import BiCGStab
+from amgcl_tpu.solver.bicgstabl import BiCGStabL
+from amgcl_tpu.solver.gmres import GMRES, FGMRES
+from amgcl_tpu.solver.lgmres import LGMRES
+from amgcl_tpu.solver.idrs import IDRs
+from amgcl_tpu.solver.richardson import Richardson
+from amgcl_tpu.solver.preonly import PreOnly
+from amgcl_tpu.relaxation.jacobi import DampedJacobi
+from amgcl_tpu.relaxation.spai0 import Spai0
+from amgcl_tpu.relaxation.spai1 import Spai1
+from amgcl_tpu.relaxation.chebyshev import Chebyshev
+from amgcl_tpu.relaxation.gauss_seidel import GaussSeidel
+from amgcl_tpu.relaxation.ilu0 import ILU0, ILUP
+from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
+from amgcl_tpu.coarsening.aggregation import Aggregation
+from amgcl_tpu.coarsening.ruge_stuben import RugeStuben
+from amgcl_tpu.coarsening.as_scalar import AsScalar
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.preconditioner import AsPreconditioner, \
+    DummyPreconditioner
+
+SOLVERS = {
+    "cg": CG, "bicgstab": BiCGStab, "bicgstabl": BiCGStabL,
+    "gmres": GMRES, "fgmres": FGMRES, "lgmres": LGMRES, "idrs": IDRs,
+    "richardson": Richardson, "preonly": PreOnly,
+}
+
+RELAXATION = {
+    "damped_jacobi": DampedJacobi, "spai0": Spai0, "spai1": Spai1,
+    "chebyshev": Chebyshev, "gauss_seidel": GaussSeidel, "ilu0": ILU0,
+    "ilup": ILUP, "iluk": ILUP,   # iluk maps to the A^p-pattern variant
+}
+
+COARSENING = {
+    "smoothed_aggregation": SmoothedAggregation, "aggregation": Aggregation,
+    "ruge_stuben": RugeStuben, "as_scalar": AsScalar,
+}
+
+DTYPES = {
+    "float32": jnp.float32, "float64": jnp.float64,
+    "bfloat16": jnp.bfloat16, "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+
+def _nest(flat: Dict[str, Any]) -> Dict[str, Any]:
+    """Dotted keys -> nested dict (`a.b.c: v` -> {a: {b: {c: v}}})."""
+    out: Dict[str, Any] = {}
+    for k, v in flat.items():
+        parts = k.split(".")
+        d = out
+        for p in parts[:-1]:
+            d = d.setdefault(p, {})
+            if not isinstance(d, dict):
+                raise ValueError("conflicting keys at %r" % k)
+        if isinstance(v, dict):
+            v = _nest(v)
+            d.setdefault(parts[-1], {}).update(v) if isinstance(
+                d.get(parts[-1]), dict) else d.__setitem__(parts[-1], v)
+        else:
+            d[parts[-1]] = v
+    return out
+
+
+def _build_dataclass(cls, prm: Dict[str, Any], path: str):
+    """Instantiate a dataclass from string-ish params, warning on unknown
+    keys (the check_params behavior, amgcl/util.hpp:148-183)."""
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in prm.items():
+        if k == "type":
+            continue
+        if k not in fields:
+            warnings.warn("unknown parameter %s.%s" % (path, k))
+            continue
+        ftype = fields[k].type
+        if isinstance(v, str):
+            if "int" in str(ftype):
+                v = int(v)
+            elif "float" in str(ftype):
+                v = float(v)
+            elif "bool" in str(ftype):
+                v = v.lower() in ("1", "true", "yes")
+        kwargs[k] = v
+    return cls(**kwargs)
+
+
+def _as_dict(prm) -> Dict[str, Any]:
+    if prm is None:
+        return {}
+    if isinstance(prm, str):
+        with open(prm) as f:
+            prm = json.load(f)
+    return _nest(dict(prm))
+
+
+def solver_from_params(prm: Dict[str, Any]):
+    """``{"type": "cg", "tol": 1e-8, ...}`` -> solver instance."""
+    kind = str(prm.get("type", "bicgstab"))
+    if kind not in SOLVERS:
+        raise ValueError("unknown solver %r (have: %s)"
+                         % (kind, sorted(SOLVERS)))
+    return _build_dataclass(SOLVERS[kind], prm, "solver")
+
+
+def relaxation_from_params(prm: Dict[str, Any]):
+    kind = str(prm.get("type", "spai0"))
+    if kind not in RELAXATION:
+        raise ValueError("unknown relaxation %r (have: %s)"
+                         % (kind, sorted(RELAXATION)))
+    return _build_dataclass(RELAXATION[kind], prm, "precond.relax")
+
+
+def coarsening_from_params(prm: Dict[str, Any]):
+    kind = str(prm.get("type", "smoothed_aggregation"))
+    if kind not in COARSENING:
+        raise ValueError("unknown coarsening %r (have: %s)"
+                         % (kind, sorted(COARSENING)))
+    return _build_dataclass(COARSENING[kind], prm, "precond.coarsening")
+
+
+def precond_params_from_dict(prm: Dict[str, Any]) -> AMGParams:
+    kw: Dict[str, Any] = {}
+    amg_fields = {f.name for f in dataclasses.fields(AMGParams)}
+    for k, v in prm.items():
+        if k in ("class", "type"):
+            continue
+        elif k == "coarsening":
+            kw["coarsening"] = coarsening_from_params(v)
+        elif k == "relax":
+            kw["relax"] = relaxation_from_params(v)
+        elif k == "dtype":
+            kw["dtype"] = DTYPES[v] if isinstance(v, str) else v
+        elif k in amg_fields:
+            f = {f.name: f for f in dataclasses.fields(AMGParams)}[k]
+            if isinstance(v, str) and k in ("coarse_enough", "max_levels",
+                                            "npre", "npost", "ncycle",
+                                            "pre_cycles"):
+                v = int(v)
+            if isinstance(v, str) and k == "direct_coarse":
+                v = v.lower() in ("1", "true", "yes")
+            kw[k] = v
+        else:
+            warnings.warn("unknown parameter precond.%s" % k)
+    return AMGParams(**kw)
+
+
+def make_solver_from_config(A, prm=None, **flat_overrides):
+    """The runtime composition entry point.
+
+    ``prm`` is a nested dict, a dict with dotted keys, or a path to a JSON
+    file; ``flat_overrides`` are extra ``key=value`` pairs with dotted
+    names, e.g. ``make_solver_from_config(A, "cfg.json",
+    **{"solver.tol": 1e-10})``."""
+    cfg = _as_dict(prm)
+    if flat_overrides:
+        extra = _nest(flat_overrides)
+        cfg = _deep_merge(cfg, extra)
+    pcfg = cfg.get("precond", {})
+    scfg = cfg.get("solver", {})
+    pclass = str(pcfg.get("class", "amg"))
+    dtype = pcfg.get("dtype", "float32")
+    dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
+    solver = solver_from_params(scfg)
+    if pclass == "amg":
+        return make_solver(A, precond_params_from_dict(pcfg), solver)
+    if pclass == "relaxation":
+        relax = relaxation_from_params(pcfg.get("relax", {}))
+        return make_solver(A, AsPreconditioner(A, relax, dtype), solver)
+    if pclass == "dummy":
+        return make_solver(A, DummyPreconditioner(A, dtype), solver)
+    raise ValueError("unknown precond.class %r" % pclass)
+
+
+def _deep_merge(a: Dict, b: Dict) -> Dict:
+    out = dict(a)
+    for k, v in b.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

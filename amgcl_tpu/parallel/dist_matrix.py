@@ -44,6 +44,8 @@ class DistDiaMatrix:
 
     @property
     def halo(self) -> int:
+        if not self.offsets:
+            return 0
         return max(max(self.offsets), -min(self.offsets), 0)
 
     def tree_flatten(self):

@@ -24,29 +24,23 @@ from amgcl_tpu.ops import device as dev
 
 
 def greedy_coloring(m: sp.csr_matrix, max_colors: int = 64) -> np.ndarray:
-    """Deterministic distance-1 coloring via iterated Luby MIS rounds."""
+    """Deterministic distance-1 coloring via iterated Luby MIS rounds
+    (reusing the MIS core of the aggregation module)."""
+    from amgcl_tpu.coarsening.aggregates import _luby_mis, _priority
+
     n = m.shape[0]
     adj = (m + m.T).tocsr()
     adj.setdiag(0)
     adj.eliminate_zeros()
-    prio = (np.random.RandomState(911).permutation(n) + 1).astype(np.float64)
+    adj = (adj != 0).astype(np.int8)
+    prio = _priority(n)
     color = np.full(n, -1, dtype=np.int64)
-    Sb = (adj != 0).astype(np.float64)
     for c in range(max_colors):
         und = color < 0
         if not und.any():
             break
-        # MIS among uncolored nodes gets color c
-        active = und.copy()
-        while active.any():
-            p_act = np.where(active, prio, 0.0)
-            nbr_max = Sb.multiply(p_act[None, :]).max(axis=1).toarray().ravel()
-            win = active & (prio > nbr_max)
-            if not win.any():
-                break
-            color[win] = c
-            covered = np.asarray(Sb @ win.astype(np.float64)).ravel() > 0
-            active &= ~(win | covered)
+        win = _luby_mis(adj, und, prio)
+        color[win] = c
     if (color < 0).any():
         raise RuntimeError("coloring failed within %d colors" % max_colors)
     # iterated-MIS coloring uses at most maxdegree+1 colors (a node is only

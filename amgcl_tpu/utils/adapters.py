@@ -1,0 +1,104 @@
+"""Problem adapters: reordering, symmetric scaling, complex→real.
+
+Reference surface: amgcl/adapter/reorder.hpp + amgcl/reorder/cuthill_mckee.hpp
+(permutation applied to matrix and vectors), amgcl/adapter/scaled_problem.hpp
+(symmetric diagonal scaling), amgcl/adapter/complex.hpp (complex system as
+its 2×2 real-block equivalent). The zero-copy/crs_tuple adapters of the
+reference collapse to ``CSR.from_scipy`` / the (ptr, col, val) constructor,
+which never copy device-side.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+from scipy.sparse.csgraph import reverse_cuthill_mckee
+
+from amgcl_tpu.ops.csr import CSR
+
+
+def cuthill_mckee(A: CSR) -> np.ndarray:
+    """Reverse Cuthill-McKee permutation (bandwidth reduction) — makes the
+    DIA device format dramatically denser in diagonals for unstructured
+    meshes. Returns perm such that B = A[perm][:, perm]."""
+    m = A.to_scipy()
+    return np.asarray(reverse_cuthill_mckee(m, symmetric_mode=True))
+
+
+def permute(A: CSR, perm: np.ndarray) -> CSR:
+    """B = P A Pᵀ with B[i, j] = A[perm[i], perm[j]]."""
+    m = A.to_scipy()[perm][:, perm].tocsr()
+    m.sort_indices()
+    return CSR.from_scipy(m)
+
+
+class Reordered:
+    """Wrap any solver factory so callers never see the permutation
+    (reference: adapter::reorder)."""
+
+    def __init__(self, A, solver_factory, perm=None):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        self.perm = cuthill_mckee(A) if perm is None else np.asarray(perm)
+        self.iperm = np.empty_like(self.perm)
+        self.iperm[self.perm] = np.arange(len(self.perm))
+        self.solve = solver_factory(permute(A, self.perm))
+
+    def __call__(self, rhs, x0=None):
+        rhs = np.asarray(rhs)[self.perm]
+        if x0 is not None:
+            x0 = np.asarray(x0)[self.perm]
+        x, info = self.solve(rhs, x0)
+        return np.asarray(x)[self.iperm], info
+
+
+class Scaled:
+    """Symmetric diagonal scaling: solve (D^-1/2 A D^-1/2) y = D^-1/2 b,
+    return x = D^-1/2 y (reference: adapter::scaled_problem)."""
+
+    def __init__(self, A, solver_factory):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        d = np.abs(A.diagonal().astype(np.float64))
+        self.s = 1.0 / np.sqrt(np.where(d > 0, d, 1.0))
+        m = A.to_scipy()
+        S = sp.diags(self.s)
+        ms = (S @ m @ S).tocsr()
+        ms.sort_indices()
+        self.solve = solver_factory(CSR.from_scipy(ms))
+
+    def __call__(self, rhs, x0=None):
+        rhs = np.asarray(rhs) * self.s
+        if x0 is not None:
+            x0 = np.asarray(x0) / self.s
+        y, info = self.solve(rhs, x0)
+        return np.asarray(y) * self.s, info
+
+
+def complex_to_real(A: CSR, rhs=None):
+    """Complex n×n system → real 2n×2n with 2×2 blocks [[re, -im],[im, re]];
+    rhs interleaves (re, im) (reference: amgcl/adapter/complex.hpp)."""
+    assert np.iscomplexobj(A.val)
+    m = A.to_scipy()
+    re, im = m.real.tocsr(), m.imag.tocsr()
+    top = sp.hstack([re, -im])
+    bot = sp.hstack([im, re])
+    # interleave via permutation so the block structure is per-unknown
+    n = A.nrows
+    P = sp.csr_matrix(
+        (np.ones(2 * n), (np.r_[0:2 * n:2, 1:2 * n:2], np.arange(2 * n))),
+        shape=(2 * n, 2 * n))
+    M = (P @ sp.vstack([top, bot]).tocsr() @ P.T).tocsr()
+    M.sort_indices()
+    Ar = CSR.from_scipy(M)
+    if rhs is None:
+        return Ar
+    rr = np.empty(2 * n)
+    rr[0::2] = np.real(rhs)
+    rr[1::2] = np.imag(rhs)
+    return Ar, rr
+
+
+def real_to_complex(x) -> np.ndarray:
+    x = np.asarray(x)
+    return x[0::2] + 1j * x[1::2]

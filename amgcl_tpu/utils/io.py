@@ -1,0 +1,102 @@
+"""Matrix/vector IO: MatrixMarket and a raw binary format.
+
+Mirrors the reference's IO surface (amgcl/io/mm.hpp:52-383 — sparse+dense,
+real+complex, general/symmetric; amgcl/io/binary.hpp:70-167 — read_crs/
+read_dense/write). MatrixMarket parsing delegates to scipy (battle-tested C
+fast path) rather than hand-rolling a reader; the binary format is
+self-describing: magic, dtype codes, shapes, then raw arrays.
+"""
+
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+import scipy.io
+import scipy.sparse as sp
+
+from amgcl_tpu.ops.csr import CSR
+
+_MAGIC = b"AMGTPU1\x00"
+_DTYPES = {0: np.float64, 1: np.float32, 2: np.complex128, 3: np.int32,
+           4: np.int64}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+# -- MatrixMarket -----------------------------------------------------------
+
+def mm_read(path):
+    """Read a MatrixMarket file -> CSR (sparse) or ndarray (dense array)."""
+    m = scipy.io.mmread(path)
+    if sp.issparse(m):
+        return CSR.from_scipy(m.tocsr())
+    a = np.asarray(m)
+    return a.ravel() if a.ndim == 2 and 1 in a.shape else a
+
+
+def mm_write(path, m, comment: str = ""):
+    """Write CSR / scipy sparse / ndarray to MatrixMarket."""
+    if isinstance(m, CSR):
+        m = m.to_scipy()
+    if sp.issparse(m):
+        scipy.io.mmwrite(path, m, comment=comment)
+    else:
+        a = np.asarray(m)
+        if a.ndim == 1:
+            a = a[:, None]
+        scipy.io.mmwrite(path, a, comment=comment)
+
+
+# -- binary -----------------------------------------------------------------
+
+def write_binary(path, m):
+    """Self-describing binary dump of a CSR matrix or dense ndarray."""
+    with open(path, "wb") as f:
+        f.write(_MAGIC)
+        if isinstance(m, CSR) or sp.issparse(m):
+            if not isinstance(m, CSR):
+                m = CSR.from_scipy(m.tocsr())
+            f.write(struct.pack("<B", 1))                    # kind: sparse
+            f.write(struct.pack("<qq", m.nrows, m.ncols))
+            br, bc = m.block_size
+            f.write(struct.pack("<qq", br, bc))
+            for arr in (m.ptr.astype(np.int64),
+                        m.col.astype(np.int32), np.ascontiguousarray(m.val)):
+                code = _DTYPE_CODES[np.dtype(arr.dtype)]
+                f.write(struct.pack("<Bq", code, arr.size))
+                f.write(arr.tobytes())
+        else:
+            a = np.ascontiguousarray(m)
+            f.write(struct.pack("<B", 0))                    # kind: dense
+            f.write(struct.pack("<B", a.ndim))
+            f.write(struct.pack("<%dq" % a.ndim, *a.shape))
+            code = _DTYPE_CODES[np.dtype(a.dtype)]
+            f.write(struct.pack("<Bq", code, a.size))
+            f.write(a.tobytes())
+
+
+def read_binary(path):
+    """Read back what write_binary produced."""
+    with open(path, "rb") as f:
+        if f.read(len(_MAGIC)) != _MAGIC:
+            raise ValueError("%s: not an amgcl_tpu binary file" % path)
+        kind = struct.unpack("<B", f.read(1))[0]
+        if kind == 1:
+            nrows, ncols = struct.unpack("<qq", f.read(16))
+            br, bc = struct.unpack("<qq", f.read(16))
+            arrs = []
+            for _ in range(3):
+                code, size = struct.unpack("<Bq", f.read(9))
+                dt = np.dtype(_DTYPES[code])
+                arrs.append(np.frombuffer(f.read(size * dt.itemsize),
+                                          dtype=dt))
+            ptr, col, val = arrs
+            if (br, bc) != (1, 1):
+                val = val.reshape(-1, br, bc)
+            return CSR(ptr, col, val, ncols)
+        ndim = struct.unpack("<B", f.read(1))[0]
+        shape = struct.unpack("<%dq" % ndim, f.read(8 * ndim))
+        code, size = struct.unpack("<Bq", f.read(9))
+        dt = np.dtype(_DTYPES[code])
+        return np.frombuffer(f.read(size * dt.itemsize),
+                             dtype=dt).reshape(shape)

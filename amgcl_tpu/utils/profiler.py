@@ -1,0 +1,89 @@
+"""Hierarchical scoped profiler — the reference's tic/toc tree
+(amgcl/profiler.hpp:53-216) with the same shape of report: a nested tree of
+named scopes with absolute seconds and percentages. Device work is made
+observable by an optional sync callback (block_until_ready) so the numbers
+mean wall-clock, not dispatch time.
+
+Usage::
+
+    prof = Profiler()
+    with prof.scope("setup"):
+        with prof.scope("coarsening"):
+            ...
+    print(prof)
+
+or ``prof.tic("setup") ... prof.toc("setup")`` like the reference macros.
+"""
+
+from __future__ import annotations
+
+import time
+from contextlib import contextmanager
+from typing import Callable, Dict, Optional
+
+
+class _Node:
+    __slots__ = ("name", "total", "count", "children", "_started")
+
+    def __init__(self, name):
+        self.name = name
+        self.total = 0.0
+        self.count = 0
+        self.children: Dict[str, "_Node"] = {}
+        self._started = None
+
+
+class Profiler:
+    def __init__(self, sync: Optional[Callable[[], None]] = None):
+        self.root = _Node("[root]")
+        self._stack = [self.root]
+        self._t0 = time.perf_counter()
+        self._sync = sync
+
+    def tic(self, name: str):
+        if self._sync:
+            self._sync()
+        cur = self._stack[-1]
+        node = cur.children.get(name)
+        if node is None:
+            node = cur.children[name] = _Node(name)
+        node._started = time.perf_counter()
+        self._stack.append(node)
+
+    def toc(self, name: str):
+        if self._sync:
+            self._sync()
+        node = self._stack.pop()
+        if node.name != name:
+            raise RuntimeError("profiler scope mismatch: toc(%r) inside %r"
+                               % (name, node.name))
+        node.total += time.perf_counter() - node._started
+        node.count += 1
+
+    @contextmanager
+    def scope(self, name: str):
+        self.tic(name)
+        try:
+            yield
+        finally:
+            self.toc(name)
+
+    def __str__(self):
+        lines = ["Profile:"]
+        total = time.perf_counter() - self._t0
+        lines.append("%-40s %10.3f s" % ("[total]", total))
+
+        def walk(node, depth):
+            for name in node.children:
+                ch = node.children[name]
+                pct = 100.0 * ch.total / total if total > 0 else 0.0
+                lines.append("%-40s %10.3f s %6.2f%%"
+                             % ("  " * depth + name, ch.total, pct))
+                walk(ch, depth + 1)
+
+        walk(self.root, 1)
+        return "\n".join(lines)
+
+
+#: module-level default profiler, like the reference's global ``prof``
+prof = Profiler()

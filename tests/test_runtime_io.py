@@ -1,0 +1,227 @@
+"""Runtime config, IO round-trips, adapters, compositions, profiler, CLI."""
+
+import json
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.utils.sample_problem import poisson3d, poisson3d_complex
+from amgcl_tpu.utils import io as aio
+from amgcl_tpu.models.runtime import make_solver_from_config
+from amgcl_tpu.models.block_solver import make_block_solver
+from amgcl_tpu.models.deflated import deflated_solver
+from amgcl_tpu.models.preconditioner import AsPreconditioner, \
+    DummyPreconditioner
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.relaxation.chebyshev import Chebyshev
+
+
+def test_runtime_dotted_config():
+    A, rhs = poisson3d(12)
+    solve = make_solver_from_config(A, {
+        "precond.coarsening.type": "smoothed_aggregation",
+        "precond.relax.type": "chebyshev",
+        "precond.dtype": "float64",
+        "solver.type": "cg",
+        "solver.tol": "1e-8",
+        "solver.maxiter": "100",
+    })
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_runtime_json_file(tmp_path):
+    A, rhs = poisson3d(10)
+    cfg = {"precond": {"relax": {"type": "damped_jacobi", "damping": 0.8},
+                       "dtype": "float64"},
+           "solver": {"type": "bicgstab", "tol": 1e-8}}
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(cfg))
+    solve = make_solver_from_config(A, str(p))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_runtime_relaxation_class():
+    A, rhs = poisson3d(10)
+    solve = make_solver_from_config(A, {
+        "precond.class": "relaxation",
+        "precond.relax.type": "ilu0",
+        "precond.dtype": "float64",
+        "solver.type": "cg", "solver.maxiter": 500, "solver.tol": 1e-8})
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_runtime_dummy_class():
+    A, rhs = poisson3d(8)
+    solve = make_solver_from_config(A, {
+        "precond.class": "dummy", "precond.dtype": "float64",
+        "solver.type": "cg", "solver.maxiter": 500, "solver.tol": 1e-8})
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_runtime_unknown_key_warns():
+    A, _ = poisson3d(6)
+    with pytest.warns(UserWarning, match="unknown parameter"):
+        make_solver_from_config(A, {"solver.typo_field": 1,
+                                    "precond.dtype": "float64"})
+
+
+def test_runtime_unknown_type_raises():
+    A, _ = poisson3d(6)
+    with pytest.raises(ValueError, match="unknown solver"):
+        make_solver_from_config(A, {"solver.type": "does_not_exist"})
+
+
+def test_mm_roundtrip(tmp_path):
+    A, _ = poisson3d(6)
+    p = str(tmp_path / "a.mtx")
+    aio.mm_write(p, A)
+    B = aio.mm_read(p)
+    assert np.allclose(B.to_dense(), A.to_dense())
+    v = np.linspace(0, 1, 10)
+    pv = str(tmp_path / "v.mtx")
+    aio.mm_write(pv, v)
+    assert np.allclose(np.asarray(aio.mm_read(pv)).ravel(), v)
+
+
+def test_binary_roundtrip(tmp_path):
+    A, rhs = poisson3d(6)
+    p = str(tmp_path / "a.bin")
+    aio.write_binary(p, A)
+    B = aio.read_binary(p)
+    assert np.allclose(B.to_dense(), A.to_dense())
+    pv = str(tmp_path / "v.bin")
+    aio.write_binary(pv, rhs)
+    assert np.allclose(aio.read_binary(pv), rhs)
+
+
+def test_reorder_adapter():
+    from amgcl_tpu.utils.adapters import Reordered
+    A, rhs = poisson3d(10)
+    solve = Reordered(A, lambda M: make_solver(
+        M, AMGParams(dtype=jnp.float64), CG(tol=1e-8)))
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_scaled_adapter():
+    from amgcl_tpu.utils.adapters import Scaled
+    A, rhs = poisson3d(10)
+    solve = Scaled(A, lambda M: make_solver(
+        M, AMGParams(dtype=jnp.float64), CG(tol=1e-8)))
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_complex_adapter():
+    from amgcl_tpu.utils.adapters import complex_to_real, real_to_complex
+    A, rhs = poisson3d_complex(8)
+    Ar, rr = complex_to_real(A, rhs)
+    solve = make_solver(Ar, AMGParams(dtype=jnp.float64),
+                        CG(maxiter=300, tol=1e-10))
+    y, info = solve(rr)
+    x = real_to_complex(np.asarray(y))
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_block_solver_scalar_io():
+    A, rhs = poisson3d(8)
+    solve = make_block_solver(A, 2, AMGParams(dtype=jnp.float64),
+                              CG(maxiter=200, tol=1e-8))
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_deflated_solver():
+    A, rhs = poisson3d(12)
+    Z = np.ones((A.nrows, 1))
+    solve = deflated_solver(A, Z, AMGParams(dtype=jnp.float64),
+                            CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_as_preconditioner_and_dummy_repr():
+    A, rhs = poisson3d(8)
+    p1 = AsPreconditioner(A, Chebyshev(), jnp.float64)
+    assert "chebyshev" in repr(p1).lower()
+    p2 = DummyPreconditioner(A, jnp.float64)
+    assert repr(p2) == "dummy"
+    solve = make_solver(A, p1, CG(maxiter=300, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+
+
+def test_profiler_tree():
+    from amgcl_tpu.utils.profiler import Profiler
+    prof = Profiler()
+    with prof.scope("a"):
+        with prof.scope("b"):
+            pass
+    with pytest.raises(RuntimeError):
+        prof.tic("x")
+        prof.toc("y")
+    s = str(Profiler())
+    assert "[total]" in s
+
+
+def test_cli_poisson(capsys, tmp_path):
+    from amgcl_tpu.cli import main
+    out = str(tmp_path / "x.mtx")
+    rc = main(["-n", "10", "-p", "precond.dtype=float64",
+               "-p", "solver.type=cg", "-p", "solver.tol=1e-8",
+               "-o", out, "--reorder"])
+    assert rc == 0
+    cap = capsys.readouterr().out
+    assert "Iterations:" in cap and "Error:" in cap
+    x = np.asarray(aio.mm_read(out)).ravel()
+    A, rhs = poisson3d(10)
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_binary_block_roundtrip(tmp_path):
+    """Regression: block val arrays used to be flattened on write."""
+    A, _ = poisson3d(6)
+    B = A.to_block(2)
+    p = str(tmp_path / "b.bin")
+    aio.write_binary(p, B)
+    C = aio.read_binary(p)
+    assert C.is_block and C.block_size == (2, 2)
+    assert np.allclose(C.unblock().to_dense(), A.to_dense())
+
+
+def test_deflated_does_not_mutate_precond():
+    """Regression: deflated_solver used to rebind the caller's hierarchy."""
+    from amgcl_tpu.models.amg import AMG
+    A, rhs = poisson3d(10)
+    amg = AMG(A, AMGParams(dtype=jnp.float64))
+    h0 = amg.hierarchy
+    d1 = deflated_solver(A, np.ones((A.nrows, 1)), amg, CG(tol=1e-8))
+    assert amg.hierarchy is h0
+    x, info = d1(rhs)
+    assert info.resid < 1e-8
+
+
+def test_cli_block_size_respects_params(capsys, tmp_path):
+    from amgcl_tpu.cli import main
+    rc = main(["-n", "8", "-b", "2", "-p", "precond.dtype=float64",
+               "-p", "solver.type=cg", "-p", "solver.tol=1e-10"])
+    assert rc == 0
+    cap = capsys.readouterr().out
+    assert "CG" in cap
+    err = float(cap.split("Error:")[1].split()[0])
+    assert err < 1e-10
