@@ -45,7 +45,6 @@ def main(argv=None):
     from amgcl_tpu.utils.profiler import Profiler
     from amgcl_tpu.utils.sample_problem import poisson3d
     from amgcl_tpu.models.runtime import make_solver_from_config
-    from amgcl_tpu.models.block_solver import make_block_solver
     from amgcl_tpu.utils.adapters import Reordered
     from amgcl_tpu.ops.csr import CSR
 
@@ -73,17 +72,11 @@ def main(argv=None):
         overrides[k] = v
 
     def factory(mat):
-        if args.block_size > 1:
-            from amgcl_tpu.models.runtime import (
-                _as_dict, _deep_merge, _nest, precond_params_from_dict,
-                solver_from_params)
-            cfg = _deep_merge(_as_dict(args.params), _nest(overrides))
-            return make_block_solver(
-                mat.unblock() if isinstance(mat, CSR) and mat.is_block
-                else mat, args.block_size,
-                precond_params_from_dict(cfg.get("precond", {})),
-                solver_from_params(cfg.get("solver", {})))
-        return make_solver_from_config(mat, args.params, **overrides)
+        if isinstance(mat, CSR) and mat.is_block and args.block_size > 1:
+            mat = mat.unblock()
+        return make_solver_from_config(mat, args.params,
+                                       block_size=args.block_size,
+                                       **overrides)
 
     with prof.scope("setup"):
         solve = Reordered(A, factory) if args.reorder else factory(A)

@@ -50,6 +50,20 @@ def _priority(n: int) -> np.ndarray:
     return (np.random.RandomState(7919).permutation(n) + 1).astype(np.float64)
 
 
+def _row_max(indptr: np.ndarray, indices: np.ndarray,
+             score: np.ndarray) -> np.ndarray:
+    """Per-row max of score[col] over a CSR pattern — one gather plus
+    ``np.maximum.reduceat``; avoids materializing a scaled copy of the graph
+    the way ``S.multiply(score).max(axis=1)`` does."""
+    n = len(indptr) - 1
+    out = np.zeros(n, dtype=score.dtype)
+    nonempty = indptr[:-1] < indptr[1:]
+    if nonempty.any():
+        out[nonempty] = np.maximum.reduceat(
+            score[indices], indptr[:-1][nonempty])
+    return out
+
+
 def _luby_mis(S2: sp.csr_matrix, active: np.ndarray, prio: np.ndarray,
               max_rounds: int = 1000) -> np.ndarray:
     """Maximal independent set over S2 restricted to ``active`` nodes,
@@ -57,17 +71,17 @@ def _luby_mis(S2: sp.csr_matrix, active: np.ndarray, prio: np.ndarray,
     n = S2.shape[0]
     und = active.copy()
     in_set = np.zeros(n, dtype=bool)
-    Sb = S2.astype(np.float64)
+    indptr, indices = S2.indptr, S2.indices
     for _ in range(max_rounds):
         if not und.any():
             break
         p_und = np.where(und, prio, 0.0)
-        nbr_max = Sb.multiply(p_und[None, :]).max(axis=1).toarray().ravel()
+        nbr_max = _row_max(indptr, indices, p_und)
         winners = und & (prio > nbr_max)
         in_set |= winners
         # winners and their S2 neighborhood leave the undecided pool
-        covered = np.asarray(
-            Sb @ winners.astype(np.float64)).ravel() > 0
+        covered = _row_max(indptr, indices,
+                           winners.astype(np.float64)) > 0
         und &= ~(winners | covered)
     return in_set
 
@@ -104,12 +118,11 @@ def mis_aggregates(S: sp.csr_matrix, max_rounds: int = 1000):
     root_of = np.full(n, -1, dtype=np.int64)
     root_of[roots] = np.flatnonzero(roots)
 
-    Sb = S.astype(np.float64)
     rows_all = np.repeat(np.arange(n), np.diff(S.indptr))
 
     # distance-1: join the adjacent root (unique since roots are S2-independent)
     p_root = np.where(roots, prio, 0.0)
-    nbr_root_max = Sb.multiply(p_root[None, :]).max(axis=1).toarray().ravel()
+    nbr_root_max = _row_max(S.indptr, S.indices, p_root)
     d1 = active & ~roots & (nbr_root_max > 0)
     sc = p_root[S.indices]
     match = d1[rows_all] & (sc > 0) & (sc == nbr_root_max[rows_all])
@@ -122,7 +135,7 @@ def mis_aggregates(S: sp.csr_matrix, max_rounds: int = 1000):
         if not todo.any():
             break
         p_asgn = np.where(assigned, prio, 0.0)
-        nbr_max = Sb.multiply(p_asgn[None, :]).max(axis=1).toarray().ravel()
+        nbr_max = _row_max(S.indptr, S.indices, p_asgn)
         join = todo & (nbr_max > 0)
         sc = p_asgn[S.indices]
         match = join[rows_all] & (sc > 0) & (sc == nbr_max[rows_all])
@@ -147,7 +160,15 @@ def mis_aggregates(S: sp.csr_matrix, max_rounds: int = 1000):
 def plain_aggregates(A: CSR, eps_strong: float = 0.08):
     """Aggregates over the scalar strength graph of A
     (reference: amgcl/coarsening/plain_aggregates.hpp:63-213, default
-    eps_strong = 0.08)."""
+    eps_strong = 0.08).
+
+    Uses the native C++ greedy distance-2 pass when the extension is
+    available (linear-time, the serial fast path); otherwise the vectorized
+    MIS formulation — the same one the distributed layer shards."""
+    from amgcl_tpu.native import native_aggregates
+    got = native_aggregates(A, eps_strong)
+    if got is not None:
+        return got
     S = strength_graph(A, eps_strong)
     return mis_aggregates(S)
 

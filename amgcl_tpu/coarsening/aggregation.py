@@ -11,7 +11,7 @@ import numpy as np
 
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.coarsening.aggregates import (
-    strength_graph, mis_aggregates, pointwise_aggregates)
+    plain_aggregates, pointwise_aggregates)
 from amgcl_tpu.coarsening.tentative import tentative_prolongation
 from amgcl_tpu.coarsening.galerkin import scaled_galerkin
 
@@ -34,8 +34,7 @@ class Aggregation:
             agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
         else:
-            S = strength_graph(scalar, self.eps_strong)
-            agg, n_agg = mis_aggregates(S)
+            agg, n_agg = plain_aggregates(scalar, self.eps_strong)
             n_pt = scalar.nrows
         if n_agg == 0:
             raise ValueError("empty coarse level (all rows isolated)")

@@ -169,13 +169,15 @@ def precond_params_from_dict(prm: Dict[str, Any]) -> AMGParams:
     return AMGParams(**kw)
 
 
-def make_solver_from_config(A, prm=None, **flat_overrides):
+def make_solver_from_config(A, prm=None, block_size: int = 1,
+                            **flat_overrides):
     """The runtime composition entry point.
 
     ``prm`` is a nested dict, a dict with dotted keys, or a path to a JSON
     file; ``flat_overrides`` are extra ``key=value`` pairs with dotted
     names, e.g. ``make_solver_from_config(A, "cfg.json",
-    **{"solver.tol": 1e-10})``."""
+    **{"solver.tol": 1e-10})``. ``block_size > 1`` routes through
+    make_block_solver (scalar rhs/x over a block-valued engine)."""
     cfg = _as_dict(prm)
     if flat_overrides:
         extra = _nest(flat_overrides)
@@ -186,6 +188,13 @@ def make_solver_from_config(A, prm=None, **flat_overrides):
     dtype = pcfg.get("dtype", "float32")
     dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
     solver = solver_from_params(scfg)
+    if block_size > 1:
+        from amgcl_tpu.models.block_solver import make_block_solver
+        if pclass != "amg":
+            raise ValueError(
+                "block_size > 1 supports precond.class=amg only")
+        return make_block_solver(A, block_size,
+                                 precond_params_from_dict(pcfg), solver)
     if pclass == "amg":
         return make_solver(A, precond_params_from_dict(pcfg), solver)
     if pclass == "relaxation":

@@ -1,0 +1,92 @@
+"""Loader for the native (C++/OpenMP) setup kernels.
+
+The solve phase is pure XLA; the setup phase's hot host passes (strength
+filtering, greedy aggregation) have native implementations in
+``csrc/setup_kernels.cpp``, compiled on first use with the toolchain baked
+into the image and loaded over ctypes (no pybind11 dependency). Falls back
+to the vectorized numpy implementations when no compiler is available —
+every caller treats this module as optional.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import tempfile
+import threading
+
+import numpy as np
+
+_LIB = None
+_LOCK = threading.Lock()
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))), "csrc", "setup_kernels.cpp")
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "_native_cache")
+
+
+def _build() -> str:
+    os.makedirs(_CACHE_DIR, exist_ok=True)
+    so = os.path.join(_CACHE_DIR, "libamgcl_tpu_native.so")
+    if os.path.exists(so) and os.path.getmtime(so) >= os.path.getmtime(_SRC):
+        return so
+    tmp = so + ".tmp%d" % os.getpid()
+    cmd = ["g++", "-O3", "-fopenmp", "-shared", "-fPIC", "-std=c++17",
+           "-o", tmp, _SRC]
+    subprocess.run(cmd, check=True, capture_output=True)
+    os.replace(tmp, so)
+    return so
+
+
+def lib():
+    """The loaded native library, or None when unavailable."""
+    global _LIB
+    with _LOCK:
+        if _LIB is None:
+            try:
+                handle = ctypes.CDLL(_build())
+            except (OSError, subprocess.CalledProcessError,
+                    FileNotFoundError):
+                _LIB = False
+                return None
+            handle.aggregate_d2.restype = ctypes.c_int64
+            handle.aggregate_d2.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p]
+            handle.strength_mask.restype = None
+            handle.strength_mask.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p]
+            handle.symmetrize_mask.restype = None
+            handle.symmetrize_mask.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p]
+            _LIB = handle
+        return _LIB or None
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p)
+
+
+def native_aggregates(A, eps_strong: float):
+    """(agg, n_agg) via the native greedy distance-2 pass, or None if the
+    native library is unavailable or the values are not float64-able."""
+    L = lib()
+    if L is None or A.is_block:
+        return None
+    try:
+        val = np.ascontiguousarray(A.val, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    n = A.nrows
+    strong = np.empty(A.nnz, dtype=np.uint8)
+    L.strength_mask(n, _ptr(ptr), _ptr(col), _ptr(val),
+                    float(eps_strong), _ptr(strong))
+    L.symmetrize_mask(n, _ptr(ptr), _ptr(col), _ptr(strong))
+    agg = np.empty(n, dtype=np.int64)
+    n_agg = L.aggregate_d2(n, _ptr(ptr), _ptr(col), _ptr(strong), _ptr(agg))
+    return agg, int(n_agg)

@@ -1,0 +1,135 @@
+"""Distributed subdomain deflation — two-level deflated Krylov.
+
+The reference's scalable flagship (amgcl/mpi/subdomain_deflation.hpp:53-610,
+Frank–Vuik): a coarse space Z of per-subdomain vectors (constant by default,
+linear with coordinates, or user-supplied), E = ZᵀAZ assembled and
+factorized on the master ranks, and the projection applied around the
+preconditioned operator.
+
+TPU rendition: Z and AZ are dense (n, k) panels sharded by rows (per-shard
+tall-skinny matmuls — MXU food), E⁻¹ is tiny and replicated, and the coarse
+reduction ZᵀR is a local (k,) partial followed by one psum. The deflated
+preconditioner is A-DEF2: M r = P(r − AZ w) + Z w with w = E⁻¹ Zᵀ r —
+wrapped around the distributed AMG hierarchy so the whole thing stays one
+SPMD program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.parallel.mesh import ROWS_AXIS
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver, DistHierarchy
+
+
+@register_pytree_node_class
+class DeflatedDistHierarchy:
+    """base hierarchy + deflation panels; shard_apply runs inside shard_map.
+
+    Z, AZ: (nd, nloc, k) sharded; Einv: (k, k) replicated."""
+
+    def __init__(self, base, Z, AZ, Einv):
+        self.base = base
+        self.Z = Z
+        self.AZ = AZ
+        self.Einv = Einv
+
+    def tree_flatten(self):
+        return (self.base, self.Z, self.AZ, self.Einv), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def specs(self):
+        s = P(ROWS_AXIS, None, None)
+        return DeflatedDistHierarchy(self.base.specs(), s, s, P())
+
+    def system_A(self):
+        return self.base.system_A()
+
+    def shard_apply(self, r):
+        Z = self.Z[0]            # (nloc, k)
+        AZ = self.AZ[0]
+        w = self.Einv @ lax.psum(Z.T @ r, ROWS_AXIS)     # (k,)
+        z = self.base.shard_apply(r - AZ @ w)
+        return z + Z @ w
+
+
+def constant_deflation(n: int, nd: int) -> np.ndarray:
+    """One indicator vector per subdomain (reference: constant_deflation)."""
+    nloc = -(-n // nd)
+    Z = np.zeros((nloc * nd, nd))
+    for d in range(nd):
+        Z[d * nloc:min((d + 1) * nloc, n), d] = 1.0
+    return Z[:n]
+
+
+def linear_deflation(coords: np.ndarray, nd: int) -> np.ndarray:
+    """[1, x, y, ...] per subdomain from point coordinates (reference:
+    linear_deflation)."""
+    n, dim = coords.shape
+    nloc = -(-n // nd)
+    k = dim + 1
+    Z = np.zeros((n, nd * k))
+    for d in range(nd):
+        lo, hi = d * nloc, min((d + 1) * nloc, n)
+        if hi <= lo:
+            continue
+        Z[lo:hi, d * k] = 1.0
+        c = coords[lo:hi]
+        c = c - c.mean(axis=0, keepdims=True)
+        Z[lo:hi, d * k + 1:d * k + 1 + dim] = c
+    return Z
+
+
+class DistDeflatedSolver(DistAMGSolver):
+    """Subdomain-deflated distributed AMG-Krylov. ``deflation`` is
+    'constant', or an explicit (n, k) matrix of deflation vectors."""
+
+    def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
+                 solver: Any = None, deflation="constant"):
+        super().__init__(A, mesh, prm, solver)
+        A = self.host_amg.host_levels[0][0]
+        n = self.n
+        nd = mesh.shape[ROWS_AXIS]
+        nloc = self.n_pad // nd
+        if isinstance(deflation, str):
+            if deflation != "constant":
+                raise ValueError("deflation must be 'constant' or a matrix")
+            Z = constant_deflation(n, nd)
+        else:
+            Z = np.asarray(deflation, dtype=np.float64)
+            if Z.ndim == 1:
+                Z = Z[:, None]
+        k = Z.shape[1]
+        AZ = np.stack([A.spmv(Z[:, j]) for j in range(k)], axis=1)
+        E = Z.T @ AZ
+        Einv = np.linalg.pinv(E)
+
+        dtype = self.prm.dtype
+        sh = NamedSharding(mesh, P(ROWS_AXIS, None, None))
+
+        def panel(M):
+            pad = np.zeros((self.n_pad, k))
+            pad[:n] = M
+            return jax.device_put(
+                jnp.asarray(pad.reshape(nd, nloc, k), dtype=dtype), sh)
+
+        self.hier = DeflatedDistHierarchy(
+            self.hier, panel(Z), panel(AZ),
+            jnp.asarray(Einv, dtype=dtype))
+        self._compiled = None
+
+    def __repr__(self):
+        return "DistDeflatedSolver(k=%d)\n%r" % (
+            self.hier.Einv.shape[0], self.host_amg)

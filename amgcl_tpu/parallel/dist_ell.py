@@ -1,0 +1,180 @@
+"""General distributed sparse matrix: row-block partition + static halo plan.
+
+The TPU-native rendition of the reference's ``comm_pattern`` +
+``distributed_matrix`` (amgcl/mpi/distributed_matrix.hpp:50-557): the
+one-time handshake that discovers which remote values each rank needs
+becomes a host-side plan built at setup; the per-iteration Isend/Irecv
+exchange becomes one ``lax.all_to_all`` over the mesh axis; and the
+local/remote SpMV split is preserved so XLA can overlap the collective with
+the local product (the reference's start_exchange → local spmv →
+finish_exchange → remote spmv, amgcl/mpi/distributed_matrix.hpp:520-534).
+
+Everything is static at trace time: the plan is baked into padded index
+arrays, so the whole solve compiles to one SPMD program.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.parallel.mesh import ROWS_AXIS
+
+
+@register_pytree_node_class
+class DistEllMatrix:
+    """Row-block sharded matrix with a static halo plan.
+
+    Arrays carry a leading shard dimension sharded over the ``rows`` axis:
+      loc_cols/loc_vals: (nd, nloc, K1) — column indices local to the shard
+      rem_cols/rem_vals: (nd, nloc, K2) — column indices into the halo buffer
+      send_idx:          (nd, nd, C)    — per-destination local indices
+    Inside ``shard_map`` each shard sees the leading dim as 1.
+    """
+
+    def __init__(self, loc_cols, loc_vals, rem_cols, rem_vals, send_idx,
+                 shape, nloc, ncloc):
+        self.loc_cols = loc_cols
+        self.loc_vals = loc_vals
+        self.rem_cols = rem_cols
+        self.rem_vals = rem_vals
+        self.send_idx = send_idx
+        self.shape = (int(shape[0]), int(shape[1]))   # padded global shape
+        self.nloc = int(nloc)      # owned rows per shard
+        self.ncloc = int(ncloc)    # owned columns per shard (input partition)
+
+    def tree_flatten(self):
+        return ((self.loc_cols, self.loc_vals, self.rem_cols, self.rem_vals,
+                 self.send_idx),
+                (self.shape, self.nloc, self.ncloc))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def specs(self):
+        """PartitionSpec pytree matching tree structure (leading dim is the
+        shard axis)."""
+        s = P(ROWS_AXIS, None, None)
+        return DistEllMatrix(s, s, s, s, P(ROWS_AXIS, None, None),
+                             self.shape, self.nloc, self.ncloc)
+
+    # -- device kernel (inside shard_map) ----------------------------------
+
+    def shard_mv(self, x_local):
+        """Overlapped halo SpMV for the shard-local slice of the pytree
+        (leading dims == 1). x_local: (ncloc,) owned input values."""
+        send = jnp.take(x_local, self.send_idx[0], axis=0)   # (nd, C)
+        recv = lax.all_to_all(send, ROWS_AXIS, 0, 0, tiled=False)
+        halo = recv.reshape(-1)
+        y_loc = _ell_mv(self.loc_cols[0], self.loc_vals[0], x_local)
+        y_rem = _ell_mv(self.rem_cols[0], self.rem_vals[0], halo)
+        return y_loc + y_rem
+
+
+def _ell_mv(cols, vals, x):
+    return jnp.einsum("nk,nk->n", vals, jnp.take(x, cols, axis=0),
+                      preferred_element_type=jnp.result_type(vals.dtype,
+                                                             x.dtype))
+
+
+def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
+    """Partition a host CSR over the mesh's ``rows`` axis and bake the halo
+    plan. Rectangular operators (transfers) partition rows and columns
+    independently into equal blocks, so P/R between two sharded levels just
+    work."""
+    assert not A.is_block, "distribute the unblocked matrix"
+    nd = mesh.shape[ROWS_AXIS]
+    n, m = A.shape
+    nloc = -(-n // nd)
+    ncloc = -(-m // nd)
+
+    rows = np.repeat(np.arange(n), A.row_nnz())
+    owner = np.minimum(A.col // ncloc, nd - 1).astype(np.int64)
+    row_shard = np.minimum(rows // nloc, nd - 1).astype(np.int64)
+    is_local = owner == row_shard
+
+    # halo needs: for each (dst, src) pair the sorted unique global columns.
+    # One lexsort/group-by over the remote entries only — O(nnz_rem log),
+    # independent of the device count.
+    rem = np.flatnonzero(~is_local)
+    key_dst = row_shard[rem]
+    key_src = owner[rem]
+    key_col = A.col[rem].astype(np.int64)
+    trip = np.unique(
+        (key_dst * nd + key_src) * (ncloc * nd) + key_col)
+    t_pair = trip // (ncloc * nd)
+    t_dst = t_pair // nd
+    t_src = t_pair % nd
+    t_col = trip % (ncloc * nd)
+    # rank within each (dst, src) group (columns are sorted inside groups)
+    grp_start = np.concatenate(
+        [[True], t_pair[1:] != t_pair[:-1]]) if len(trip) else \
+        np.zeros(0, bool)
+    grp_idx = np.arange(len(trip)) - np.maximum.accumulate(
+        np.where(grp_start, np.arange(len(trip)), 0)) if len(trip) else \
+        np.zeros(0, np.int64)
+    C = int(grp_idx.max()) + 1 if len(trip) else 1
+
+    send_idx = np.zeros((nd, nd, C), dtype=np.int32)
+    send_idx[t_src, t_dst, grp_idx] = (t_col - t_src * ncloc).astype(np.int32)
+
+    # remote column -> halo buffer position (per dst shard):
+    # buffer layout = concat over src of C padded slots
+    halo_pos = {}
+    for j in range(len(trip)):
+        halo_pos[(int(t_dst[j]), int(t_col[j]))] = \
+            int(t_src[j]) * C + int(grp_idx[j])
+
+    # per-shard ELL packing
+    K1 = 1
+    K2 = 1
+    loc_lists = []
+    rem_lists = []
+    for s in range(nd):
+        # clamp: trailing shards may lie entirely in the padded range
+        r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
+        lo, hi = int(A.ptr[r0]), int(A.ptr[r1])
+        rr = rows[lo:hi] - r0
+        cc = A.col[lo:hi]
+        vv = A.val[lo:hi]
+        lm = is_local[lo:hi]
+        loc_lists.append((rr[lm], cc[lm] - s * ncloc, vv[lm]))
+        rposs = np.asarray([halo_pos[(s, int(c))] for c in cc[~lm]],
+                           dtype=np.int32)
+        rem_lists.append((rr[~lm], rposs, vv[~lm]))
+        if len(rr[lm]):
+            K1 = max(K1, int(np.bincount(rr[lm]).max()))
+        if len(rr[~lm]):
+            K2 = max(K2, int(np.bincount(rr[~lm]).max()))
+
+    def pack(lists, K):
+        cols = np.zeros((nd, nloc, K), dtype=np.int32)
+        vals = np.zeros((nd, nloc, K), dtype=np.float64)
+        for s, (rr, cc, vv) in enumerate(lists):
+            if len(rr) == 0:
+                continue
+            order = np.argsort(rr, kind="stable")
+            rr, cc, vv = rr[order], cc[order], vv[order]
+            pos = np.arange(len(rr)) - np.concatenate(
+                [[0], np.cumsum(np.bincount(rr, minlength=nloc))[:-1]]
+            )[rr]
+            cols[s, rr, pos] = cc
+            vals[s, rr, pos] = vv
+        return cols, vals
+
+    lc, lv = pack(loc_lists, K1)
+    rc, rv = pack(rem_lists, K2)
+
+    mat_sharding = NamedSharding(mesh, P(ROWS_AXIS, None, None))
+    put = lambda a, dt: jax.device_put(jnp.asarray(a, dtype=dt), mat_sharding)
+    return DistEllMatrix(
+        put(lc, jnp.int32), put(lv, dtype), put(rc, jnp.int32),
+        put(rv, dtype),
+        jax.device_put(jnp.asarray(send_idx), mat_sharding),
+        (nloc * nd, ncloc * nd), nloc, ncloc)

@@ -19,8 +19,18 @@ from amgcl_tpu.ops.csr import CSR
 
 _MAGIC = b"AMGTPU1\x00"
 _DTYPES = {0: np.float64, 1: np.float32, 2: np.complex128, 3: np.int32,
-           4: np.int64}
+           4: np.int64, 5: np.complex64, 6: np.float16}
 _DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+def _storable(a: np.ndarray) -> np.ndarray:
+    """Cast exotic accelerator dtypes (bfloat16, ...) to the nearest
+    storable numpy dtype instead of raising KeyError mid-save."""
+    if np.dtype(a.dtype) in _DTYPE_CODES:
+        return a
+    if np.issubdtype(np.asarray(a).dtype, np.complexfloating):
+        return a.astype(np.complex128)
+    return a.astype(np.float32)
 
 
 # -- MatrixMarket -----------------------------------------------------------
@@ -60,13 +70,13 @@ def write_binary(path, m):
             f.write(struct.pack("<qq", m.nrows, m.ncols))
             br, bc = m.block_size
             f.write(struct.pack("<qq", br, bc))
-            for arr in (m.ptr.astype(np.int64),
-                        m.col.astype(np.int32), np.ascontiguousarray(m.val)):
+            for arr in (m.ptr.astype(np.int64), m.col.astype(np.int32),
+                        _storable(np.ascontiguousarray(m.val))):
                 code = _DTYPE_CODES[np.dtype(arr.dtype)]
                 f.write(struct.pack("<Bq", code, arr.size))
                 f.write(arr.tobytes())
         else:
-            a = np.ascontiguousarray(m)
+            a = _storable(np.ascontiguousarray(m))
             f.write(struct.pack("<B", 0))                    # kind: dense
             f.write(struct.pack("<B", a.ndim))
             f.write(struct.pack("<%dq" % a.ndim, *a.shape))

@@ -1,0 +1,134 @@
+// Native setup-phase kernels for amgcl_tpu.
+//
+// The AMG hierarchy is constructed on the host (SURVEY.md: the reference
+// builds on CPU and moves the hierarchy to the backend); these kernels are
+// the hot host-side passes, exposed over a plain C ABI and loaded with
+// ctypes. Everything here is a fresh implementation of standard algorithms
+// (Vanek-style greedy aggregation, strength filtering, CSR transpose) — not
+// a translation of the reference sources.
+//
+// Build: g++ -O3 -march=native -fopenmp -shared -fPIC \
+//            -o libamgcl_tpu_native.so setup_kernels.cpp
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#ifdef _OPENMP
+#include <omp.h>
+#endif
+
+extern "C" {
+
+// Greedy distance-2 aggregation over a strength mask.
+//
+// ptr/col: CSR pattern of A (n rows); strong: per-entry 0/1 strength flag
+// (diagonal entries must be 0). agg (out, size n): aggregate id per node or
+// -1 for nodes with no strong connections. Returns the number of
+// aggregates.
+//
+// Sweep: visiting nodes in index order, a node that was never claimed
+// becomes the root of a new aggregate, finalizes all its unclaimed or
+// tentatively-claimed strong neighbors, and tentatively claims their
+// neighbors (a later root may steal tentative nodes as its own distance-1
+// members; leftover tentative nodes keep the aggregate that claimed them).
+int64_t aggregate_d2(int64_t n, const int64_t* ptr, const int32_t* col,
+                     const uint8_t* strong, int64_t* agg) {
+  const int64_t kUnset = -3, kTentative = -2, kIsolated = -1;
+  std::vector<int64_t> owner(n, kUnset);  // tentative owner id
+  for (int64_t i = 0; i < n; ++i) {
+    bool has_strong = false;
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
+      if (strong[j]) { has_strong = true; break; }
+    agg[i] = has_strong ? kUnset : kIsolated;
+  }
+
+  int64_t count = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    if (agg[i] != kUnset) continue;
+    if (owner[i] != kUnset) continue;  // tentatively claimed: not a root
+    const int64_t id = count++;
+    agg[i] = id;
+    // finalize strong neighbors
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      if (!strong[j]) continue;
+      const int32_t c = col[j];
+      if (agg[c] == kUnset) {
+        agg[c] = id;
+        // tentatively claim the neighbors' neighbors
+        for (int64_t k = ptr[c]; k < ptr[c + 1]; ++k) {
+          if (!strong[k]) continue;
+          const int32_t cc = col[k];
+          if (agg[cc] == kUnset && owner[cc] == kUnset) owner[cc] = id;
+        }
+      }
+    }
+  }
+  // leftover tentatives keep their claiming aggregate
+  for (int64_t i = 0; i < n; ++i)
+    if (agg[i] == kUnset) agg[i] = owner[i] != kUnset ? owner[i] : kIsolated;
+
+  // aggregates can lose every finalized member only if they never had one;
+  // compress ids to be safe (cheap single pass)
+  std::vector<int64_t> seen(count, 0);
+  for (int64_t i = 0; i < n; ++i)
+    if (agg[i] >= 0) seen[agg[i]] = 1;
+  std::vector<int64_t> remap(count, -1);
+  int64_t live = 0;
+  for (int64_t a = 0; a < count; ++a)
+    if (seen[a]) remap[a] = live++;
+  if (live != count)
+    for (int64_t i = 0; i < n; ++i)
+      if (agg[i] >= 0) agg[i] = remap[agg[i]];
+  return live;
+}
+
+// Per-entry strength flag: |a_ij|^2 > eps^2 * |a_ii * a_jj| (off-diagonal).
+void strength_mask(int64_t n, const int64_t* ptr, const int32_t* col,
+                   const double* val, double eps, uint8_t* strong) {
+  std::vector<double> dia(n, 0.0);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
+      if (col[j] == i) dia[i] = val[j] < 0 ? -val[j] : val[j];
+  const double e2 = eps * eps;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      const int32_t c = col[j];
+      strong[j] =
+          (c != i) && (val[j] * val[j] > e2 * dia[i] * dia[c]) ? 1 : 0;
+    }
+}
+
+// Symmetrize a 0/1 strength mask in place: strong[i->j] |= strong[j->i].
+// Requires sorted column indices per row (binary search on the reverse
+// entry).
+void symmetrize_mask(int64_t n, const int64_t* ptr, const int32_t* col,
+                     uint8_t* strong) {
+#pragma omp parallel for schedule(dynamic, 1024)
+  for (int64_t i = 0; i < n; ++i) {
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      if (strong[j]) continue;
+      const int32_t c = col[j];
+      // find (c, i)
+      int64_t lo = ptr[c], hi = ptr[c + 1];
+      while (lo < hi) {
+        const int64_t mid = (lo + hi) / 2;
+        if (col[mid] < i) lo = mid + 1; else hi = mid;
+      }
+      if (lo < ptr[c + 1] && col[lo] == (int32_t)i && strong[lo])
+        strong[j] = 1;
+    }
+  }
+}
+
+int omp_max_threads() {
+#ifdef _OPENMP
+  return omp_get_max_threads();
+#else
+  return 1;
+#endif
+}
+
+}  // extern "C"
