@@ -1,0 +1,37 @@
+"""Rigid-body near-nullspace for elasticity problems: 3 modes in 2D
+(two translations + rotation), 6 in 3D (reference:
+amgcl/coarsening/rigid_body_modes.hpp, used by the Nullspace tutorial)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def rigid_body_modes(coords: np.ndarray) -> np.ndarray:
+    """coords: (n_points, ndim) with ndim in {2, 3}. Returns the nullspace
+    matrix B of shape (n_points * ndim, 3 or 6), ordered per-point
+    (displacement dofs interleaved), columns orthonormalized."""
+    coords = np.asarray(coords, dtype=np.float64)
+    n, dim = coords.shape
+    c = coords - coords.mean(axis=0, keepdims=True)
+    if dim == 2:
+        B = np.zeros((2 * n, 3))
+        B[0::2, 0] = 1.0                      # x translation
+        B[1::2, 1] = 1.0                      # y translation
+        B[0::2, 2] = -c[:, 1]                 # rotation
+        B[1::2, 2] = c[:, 0]
+    elif dim == 3:
+        B = np.zeros((3 * n, 6))
+        for d in range(3):
+            B[d::3, d] = 1.0                  # translations
+        x, y, z = c[:, 0], c[:, 1], c[:, 2]
+        B[1::3, 3] = -z                        # rotation about x
+        B[2::3, 3] = y
+        B[0::3, 4] = z                         # rotation about y
+        B[2::3, 4] = -x
+        B[0::3, 5] = -y                        # rotation about z
+        B[1::3, 5] = x
+    else:
+        raise ValueError("coords must be 2D or 3D")
+    q, _ = np.linalg.qr(B)
+    return q

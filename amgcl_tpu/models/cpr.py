@@ -1,0 +1,131 @@
+"""CPR — constrained pressure residual preconditioner for reservoir-type
+block systems (reference: amgcl/preconditioner/cpr.hpp:45-561, cpr_drs
+variant amgcl/preconditioner/cpr_drs.hpp).
+
+Two-stage apply on a cell-block system (pressure is unknown 0 of each
+b-sized cell block):
+
+  1. pressure stage: restrict the residual with per-cell decoupling weights
+     (quasi-IMPES: first row of each diagonal block's inverse; DRS: dynamic
+     row-sum weights), solve the extracted pressure matrix App with AMG,
+     prolong the correction back into the pressure slots;
+  2. global stage: one application of a global smoother on the full system.
+
+All device work is batched small-dense algebra (the weight contraction is an
+(n_cells, b)·(n_cells, b) einsum) plus the usual SpMVs — MXU/VPU-friendly.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.relaxation.spai0 import Spai0
+
+
+@register_pytree_node_class
+class CPRHierarchy:
+    def __init__(self, A_full, W, p_hier, smoother, block):
+        self.A_full = A_full
+        self.W = W               # (n_cells, b) decoupling weights
+        self.p_hier = p_hier
+        self.smoother = smoother
+        self.block = int(block)
+
+    def tree_flatten(self):
+        return (self.A_full, self.W, self.p_hier, self.smoother), (self.block,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    def apply(self, r):
+        b = self.block
+        rb = r.reshape(-1, b)
+        rp = jnp.einsum("nb,nb->n", self.W, rb)
+        dp = self.p_hier.apply(rp)
+        x = jnp.zeros_like(rb).at[:, 0].set(dp).reshape(r.shape)
+        # global smoothing of the remaining residual
+        s = self.smoother.apply(self.A_full, r - dev.spmv(self.A_full, x))
+        return x + s
+
+    @property
+    def system_matrix(self):
+        return self.A_full
+
+
+def _pressure_matrix(A: CSR, W: np.ndarray) -> CSR:
+    """App_ij = w_i · A_ij[:, 0] over the block pattern."""
+    app = np.einsum("eb,eb->e",
+                    W[np.repeat(np.arange(A.nrows), A.row_nnz())],
+                    A.val[:, :, 0])
+    return CSR(A.ptr.copy(), A.col.copy(), app, A.ncols)
+
+
+class CPR:
+    """make_solver-compatible preconditioner; ``A`` is a block CSR (or a
+    scalar CSR plus ``block_size``)."""
+
+    weighting = "quasi_impes"
+
+    def __init__(self, A, block_size: Optional[int] = None,
+                 pressure_prm: Optional[AMGParams] = None,
+                 relax: Any = None, dtype=jnp.float32, **wkw):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        if not A.is_block:
+            if not block_size or block_size < 2:
+                raise ValueError("CPR needs a block system (block_size >= 2)")
+            A = A.to_block(block_size)
+        self.A_host = A
+        self.dtype = dtype
+        b = A.block_size[0]
+        W = self._weights(A, **wkw)
+        App = _pressure_matrix(A, W)
+        pprm = pressure_prm or AMGParams(dtype=dtype)
+        self.p_amg = AMG(App, pprm)
+        smoother = (relax or Spai0()).build(A, dtype)
+        self.hierarchy = CPRHierarchy(
+            dev.to_device(A, "ell", dtype),
+            jnp.asarray(W, dtype=dtype),
+            self.p_amg.hierarchy, smoother, b)
+
+    def _weights(self, A: CSR, **kw) -> np.ndarray:
+        """Quasi-IMPES: first row of each diagonal block's inverse
+        (decouples the pressure equation from the other unknowns)."""
+        Dinv = A.diagonal(invert=True)
+        return Dinv[:, 0, :]
+
+    def __repr__(self):
+        return "cpr(%s)\n[ P ]\n%r" % (self.weighting, self.p_amg)
+
+
+class CPRDRS(CPR):
+    """CPR with dynamic row-sum weights (reference: cpr_drs.hpp): instead of
+    the diagonal-block inverse, the pressure equation is formed from a
+    weighted sum of the cell's equations, with weights from the column sums
+    of each unknown over the cell row — rows whose pressure coupling is not
+    diagonally dominated (ratio below ``eps_dd``) fall back to the plain
+    first-equation extraction."""
+
+    weighting = "drs"
+
+    def _weights(self, A: CSR, eps_dd: float = 0.2, **kw) -> np.ndarray:
+        b = A.block_size[0]
+        n = A.nrows
+        rows = np.repeat(np.arange(n), A.row_nnz())
+        # column sums per unknown over each cell row: how strongly each
+        # in-cell equation couples to global pressure
+        colsum = np.zeros((n, b))
+        np.add.at(colsum, rows, np.abs(A.val[:, :, 0]))
+        dia = np.abs(A.diagonal()[:, :, 0])
+        dd = dia / np.where(colsum > 0, colsum, 1.0)
+        w = np.where(dd >= eps_dd, 1.0, 0.0)
+        w[:, 0] = 1.0                       # always keep the pressure row
+        return w

@@ -33,11 +33,12 @@ from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.relaxation.spai1 import Spai1
 from amgcl_tpu.relaxation.chebyshev import Chebyshev
 from amgcl_tpu.relaxation.gauss_seidel import GaussSeidel
-from amgcl_tpu.relaxation.ilu0 import ILU0, ILUP
+from amgcl_tpu.relaxation.ilu0 import ILU0, ILUP, ILUT
 from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
 from amgcl_tpu.coarsening.aggregation import Aggregation
 from amgcl_tpu.coarsening.ruge_stuben import RugeStuben
 from amgcl_tpu.coarsening.as_scalar import AsScalar
+from amgcl_tpu.coarsening.smoothed_aggr_emin import SmoothedAggrEMin
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.models.make_solver import make_solver
 from amgcl_tpu.models.preconditioner import AsPreconditioner, \
@@ -53,11 +54,13 @@ RELAXATION = {
     "damped_jacobi": DampedJacobi, "spai0": Spai0, "spai1": Spai1,
     "chebyshev": Chebyshev, "gauss_seidel": GaussSeidel, "ilu0": ILU0,
     "ilup": ILUP, "iluk": ILUP,   # iluk maps to the A^p-pattern variant
+    "ilut": ILUT,
 }
 
 COARSENING = {
     "smoothed_aggregation": SmoothedAggregation, "aggregation": Aggregation,
     "ruge_stuben": RugeStuben, "as_scalar": AsScalar,
+    "smoothed_aggr_emin": SmoothedAggrEMin,
 }
 
 DTYPES = {

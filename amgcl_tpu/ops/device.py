@@ -64,6 +64,9 @@ class DiaMatrix:
 
     def mv(self, x):
         n, m = self.shape
+        from amgcl_tpu.ops.pallas_spmv import pallas_enabled, dia_spmv
+        if pallas_enabled() and jax.default_backend() == "tpu":
+            return dia_spmv(self.offsets, self.data, x)
         lo = min(self.offsets + (0,))
         # each diagonal d reads xp[base+d : base+d+n); pad the tail so the
         # slice stays in range even for tall (nrows > ncols) matrices —

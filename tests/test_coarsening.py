@@ -5,6 +5,7 @@ import numpy as np
 import jax.numpy as jnp
 import pytest
 
+from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.models.make_solver import make_solver
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.solver.cg import CG
@@ -81,3 +82,39 @@ def test_setup_does_not_mutate_input(coarsening_factory):
     assert np.array_equal(A.ptr, ptr)
     assert np.array_equal(A.col, col)
     assert np.array_equal(A.val, val)
+
+
+def test_smoothed_aggr_emin():
+    from amgcl_tpu.coarsening.smoothed_aggr_emin import SmoothedAggrEMin
+    A, rhs = poisson3d(14)
+    solve = make_solver(
+        A, AMGParams(coarsening=SmoothedAggrEMin(), dtype=jnp.float64,
+                     coarse_enough=400),
+        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    assert info.iters < 40
+
+
+def test_rigid_body_modes_nullspace():
+    """2D elasticity-style: vector Laplacian with rigid-body nullspace."""
+    import scipy.sparse as sp
+    from amgcl_tpu.coarsening.rigid_body_modes import rigid_body_modes
+    n = 14
+    T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                 [-1, 0, 1])
+    L = (sp.kron(sp.identity(n), T) + sp.kron(T, sp.identity(n))).tocsr()
+    K = sp.kron(L, np.eye(2)).tocsr()      # interleaved 2D displacement
+    g = np.arange(n, dtype=float)
+    X, Y = np.meshgrid(g, g, indexing="ij")
+    coords = np.stack([X.ravel(), Y.ravel()], axis=1)
+    B = rigid_body_modes(coords)
+    assert B.shape == (2 * n * n, 3)
+    solve = make_solver(
+        CSR.from_scipy(K),
+        AMGParams(coarsening=SmoothedAggregation(nullspace=B),
+                  dtype=jnp.float64, coarse_enough=300),
+        CG(maxiter=200, tol=1e-8))
+    rhs = np.ones(2 * n * n)
+    x, info = solve(rhs)
+    assert info.resid < 1e-8

@@ -1,0 +1,88 @@
+"""Coupled-physics preconditioners: Schur pressure correction and CPR."""
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.models.schur import SchurPressureCorrection
+from amgcl_tpu.models.cpr import CPR, CPRDRS
+from amgcl_tpu.solver.gmres import FGMRES
+from amgcl_tpu.solver.bicgstab import BiCGStab
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+
+def stokes_like(n):
+    """Stabilized Stokes-type saddle point: [A Bt; B -eps M] with A the
+    2D vector Laplacian and B a discrete divergence."""
+    T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                 [-1, 0, 1])
+    L = (sp.kron(sp.identity(n), T) + sp.kron(T, sp.identity(n))).tocsr()
+    nu = L.shape[0]
+    A = sp.block_diag([L, L]).tocsr()            # two velocity components
+    D = sp.diags([-np.ones(nu - 1), np.ones(nu)], [-1, 0],
+                 shape=(nu, nu))
+    B = sp.hstack([D, 0.5 * D]).tocsr()          # (np_, 2nu)
+    eps = 1e-2
+    M = sp.identity(nu) * eps
+    K = sp.bmat([[A, B.T], [B, -M]]).tocsr()
+    pmask = np.zeros(K.shape[0], dtype=bool)
+    pmask[2 * nu:] = True
+    return CSR.from_scipy(K), pmask
+
+
+def test_schur_pressure_correction():
+    A, pmask = stokes_like(12)
+    rhs = np.ones(A.nrows)
+    pre = SchurPressureCorrection(
+        A, pmask,
+        usolver_prm=AMGParams(dtype=jnp.float64, coarse_enough=100),
+        psolver_prm=AMGParams(dtype=jnp.float64, coarse_enough=100),
+        dtype=jnp.float64)
+    solve = make_solver(A, pre, FGMRES(maxiter=300, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+    assert "schur" in repr(pre)
+
+
+def reservoir_like(n, b=3):
+    """Block system with a Poisson-ish pressure coupling plus local
+    saturation equations per cell."""
+    Ap, _ = poisson3d(n)
+    m = Ap.to_scipy()
+    nc = m.shape[0]
+    K = sp.kron(m, np.eye(b)).tocsr()
+    # couple saturations to pressure inside each cell and make the
+    # saturation equations strongly diagonal
+    rows = np.concatenate([np.arange(nc) * b + k for k in range(1, b)])
+    extra = sp.csr_matrix(
+        (np.full(len(rows), 0.3), (rows, (rows // b) * b)),
+        shape=K.shape)
+    diag = sp.csr_matrix(
+        (np.full(len(rows), float(nc)), (rows, rows)), shape=K.shape)
+    M = (K + extra + diag).tocsr()
+    return CSR.from_scipy(M).to_block(b), np.ones(nc * b)
+
+
+@pytest.mark.parametrize("cls", [CPR, CPRDRS])
+def test_cpr(cls):
+    A, rhs = reservoir_like(8, 3)
+    pre = cls(A, pressure_prm=AMGParams(dtype=jnp.float64,
+                                        coarse_enough=100),
+              dtype=jnp.float64)
+    solve = make_solver(A, pre, BiCGStab(maxiter=200, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_cpr_rejects_scalar():
+    A, _ = poisson3d(6)
+    with pytest.raises(ValueError, match="block"):
+        CPR(A)

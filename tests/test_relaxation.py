@@ -122,3 +122,13 @@ def test_ilu0_block_matrix():
     r = A.spmv(e)
     z = st.apply(Ad, jnp.asarray(r))
     assert np.linalg.norm(e - np.asarray(z)) < 0.9 * np.linalg.norm(e)
+
+
+def test_ilut():
+    from amgcl_tpu.relaxation.ilu0 import ILUT
+    A, rhs = convection_diffusion_2d(20, eps=0.05)
+    solve = make_solver(
+        A, AMGParams(relax=ILUT(p=2, tau=1e-2), dtype=jnp.float64),
+        BiCGStab(maxiter=200, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
