@@ -81,9 +81,14 @@ def _filtered(A: CSR, eps_strong: float):
     rows = np.repeat(np.arange(A.nrows), A.row_nnz())
     strong = (np.abs(A.val) ** 2 > eps_strong ** 2 * d[rows] * d[A.col]) \
         | (rows == A.col)
-    # lump removed entries onto the diagonal
-    removed_sum = np.zeros(A.nrows, dtype=A.val.dtype)
-    np.add.at(removed_sum, rows[~strong], A.val[~strong])
+    # lump removed entries onto the diagonal (bincount: ~10x np.add.at)
+    weak = ~strong
+    removed_sum = np.bincount(
+        rows[weak], weights=A.val[weak].real, minlength=A.nrows
+    ).astype(A.val.dtype)
+    if np.iscomplexobj(A.val):
+        removed_sum = removed_sum + 1j * np.bincount(
+            rows[weak], weights=A.val[weak].imag, minlength=A.nrows)
     Af = A.filter_rows(strong)
     dia_mask = np.repeat(np.arange(Af.nrows), Af.row_nnz()) == Af.col
     Af.val = Af.val.copy()

@@ -157,7 +157,31 @@ class AMG:
             Acur = Ac
         host.append((Acur, None, None))
         self.host_levels = host
+        self._coarse_op = coarsening.coarse_operator
+        self._to_device_levels()
 
+    def rebuild(self, A: CSR):
+        """Fast rebuild for time-dependent problems: the matrix VALUES
+        changed but the structure (and thus the transfer operators) are
+        reused — only the Galerkin products, smoother states, and device
+        transfers are redone (reference: amg::rebuild, amgcl/amg.hpp:229-269
+        with allow_rebuild)."""
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        if A.shape != self.host_levels[0][0].shape:
+            raise ValueError("rebuild requires the same matrix dimensions")
+        host = []
+        Acur = A
+        for (_, P, R) in self.host_levels[:-1]:
+            host.append((Acur, P, R))
+            Acur = self._coarse_op(Acur, P, R)
+        host.append((Acur, None, None))
+        self.host_levels = host
+        self._to_device_levels()
+
+    def _to_device_levels(self):
+        prm = self.prm
+        host = self.host_levels
         dtype = prm.dtype
         dev_levels = []
         for (Ai, P, R) in host[:-1]:

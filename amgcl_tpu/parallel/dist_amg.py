@@ -47,15 +47,62 @@ def _pad_vec(v, nloc, nd, dtype):
 
 
 @register_pytree_node_class
+class DistSmoother:
+    """Sharded smoother state: 'diag' (spai0/jacobi scale per row) or
+    'cheb' (Chebyshev polynomial — SpMV-only, scalars static)."""
+
+    def __init__(self, kind, scale=None, theta=0.0, delta=1.0, degree=0):
+        self.kind = kind
+        self.scale = scale          # (nd, nloc) or None
+        self.theta = float(theta)
+        self.delta = float(delta)
+        self.degree = int(degree)
+
+    def tree_flatten(self):
+        return (self.scale,), (self.kind, self.theta, self.delta,
+                               self.degree)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(aux[0], children[0], *aux[1:])
+
+    def spec(self):
+        return DistSmoother(self.kind,
+                            None if self.scale is None else P(ROWS_AXIS,
+                                                              None),
+                            self.theta, self.delta, self.degree)
+
+    # -- inside shard_map (Aop wraps the level's halo SpMV) ----------------
+
+    def _cheb(self, Aop, f):
+        from amgcl_tpu.relaxation.chebyshev import ChebyshevState
+        dinv = None if self.scale is None else self.scale[0]
+        st = ChebyshevState(dinv, self.degree, self.theta, self.delta,
+                            dinv is not None)
+        return st.apply(Aop, f)
+
+    def apply0(self, Aop, f):
+        """One application from a zero initial guess."""
+        if self.kind == "cheb":
+            return self._cheb(Aop, f)
+        return self.scale[0] * f
+
+    def sweep(self, Aop, f, u):
+        if self.kind == "cheb":
+            return u + self._cheb(Aop, f - Aop.mv(u))
+        return u + self.scale[0] * (f - Aop.mv(u))
+
+
+@register_pytree_node_class
 class DistLevel:
-    def __init__(self, A, P_op, R_op, scale):
+    def __init__(self, A, P_op, R_op, smoother):
         self.A = A
         self.P_op = P_op        # None on the coarsest level
         self.R_op = R_op
-        self.scale = scale      # (nd, nloc) sharded smoother scale
+        self.smoother = smoother
 
     def tree_flatten(self):
-        return (self.A, self.P_op, self.R_op, self.scale), None
+        return (self.A, self.P_op, self.R_op, self.smoother), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -87,7 +134,7 @@ class DistHierarchy:
         lvls = [DistLevel(l.A.specs(),
                           None if l.P_op is None else l.P_op.specs(),
                           None if l.R_op is None else l.R_op.specs(),
-                          P(ROWS_AXIS, None)) for l in self.levels]
+                          l.smoother.spec()) for l in self.levels]
         return DistHierarchy(lvls, None if self.coarse_inv is None else P(),
                              self.npre, self.npost, self.ncycle,
                              self.pre_cycles)
@@ -96,7 +143,8 @@ class DistHierarchy:
 
     def shard_cycle(self, i, f):
         lv = self.levels[i]
-        scale = lv.scale[0]
+        Aop = _LocalOp(lv.A)
+        sm = lv.smoother
         if i == len(self.levels) - 1:
             if self.coarse_inv is not None:
                 full = lax.all_gather(f, ROWS_AXIS, tiled=True)
@@ -104,11 +152,11 @@ class DistHierarchy:
                 s = lax.axis_index(ROWS_AXIS)
                 return lax.dynamic_slice(u_full, (s * f.shape[0],),
                                          (f.shape[0],))
-            return scale * f
+            return sm.apply0(Aop, f)
         if self.npre > 0:
-            u = scale * f
+            u = sm.apply0(Aop, f)
             for _ in range(self.npre - 1):
-                u = u + scale * (f - lv.A.shard_mv(u))
+                u = sm.sweep(Aop, f, u)
         else:
             u = jnp.zeros_like(f)
         r = f - lv.A.shard_mv(u)
@@ -119,7 +167,7 @@ class DistHierarchy:
             uc = uc + self.shard_cycle(i + 1, rc)
         u = u + lv.P_op.shard_mv(uc)
         for _ in range(self.npost):
-            u = u + scale * (f - lv.A.shard_mv(u))
+            u = sm.sweep(Aop, f, u)
         return u
 
     def shard_apply(self, r):
@@ -171,24 +219,36 @@ class DistAMGSolver:
                     Pk.unblock() if Pk.is_block else Pk, mesh, dtype)
                 dR = build_dist_ell(
                     Rk.unblock() if Rk.is_block else Rk, mesh, dtype)
-            # smoother scale: damped-Jacobi/SPAI0-style diagonal state
             st = self.prm.relax.build(Ak, dtype)
-            if hasattr(st, "scale") and np.ndim(st.scale) == 1:
-                scale = np.asarray(st.scale, dtype=np.float64)
+            from amgcl_tpu.relaxation.chebyshev import ChebyshevState
+            if isinstance(st, ChebyshevState):
+                dinv_sh = None
+                if st.scale:
+                    pad = np.zeros(dA.nloc * nd)
+                    pad[:Ak_s.nrows] = np.asarray(st.dinv, dtype=np.float64)
+                    dinv_sh = jax.device_put(
+                        jnp.asarray(pad.reshape(nd, dA.nloc), dtype=dtype),
+                        NamedSharding(mesh, P(ROWS_AXIS, None)))
+                sm = DistSmoother("cheb", dinv_sh, st.theta, st.delta,
+                                  st.degree)
             else:
-                import warnings
-                warnings.warn(
-                    "distributed AMG currently shards diagonal-type "
-                    "smoothers only (spai0/damped_jacobi); %s falls back "
-                    "to damped Jacobi" % type(self.prm.relax).__name__)
-                scale = 0.72 * Ak_s.diagonal(invert=True)
-            pad = np.zeros(dA.nloc * nd)
-            pad[:len(scale)] = scale
-            levels.append(DistLevel(
-                dA, dP, dR,
-                jax.device_put(
-                    jnp.asarray(pad.reshape(nd, dA.nloc), dtype=dtype),
-                    NamedSharding(mesh, P(ROWS_AXIS, None)))))
+                if hasattr(st, "scale") and np.ndim(st.scale) == 1:
+                    scale = np.asarray(st.scale, dtype=np.float64)
+                else:
+                    import warnings
+                    warnings.warn(
+                        "distributed AMG shards diagonal-type and Chebyshev "
+                        "smoothers; %s falls back to damped Jacobi"
+                        % type(self.prm.relax).__name__)
+                    scale = 0.72 * Ak_s.diagonal(invert=True)
+                pad = np.zeros(dA.nloc * nd)
+                pad[:len(scale)] = scale
+                sm = DistSmoother(
+                    "diag",
+                    jax.device_put(
+                        jnp.asarray(pad.reshape(nd, dA.nloc), dtype=dtype),
+                        NamedSharding(mesh, P(ROWS_AXIS, None))))
+            levels.append(DistLevel(dA, dP, dR, sm))
         coarse_inv = None
         if host.hierarchy.coarse is not None:
             inv = np.asarray(host.hierarchy.coarse.inv, dtype=np.float64)

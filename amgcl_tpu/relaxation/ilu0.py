@@ -46,20 +46,29 @@ class ILU0State:
         return cls(*children, aux[0])
 
     def apply(self, A, f):
-        """z ≈ (LU)⁻¹ f. Lower solve: y = f − Ls y, iterated; upper solve:
-        x = Uinv (y − Us x), iterated."""
-        y = f
-        for _ in range(self.jacobi_iters):
-            y = f - dev.spmv(self.Ls, y)
-        x = self.uinv * y
-        for _ in range(self.jacobi_iters):
-            x = self.uinv * (y - dev.spmv(self.Us, x))
-        return x
+        """z ≈ (LU)⁻¹ f via Jacobi-approximate triangular solves."""
+        return ilu_jacobi_solve(
+            lambda v: dev.spmv(self.Ls, v),
+            lambda v: dev.spmv(self.Us, v),
+            self.uinv, self.jacobi_iters, f)
 
     def apply_pre(self, A, f, x):
         return x + self.apply(A, f - dev.spmv(A, x))
 
     apply_post = apply_pre
+
+
+def ilu_jacobi_solve(mv_lower, mv_upper, uinv, iters, f):
+    """Shared approximate (LU)⁻¹ f: lower solve y = f − Ls y iterated, then
+    upper solve x = Uinv (y − Us x) iterated — used by the serial smoother
+    and the distributed additive-Schwarz preconditioner alike."""
+    y = f
+    for _ in range(iters):
+        y = f - mv_lower(y)
+    x = uinv * y
+    for _ in range(iters):
+        x = uinv * (y - mv_upper(x))
+    return x
 
 
 def _chow_patel_build(ptr, col, val, n, sweeps, jacobi_iters, dtype,

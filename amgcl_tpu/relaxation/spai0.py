@@ -39,8 +39,7 @@ class Spai0:
             M[zero_row] = 0.0
             return ScaledResidualSmoother(jnp.asarray(M, dtype=dtype), br)
         rows = np.repeat(np.arange(A.nrows), A.row_nnz())
-        denom = np.zeros(A.nrows, dtype=np.float64)
-        np.add.at(denom, rows, np.abs(A.val.astype(np.complex128)) ** 2
-                  if np.iscomplexobj(A.val) else A.val ** 2)
+        sq = (np.abs(A.val) ** 2).real.astype(np.float64)
+        denom = np.bincount(rows, weights=sq, minlength=A.nrows)
         m = A.diagonal() / np.where(denom != 0, denom, 1.0)
         return ScaledResidualSmoother(jnp.asarray(m, dtype=dtype))

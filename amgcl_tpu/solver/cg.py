@@ -21,17 +21,21 @@ class CG:
     tol: float = 1e-8
     abstol: float = 0.0
 
-    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
+              abstol=None):
         """Returns (x, iters, relative_residual). ``precond`` is a traceable
-        function r -> approximate solution of A z = r."""
+        function r -> approximate solution of A z = r. ``abstol`` may be a
+        traced value (used by iterative refinement to stop correction solves
+        exactly at the global target)."""
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
         r = dev.residual(rhs, A, x)
         norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
         # if ||rhs|| == 0 the solution is x = 0 (reference cg.hpp:144-149)
         norm_scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
-        eps = jnp.maximum(self.tol * norm_scale,
-                          jnp.asarray(self.abstol, rhs.dtype).real)
+        if abstol is None:
+            abstol = jnp.asarray(self.abstol, rhs.dtype).real
+        eps = jnp.maximum(self.tol * norm_scale, abstol)
 
         def cond(state):
             x, r, p, rho_prev, it, res = state

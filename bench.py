@@ -19,6 +19,9 @@ import numpy as np
 
 def main():
     import jax
+    # x64 so the refinement's outer residual really is float64 (the
+    # correction solves stay float32)
+    jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
     from amgcl_tpu.utils.sample_problem import poisson3d
     from amgcl_tpu.models.make_solver import make_solver
@@ -32,7 +35,7 @@ def main():
 
     t0 = time.perf_counter()
     solver = make_solver(A, AMGParams(dtype=jnp.float32),
-                         CG(maxiter=100, tol=1e-6))
+                         CG(maxiter=100, tol=1e-6), refine=3)
     t_setup = time.perf_counter() - t0
 
     rhs_dev = jnp.asarray(rhs, dtype=jnp.float32)

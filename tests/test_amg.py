@@ -125,3 +125,36 @@ def test_rhs_shape_check():
     solve = make_solver(A, AMGParams(dtype=jnp.float64), CG())
     with pytest.raises(ValueError, match="unknowns"):
         solve(np.ones(len(rhs) + 1))
+
+
+def test_refine_reaches_true_tolerance():
+    """f32 hierarchy + f32 CG drifts from the true residual; refinement
+    restarts must recover it."""
+    A, rhs = poisson3d(20)
+    s_plain = make_solver(A, AMGParams(dtype=jnp.float32),
+                          CG(maxiter=100, tol=1e-6))
+    s_ref = make_solver(A, AMGParams(dtype=jnp.float32),
+                        CG(maxiter=100, tol=1e-6), refine=3)
+    x0, _ = s_plain(rhs)
+    x1, info = s_ref(rhs)
+    t0 = np.linalg.norm(rhs - A.spmv(np.asarray(x0, np.float64)))
+    t1 = np.linalg.norm(rhs - A.spmv(np.asarray(x1, np.float64)))
+    nb = np.linalg.norm(rhs)
+    assert t1 / nb <= 2e-6
+    assert t1 <= t0
+
+
+def test_rebuild_fast_path():
+    """allow_rebuild equivalent: same structure, new values."""
+    A, rhs = poisson3d(14)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=300),
+                        CG(maxiter=100, tol=1e-8))
+    x1, i1 = solve(rhs)
+    # scale the operator: structure identical, values changed
+    A2 = CSR(A.ptr.copy(), A.col.copy(), 2.0 * A.val, A.ncols)
+    solve.rebuild(A2)
+    x2, i2 = solve(rhs)
+    assert i2.resid < 1e-8
+    r = rhs - A2.spmv(np.asarray(x2))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+    assert np.allclose(np.asarray(x2), np.asarray(x1) / 2.0, atol=1e-6)

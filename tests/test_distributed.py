@@ -135,3 +135,31 @@ def test_linear_deflation_vectors(mesh8):
                            CG(maxiter=100, tol=1e-8), deflation=Zd)
     x, info = s(rhs)
     assert info.resid < 1e-8
+
+
+def test_block_preconditioner_ras(mesh8):
+    from amgcl_tpu.parallel.block_precond import DistBlockPreconditioner
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(12)
+    s = DistBlockPreconditioner(A, mesh8, CG(maxiter=500, tol=1e-8),
+                                dtype=jnp.float64)
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_dist_chebyshev_smoother(mesh8):
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.relaxation.chebyshev import Chebyshev
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(12)
+    s = DistAMGSolver(A, mesh8,
+                      AMGParams(relax=Chebyshev(), dtype=jnp.float64,
+                                coarse_enough=300),
+                      CG(maxiter=100, tol=1e-8))
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
