@@ -30,6 +30,9 @@ def main(argv=None):
     ap.add_argument("-b", "--block-size", type=int, default=1)
     ap.add_argument("--reorder", action="store_true",
                     help="apply Cuthill-McKee reordering")
+    ap.add_argument("--mesh", type=int, default=0, metavar="N",
+                    help="distributed solve over an N-device mesh "
+                         "(the mpi_solver equivalent; 0 = serial)")
     ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
     ap.add_argument("-x", "--x0", help="initial guess file")
     args = ap.parse_args(argv)
@@ -74,6 +77,17 @@ def main(argv=None):
     def factory(mat):
         if isinstance(mat, CSR) and mat.is_block and args.block_size > 1:
             mat = mat.unblock()
+        if args.mesh:
+            from amgcl_tpu.models.runtime import make_dist_solver_from_config
+            from amgcl_tpu.parallel.mesh import make_mesh
+            if args.block_size > 1:
+                import warnings
+                warnings.warn("--block-size is not supported with --mesh; "
+                              "solving the scalar system")
+            if isinstance(mat, CSR) and mat.is_block:
+                mat = mat.unblock()
+            return make_dist_solver_from_config(
+                mat, make_mesh(args.mesh), args.params, **overrides)
         return make_solver_from_config(mat, args.params,
                                        block_size=args.block_size,
                                        **overrides)

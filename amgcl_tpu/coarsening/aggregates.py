@@ -29,7 +29,7 @@ def strength_graph(A: CSR, eps_strong: float) -> sp.csr_matrix:
     assert not A.is_block
     m = A.to_scipy()
     d = np.abs(A.diagonal())
-    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    rows = A.expanded_rows()
     strong = (np.abs(A.val) ** 2 > eps_strong ** 2 * d[rows] * d[A.col]) \
         & (rows != A.col)
     # copy col/ptr: eliminate_zeros() compacts the arrays in place, and they

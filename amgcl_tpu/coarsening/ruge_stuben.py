@@ -24,7 +24,7 @@ from amgcl_tpu.coarsening.aggregates import _priority
 def _strength_rs(A: CSR, eps: float):
     """Directed RS strength: i strongly depends on j when
     -a_ij >= eps * max_k(-a_ik); returns boolean mask per entry."""
-    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    rows = A.expanded_rows()
     off = rows != A.col
     neg = np.where(off, -A.val.real, 0.0)
     rowmax = np.zeros(A.nrows)

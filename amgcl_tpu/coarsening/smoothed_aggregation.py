@@ -78,7 +78,7 @@ def _filtered(A: CSR, eps_strong: float):
     """(A_f, D_f^{-1}): strength-filtered matrix and its inverted diagonal.
     Weak off-diagonal entries are removed and added to the diagonal."""
     d = np.abs(A.diagonal())
-    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+    rows = A.expanded_rows()
     strong = (np.abs(A.val) ** 2 > eps_strong ** 2 * d[rows] * d[A.col]) \
         | (rows == A.col)
     # lump removed entries onto the diagonal (bincount: ~10x np.add.at)

@@ -63,7 +63,7 @@ class CPRHierarchy:
 def _pressure_matrix(A: CSR, W: np.ndarray) -> CSR:
     """App_ij = w_i · A_ij[:, 0] over the block pattern."""
     app = np.einsum("eb,eb->e",
-                    W[np.repeat(np.arange(A.nrows), A.row_nnz())],
+                    W[A.expanded_rows()],
                     A.val[:, :, 0])
     return CSR(A.ptr.copy(), A.col.copy(), app, A.ncols)
 

@@ -47,9 +47,11 @@ class make_solver:
             A = CSR.from_scipy(A)
         self.A_host = A
         precond = precond if precond is not None else AMGParams()
+        built_from_A = False
         if isinstance(precond, AMGParams):
             self.precond = AMG(A, precond)
             self.precond_dtype = precond.dtype
+            built_from_A = True
         elif hasattr(precond, "hierarchy"):
             # prebuilt preconditioner (AMG, AsPreconditioner, Dummy, ...)
             self.precond = precond
@@ -62,7 +64,19 @@ class make_solver:
         self.solver = solver or CG()
         self.solver_dtype = solver_dtype or self.precond_dtype
         self.refine = int(refine)
-        self.A_dev = dev.to_device(A, matrix_format, self.solver_dtype)
+        self.matrix_format = matrix_format
+        hier_A = getattr(getattr(self.precond, "hierarchy", None),
+                         "system_matrix", None)
+        if (built_from_A and hier_A is not None
+                and self.solver_dtype == self.precond_dtype
+                and matrix_format == "auto"):
+            # the hierarchy's finest-level operator IS this matrix in the
+            # same format/dtype — skip a duplicate device conversion.
+            # (Only when the preconditioner was built from A right here — a
+            # prebuilt preconditioner may wrap a different operator.)
+            self.A_dev = hier_A
+        else:
+            self.A_dev = dev.to_device(A, matrix_format, self.solver_dtype)
         # refinement needs the operator in f64 for the outer residual: the
         # f32 evaluation of b - A x floors around eps32·||A||·||x||/||b||,
         # far above 1e-6 for large stiff systems
@@ -91,9 +105,10 @@ class make_solver:
                             % type(self.precond).__name__)
         self.precond.rebuild(A)
         self.A_host = A
-        self.A_dev = dev.to_device(A, "auto", self.solver_dtype)
+        self.A_dev = dev.to_device(A, self.matrix_format, self.solver_dtype)
         if self.refine > 0:
-            self.A_dev64 = dev.to_device(A, "auto", self._wide_dtype())
+            self.A_dev64 = dev.to_device(A, self.matrix_format,
+                                         self._wide_dtype())
         self._compiled = None
 
     def _wide_dtype(self):

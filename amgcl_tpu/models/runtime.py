@@ -34,6 +34,7 @@ from amgcl_tpu.relaxation.spai1 import Spai1
 from amgcl_tpu.relaxation.chebyshev import Chebyshev
 from amgcl_tpu.relaxation.gauss_seidel import GaussSeidel
 from amgcl_tpu.relaxation.ilu0 import ILU0, ILUP, ILUT
+from amgcl_tpu.relaxation.as_block import AsBlock
 from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
 from amgcl_tpu.coarsening.aggregation import Aggregation
 from amgcl_tpu.coarsening.ruge_stuben import RugeStuben
@@ -54,7 +55,7 @@ RELAXATION = {
     "damped_jacobi": DampedJacobi, "spai0": Spai0, "spai1": Spai1,
     "chebyshev": Chebyshev, "gauss_seidel": GaussSeidel, "ilu0": ILU0,
     "ilup": ILUP, "iluk": ILUP,   # iluk maps to the A^p-pattern variant
-    "ilut": ILUT,
+    "ilut": ILUT, "as_block": AsBlock,
 }
 
 COARSENING = {
@@ -206,6 +207,43 @@ def make_solver_from_config(A, prm=None, block_size: int = 1,
     if pclass == "dummy":
         return make_solver(A, DummyPreconditioner(A, dtype), solver)
     raise ValueError("unknown precond.class %r" % pclass)
+
+
+def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
+    """Distributed runtime composition (the reference's mpi runtime
+    wrappers, amgcl/mpi/preconditioner.hpp): precond.class selects
+    amg (DistAMGSolver), deflated_amg (subdomain deflation), or
+    block (additive-Schwarz ILU)."""
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.parallel.deflation import DistDeflatedSolver
+    from amgcl_tpu.parallel.block_precond import DistBlockPreconditioner
+
+    mesh = mesh or make_mesh()
+    cfg = _as_dict(prm)
+    if flat_overrides:
+        cfg = _deep_merge(cfg, _nest(flat_overrides))
+    pcfg = cfg.get("precond", {})
+    scfg = cfg.get("solver", {})
+    pclass = str(pcfg.get("class", "amg"))
+    solver = solver_from_params(scfg)
+    if pclass == "amg":
+        return DistAMGSolver(A, mesh, precond_params_from_dict(pcfg), solver)
+    if pclass == "deflated_amg":
+        return DistDeflatedSolver(A, mesh, precond_params_from_dict(pcfg),
+                                  solver)
+    if pclass == "block":
+        dtype = pcfg.get("dtype", "float32")
+        dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
+        known = {"class", "dtype", "sweeps", "jacobi_iters"}
+        for k in pcfg:
+            if k not in known:
+                warnings.warn("unknown parameter precond.%s" % k)
+        return DistBlockPreconditioner(
+            A, mesh, solver, dtype,
+            sweeps=int(pcfg.get("sweeps", 5)),
+            jacobi_iters=int(pcfg.get("jacobi_iters", 2)))
+    raise ValueError("unknown distributed precond.class %r" % pclass)
 
 
 def _deep_merge(a: Dict, b: Dict) -> Dict:

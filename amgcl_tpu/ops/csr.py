@@ -68,6 +68,15 @@ class CSR:
     def row_nnz(self) -> np.ndarray:
         return np.diff(self.ptr)
 
+    def expanded_rows(self) -> np.ndarray:
+        """Row index per nonzero (cached — CSR instances are treated as
+        immutable once built; mutate via copy())."""
+        r = getattr(self, "_rows_cache", None)
+        if r is None or len(r) != self.nnz:
+            r = np.repeat(np.arange(self.nrows), self.row_nnz())
+            self._rows_cache = r
+        return r
+
     def copy(self) -> "CSR":
         return CSR(self.ptr.copy(), self.col.copy(), self.val.copy(), self.ncols)
 
@@ -230,6 +239,25 @@ class CSR:
         counts = np.bincount(new_rows, minlength=self.nrows)
         ptr = np.concatenate([[0], np.cumsum(counts)]).astype(np.int64)
         return CSR(ptr, self.col[keep], self.val[keep], self.ncols)
+
+
+def from_row_generator(nrows: int, ncols: int, rowfn) -> CSR:
+    """Matrix-free assembly: build a CSR by generating one row at a time
+    (reference: amgcl/adapter/crs_builder.hpp — the row-generator adapter).
+    ``rowfn(i) -> (cols, vals)``. The generator runs once at setup; the
+    resulting CSR then follows the normal host-build → device path."""
+    ptr = np.zeros(nrows + 1, dtype=np.int64)
+    cols_l = []
+    vals_l = []
+    for i in range(nrows):
+        c, v = rowfn(i)
+        c = np.asarray(c, dtype=np.int32)
+        order = np.argsort(c, kind="stable")
+        cols_l.append(c[order])
+        vals_l.append(np.asarray(v)[order])
+        ptr[i + 1] = ptr[i] + len(c)
+    return CSR(ptr, np.concatenate(cols_l) if cols_l else np.zeros(0, np.int32),
+               np.concatenate(vals_l) if vals_l else np.zeros(0), ncols)
 
 
 # -- spectral radius (builtin.hpp:775-909) ---------------------------------

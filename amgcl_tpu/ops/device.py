@@ -171,7 +171,7 @@ def csr_to_ell(A: CSR, dtype=jnp.float32) -> EllMatrix:
         vals = np.zeros((n, K, br, bc), dtype=A.val.dtype)
     else:
         vals = np.zeros((n, K), dtype=A.val.dtype)
-    rows = np.repeat(np.arange(n), nnz_row)
+    rows = A.expanded_rows()
     pos = np.arange(A.nnz) - A.ptr[rows]
     cols[rows, pos] = A.col
     vals[rows, pos] = A.val
@@ -179,27 +179,50 @@ def csr_to_ell(A: CSR, dtype=jnp.float32) -> EllMatrix:
                      A.shape, A.block_size)
 
 
+def _dia_offsets(A: CSR) -> np.ndarray:
+    """Distinct diagonals of A — cached; cheap enough to query during auto
+    format selection without committing to the full scatter plan."""
+    off = getattr(A, "_dia_offsets_cache", None)
+    if off is None:
+        d = A.col.astype(np.int64) - A.expanded_rows()
+        off = np.unique(d)
+        A._dia_offsets_cache = off
+    return off
+
+
+def _dia_struct(A: CSR):
+    """(offsets, flat scatter positions) for the DIA packing — cached on the
+    matrix so repeated conversions (e.g. f32 + f64 copies of the same
+    operator) skip the O(nnz log) unique/searchsorted."""
+    st = getattr(A, "_dia_struct_cache", None)
+    if st is not None:
+        return st
+    rows = A.expanded_rows()
+    d = A.col.astype(np.int64) - rows
+    offsets = _dia_offsets(A)
+    idx = np.searchsorted(offsets, d)
+    pos = idx * A.nrows + rows
+    A._dia_struct_cache = (offsets, pos)
+    return offsets, pos
+
+
 def csr_to_dia(A: CSR, dtype=jnp.float32) -> DiaMatrix:
     """Pack a host scalar CSR into device DIA format."""
     assert not A.is_block
-    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
-    d = A.col.astype(np.int64) - rows
-    offsets = np.unique(d)
-    idx = np.searchsorted(offsets, d)
+    offsets, pos = _dia_struct(A)
     # single flat scatter instead of 2-D fancy indexing (3-4x faster at
     # tens of millions of nonzeros)
     flat = np.zeros(len(offsets) * A.nrows, dtype=A.val.dtype)
-    flat[idx * A.nrows + rows] = A.val
+    flat[pos] = A.val
     data = flat.reshape(len(offsets), A.nrows)
     return DiaMatrix(offsets.tolist(), jnp.asarray(data, dtype=dtype), A.shape)
 
 
 def dia_efficiency(A: CSR):
     """(ndiags, fill_ratio) for the DIA packing of A — used by auto format
-    selection; fill_ratio = stored / nnz."""
-    rows = np.repeat(np.arange(A.nrows), A.row_nnz())
-    offsets = np.unique(A.col.astype(np.int64) - rows)
-    nd = len(offsets)
+    selection; fill_ratio = stored / nnz. Only the offsets are computed —
+    the O(nnz) scatter plan is built lazily if DIA is actually chosen."""
+    nd = len(_dia_offsets(A))
     fill = nd * A.nrows / max(A.nnz, 1)
     return nd, fill
 

@@ -12,7 +12,7 @@ solves, as in the serial ILU).
 
 from __future__ import annotations
 
-from typing import Any, Optional
+from typing import Any
 
 import numpy as np
 import jax
@@ -21,11 +21,10 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
-from amgcl_tpu.models.make_solver import SolverInfo
 from amgcl_tpu.solver.cg import CG
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
 from amgcl_tpu.parallel.dist_ell import build_dist_ell
-from amgcl_tpu.parallel.dist_amg import DistAMGSolver, _LocalOp
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver
 
 
 @register_pytree_node_class
@@ -83,7 +82,7 @@ class DistBlockPreconditioner(DistAMGSolver):
         self.prm = SimpleNamespace(dtype=dtype)
 
         # block-diagonal part: drop entries crossing shard boundaries
-        rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+        rows = A.expanded_rows()
         same = (rows // nloc) == (A.col // nloc)
         Abd = A.filter_rows(same)
         # keep unit diagonal on padded/empty rows implicitly via udia guard

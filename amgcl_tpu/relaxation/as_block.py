@@ -1,0 +1,22 @@
+"""``as_block``: run a scalar relaxation policy on the unblocked view of a
+block matrix (reference: amgcl/relaxation/as_block.hpp) — lets scalar-only
+smoothers (e.g. SPAI-1) participate in a block-valued hierarchy. Vectors are
+scalar-flat on device either way, so the built state composes directly."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any
+
+import jax.numpy as jnp
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.relaxation.spai0 import Spai0
+
+
+@dataclass
+class AsBlock:
+    base: Any = field(default_factory=Spai0)
+
+    def build(self, A: CSR, dtype=jnp.float32):
+        return self.base.build(A.unblock() if A.is_block else A, dtype)

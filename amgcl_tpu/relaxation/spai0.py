@@ -22,7 +22,7 @@ class Spai0:
             # Block SPAI0: row-wise least squares for block-diagonal M gives
             # M_i · (Σ_j a_ij a_ijᵀ) = a_iiᵀ.
             br = A.block_size[0]
-            rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+            rows = A.expanded_rows()
             G = np.zeros((A.nrows, br, br))
             np.add.at(G, rows, np.einsum("nij,nkj->nik", A.val, A.val))
             dia = A.diagonal()
@@ -38,7 +38,7 @@ class Spai0:
             M = np.swapaxes(M, 1, 2)
             M[zero_row] = 0.0
             return ScaledResidualSmoother(jnp.asarray(M, dtype=dtype), br)
-        rows = np.repeat(np.arange(A.nrows), A.row_nnz())
+        rows = A.expanded_rows()
         sq = (np.abs(A.val) ** 2).real.astype(np.float64)
         denom = np.bincount(rows, weights=sq, minlength=A.nrows)
         m = A.diagonal() / np.where(denom != 0, denom, 1.0)

@@ -114,3 +114,21 @@ def test_scale_and_filter_rows():
     keep = A.val > 0.5
     F = A.filter_rows(keep)
     assert F.nnz == int(keep.sum())
+
+
+def test_from_row_generator():
+    from amgcl_tpu.ops.csr import from_row_generator
+
+    def row(i):  # 1D Laplacian, matrix-free
+        cols, vals = [i], [2.0]
+        if i > 0:
+            cols.append(i - 1); vals.append(-1.0)
+        if i < 19:
+            cols.append(i + 1); vals.append(-1.0)
+        return cols, vals
+
+    A = from_row_generator(20, 20, row)
+    import scipy.sparse as sp
+    ref = sp.diags([-np.ones(19), 2 * np.ones(20), -np.ones(19)],
+                   [-1, 0, 1]).toarray()
+    assert np.allclose(A.to_dense(), ref)

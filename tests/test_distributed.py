@@ -163,3 +163,24 @@ def test_dist_chebyshev_smoother(mesh8):
     assert info.resid < 1e-8
     r = rhs - A.spmv(x)
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_dist_runtime_config(mesh8):
+    from amgcl_tpu.models.runtime import make_dist_solver_from_config
+    A, rhs = poisson3d(12)
+    for pclass in ("amg", "deflated_amg", "block"):
+        s = make_dist_solver_from_config(
+            A, mesh8, {"precond.class": pclass, "precond.dtype": "float64",
+                       "solver.type": "cg", "solver.maxiter": 500,
+                       "solver.tol": 1e-8})
+        x, info = s(rhs)
+        assert info.resid < 1e-8, pclass
+
+
+def test_cli_mesh_flag(capsys):
+    from amgcl_tpu.cli import main
+    rc = main(["-n", "10", "--mesh", "4", "-p", "precond.dtype=float64",
+               "-p", "solver.type=cg", "-p", "solver.tol=1e-8"])
+    assert rc == 0
+    cap = capsys.readouterr().out
+    assert "Iterations:" in cap

@@ -132,3 +132,16 @@ def test_ilut():
         BiCGStab(maxiter=200, tol=1e-8))
     x, info = solve(rhs)
     assert info.resid < 1e-8
+
+
+def test_as_block_wrapper():
+    from amgcl_tpu.relaxation.as_block import AsBlock
+    from amgcl_tpu.relaxation.spai1 import Spai1
+    from amgcl_tpu.utils.sample_problem import poisson3d_block
+    A, rhs = poisson3d_block(6, 2)
+    solve = make_solver(
+        A, AMGParams(relax=AsBlock(Spai1()), dtype=jnp.float64,
+                     coarse_enough=100),
+        CG(maxiter=200, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
