@@ -1,0 +1,119 @@
+"""Device-side (jittable) distance-2 MIS aggregation.
+
+The reference's distributed PMIS coarsening is 1131 lines of rank-boundary
+ownership resolution (amgcl/mpi/coarsening/pmis.hpp:49-1131). Reformulated
+for SPMD hardware, the whole algorithm is max-plus propagation over the
+strength graph: a node's aggregate is identified by its root's (unique)
+priority, and every step — root election, distance-1 capture, distance-2
+capture — is one or two ELL row-max gathers. That makes it shard-able the
+same way the SpMV is (the row-max gather is an spmv with (max, ×) algebra),
+so multi-host setup needs no dynamic messaging at all.
+
+Used as an optional device path: ``device_aggregates`` runs under ``jit``
+with fixed shapes and a static round count; the host (numpy / native C++)
+paths remain the default for serial setup.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from amgcl_tpu.ops.csr import CSR
+
+
+def _ell_row_max(cols, valid, x):
+    """Per-row max over an ELL adjacency: max_k x[cols[:, k]] (masked).
+    Priorities/keys are int32 so the max-plus algebra is EXACT on TPUs
+    without x64 (float32 would collide above 2^24 rows)."""
+    g = jnp.take(x, cols, axis=0)               # (n, K)
+    return jnp.max(jnp.where(valid, g, 0), axis=1)
+
+
+def device_aggregates(cols, valid, prio, rounds: int = 40):
+    """Distance-2 MIS aggregation, fully on device.
+
+    cols/valid: (n, K) ELL adjacency of the symmetric strength graph
+    (valid == False marks padding). prio: unique positive priorities.
+    Returns (key, assigned): ``key[i]`` is the root priority of i's
+    aggregate (0 for isolated rows). Keys compress to contiguous ids with
+    one unique() on the host or device."""
+    prio = prio.astype(jnp.int32)
+    n = prio.shape[0]
+    has_nbr = jnp.any(valid, axis=1)
+
+    def body(carry, _):
+        key, und = carry
+        p_und = jnp.where(und, prio, 0)
+        # closed 2-hop max of undecided priorities; a node's own priority
+        # reflects back through its neighbors, so the maximum of the CLOSED
+        # neighborhood equals prio exactly when the node wins (priorities
+        # are unique)
+        m1 = _ell_row_max(cols, valid, p_und)
+        m2 = jnp.maximum(_ell_row_max(cols, valid,
+                                      jnp.maximum(m1, p_und)), m1)
+        winners = und & (prio >= m2)
+        key = jnp.where(winners, prio, key)
+        # distance-1 capture: adopt the best adjacent new root
+        pw = jnp.where(winners, prio, 0)
+        w1 = _ell_row_max(cols, valid, pw)
+        d1 = und & ~winners & (w1 > 0)
+        key = jnp.where(d1, w1, key)
+        # distance-2 capture: adopt the key of the best captured neighbor
+        cap = winners | d1
+        kcap = jnp.where(cap, key, 0)
+        # carry the capturer's KEY, selected by the capturer's priority
+        pcap = jnp.where(cap, prio, 0)
+        best_p = _ell_row_max(cols, valid, pcap)
+        # recover the key attached to the argmax-priority neighbor: propagate
+        # (priority, key) pairs packed as priority * (n+1) + rank(key)…
+        # simpler and exact: two gathers — find neighbors whose priority
+        # equals the row max, take the max of their keys (unique priorities
+        # make the argmax unique)
+        pg = jnp.take(pcap, cols, axis=0)
+        kg = jnp.take(kcap, cols, axis=0)
+        hit = valid & (pg > 0) & (pg == best_p[:, None])
+        k2 = jnp.max(jnp.where(hit, kg, 0), axis=1)
+        d2 = und & ~cap & (best_p > 0)
+        key = jnp.where(d2, k2, key)
+        und = und & ~(winners | d1 | d2)
+        return (key, und), und.sum()
+
+    key0 = jnp.zeros(n, dtype=jnp.int32)
+    und0 = has_nbr
+    (key, und), _ = lax.scan(body, (key0, und0), None, length=rounds)
+    # leftovers (pathological fragments): become their own roots
+    key = jnp.where(und, prio, key)
+    return key, key > 0
+
+
+_jitted_device_aggregates = jax.jit(device_aggregates,
+                                    static_argnames="rounds")
+
+
+def aggregates_on_device(A: CSR, eps_strong: float = 0.08,
+                         rounds: int = 40):
+    """Convenience wrapper: host strength graph -> device MIS -> (agg, n_agg)
+    in the host convention (-1 for isolated rows)."""
+    from amgcl_tpu.coarsening.aggregates import strength_graph, _priority
+    S = strength_graph(A, eps_strong)
+    n = S.shape[0]
+    nnz_row = np.diff(S.indptr)
+    K = max(int(nnz_row.max()), 1)
+    cols = np.zeros((n, K), dtype=np.int32)
+    valid = np.zeros((n, K), dtype=bool)
+    rows = np.repeat(np.arange(n), nnz_row)
+    pos = np.arange(S.nnz) - S.indptr[rows]
+    cols[rows, pos] = S.indices
+    valid[rows, pos] = True
+    prio = jnp.asarray(_priority(n).astype(np.int32))
+    key, assigned = _jitted_device_aggregates(
+        jnp.asarray(cols), jnp.asarray(valid), prio, rounds=rounds)
+    key = np.asarray(key)
+    agg = np.full(n, -1, dtype=np.int64)
+    live = key > 0
+    uniq, inv = np.unique(key[live], return_inverse=True)
+    agg[live] = inv
+    return agg, len(uniq)
