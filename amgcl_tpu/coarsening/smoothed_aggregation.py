@@ -77,6 +77,11 @@ class SmoothedAggregation:
 def _filtered(A: CSR, eps_strong: float):
     """(A_f, D_f^{-1}): strength-filtered matrix and its inverted diagonal.
     Weak off-diagonal entries are removed and added to the diagonal."""
+    if A.dtype == np.float64:
+        from amgcl_tpu.native import native_filtered
+        got = native_filtered(A, eps_strong)
+        if got is not None:
+            return CSR(got[0], got[1], got[2], A.ncols), got[3]
     d = np.abs(A.diagonal())
     rows = A.expanded_rows()
     strong = (np.abs(A.val) ** 2 > eps_strong ** 2 * d[rows] * d[A.col]) \

@@ -62,6 +62,19 @@ def lib():
             handle.symmetrize_mask.argtypes = [
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p]
+            handle.spgemm_symbolic.restype = None
+            handle.spgemm_symbolic.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 5
+            handle.spgemm_numeric.restype = None
+            handle.spgemm_numeric.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9
+            handle.filter_count.restype = None
+            handle.filter_count.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p]
+            handle.filter_fill.restype = None
+            handle.filter_fill.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p,
+                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
             _LIB = handle
         return _LIB or None
 
@@ -74,7 +87,7 @@ def native_aggregates(A, eps_strong: float):
     """(agg, n_agg) via the native greedy distance-2 pass, or None if the
     native library is unavailable or the values are not float64-able."""
     L = lib()
-    if L is None or A.is_block:
+    if L is None or A.is_block or np.iscomplexobj(A.val):
         return None
     try:
         val = np.ascontiguousarray(A.val, dtype=np.float64)
@@ -90,3 +103,64 @@ def native_aggregates(A, eps_strong: float):
     agg = np.empty(n, dtype=np.int64)
     n_agg = L.aggregate_d2(n, _ptr(ptr), _ptr(col), _ptr(strong), _ptr(agg))
     return agg, int(n_agg)
+
+
+def native_spgemm(A, B):
+    """C = A @ B via the native two-phase hash SpGEMM, or None if
+    unavailable / non-f64-able. Returns (ptr, col, val).
+
+    Only engaged on multi-core hosts: the OpenMP parallelism is the whole
+    point — single-threaded, scipy's SMMP kernel is faster than the hash
+    accumulator, so we defer to it there."""
+    L = lib()
+    if L is None or A.is_block or B.is_block or L.omp_max_threads() < 2:
+        return None
+    if np.iscomplexobj(A.val) or np.iscomplexobj(B.val):
+        return None
+    try:
+        aval = np.ascontiguousarray(A.val, dtype=np.float64)
+        bval = np.ascontiguousarray(B.val, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    aptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    acol = np.ascontiguousarray(A.col, dtype=np.int32)
+    bptr = np.ascontiguousarray(B.ptr, dtype=np.int64)
+    bcol = np.ascontiguousarray(B.col, dtype=np.int32)
+    n = A.nrows
+    rn = np.empty(n, dtype=np.int64)
+    L.spgemm_symbolic(n, _ptr(aptr), _ptr(acol), _ptr(bptr), _ptr(bcol),
+                      _ptr(rn))
+    cptr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(rn, out=cptr[1:])
+    ccol = np.empty(cptr[-1], dtype=np.int32)
+    cval = np.empty(cptr[-1], dtype=np.float64)
+    L.spgemm_numeric(n, _ptr(aptr), _ptr(acol), _ptr(aval), _ptr(bptr),
+                     _ptr(bcol), _ptr(bval), _ptr(cptr), _ptr(ccol),
+                     _ptr(cval))
+    return cptr, ccol, cval
+
+
+def native_filtered(A, eps_strong):
+    """(ptr, col, val, dinv) of the strength-filtered lumped matrix, or
+    None if unavailable."""
+    L = lib()
+    if L is None or A.is_block or np.iscomplexobj(A.val):
+        return None
+    try:
+        val = np.ascontiguousarray(A.val, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    n = A.nrows
+    rn = np.empty(n, dtype=np.int64)
+    L.filter_count(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
+                   _ptr(rn))
+    optr = np.zeros(n + 1, dtype=np.int64)
+    np.cumsum(rn, out=optr[1:])
+    ocol = np.empty(optr[-1], dtype=np.int32)
+    oval = np.empty(optr[-1], dtype=np.float64)
+    dinv = np.empty(n, dtype=np.float64)
+    L.filter_fill(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
+                  _ptr(optr), _ptr(ocol), _ptr(oval), _ptr(dinv))
+    return optr, ocol, oval, dinv

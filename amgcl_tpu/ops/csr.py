@@ -165,7 +165,14 @@ class CSR:
         return CSR(m.indptr, m.indices, m.data, self.nrows)
 
     def __matmul__(self, other: "CSR") -> "CSR":
-        """SpGEMM (builtin.hpp:378-397, detail/spgemm.hpp:62,411)."""
+        """SpGEMM (builtin.hpp:378-397, detail/spgemm.hpp:62,411). Uses the
+        native OpenMP hash-SpGEMM when available, scipy otherwise."""
+        if not (self.is_block or other.is_block) \
+                and self.dtype == np.float64 and other.dtype == np.float64:
+            from amgcl_tpu.native import native_spgemm
+            got = native_spgemm(self, other)
+            if got is not None:
+                return CSR(got[0], got[1], got[2], other.ncols)
         if self.is_block or other.is_block:
             br = self.block_size[0]
             bc = other.block_size[1]

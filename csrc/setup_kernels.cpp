@@ -10,6 +10,7 @@
 // Build: g++ -O3 -march=native -fopenmp -shared -fPIC \
 //            -o libamgcl_tpu_native.so setup_kernels.cpp
 
+#include <algorithm>
 #include <cstdint>
 #include <cstring>
 #include <vector>
@@ -129,6 +130,179 @@ int omp_max_threads() {
 #else
   return 1;
 #endif
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Sparse general matrix-matrix multiply (CSR x CSR), two-phase, hash-based
+// per-row accumulators (open addressing, power-of-2 capacity) — no large
+// per-thread scratch, rows parallelized dynamically.
+
+namespace {
+
+struct HashAcc {
+  std::vector<int32_t> keys;
+  std::vector<double> vals;
+  int64_t mask = 0;
+
+  void reset_keys(int64_t cap_hint) {
+    int64_t cap = 16;
+    while (cap < cap_hint * 2) cap <<= 1;
+    keys.assign(cap, -1);
+    mask = cap - 1;
+  }
+
+  void reset(int64_t cap_hint) {
+    reset_keys(cap_hint);
+    vals.assign(mask + 1, 0.0);
+  }
+
+  // insert without value accumulation; returns true when the key is new
+  inline bool insert_key(int32_t key) {
+    int64_t h = (static_cast<uint32_t>(key) * 2654435761u) & mask;
+    while (true) {
+      if (keys[h] == key) return false;
+      if (keys[h] == -1) { keys[h] = key; return true; }
+      h = (h + 1) & mask;
+    }
+  }
+
+  inline void add(int32_t key, double v) {
+    int64_t h = (static_cast<uint32_t>(key) * 2654435761u) & mask;
+    while (true) {
+      if (keys[h] == key) { vals[h] += v; return; }
+      if (keys[h] == -1) { keys[h] = key; vals[h] = v; return; }
+      h = (h + 1) & mask;
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Pass 1: per-row nnz of C = A (n x k) * B (k x m).
+void spgemm_symbolic(int64_t n, const int64_t* aptr, const int32_t* acol,
+                     const int64_t* bptr, const int32_t* bcol,
+                     int64_t* c_row_nnz) {
+#pragma omp parallel
+  {
+    HashAcc acc;
+#pragma omp for schedule(dynamic, 256)
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t hint = 8;
+      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j)
+        hint += bptr[acol[j] + 1] - bptr[acol[j]];
+      acc.reset_keys(hint);
+      int64_t cnt = 0;
+      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j) {
+        const int32_t a = acol[j];
+        for (int64_t t = bptr[a]; t < bptr[a + 1]; ++t)
+          if (acc.insert_key(bcol[t])) ++cnt;
+      }
+      c_row_nnz[i] = cnt;
+    }
+  }
+}
+
+// Pass 2: fill col/val given precomputed cptr (exclusive scan of row nnz).
+// Column indices are emitted sorted per row.
+void spgemm_numeric(int64_t n, const int64_t* aptr, const int32_t* acol,
+                    const double* aval, const int64_t* bptr,
+                    const int32_t* bcol, const double* bval,
+                    const int64_t* cptr, int32_t* ccol, double* cval) {
+#pragma omp parallel
+  {
+    HashAcc acc;
+    std::vector<int64_t> tmp;
+#pragma omp for schedule(dynamic, 256)
+    for (int64_t i = 0; i < n; ++i) {
+      int64_t hint = 8;
+      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j)
+        hint += bptr[acol[j] + 1] - bptr[acol[j]];
+      acc.reset(hint);
+      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j) {
+        const int32_t a = acol[j];
+        const double av = aval[j];
+        for (int64_t t = bptr[a]; t < bptr[a + 1]; ++t)
+          acc.add(bcol[t], av * bval[t]);
+      }
+      tmp.clear();
+      for (int64_t h = 0; h <= acc.mask; ++h)
+        if (acc.keys[h] != -1) tmp.push_back(h);
+      // sort by column index
+      std::sort(tmp.begin(), tmp.end(),
+                [&](int64_t x, int64_t y) { return acc.keys[x] < acc.keys[y]; });
+      int64_t o = cptr[i];
+      for (int64_t h : tmp) {
+        ccol[o] = acc.keys[h];
+        cval[o] = acc.vals[h];
+        ++o;
+      }
+    }
+  }
+}
+
+// Strength-filtered matrix with weak-entry lumping (the SA "filtered"
+// operator): strong entries (|a_ij|^2 > eps^2 |a_ii a_jj|) and diagonals
+// are kept, weak off-diagonals removed and added to the diagonal.
+// Pass 1 counts kept entries per row; pass 2 fills.
+void filter_count(int64_t n, const int64_t* ptr, const int32_t* col,
+                  const double* val, double eps, int64_t* row_nnz) {
+  std::vector<double> dia(n, 0.0);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
+      if (col[j] == i) dia[i] = val[j] < 0 ? -val[j] : val[j];
+  const double e2 = eps * eps;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t cnt = 0;
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      const int32_t c = col[j];
+      if (c == i || val[j] * val[j] > e2 * dia[i] * dia[c]) ++cnt;
+    }
+    row_nnz[i] = cnt;
+  }
+}
+
+void filter_fill(int64_t n, const int64_t* ptr, const int32_t* col,
+                 const double* val, double eps, const int64_t* optr,
+                 int32_t* ocol, double* oval, double* dinv) {
+  std::vector<double> dia(n, 0.0);
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i)
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
+      if (col[j] == i) dia[i] = val[j] < 0 ? -val[j] : val[j];
+  const double e2 = eps * eps;
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t o = optr[i];
+    int64_t dpos = -1;
+    double lump = 0.0;
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      const int32_t c = col[j];
+      if (c == i) {
+        dpos = o;
+        ocol[o] = c;
+        oval[o] = val[j];
+        ++o;
+      } else if (val[j] * val[j] > e2 * dia[i] * dia[c]) {
+        ocol[o] = c;
+        oval[o] = val[j];
+        ++o;
+      } else {
+        lump += val[j];
+      }
+    }
+    double d = 0.0;
+    if (dpos >= 0) {
+      oval[dpos] += lump;
+      d = oval[dpos];
+    }
+    dinv[i] = d != 0.0 ? 1.0 / d : 1.0;
+  }
 }
 
 }  // extern "C"
