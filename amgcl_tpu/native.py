@@ -113,8 +113,13 @@ def native_spgemm(A, B):
     point — single-threaded, scipy's SMMP kernel is faster than the hash
     accumulator, so we defer to it there."""
     L = lib()
-    if L is None or A.is_block or B.is_block or L.omp_max_threads() < 2:
+    force = os.environ.get("AMGCL_TPU_FORCE_NATIVE_SPGEMM") == "1"
+    if L is None or A.is_block or B.is_block \
+            or (L.omp_max_threads() < 2 and not force):
         return None
+    if A.ncols != B.nrows:
+        raise ValueError("spgemm dimension mismatch: %s x %s"
+                         % (A.shape, B.shape))
     if np.iscomplexobj(A.val) or np.iscomplexobj(B.val):
         return None
     try:

@@ -1,0 +1,64 @@
+"""Drop-in surface for pyamgcl users (reference: pyamgcl/__init__.py:6-50 —
+scipy-sparse in, dict-of-dotted-params in, numpy out).
+
+    import amgcl_tpu.pyamgcl_compat as pyamgcl
+    solve = pyamgcl.solver(A, prm={"solver.type": "bicgstab"})
+    x = solve(rhs)
+
+``solver`` bundles preconditioner+Krylov like pyamgcl.solver; ``amgcl``
+exposes the preconditioner alone (callable as M⁻¹ y, usable as a
+scipy.sparse.linalg.LinearOperator via .aslinearoperator()).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from amgcl_tpu.models.runtime import make_solver_from_config, \
+    precond_params_from_dict, _as_dict
+from amgcl_tpu.models.amg import AMG
+from amgcl_tpu.ops.csr import CSR
+
+
+class solver:
+    """pyamgcl.solver equivalent: ``solver(A, prm)(rhs) -> x``."""
+
+    def __init__(self, A, prm=None):
+        self._inner = make_solver_from_config(A, prm or {})
+        self.iterations = 0
+        self.error = 0.0
+
+    def __call__(self, rhs, x0=None):
+        x, info = self._inner(np.asarray(rhs), x0)
+        self.iterations = info.iters
+        self.error = info.resid
+        return np.array(x)   # writable copy: scipy callers mutate in place
+
+    def __repr__(self):
+        return repr(self._inner)
+
+
+class amgcl:
+    """pyamgcl.amgcl equivalent: the preconditioner alone; calling it
+    applies one V-cycle."""
+
+    def __init__(self, A, prm=None):
+        cfg = _as_dict(prm)
+        self._amg = AMG(A if isinstance(A, CSR) else CSR.from_scipy(A),
+                        precond_params_from_dict(cfg.get("precond", cfg)))
+        import jax
+        self._apply = jax.jit(lambda h, r: h.apply(r))
+
+    def __call__(self, rhs):
+        import jax.numpy as jnp
+        r = jnp.asarray(np.asarray(rhs), dtype=self._amg.prm.dtype)
+        return np.array(self._apply(self._amg.hierarchy, r))
+
+    def aslinearoperator(self):
+        from scipy.sparse.linalg import LinearOperator
+        n = self._amg.host_levels[0][0].nrows \
+            * self._amg.host_levels[0][0].block_size[0]
+        return LinearOperator((n, n), matvec=self.__call__)
+
+    def __repr__(self):
+        return repr(self._amg)

@@ -20,6 +20,7 @@ class CG:
     maxiter: int = 100
     tol: float = 1e-8
     abstol: float = 0.0
+    verbose: bool = False   # print residual every 5 iterations (cg.hpp:199)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
               abstol=None):
@@ -52,6 +53,13 @@ class CG:
             x = dev.axpby(alpha, p, 1.0, x)
             r = dev.axpby(-alpha, q, 1.0, r)
             res = jnp.sqrt(jnp.abs(dot(r, r)))
+            if self.verbose:
+                import jax
+                jax.lax.cond(
+                    (it + 1) % 5 == 0,
+                    lambda: jax.debug.print("iter {i}: resid {r:.6e}",
+                                            i=it + 1, r=res / norm_scale),
+                    lambda: None)
             return (x, r, p, rho, it + 1, res)
 
         res0 = jnp.sqrt(jnp.abs(dot(r, r)))

@@ -218,10 +218,8 @@ void spgemm_numeric(int64_t n, const int64_t* aptr, const int32_t* acol,
     std::vector<int64_t> tmp;
 #pragma omp for schedule(dynamic, 256)
     for (int64_t i = 0; i < n; ++i) {
-      int64_t hint = 8;
-      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j)
-        hint += bptr[acol[j] + 1] - bptr[acol[j]];
-      acc.reset(hint);
+      // the symbolic pass already produced the exact per-row nnz
+      acc.reset(cptr[i + 1] - cptr[i] + 8);
       for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j) {
         const int32_t a = acol[j];
         const double av = aval[j];

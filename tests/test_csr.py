@@ -132,3 +132,21 @@ def test_from_row_generator():
     ref = sp.diags([-np.ones(19), 2 * np.ones(20), -np.ones(19)],
                    [-1, 0, 1]).toarray()
     assert np.allclose(A.to_dense(), ref)
+
+
+def test_native_spgemm_parity(monkeypatch):
+    """Exercise the native hash-SpGEMM even on single-core hosts (the
+    normal gate defers to scipy there) and check exact parity."""
+    from amgcl_tpu import native
+    if native.lib() is None:
+        pytest.skip("native kernels unavailable")
+    monkeypatch.setenv("AMGCL_TPU_FORCE_NATIVE_SPGEMM", "1")
+    A = random_csr(80, 60, density=0.08, seed=21)
+    B = random_csr(60, 70, density=0.08, seed=22)
+    got = native.native_spgemm(A, B)
+    assert got is not None
+    C = CSR(got[0], got[1], got[2], 70)
+    assert np.allclose(C.to_dense(), A.to_dense() @ B.to_dense())
+    # dimension mismatch raises instead of reading out of bounds
+    with pytest.raises(ValueError, match="dimension mismatch"):
+        native.native_spgemm(A, random_csr(10, 10, seed=23))

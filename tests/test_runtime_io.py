@@ -225,3 +225,22 @@ def test_cli_block_size_respects_params(capsys, tmp_path):
     assert "CG" in cap
     err = float(cap.split("Error:")[1].split()[0])
     assert err < 1e-10
+
+
+def test_pyamgcl_compat_surface():
+    """Drop-in pyamgcl-style usage (reference: tests/test_pyamgcl.py)."""
+    import amgcl_tpu.pyamgcl_compat as pyamgcl
+    import scipy.sparse.linalg as spla
+    A, rhs = poisson3d(10)
+    s = pyamgcl.solver(A.to_scipy(), {"precond.dtype": "float64",
+                                      "solver.type": "cg",
+                                      "solver.tol": 1e-8})
+    x = s(rhs)
+    assert s.iterations > 0 and s.error < 1e-8
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+    # preconditioner alone, as a scipy LinearOperator inside scipy's CG
+    M = pyamgcl.amgcl(A.to_scipy(), {"dtype": "float64"})
+    xs, ok = spla.cg(A.to_scipy(), rhs, M=M.aslinearoperator(),
+                     rtol=1e-8, maxiter=100)
+    assert ok == 0
