@@ -1,64 +1,92 @@
-"""Drop-in surface for pyamgcl users (reference: pyamgcl/__init__.py:6-50 —
-scipy-sparse in, dict-of-dotted-params in, numpy out).
+"""Drop-in surface for pyamgcl users (reference: pyamgcl/__init__.py:6-60).
+
+Matches the reference's calling shapes:
 
     import amgcl_tpu.pyamgcl_compat as pyamgcl
-    solve = pyamgcl.solver(A, prm={"solver.type": "bicgstab"})
-    x = solve(rhs)
+    P = pyamgcl.amgcl(A, {"coarsening.type": "smoothed_aggregation"})
+    solve = pyamgcl.solver(P, {"type": "cg", "tol": 1e-8})
+    x = solve(rhs)          # matrix from P
+    x = solve(A_new, rhs)   # new matrix, same preconditioner
 
-``solver`` bundles preconditioner+Krylov like pyamgcl.solver; ``amgcl``
-exposes the preconditioner alone (callable as M⁻¹ y, usable as a
-scipy.sparse.linalg.LinearOperator via .aslinearoperator()).
+``amgcl`` is the preconditioner alone (callable as one cycle, ``.shape``,
+``aslinearoperator()`` for scipy solvers).
 """
 
 from __future__ import annotations
 
 import numpy as np
 
-from amgcl_tpu.models.runtime import make_solver_from_config, \
-    precond_params_from_dict, _as_dict
+from amgcl_tpu.models.runtime import precond_params_from_dict, \
+    solver_from_params, _as_dict
 from amgcl_tpu.models.amg import AMG
+from amgcl_tpu.models.make_solver import make_solver
 from amgcl_tpu.ops.csr import CSR
 
 
-class solver:
-    """pyamgcl.solver equivalent: ``solver(A, prm)(rhs) -> x``."""
-
-    def __init__(self, A, prm=None):
-        self._inner = make_solver_from_config(A, prm or {})
-        self.iterations = 0
-        self.error = 0.0
-
-    def __call__(self, rhs, x0=None):
-        x, info = self._inner(np.asarray(rhs), x0)
-        self.iterations = info.iters
-        self.error = info.resid
-        return np.array(x)   # writable copy: scipy callers mutate in place
-
-    def __repr__(self):
-        return repr(self._inner)
-
-
 class amgcl:
-    """pyamgcl.amgcl equivalent: the preconditioner alone; calling it
-    applies one V-cycle."""
+    """pyamgcl.amgcl equivalent: the AMG hierarchy as a preconditioner.
+    ``prm`` uses the reference's flat dotted keys without the ``precond.``
+    prefix (e.g. ``coarsening.type``, ``relax.type``, ``dtype``)."""
 
     def __init__(self, A, prm=None):
-        cfg = _as_dict(prm)
-        self._amg = AMG(A if isinstance(A, CSR) else CSR.from_scipy(A),
-                        precond_params_from_dict(cfg.get("precond", cfg)))
+        self._amg = AMG(A, precond_params_from_dict(_as_dict(prm)))
+        A0 = self._amg.host_levels[0][0]
+        n = A0.nrows * A0.block_size[0]
+        self.shape = (n, n)
         import jax
         self._apply = jax.jit(lambda h, r: h.apply(r))
 
     def __call__(self, rhs):
         import jax.numpy as jnp
         r = jnp.asarray(np.asarray(rhs), dtype=self._amg.prm.dtype)
+        # writable copy: scipy callers mutate the matvec result in place
         return np.array(self._apply(self._amg.hierarchy, r))
 
     def aslinearoperator(self):
         from scipy.sparse.linalg import LinearOperator
-        n = self._amg.host_levels[0][0].nrows \
-            * self._amg.host_levels[0][0].block_size[0]
-        return LinearOperator((n, n), matvec=self.__call__)
+        return LinearOperator(self.shape, matvec=self.__call__,
+                              dtype=np.dtype(self._amg.prm.dtype))
 
     def __repr__(self):
         return repr(self._amg)
+
+
+class solver:
+    """pyamgcl.solver equivalent: ``solver(P, prm)`` with P an ``amgcl``
+    preconditioner and ``prm`` flat solver params ({"type", "tol",
+    "maxiter", ...}); callable as ``solve(rhs)`` or ``solve(A_new, rhs)``
+    (new matrix, same preconditioner — the reference's non-steady-state
+    workflow)."""
+
+    def __init__(self, P: amgcl, prm=None):
+        self.P = P
+        self._solver = solver_from_params(dict(prm or {}))
+        self._bundle = None
+        self._bundle_for = None
+        self.iterations = 0
+        self.error = 0.0
+
+    def _get_bundle(self, A):
+        key = id(A) if A is not None else None
+        if self._bundle is None or self._bundle_for != key:
+            mat = self.P._amg.host_levels[0][0] if A is None else A
+            self._bundle = make_solver(mat, self.P._amg, self._solver)
+            self._bundle_for = key
+        return self._bundle
+
+    def __call__(self, *args):
+        if len(args) == 1:
+            bundle = self._get_bundle(None)
+            rhs = args[0]
+        elif len(args) == 2:
+            bundle = self._get_bundle(args[0])
+            rhs = args[1]
+        else:
+            raise TypeError("solver() takes (rhs) or (A, rhs)")
+        x, info = bundle(np.asarray(rhs))
+        self.iterations = info.iters
+        self.error = info.resid
+        return np.array(x)   # writable copy
+
+    def __repr__(self):
+        return repr(self.P)

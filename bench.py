@@ -12,12 +12,41 @@ number a single TPU chip must beat. vs_baseline = baseline_time / our_time
 """
 
 import json
+import os
+import threading
 import time
 
 import numpy as np
 
 
+def _device_watchdog(timeout_s: float = 240.0):
+    """The axon TPU tunnel can wedge so that backend init blocks forever
+    (observed in this image). Probe device init in a thread; on timeout,
+    emit a diagnostic JSON line and hard-exit instead of hanging the
+    driver."""
+    done = threading.Event()
+
+    def probe():
+        import jax
+        jax.devices()
+        done.set()
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    if not done.wait(timeout_s):
+        import sys
+        print(json.dumps({
+            "metric": "poisson3d_128_sa_cg_spai0_solve_time",
+            "value": None, "unit": "s", "vs_baseline": None,
+            "error": "device backend init timed out after %.0fs "
+                     "(TPU tunnel unreachable)" % timeout_s,
+        }))
+        sys.stdout.flush()
+        os._exit(2)
+
+
 def main():
+    _device_watchdog()
     import jax
     # x64 so the refinement's outer residual really is float64 (the
     # correction solves stay float32)

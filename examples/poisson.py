@@ -9,10 +9,7 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
-import sys
 
-import numpy as np
-import jax.numpy as jnp
 
 from amgcl_tpu import make_solver, AMGParams
 from amgcl_tpu.solver.cg import CG

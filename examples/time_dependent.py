@@ -8,7 +8,6 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 import time
 
-import numpy as np
 import jax
 
 jax.config.update("jax_enable_x64", True)

@@ -228,17 +228,25 @@ def test_cli_block_size_respects_params(capsys, tmp_path):
 
 
 def test_pyamgcl_compat_surface():
-    """Drop-in pyamgcl-style usage (reference: tests/test_pyamgcl.py)."""
+    """Drop-in pyamgcl-style usage with the REFERENCE calling shapes
+    (reference: pyamgcl/__init__.py + tests/test_pyamgcl.py): solver takes
+    a prebuilt amgcl preconditioner and flat solver params; solve(rhs) and
+    solve(A_new, rhs) both work."""
     import amgcl_tpu.pyamgcl_compat as pyamgcl
     import scipy.sparse.linalg as spla
     A, rhs = poisson3d(10)
-    s = pyamgcl.solver(A.to_scipy(), {"precond.dtype": "float64",
-                                      "solver.type": "cg",
-                                      "solver.tol": 1e-8})
+    P = pyamgcl.amgcl(A.to_scipy(), {"dtype": "float64"})
+    assert P.shape == (A.nrows, A.nrows)
+    s = pyamgcl.solver(P, {"type": "cg", "tol": 1e-8})
     x = s(rhs)
     assert s.iterations > 0 and s.error < 1e-8
     r = rhs - A.spmv(x)
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+    # two-arg form: new matrix, same preconditioner
+    A2 = CSR(A.ptr.copy(), A.col.copy(), 1.1 * A.val, A.ncols)
+    x2 = s(A2, rhs)
+    r2 = rhs - A2.spmv(x2)
+    assert np.linalg.norm(r2) / np.linalg.norm(rhs) < 1e-7
     # preconditioner alone, as a scipy LinearOperator inside scipy's CG
     M = pyamgcl.amgcl(A.to_scipy(), {"dtype": "float64"})
     xs, ok = spla.cg(A.to_scipy(), rhs, M=M.aslinearoperator(),
