@@ -106,8 +106,10 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
     key_dst = row_shard[rem]
     key_src = owner[rem]
     key_col = A.col[rem].astype(np.int64)
-    trip = np.unique(
-        (key_dst * nd + key_src) * (ncloc * nd) + key_col)
+    # single source of the composite key: trip derives from rem_keys, and
+    # the same array drives the searchsorted position lookup below
+    rem_keys = (key_dst * nd + key_src) * (ncloc * nd) + key_col
+    trip = np.unique(rem_keys)
     t_pair = trip // (ncloc * nd)
     t_dst = t_pair // nd
     t_src = t_pair % nd
@@ -124,12 +126,12 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
     send_idx = np.zeros((nd, nd, C), dtype=np.int32)
     send_idx[t_src, t_dst, grp_idx] = (t_col - t_src * ncloc).astype(np.int32)
 
-    # remote column -> halo buffer position (per dst shard):
-    # buffer layout = concat over src of C padded slots
-    halo_pos = {}
-    for j in range(len(trip)):
-        halo_pos[(int(t_dst[j]), int(t_col[j]))] = \
-            int(t_src[j]) * C + int(grp_idx[j])
+    # remote entry -> halo buffer position (buffer = concat over src of C
+    # padded slots): one searchsorted maps every entry at once.
+    loc_in_trip = np.searchsorted(trip, rem_keys)
+    halo_pos_full = np.zeros(A.nnz, dtype=np.int32)
+    halo_pos_full[rem] = (t_src[loc_in_trip] * C
+                          + grp_idx[loc_in_trip]).astype(np.int32)
 
     # per-shard ELL packing
     K1 = 1
@@ -145,9 +147,7 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
         vv = A.val[lo:hi]
         lm = is_local[lo:hi]
         loc_lists.append((rr[lm], cc[lm] - s * ncloc, vv[lm]))
-        rposs = np.asarray([halo_pos[(s, int(c))] for c in cc[~lm]],
-                           dtype=np.int32)
-        rem_lists.append((rr[~lm], rposs, vv[~lm]))
+        rem_lists.append((rr[~lm], halo_pos_full[lo:hi][~lm], vv[~lm]))
         if len(rr[lm]):
             K1 = max(K1, int(np.bincount(rr[lm]).max()))
         if len(rr[~lm]):
