@@ -33,7 +33,7 @@ from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.relaxation.spai1 import Spai1
 from amgcl_tpu.relaxation.chebyshev import Chebyshev
 from amgcl_tpu.relaxation.gauss_seidel import GaussSeidel
-from amgcl_tpu.relaxation.ilu0 import ILU0, ILUP, ILUT
+from amgcl_tpu.relaxation.ilu0 import ILU0, ILUK, ILUP, ILUT
 from amgcl_tpu.relaxation.as_block import AsBlock
 from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
 from amgcl_tpu.coarsening.aggregation import Aggregation
@@ -54,7 +54,7 @@ SOLVERS = {
 RELAXATION = {
     "damped_jacobi": DampedJacobi, "spai0": Spai0, "spai1": Spai1,
     "chebyshev": Chebyshev, "gauss_seidel": GaussSeidel, "ilu0": ILU0,
-    "ilup": ILUP, "iluk": ILUP,   # iluk maps to the A^p-pattern variant
+    "ilup": ILUP, "iluk": ILUK,
     "ilut": ILUT, "as_block": AsBlock,
 }
 

@@ -70,6 +70,11 @@ def lib():
             handle.filter_count.argtypes = [
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p]
+            handle.iluk_symbolic.restype = ctypes.c_int64
+            handle.iluk_symbolic.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
+                ctypes.c_void_p]
             handle.filter_fill.restype = None
             handle.filter_fill.argtypes = [
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -169,3 +174,25 @@ def native_filtered(A, eps_strong):
     L.filter_fill(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
                   _ptr(optr), _ptr(ocol), _ptr(oval), _ptr(dinv))
     return optr, ocol, oval, dinv
+
+
+def native_iluk_pattern(A, k: int):
+    """Level-of-fill ILU(k) pattern: (ptr, col) of the symbolic factor, or
+    None if the native library is unavailable. The input pattern must be
+    sorted (CSR canonical form)."""
+    L = lib()
+    if L is None or A.is_block:
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    n = A.nrows
+    budget = max(A.nnz * (k + 2), 64)
+    for _ in range(8):
+        optr = np.zeros(n + 1, dtype=np.int64)
+        ocol = np.empty(budget, dtype=np.int32)
+        got = L.iluk_symbolic(n, _ptr(ptr), _ptr(col), int(k), budget,
+                              _ptr(optr), _ptr(ocol))
+        if got >= 0:
+            return optr, ocol[:got]
+        budget *= 2
+    raise MemoryError("iluk pattern did not fit after retries")

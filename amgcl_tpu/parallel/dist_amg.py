@@ -36,7 +36,8 @@ from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.models.make_solver import SolverInfo
 from amgcl_tpu.solver.cg import CG
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
-from amgcl_tpu.parallel.dist_ell import DistEllMatrix, build_dist_ell
+from amgcl_tpu.parallel.dist_ell import (DistEllMatrix,
+    build_dist_ell, pack_rows_ell)
 from amgcl_tpu.parallel.dist_matrix import dist_inner_product
 
 
@@ -110,49 +111,104 @@ class DistLevel:
 
 
 @register_pytree_node_class
+class TransitionOps:
+    """Transfers between the sharded and replicated parts of the hierarchy
+    (the repartition/merge analogue: instead of shrinking to fewer ranks —
+    pointless on a TPU mesh where idle chips save nothing — small levels
+    are REPLICATED and every shard computes them redundantly, trading tiny
+    duplicate FLOPs for zero all_to_all latency per coarse level; reference
+    role: amgcl/mpi/partition/merge.hpp).
+
+    p_cols/p_vals: (nd, nloc, K) sharded — P rows by fine shard, columns
+    into the replicated coarse vector. r_cols/r_vals: (nd, nc, K) sharded —
+    per-shard column-restricted R; the replicated result is the psum of the
+    per-shard partial products."""
+
+    def __init__(self, p_cols, p_vals, r_cols, r_vals):
+        self.p_cols = p_cols
+        self.p_vals = p_vals
+        self.r_cols = r_cols
+        self.r_vals = r_vals
+
+    def tree_flatten(self):
+        return (self.p_cols, self.p_vals, self.r_cols, self.r_vals), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def specs(self):
+        sp = P(ROWS_AXIS, None, None)
+        return TransitionOps(sp, sp, sp, sp)
+
+    def restrict(self, r_local):
+        """sharded fine residual -> replicated coarse rhs."""
+        part = jnp.einsum(
+            "nk,nk->n", self.r_vals[0],
+            jnp.take(r_local, self.r_cols[0], axis=0))
+        return lax.psum(part, ROWS_AXIS)
+
+    def prolong(self, uc_full):
+        """replicated coarse correction -> sharded fine update."""
+        return jnp.einsum(
+            "nk,nk->n", self.p_vals[0],
+            jnp.take(uc_full, self.p_cols[0], axis=0))
+
+
+@register_pytree_node_class
 class DistHierarchy:
     """Sharded multilevel state; ``shard_apply`` runs inside shard_map."""
 
-    def __init__(self, levels, coarse_inv, npre=1, npost=1, ncycle=1,
-                 pre_cycles=1):
-        self.levels = list(levels)
-        self.coarse_inv = coarse_inv   # replicated (nc, nc) or None
+    def __init__(self, levels, rep, trans, top_A=None, npre=1, npost=1,
+                 ncycle=1, pre_cycles=1):
+        self.levels = list(levels)   # sharded levels (may be empty)
+        self.rep = rep               # replicated serial sub-hierarchy
+        self.trans = trans           # TransitionOps (None = whole-vector
+                                     # gather/slice, the no-shard case)
+        self.top_A = top_A           # system matrix when levels is empty
         self.npre = int(npre)
         self.npost = int(npost)
         self.ncycle = int(ncycle)
         self.pre_cycles = int(pre_cycles)
 
     def tree_flatten(self):
-        return ((self.levels, self.coarse_inv),
+        return ((self.levels, self.rep, self.trans, self.top_A),
                 (self.npre, self.npost, self.ncycle, self.pre_cycles))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(children[0], children[1], *aux)
+        return cls(*children, *aux)
 
     def specs(self):
+        import jax
         lvls = [DistLevel(l.A.specs(),
                           None if l.P_op is None else l.P_op.specs(),
                           None if l.R_op is None else l.R_op.specs(),
                           l.smoother.spec()) for l in self.levels]
-        return DistHierarchy(lvls, None if self.coarse_inv is None else P(),
-                             self.npre, self.npost, self.ncycle,
-                             self.pre_cycles)
+        rep_spec = jax.tree.map(lambda _: P(), self.rep)  # fully replicated
+        return DistHierarchy(
+            lvls, rep_spec,
+            None if self.trans is None else self.trans.specs(),
+            None if self.top_A is None else self.top_A.specs(),
+            self.npre, self.npost, self.ncycle, self.pre_cycles)
 
     # -- inside shard_map ---------------------------------------------------
+
+    def _rep_solve(self, fc_full):
+        """Replicated sub-hierarchy visit(s): every shard runs the same
+        serial cycle on the full coarse vector — redundant FLOPs on tiny
+        levels instead of per-level collectives."""
+        from amgcl_tpu.ops import device as sdev
+        uc = self.rep.cycle(0, fc_full)
+        for _ in range(self.ncycle - 1):
+            rc = fc_full - sdev.spmv(self.rep.levels[0].A, uc)
+            uc = uc + self.rep.cycle(0, rc)
+        return uc
 
     def shard_cycle(self, i, f):
         lv = self.levels[i]
         Aop = _LocalOp(lv.A)
         sm = lv.smoother
-        if i == len(self.levels) - 1:
-            if self.coarse_inv is not None:
-                full = lax.all_gather(f, ROWS_AXIS, tiled=True)
-                u_full = self.coarse_inv @ full
-                s = lax.axis_index(ROWS_AXIS)
-                return lax.dynamic_slice(u_full, (s * f.shape[0],),
-                                         (f.shape[0],))
-            return sm.apply0(Aop, f)
         if self.npre > 0:
             u = sm.apply0(Aop, f)
             for _ in range(self.npre - 1):
@@ -160,17 +216,39 @@ class DistHierarchy:
         else:
             u = jnp.zeros_like(f)
         r = f - lv.A.shard_mv(u)
-        fc = lv.R_op.shard_mv(r)
-        uc = self.shard_cycle(i + 1, fc)
-        for _ in range(self.ncycle - 1):   # W-cycle extra coarse visits
-            rc = fc - self.levels[i + 1].A.shard_mv(uc)
-            uc = uc + self.shard_cycle(i + 1, rc)
-        u = u + lv.P_op.shard_mv(uc)
+        if i == len(self.levels) - 1:
+            # boundary to the replicated tail
+            fc_full = self.trans.restrict(r)
+            uc_full = self._rep_solve(fc_full)
+            u = u + self.trans.prolong(uc_full)
+        else:
+            fc = lv.R_op.shard_mv(r)
+            uc = self.shard_cycle(i + 1, fc)
+            for _ in range(self.ncycle - 1):   # W-cycle extra coarse visits
+                rc = fc - self.levels[i + 1].A.shard_mv(uc)
+                uc = uc + self.shard_cycle(i + 1, rc)
+            u = u + lv.P_op.shard_mv(uc)
         for _ in range(self.npost):
             u = sm.sweep(Aop, f, u)
         return u
 
+    def _whole_vector_apply(self, r):
+        """No sharded levels: gather the whole (small) residual, run the
+        replicated hierarchy, slice the local part back."""
+        M = self.rep.system_matrix
+        # scalar length: ELL block matrices report shape in block units
+        n_rep = M.shape[0] * getattr(M, "block", (1, 1))[0]
+        nloc = r.shape[0]
+        r_full = lax.all_gather(r, ROWS_AXIS, tiled=True)[:n_rep]
+        u_full = self.rep.apply(r_full)
+        pad = jnp.zeros(nloc * lax.axis_size(ROWS_AXIS), u_full.dtype)
+        pad = lax.dynamic_update_slice(pad, u_full, (0,))
+        s = lax.axis_index(ROWS_AXIS)
+        return lax.dynamic_slice(pad, (s * nloc,), (nloc,))
+
     def shard_apply(self, r):
+        if not self.levels:
+            return self._whole_vector_apply(r)
         x = self.shard_cycle(0, r)
         for _ in range(self.pre_cycles - 1):
             rr = r - self.levels[0].A.shard_mv(x)
@@ -178,7 +256,44 @@ class DistHierarchy:
         return x
 
     def system_A(self):
-        return self.levels[0].A
+        return self.levels[0].A if self.levels else self.top_A
+
+
+def _transition_ops(Pt: CSR, Rt: CSR, nd, nloc, mesh, dtype):
+    """Build TransitionOps from the host transfer operators at the
+    sharded/replicated boundary. Pt: (n_fine, nc); Rt: (nc, n_fine)."""
+    n_f, nc = Pt.shape
+    # P: rows sharded by the fine partition, columns global (replicated uc)
+    prows = Pt.expanded_rows()
+    K1 = max(int(Pt.row_nnz().max()), 1) if Pt.nnz else 1
+    pc = np.zeros((nd, nloc, K1), dtype=np.int32)
+    pv = np.zeros((nd, nloc, K1), dtype=np.float64)
+    for s_ in range(nd):
+        r0, r1 = min(s_ * nloc, n_f), min((s_ + 1) * nloc, n_f)
+        lo, hi = int(Pt.ptr[r0]), int(Pt.ptr[r1])
+        c, v = pack_rows_ell(prows[lo:hi] - r0, Pt.col[lo:hi],
+                              Pt.val[lo:hi], nloc, K1)
+        pc[s_], pv[s_] = c, v
+    # R: per-shard column restriction; rows = full coarse vector
+    rrows = Rt.expanded_rows()
+    owner = np.minimum(Rt.col // nloc, nd - 1)
+    K2 = 1
+    packs = []
+    for s_ in range(nd):
+        sel = owner == s_
+        if sel.any():
+            K2 = max(K2, int(np.bincount(rrows[sel], minlength=nc).max()))
+    rc = np.zeros((nd, nc, K2), dtype=np.int32)
+    rv = np.zeros((nd, nc, K2), dtype=np.float64)
+    for s_ in range(nd):
+        sel = owner == s_
+        c, v = pack_rows_ell(rrows[sel], Rt.col[sel] - s_ * nloc,
+                              Rt.val[sel], nc, K2)
+        rc[s_], rv[s_] = c, v
+    sh = NamedSharding(mesh, P(ROWS_AXIS, None, None))
+    put = lambda a, dt: jax.device_put(jnp.asarray(a, dtype=dt), sh)
+    return TransitionOps(put(pc, jnp.int32), put(pv, dtype),
+                         put(rc, jnp.int32), put(rv, dtype))
 
 
 class _LocalOp:
@@ -197,7 +312,7 @@ class DistAMGSolver:
     over the mesh, one compiled SPMD program per (structure, params)."""
 
     def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
-                 solver: Any = None):
+                 solver: Any = None, replicate_below: int = 4096):
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.mesh = mesh
@@ -208,13 +323,25 @@ class DistAMGSolver:
 
         host = AMG(A, self.prm)          # serial host-side construction
         self.host_amg = host
+        # split: levels at or above `replicate_below` rows stay sharded;
+        # the tail is replicated (the merge/repartition analogue) — at
+        # minimum the coarsest level
+        sizes = [h[0].nrows * h[0].block_size[0] for h in host.host_levels]
+        if len(sizes) == 1:
+            t = 0                      # whole hierarchy replicated
+        else:
+            t = next((j for j, sz in enumerate(sizes)
+                      if sz < replicate_below and j > 0),
+                     len(sizes) - 1)
+        self._split = t
         levels = []
-        vec_shard = NamedSharding(mesh, P(ROWS_AXIS, None))
-        for k, (Ak, Pk, Rk) in enumerate(host.host_levels):
+        for k, (Ak, Pk, Rk) in enumerate(host.host_levels[:t]):
             Ak_s = Ak.unblock() if Ak.is_block else Ak
             dA = build_dist_ell(Ak_s, mesh, dtype)
             dP = dR = None
-            if Pk is not None:
+            # the last sharded level's transfers become the transition ops,
+            # so don't build (then discard) distributed versions of them
+            if Pk is not None and k != t - 1:
                 dP = build_dist_ell(
                     Pk.unblock() if Pk.is_block else Pk, mesh, dtype)
                 dR = build_dist_ell(
@@ -249,18 +376,32 @@ class DistAMGSolver:
                         jnp.asarray(pad.reshape(nd, dA.nloc), dtype=dtype),
                         NamedSharding(mesh, P(ROWS_AXIS, None))))
             levels.append(DistLevel(dA, dP, dR, sm))
-        coarse_inv = None
-        if host.hierarchy.coarse is not None:
-            inv = np.asarray(host.hierarchy.coarse.inv, dtype=np.float64)
-            nc_pad = levels[-1].A.nloc * nd
-            padinv = np.zeros((nc_pad, nc_pad))
-            padinv[:inv.shape[0], :inv.shape[1]] = inv
-            coarse_inv = jnp.asarray(padinv, dtype=dtype)
-        self.hier = DistHierarchy(levels, coarse_inv,
+
+        # replicated tail = the serial device hierarchy's own levels
+        from amgcl_tpu.models.amg import Hierarchy as SerialHierarchy
+        rep = SerialHierarchy(host.hierarchy.levels[t:],
+                              host.hierarchy.coarse,
+                              self.prm.npre, self.prm.npost,
+                              self.prm.ncycle, 1)
+        top_A = None
+        trans = None
+        if t == 0:
+            A0 = host.host_levels[0][0]
+            top_A = build_dist_ell(A0.unblock() if A0.is_block else A0,
+                                   mesh, dtype)
+        else:
+            Pt = host.host_levels[t - 1][1]
+            Rt = host.host_levels[t - 1][2]
+            trans = _transition_ops(
+                Pt.unblock() if Pt.is_block else Pt,
+                Rt.unblock() if Rt.is_block else Rt,
+                nd, levels[-1].A.nloc, mesh, dtype)
+        self.hier = DistHierarchy(levels, rep, trans, top_A,
                                   self.prm.npre, self.prm.npost,
                                   self.prm.ncycle, self.prm.pre_cycles)
         self.n = A.nrows * A.block_size[0]
-        self.n_pad = levels[0].A.nloc * nd
+        first_A = levels[0].A if levels else top_A
+        self.n_pad = first_A.nloc * nd
         self._compiled = None
 
     def _build_compiled(self):

@@ -83,6 +83,22 @@ def _ell_mv(cols, vals, x):
                                                              x.dtype))
 
 
+def pack_rows_ell(rr, cc, vv, nrows, K):
+    """Pack (row, col, val) triples into dense (nrows, K) ELL arrays —
+    the shared per-shard packing used by the halo plan and the
+    sharded/replicated transition operators."""
+    cols = np.zeros((nrows, K), dtype=np.int32)
+    vals = np.zeros((nrows, K), dtype=np.float64)
+    if len(rr):
+        order = np.argsort(rr, kind="stable")
+        rr, cc, vv = rr[order], cc[order], vv[order]
+        pos = np.arange(len(rr)) - np.concatenate(
+            [[0], np.cumsum(np.bincount(rr, minlength=nrows))[:-1]])[rr]
+        cols[rr, pos] = cc
+        vals[rr, pos] = vv
+    return cols, vals
+
+
 def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
     """Partition a host CSR over the mesh's ``rows`` axis and bake the halo
     plan. Rectangular operators (transfers) partition rows and columns
@@ -157,15 +173,7 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
         cols = np.zeros((nd, nloc, K), dtype=np.int32)
         vals = np.zeros((nd, nloc, K), dtype=np.float64)
         for s, (rr, cc, vv) in enumerate(lists):
-            if len(rr) == 0:
-                continue
-            order = np.argsort(rr, kind="stable")
-            rr, cc, vv = rr[order], cc[order], vv[order]
-            pos = np.arange(len(rr)) - np.concatenate(
-                [[0], np.cumsum(np.bincount(rr, minlength=nloc))[:-1]]
-            )[rr]
-            cols[s, rr, pos] = cc
-            vals[s, rr, pos] = vv
+            cols[s], vals[s] = pack_rows_ell(rr, cc, vv, nloc, K)
         return cols, vals
 
     lc, lv = pack(loc_lists, K1)

@@ -209,6 +209,35 @@ class ILUT:
 
 
 @dataclass
+class ILUK:
+    """ILU(k) with true level-of-fill symbolic factorization (reference:
+    amgcl/relaxation/iluk.hpp): the fill pattern comes from symbolic
+    elimination with level tracking (native C++ row-merge), then the
+    Chow-Patel fixed point computes the numeric factors on that pattern.
+    Falls back to the A^p-pattern ILUP when the native library is absent."""
+    k: int = 1
+    sweeps: int = 8
+    jacobi_iters: int = 2
+
+    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+        from amgcl_tpu.native import native_iluk_pattern
+        from amgcl_tpu.relaxation.spai1 import gather_sparse_entries
+        S = A.unblock() if A.is_block else A
+        m = S.to_scipy().astype(np.float64)
+        m.sort_indices()
+        base = CSR.from_scipy(m)
+        got = native_iluk_pattern(base, self.k)
+        if got is None:
+            return ILUP(p=self.k, sweeps=self.sweeps,
+                        jacobi_iters=self.jacobi_iters).build(A, dtype)
+        optr, ocol = got
+        frows = np.repeat(np.arange(m.shape[0]), np.diff(optr))
+        fvals = gather_sparse_entries(m, frows, ocol)
+        return _chow_patel_build(optr, ocol, fvals, m.shape[0],
+                                 self.sweeps, self.jacobi_iters, dtype)
+
+
+@dataclass
 class ILUP:
     """ILU over the sparsity of A^(p+1): the fill pattern is widened to the
     p-th power of A's connectivity and the same Chow-Patel fixed point runs

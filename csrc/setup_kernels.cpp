@@ -304,3 +304,87 @@ void filter_fill(int64_t n, const int64_t* ptr, const int32_t* col,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// ILU(k) symbolic factorization: classic row-merge fill-level computation
+// (IKJ ordering). For each row i, start from A's pattern at level 0; for
+// each candidate column j < i (in ascending order), merge row j of the
+// symbolic factor with propagated level lev(i,j) + lev(j,t) + 1; keep
+// entries with level <= k. Sequential over rows (the dependency is real),
+// linear-ish work for small k.
+
+extern "C" {
+
+// Pass 1+2 in one call with caller-provided output budget. Returns the
+// total output nnz, or -1 if the budget was too small (caller doubles and
+// retries). Output rows are sorted.
+int64_t iluk_symbolic(int64_t n, const int64_t* ptr, const int32_t* col,
+                      int64_t k, int64_t budget, int64_t* optr,
+                      int32_t* ocol) {
+  std::vector<int32_t> levels(budget, 0);
+  // per-row workspace: linked-list row merge (Saad's style, re-derived)
+  std::vector<int32_t> lev_w(n, -1);   // working levels per column
+  std::vector<int32_t> next(n, -1);    // sorted linked list of columns
+  optr[0] = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    // init working row from A's pattern
+    int32_t head = -2;
+    {
+      int32_t prev = -1;
+      for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+        const int32_t c = col[j];
+        lev_w[c] = 0;
+        if (prev < 0) head = c; else next[prev] = c;
+        prev = c;
+      }
+      if (prev >= 0) next[prev] = -2;  // terminator
+      else head = -2;
+    }
+    // eliminate: walk columns j < i in ascending order
+    for (int32_t j = head; j != -2 && j < (int32_t)i; j = next[j]) {
+      const int32_t lev_ij = lev_w[j];
+      if (lev_ij > k) continue;
+      // merge factor row j (strictly upper part), propagated level
+      int32_t p = j;  // insertion cursor in the linked list
+      for (int64_t t = optr[j]; t < optr[j + 1]; ++t) {
+        const int32_t c = ocol[t];
+        if (c <= j) continue;
+        const int32_t lv = lev_ij + levels[t] + 1;
+        if (lv > k) continue;
+        if (lev_w[c] >= 0) {
+          if (lv < lev_w[c]) lev_w[c] = lv;
+        } else {
+          // insert c into the sorted list after cursor p
+          while (next[p] != -2 && next[p] < c) p = next[p];
+          next[c] = next[p];
+          next[p] = c;
+          lev_w[c] = lv;
+        }
+      }
+    }
+    // emit row i
+    int64_t o = optr[i];
+    for (int32_t c = head; c != -2; c = next[c]) {
+      if (lev_w[c] <= k) {
+        if (o >= budget) {  // out of space: clean up and signal retry
+          for (int32_t cc = head; cc != -2; cc = next[cc]) lev_w[cc] = -1;
+          return -1;
+        }
+        ocol[o] = c;
+        levels[o] = lev_w[c];
+        ++o;
+      }
+    }
+    optr[i + 1] = o;
+    // reset workspace
+    for (int32_t c = head; c != -2; ) {
+      const int32_t nx = next[c];
+      lev_w[c] = -1;
+      next[c] = -1;
+      c = nx;
+    }
+  }
+  return optr[n];
+}
+
+}  // extern "C"

@@ -184,3 +184,50 @@ def test_cli_mesh_flag(capsys):
     assert rc == 0
     cap = capsys.readouterr().out
     assert "Iterations:" in cap
+
+
+def test_replicated_tail_split(mesh8):
+    """Small levels run replicated (merge analogue): deep hierarchy splits,
+    convergence matches the serial path."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(16)
+    s = DistAMGSolver(A, mesh8,
+                      AMGParams(dtype=jnp.float64, coarse_enough=100),
+                      CG(maxiter=100, tol=1e-8), replicate_below=2000)
+    assert s._split >= 1 and len(s.hier.levels) == s._split
+    assert s.hier.rep.levels       # non-empty replicated tail
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_fully_replicated_small_problem(mesh8):
+    """Single-level hierarchy: the whole preconditioner replicates."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(8)   # 512 rows < coarse_enough
+    s = DistAMGSolver(A, mesh8, AMGParams(dtype=jnp.float64),
+                      CG(maxiter=50, tol=1e-10))
+    assert s._split == 0 and not s.hier.levels
+    x, info = s(rhs)
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-9
+
+
+def test_fully_replicated_block_matrix(mesh8):
+    """Regression: block-unit shapes truncated the gathered residual in the
+    fully-replicated path."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.utils.sample_problem import poisson3d_block
+    A, rhs = poisson3d_block(6, 2)   # 432 scalar rows, single level
+    s = DistAMGSolver(A, mesh8, AMGParams(dtype=jnp.float64),
+                      CG(maxiter=50, tol=1e-10))
+    x, info = s(rhs)
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-9

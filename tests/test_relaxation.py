@@ -145,3 +145,23 @@ def test_as_block_wrapper():
         CG(maxiter=200, tol=1e-8))
     x, info = solve(rhs)
     assert info.resid < 1e-8
+
+
+def test_iluk_level_of_fill():
+    from amgcl_tpu.native import native_iluk_pattern, lib
+    from amgcl_tpu.relaxation.ilu0 import ILUK
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, rhs = poisson3d(8)
+    if lib() is not None:
+        # k=0 pattern must equal A's own pattern
+        optr, ocol = native_iluk_pattern(A, 0)
+        assert np.array_equal(optr, A.ptr)
+        assert np.array_equal(ocol, A.col)
+        # k=1 strictly widens it
+        optr1, ocol1 = native_iluk_pattern(A, 1)
+        assert optr1[-1] > optr[-1]
+    solve = make_solver(
+        A, AMGParams(relax=ILUK(k=1), dtype=jnp.float64, coarse_enough=200),
+        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
