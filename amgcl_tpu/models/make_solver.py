@@ -27,6 +27,7 @@ from amgcl_tpu.solver.cg import CG
 class SolverInfo:
     iters: int
     resid: float
+    history: Any = None   # per-iteration relative residuals when recorded
 
     def __iter__(self):  # (iters, resid) tuple-unpacking like the reference
         yield self.iters
@@ -123,7 +124,10 @@ class make_solver:
             z = hier.apply(r.astype(pdtype))
             return z.astype(rhs.dtype)
 
-        x, iters, resid = self.solver.solve(A_dev, apply_precond, rhs, x0)
+        got = self.solver.solve(A_dev, apply_precond, rhs, x0)
+        x, iters, resid = got[:3]
+        hist = got[3] if len(got) > 3 else None
+        hist_n = iters          # history covers the initial solve only
         if self.refine > 0:
             # correction-form iterative refinement (classic mixed-precision
             # recipe, mixing.hpp's spirit taken further): the outer residual
@@ -159,9 +163,9 @@ class make_solver:
                 if has_abstol:
                     kw["abstol"] = jnp.abs(tol * scale).astype(
                         rhs.real.dtype)
-                dx, it2, _ = self.solver.solve(
+                dx, it2 = self.solver.solve(
                     A_dev, apply_precond, r64.astype(rhs.dtype),
-                    jnp.zeros_like(rhs), **kw)
+                    jnp.zeros_like(rhs), **kw)[:2]
                 x64 = x64 + dx.astype(wide)
                 r64, rt2 = true_res(x64)
                 return (x64, r64, it + it2, k + 1, rt2)
@@ -170,7 +174,7 @@ class make_solver:
             r0, rt0 = true_res(x64)
             x, _, iters, _, resid = _lax.while_loop(
                 cond, body, (x64, r0, iters, 0, rt0))
-        return x, iters, resid
+        return x, iters, resid, hist, hist_n
 
     def __call__(self, rhs, x0=None):
         n = self.A_host.nrows * self.A_host.block_size[0]
@@ -185,9 +189,15 @@ class make_solver:
             x0 = jnp.zeros_like(rhs)
         if self._compiled is None:
             self._compiled = jax.jit(self._solve_fn)
-        x, iters, resid = self._compiled(self.A_dev, self.A_dev64,
-                                         self.precond.hierarchy, rhs, x0)
-        return x, SolverInfo(int(iters), float(resid))
+        got = self._compiled(self.A_dev, self.A_dev64,
+                             self.precond.hierarchy, rhs, x0)
+        x, iters, resid = got[:3]
+        hist = None
+        if len(got) > 3 and got[3] is not None:
+            # slice by the recorded count — NaN filtering would also drop
+            # genuine NaN residuals from a breakdown
+            hist = np.asarray(got[3])[:int(got[4])]
+        return x, SolverInfo(int(iters), float(resid), hist)
 
     def __repr__(self):
         return ("make_solver\n===========\nSolver: %s\n\nPreconditioner:\n%r"

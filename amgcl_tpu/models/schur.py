@@ -62,12 +62,10 @@ class SchurHierarchy:
         return cls(*children, *aux)
 
     def _usolve(self, f):
-        x, _, _ = self.usolver.solve(self.Kuu, self.u_hier.apply, f)
-        return x
+        return self.usolver.solve(self.Kuu, self.u_hier.apply, f)[0]
 
     def _psolve(self, f):
-        x, _, _ = self.psolver.solve(self.S, self.p_hier.apply, f)
-        return x
+        return self.psolver.solve(self.S, self.p_hier.apply, f)[0]
 
     def apply(self, r):
         fu = jnp.take(r, self.u_idx)

@@ -278,7 +278,6 @@ def _transition_ops(Pt: CSR, Rt: CSR, nd, nloc, mesh, dtype):
     rrows = Rt.expanded_rows()
     owner = np.minimum(Rt.col // nloc, nd - 1)
     K2 = 1
-    packs = []
     for s_ in range(nd):
         sel = owner == s_
         if sel.any():
@@ -410,9 +409,10 @@ class DistAMGSolver:
 
         def body(hier, rhs, x0):
             Aop = _LocalOp(hier.system_A())
+            # [:3]: solvers with record_history return an extra element
             x, it, res = solver.solve(
                 Aop, hier.shard_apply, rhs, x0,
-                inner_product=dist_inner_product)
+                inner_product=dist_inner_product)[:3]
             return x, it, res
 
         fn = shard_map(

@@ -21,6 +21,7 @@ class CG:
     tol: float = 1e-8
     abstol: float = 0.0
     verbose: bool = False   # print residual every 5 iterations (cg.hpp:199)
+    record_history: bool = False  # per-iteration relative residuals
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
               abstol=None):
@@ -39,11 +40,11 @@ class CG:
         eps = jnp.maximum(self.tol * norm_scale, abstol)
 
         def cond(state):
-            x, r, p, rho_prev, it, res = state
+            x, r, p, rho_prev, it, res, hist = state
             return (it < self.maxiter) & (res > eps)
 
         def body(state):
-            x, r, p, rho_prev, it, res = state
+            x, r, p, rho_prev, it, res, hist = state
             s = precond(r)
             rho = dot(r, s)
             beta = jnp.where(rho_prev == 0, 0.0, rho / rho_prev)
@@ -53,6 +54,8 @@ class CG:
             x = dev.axpby(alpha, p, 1.0, x)
             r = dev.axpby(-alpha, q, 1.0, r)
             res = jnp.sqrt(jnp.abs(dot(r, r)))
+            if self.record_history:
+                hist = hist.at[it].set((res / norm_scale).real)
             if self.verbose:
                 import jax
                 jax.lax.cond(
@@ -60,10 +63,15 @@ class CG:
                     lambda: jax.debug.print("iter {i}: resid {r:.6e}",
                                             i=it + 1, r=res / norm_scale),
                     lambda: None)
-            return (x, r, p, rho, it + 1, res)
+            return (x, r, p, rho, it + 1, res, hist)
 
         res0 = jnp.sqrt(jnp.abs(dot(r, r)))
-        state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype), 0, res0)
-        x, r, p, rho, iters, res = lax.while_loop(cond, body, state)
+        hist0 = jnp.full(self.maxiter if self.record_history else 1,
+                         jnp.nan, dtype=rhs.real.dtype)
+        state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype), 0, res0,
+                 hist0)
+        x, r, p, rho, iters, res, hist = lax.while_loop(cond, body, state)
         x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
+        if self.record_history:
+            return x, iters, res / norm_scale, hist
         return x, iters, res / norm_scale

@@ -125,3 +125,30 @@ def test_lgmres_small_restart_beats_gmres_stall():
                                                     tol=1e-8))(rhs)
     assert il.resid < 1e-8
     assert il.iters <= ig.iters + 8
+
+
+def test_cg_convergence_history():
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, rhs = poisson3d(12)
+    cg = CG(maxiter=100, tol=1e-10, record_history=True)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        cg)
+    x, info = solve(rhs)
+    vals = np.asarray(info.history)
+    assert len(vals) == info.iters
+    assert np.all(np.diff(np.log10(vals[1:])) < 1)   # broadly decreasing
+    assert abs(vals[-1] - info.resid) < 1e-12
+
+
+def test_history_with_refinement_contract():
+    """Under refinement, history covers the initial solve and its length
+    matches the recorded count (not the accumulated iters)."""
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, rhs = poisson3d(16)
+    cg = CG(maxiter=100, tol=1e-6, record_history=True)
+    solve = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=300),
+                        cg, refine=2)
+    x, info = solve(rhs)
+    assert info.history is not None
+    assert len(info.history) <= info.iters
+    assert not np.any(np.isnan(info.history))
