@@ -1,0 +1,147 @@
+"""Distributed CPR — constrained pressure residual over the mesh
+(reference: amgcl/mpi/cpr.hpp).
+
+Composition of existing sharded pieces: the quasi-IMPES weight contraction
+is a per-shard batched einsum, the pressure stage is a full distributed AMG
+hierarchy (nested ``shard_apply``), and the global stage is a sharded
+diagonal-type smoother sweep on the full block system — everything runs in
+the same shard_map program as the outer Krylov loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.models.cpr import _pressure_matrix
+from amgcl_tpu.relaxation.spai0 import Spai0
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.parallel.mesh import ROWS_AXIS
+from amgcl_tpu.parallel.dist_ell import build_dist_ell
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver, _LocalOp
+
+
+@register_pytree_node_class
+class DistCPRHierarchy:
+    """A_full: sharded scalar view of the block system; W: (nd, ncell_loc, b)
+    sharded weights; p_hier: distributed pressure hierarchy; scale:
+    (nd, nloc) sharded global-smoother diagonal."""
+
+    def __init__(self, A_full, W, p_hier, scale, block):
+        self.A_full = A_full
+        self.W = W
+        self.p_hier = p_hier
+        self.scale = scale
+        self.block = int(block)
+
+    def tree_flatten(self):
+        return (self.A_full, self.W, self.p_hier, self.scale), (self.block,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    def specs(self):
+        return DistCPRHierarchy(
+            self.A_full.specs(), P(ROWS_AXIS, None, None),
+            self.p_hier.specs(), P(ROWS_AXIS, None), self.block)
+
+    def shard_apply(self, r):
+        b = self.block
+        rb = r.reshape(-1, b)
+        rp = jnp.einsum("nb,nb->n", self.W[0], rb)
+        dp = self.p_hier.shard_apply(rp)
+        x = jnp.zeros_like(rb).at[:, 0].set(dp).reshape(r.shape)
+        # global smoothing of the remaining residual
+        res = r - self.A_full.shard_mv(x)
+        return x + self.scale[0] * res
+
+    def system_A(self):
+        return self.A_full
+
+
+class DistCPRSolver(DistAMGSolver):
+    """Distributed Krylov with the CPR preconditioner. ``A`` must be a
+    block CSR (or scalar + block_size)."""
+
+    def __init__(self, A, mesh, block_size: Optional[int] = None,
+                 pressure_prm: Optional[AMGParams] = None,
+                 solver: Any = None, relax: Any = None,
+                 dtype=jnp.float32):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        if not A.is_block:
+            if not block_size or block_size < 2:
+                raise ValueError("CPR needs a block system (block_size >= 2)")
+            A = A.to_block(block_size)
+        b = A.block_size[0]
+        self.mesh = mesh
+        self.solver = solver or CG()
+        nd = mesh.shape[ROWS_AXIS]
+        from types import SimpleNamespace
+        self.prm = SimpleNamespace(dtype=dtype)
+
+        # pressure stage: distributed AMG on the quasi-IMPES reduced matrix
+        W = A.diagonal(invert=True)[:, 0, :]
+        App = _pressure_matrix(A, W)
+        pprm = pressure_prm or AMGParams(dtype=dtype)
+        p_solver = DistAMGSolver(App, mesh, pprm)
+        # global smoother on the scalar view of the block system
+        As = A.unblock()
+        dA = build_dist_ell(As, mesh, dtype)
+        st = (relax or Spai0()).build(A, dtype)
+        if hasattr(st, "scale") and np.ndim(st.scale) == 1:
+            scale = np.asarray(st.scale, dtype=np.float64)
+        else:
+            # scalar spai0 of the unblocked system beats plain Jacobi and
+            # needs no block-state sharding (block-M sharding: round 2)
+            import warnings
+            warnings.warn(
+                "distributed CPR shards diagonal-type global smoothers; "
+                "%s falls back to scalar SPAI-0"
+                % type(relax or Spai0()).__name__)
+            scale = np.asarray(Spai0().build(As, dtype).scale,
+                               dtype=np.float64)
+        self.n = As.nrows
+        nloc = dA.nloc
+        self.n_pad = nloc * nd
+        pad = np.zeros(self.n_pad)
+        pad[:len(scale)] = scale
+        # weights padded to the cell partition of the scalar padding:
+        # n_pad is a multiple of nd; require it to also tile into b-cells
+        if nloc % b:
+            raise ValueError(
+                "shard size %d does not tile into %d-cell blocks — pad the "
+                "system or choose a divisible mesh" % (nloc, b))
+        # the scalar partition's cell view must coincide with the pressure
+        # hierarchy's own partition, so the nested shard_apply sees aligned
+        # local vectors
+        first = (p_solver.hier.levels[0].A if p_solver.hier.levels
+                 else p_solver.hier.top_A)
+        if first.nloc * b != nloc:
+            raise ValueError(
+                "pressure partition (%d cells/shard) does not align with "
+                "the block partition (%d rows/shard)" % (first.nloc, nloc))
+        Wpad = np.zeros((self.n_pad // b, b))
+        Wpad[:A.nrows] = W
+        shard3 = NamedSharding(mesh, P(ROWS_AXIS, None, None))
+        shard2 = NamedSharding(mesh, P(ROWS_AXIS, None))
+        self.hier = DistCPRHierarchy(
+            dA,
+            jax.device_put(jnp.asarray(
+                Wpad.reshape(nd, nloc // b, b), dtype=dtype), shard3),
+            p_solver.hier,
+            jax.device_put(jnp.asarray(
+                pad.reshape(nd, nloc), dtype=dtype), shard2),
+            b)
+        self._compiled = None
+
+    def __repr__(self):
+        return "DistCPRSolver over %d devices" % self.mesh.shape[ROWS_AXIS]

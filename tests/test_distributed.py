@@ -231,3 +231,20 @@ def test_fully_replicated_block_matrix(mesh8):
     x, info = s(rhs)
     r = rhs - A.spmv(x)
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-9
+
+
+def test_dist_cpr(mesh8):
+    from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+    from tests.test_coupled import reservoir_like
+    A, rhs = reservoir_like(8, 3)
+    s = DistCPRSolver(A, mesh8,
+                      pressure_prm=AMGParams(dtype=jnp.float64,
+                                             coarse_enough=100),
+                      solver=BiCGStab(maxiter=200, tol=1e-8),
+                      dtype=jnp.float64)
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
