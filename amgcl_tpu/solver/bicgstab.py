@@ -1,6 +1,9 @@
-"""Preconditioned BiCGStab for non-symmetric systems, right-preconditioned
-(the reference defaults to side=right, amgcl/solver/bicgstab.hpp with
-precond_side option). Whole iteration is one ``lax.while_loop``."""
+"""Preconditioned BiCGStab for non-symmetric systems with selectable
+preconditioning side (reference: amgcl/solver/bicgstab.hpp, default
+side::right; the convergence criterion uses the UNPRECONDITIONED rhs norm
+for both sides, bicgstab.hpp:168-186, and with side=left the tracked
+residual is the preconditioned one). Whole iteration is one
+``lax.while_loop``."""
 
 from __future__ import annotations
 
@@ -17,16 +20,35 @@ class BiCGStab:
     maxiter: int = 100
     tol: float = 1e-8
     abstol: float = 0.0
+    precond_side: str = "right"
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        if self.precond_side not in ("left", "right"):
+            raise ValueError("precond_side must be 'left' or 'right', got %r"
+                             % self.precond_side)
+        left = self.precond_side == "left"
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
-        r = dev.residual(rhs, A, x)
-        rhat = r
+
+        # criterion on the unpreconditioned rhs norm for BOTH sides
         norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
         scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
         eps = jnp.maximum(self.tol * scale,
                           jnp.asarray(self.abstol, rhs.dtype).real)
+
+        if left:
+            r = precond(dev.residual(rhs, A, x))
+        else:
+            r = dev.residual(rhs, A, x)
+        rhat = r
+
+        def apply_op(p):
+            """(v, z): v enters the recurrence, z accumulates into x."""
+            if left:
+                return precond(dev.spmv(A, p)), p
+            z = precond(p)
+            return dev.spmv(A, z), z
+
         one = jnp.ones((), rhs.dtype)
 
         def cond(st):
@@ -39,13 +61,11 @@ class BiCGStab:
             beta = (rho_new / jnp.where(rho == 0, 1, rho)) \
                 * (alpha / jnp.where(omega == 0, 1, omega))
             p = r + beta * (p - omega * v)
-            phat = precond(p)
-            v = dev.spmv(A, phat)
+            v, phat = apply_op(p)
             denom = dot(rhat, v)
             alpha = rho_new / jnp.where(denom == 0, 1, denom)
             s = r - alpha * v
-            shat = precond(s)
-            t = dev.spmv(A, shat)
+            t, shat = apply_op(s)
             tt = dot(t, t)
             omega = dot(t, s) / jnp.where(tt == 0, 1, tt)
             x = x + alpha * phat + omega * shat

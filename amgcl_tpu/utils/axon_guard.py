@@ -1,0 +1,24 @@
+"""Workaround for this image's axon TPU plugin.
+
+The plugin force-registers itself (sitecustomize) and overrides the
+``jax_platforms`` config at registration time, which beats the env var;
+when its tunnel is wedged, ANY backend init hangs forever — even with
+``JAX_PLATFORMS=cpu``. Callers that must never touch the TPU (tests, the
+virtual-mesh dryrun) drop the factory and force cpu before the first
+backend init. Shared by tests/conftest.py and __graft_entry__.py so the
+two copies cannot drift.
+"""
+
+from __future__ import annotations
+
+
+def force_cpu_backend() -> None:
+    import jax
+    try:
+        from jax._src import xla_bridge as _xb
+        _xb._backend_factories.pop("axon", None)
+    except Exception:
+        pass
+    # outside the try: the config override must happen even if the private
+    # factory registry moved in a newer JAX
+    jax.config.update("jax_platforms", "cpu")

@@ -15,17 +15,8 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax
 
-# The axon TPU plugin (sitecustomize in this image) force-registers itself
-# and hooks backend lookup; when its tunnel is wedged, ANY backend init
-# hangs forever — even with JAX_PLATFORMS=cpu. Tests must never touch the
-# TPU, so drop the factory before the first backend init.
-try:
-    from jax._src import xla_bridge as _xb
-    _xb._backend_factories.pop("axon", None)
-except Exception:
-    pass
-# the plugin also overrides the jax_platforms config at registration time
-# (which beats the env var) — force it back
-jax.config.update("jax_platforms", "cpu")
+from amgcl_tpu.utils.axon_guard import force_cpu_backend
+
+force_cpu_backend()
 
 jax.config.update("jax_enable_x64", True)

@@ -152,3 +152,14 @@ def test_history_with_refinement_contract():
     assert info.history is not None
     assert len(info.history) <= info.iters
     assert not np.any(np.isnan(info.history))
+
+
+def test_bicgstab_precond_side():
+    A, rhs = convection_diffusion_2d(20, eps=0.05)
+    for side in ("right", "left"):
+        solve = make_solver(
+            A, AMGParams(dtype=jnp.float64, coarse_enough=150),
+            BiCGStab(maxiter=200, tol=1e-8, precond_side=side))
+        x, info = solve(rhs)
+        r = rhs - A.spmv(np.asarray(x))
+        assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5, side
