@@ -1,0 +1,155 @@
+"""Distributed Schur pressure correction (reference:
+amgcl/mpi/schur_pressure_correction.hpp).
+
+The u/p field split over a sharded vector is expressed with selection
+matrices S_u (nu x n) and S_p (np x n) — one entry per row — distributed as
+ordinary :class:`DistEllMatrix` operators: applying them IS the
+redistribution (the general all_to_all halo plan does the data movement),
+and their transposes scatter the fields back. The two inner solves are full
+distributed AMG hierarchies; the off-diagonal couplings are sharded
+rectangular operators. Everything composes inside one shard_map program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import numpy as np
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.parallel.mesh import ROWS_AXIS
+from amgcl_tpu.parallel.dist_ell import build_dist_ell
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+
+
+@register_pytree_node_class
+class DistSchurHierarchy:
+    def __init__(self, A_full, Su, Sp, SuT, SpT, Kup, Kpu, u_hier, p_hier):
+        self.A_full = A_full
+        self.Su = Su
+        self.Sp = Sp
+        self.SuT = SuT
+        self.SpT = SpT
+        self.Kup = Kup
+        self.Kpu = Kpu
+        self.u_hier = u_hier
+        self.p_hier = p_hier
+
+    def tree_flatten(self):
+        return ((self.A_full, self.Su, self.Sp, self.SuT, self.SpT,
+                 self.Kup, self.Kpu, self.u_hier, self.p_hier), None)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def specs(self):
+        return DistSchurHierarchy(
+            self.A_full.specs(), self.Su.specs(), self.Sp.specs(),
+            self.SuT.specs(), self.SpT.specs(), self.Kup.specs(),
+            self.Kpu.specs(), self.u_hier.specs(), self.p_hier.specs())
+
+    def shard_apply(self, r):
+        fu = self.Su.shard_mv(r)
+        fp = self.Sp.shard_mv(r)
+        u1 = self.u_hier.shard_apply(fu)
+        p = self.p_hier.shard_apply(fp - self.Kpu.shard_mv(u1))
+        u = self.u_hier.shard_apply(fu - self.Kup.shard_mv(p))
+        return self.SuT.shard_mv(u) + self.SpT.shard_mv(p)
+
+    def system_A(self):
+        return self.A_full
+
+
+def _selection(indices: np.ndarray, n: int) -> CSR:
+    """Rows pick the listed global entries: S[i, indices[i]] = 1."""
+    k = len(indices)
+    return CSR(np.arange(k + 1, dtype=np.int64),
+               indices.astype(np.int32), np.ones(k), n)
+
+
+class DistSchurSolver(DistAMGSolver):
+    """Distributed Krylov with the Schur pressure correction."""
+
+    def __init__(self, A, mesh, pmask, usolver_prm: Optional[AMGParams] = None,
+                 psolver_prm: Optional[AMGParams] = None,
+                 solver: Any = None, simplec_dia: bool = True,
+                 dtype=jnp.float32):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        pmask = np.asarray(pmask, dtype=bool)
+        if pmask.shape != (A.nrows,) or not pmask.any() or pmask.all():
+            raise ValueError("pmask must split the rows into two nonempty "
+                             "fields")
+        self.mesh = mesh
+        self.solver = solver or CG()
+        nd = mesh.shape[ROWS_AXIS]
+        from types import SimpleNamespace
+        self.prm = SimpleNamespace(dtype=dtype)
+
+        m = A.to_scipy()
+        ui = np.flatnonzero(~pmask)
+        pi = np.flatnonzero(pmask)
+        Kuu = CSR.from_scipy(m[ui][:, ui].tocsr())
+        Kup = CSR.from_scipy(m[ui][:, pi].tocsr())
+        Kpu = CSR.from_scipy(m[pi][:, ui].tocsr())
+        Kpp = CSR.from_scipy(m[pi][:, pi].tocsr())
+        if simplec_dia:
+            duu = np.asarray(abs(Kuu.to_scipy()).sum(axis=1)).ravel()
+        else:
+            duu = Kuu.diagonal().real
+        dinv = 1.0 / np.where(duu != 0, duu, 1.0)
+        S = CSR.from_scipy((Kpp.to_scipy() - (Kpu.to_scipy()
+                            .multiply(dinv[None, :]) @ Kup.to_scipy()))
+                           .tocsr())
+
+        self.u_solver = DistAMGSolver(Kuu, mesh,
+                                      usolver_prm or AMGParams(dtype=dtype))
+        self.p_solver = DistAMGSolver(S, mesh,
+                                      psolver_prm or AMGParams(dtype=dtype))
+
+        self.n = A.nrows
+        dA = build_dist_ell(A, mesh, dtype)
+        self.n_pad = dA.nloc * nd
+        nu_pad = self.u_solver.n_pad
+        np_pad = self.p_solver.n_pad
+
+        # selection matrices, padded to the partitions on both sides
+        Su = _selection(ui, self.n_pad)
+        Su.ptr = np.concatenate(
+            [Su.ptr, np.full(nu_pad - len(ui), Su.ptr[-1])])
+        Sp = _selection(pi, self.n_pad)
+        Sp.ptr = np.concatenate(
+            [Sp.ptr, np.full(np_pad - len(pi), Sp.ptr[-1])])
+        # transposes of the padded selections are already (n_pad, nu_pad)
+        # and (n_pad, np_pad)
+        SuT = CSR.from_scipy(Su.to_scipy().T.tocsr())
+        SpT = CSR.from_scipy(Sp.to_scipy().T.tocsr())
+
+        # pad off-diagonal couplings to the u/p partitions
+        def pad_rect(M, rows_to, cols_to):
+            out = M.copy()
+            out.ptr = np.concatenate(
+                [out.ptr, np.full(rows_to - out.nrows, out.ptr[-1])])
+            out.ncols = cols_to
+            return out
+
+        self.hier = DistSchurHierarchy(
+            dA,
+            build_dist_ell(Su, mesh, dtype),
+            build_dist_ell(Sp, mesh, dtype),
+            build_dist_ell(SuT, mesh, dtype),
+            build_dist_ell(SpT, mesh, dtype),
+            build_dist_ell(pad_rect(Kup, nu_pad, np_pad), mesh, dtype),
+            build_dist_ell(pad_rect(Kpu, np_pad, nu_pad), mesh, dtype),
+            self.u_solver.hier, self.p_solver.hier)
+        self._compiled = None
+
+    def __repr__(self):
+        return ("DistSchurSolver over %d devices\n[U]\n%r\n[P]\n%r"
+                % (self.mesh.shape[ROWS_AXIS], self.u_solver.host_amg,
+                   self.p_solver.host_amg))

@@ -67,10 +67,14 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None):
         v = V[j] if direction is None else direction(j, V)
         w, z = apply_op(v)
         Z = Z.at[j].set(z)
-        # CGS2: h = V w; w -= V^T h; second pass for stability
-        h1 = jnp.conj(V) @ w
+        # CGS2: h = V w; w -= V^T h; second pass for stability. The basis
+        # dots go through the inner-product seam (vmapped) so the same code
+        # is correct inside shard_map, where a raw V @ w would silently
+        # compute shard-local (unreduced) products.
+        vdots = jax.vmap(lambda vv: dot(vv, w))
+        h1 = vdots(V)
         w = w - V.T @ h1
-        h2 = jnp.conj(V) @ w
+        h2 = vdots(V)
         w = w - V.T @ h2
         h = h1 + h2
         hn = jnp.sqrt(jnp.abs(dot(w, w)))

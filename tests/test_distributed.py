@@ -248,3 +248,21 @@ def test_dist_cpr(mesh8):
     assert info.resid < 1e-8
     r = rhs - A.spmv(x)
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_dist_schur(mesh8):
+    from amgcl_tpu.parallel.dist_schur import DistSchurSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.gmres import FGMRES
+    from tests.test_coupled import stokes_like
+    A, pmask = stokes_like(10)
+    rhs = np.ones(A.nrows)
+    s = DistSchurSolver(A, mesh8, pmask,
+                        AMGParams(dtype=jnp.float64, coarse_enough=100),
+                        AMGParams(dtype=jnp.float64, coarse_enough=100),
+                        solver=FGMRES(maxiter=300, tol=1e-8),
+                        dtype=jnp.float64)
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
