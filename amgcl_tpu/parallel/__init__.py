@@ -2,11 +2,22 @@
 
 The TPU-native equivalent of the reference's MPI layer (amgcl/mpi/):
 row-block domain decomposition over a ``jax.sharding.Mesh``, halo exchange
-via ``lax.ppermute``/gathers instead of Isend/Irecv, and ``lax.psum`` inner
-products instead of MPI_Allreduce (reference:
+via ``lax.all_to_all``/``ppermute`` instead of Isend/Irecv, and ``lax.psum``
+inner products instead of MPI_Allreduce (reference:
 amgcl/mpi/distributed_matrix.hpp:316-557, amgcl/mpi/inner_product.hpp:45-67).
 """
 
 from amgcl_tpu.parallel.mesh import make_mesh, ROWS_AXIS
+from amgcl_tpu.parallel.dist_ell import DistEllMatrix, build_dist_ell
+from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix, dist_inner_product
+from amgcl_tpu.parallel.dist_solver import dist_cg
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+from amgcl_tpu.parallel.deflation import DistDeflatedSolver
+from amgcl_tpu.parallel.block_precond import DistBlockPreconditioner
+from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
+from amgcl_tpu.parallel.dist_schur import DistSchurSolver
 
-__all__ = ["make_mesh", "ROWS_AXIS"]
+__all__ = ["make_mesh", "ROWS_AXIS", "DistEllMatrix", "build_dist_ell",
+           "DistDiaMatrix", "dist_inner_product", "dist_cg", "DistAMGSolver",
+           "DistDeflatedSolver", "DistBlockPreconditioner", "DistCPRSolver",
+           "DistSchurSolver"]
