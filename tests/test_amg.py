@@ -158,3 +158,14 @@ def test_rebuild_fast_path():
     r = rhs - A2.spmv(np.asarray(x2))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
     assert np.allclose(np.asarray(x2), np.asarray(x1) / 2.0, atol=1e-6)
+
+
+def test_bfloat16_hierarchy_smoke():
+    """bf16 preconditioner inside an f32 Krylov loop — the TPU-lean mixed
+    precision configuration."""
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.bfloat16),
+                        CG(maxiter=200, tol=1e-5), solver_dtype=jnp.float32)
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x, dtype=np.float64))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4

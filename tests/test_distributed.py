@@ -266,3 +266,17 @@ def test_dist_schur(mesh8):
     assert info.resid < 1e-8
     r = rhs - A.spmv(x)
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_dist_lgmres(mesh8):
+    """LGMRES's own Arnoldi body must also reduce basis dots globally."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.lgmres import LGMRES
+    A, rhs = poisson3d(12)
+    s = DistAMGSolver(A, mesh8,
+                      AMGParams(dtype=jnp.float64, coarse_enough=300),
+                      LGMRES(M=10, K=2, maxiter=200, tol=1e-9))
+    x, info = s(rhs)
+    r = rhs - A.spmv(x)
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
