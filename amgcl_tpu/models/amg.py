@@ -120,6 +120,13 @@ class Hierarchy:
         return self.levels[0].A
 
 
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "K", "M", "G"):
+        if n < 1024 or unit == "G":
+            return "%.2f %s" % (n, unit)
+        n /= 1024.0
+
+
 class AMG:
     """Host-side builder + owner of the device hierarchy.
 
@@ -228,6 +235,7 @@ class AMG:
             "Grid complexity:     %.2f" % (
                 sum(l[0].nrows for l in self.host_levels)
                 / max(self.host_levels[0][0].nrows, 1)),
+            "Memory footprint:    %s" % _human_bytes(self.bytes()),
             "",
             "level     unknowns       nonzeros",
             "---------------------------------",
@@ -237,12 +245,13 @@ class AMG:
         return "\n".join(lines)
 
     def bytes(self):
+        """Device bytes of the whole hierarchy pytree — operators,
+        transfers, smoother states, coarse factor (the reference's bytes()
+        additionally counts its preallocated f/u/t work vectors,
+        amg.hpp:332-343; here those are XLA-managed temporaries)."""
+        import jax
         total = 0
-        for lv in self.hierarchy.levels:
-            for m in (lv.A, lv.P, lv.R):
-                if m is not None:
-                    total += m.bytes()
-        if self.hierarchy.coarse is not None:
-            total += self.hierarchy.coarse.inv.size \
-                * self.hierarchy.coarse.inv.dtype.itemsize
+        for leaf in jax.tree.leaves(self.hierarchy):
+            if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+                total += leaf.size * leaf.dtype.itemsize
         return total

@@ -169,3 +169,10 @@ def test_bfloat16_hierarchy_smoke():
     x, info = solve(rhs)
     r = rhs - A.spmv(np.asarray(x, dtype=np.float64))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4
+
+
+def test_memory_report():
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64))
+    assert amg.bytes() > 0
+    assert "Memory footprint:" in repr(amg)
