@@ -24,6 +24,12 @@ def timed(fn):
 
 
 def main():
+    # CPU-only suite: drop the axon plugin's forced registration (its
+    # wedged tunnel otherwise hangs backend init even with
+    # JAX_PLATFORMS=cpu)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from amgcl_tpu.utils.axon_guard import force_cpu_backend
+        force_cpu_backend()
     import jax
     jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
