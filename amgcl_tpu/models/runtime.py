@@ -209,11 +209,16 @@ def make_solver_from_config(A, prm=None, block_size: int = 1,
     raise ValueError("unknown precond.class %r" % pclass)
 
 
+def _parse_dtype(v):
+    return DTYPES[v] if isinstance(v, str) else v
+
+
 def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
     """Distributed runtime composition (the reference's mpi runtime
     wrappers, amgcl/mpi/preconditioner.hpp): precond.class selects
-    amg (DistAMGSolver), deflated_amg (subdomain deflation), or
-    block (additive-Schwarz ILU)."""
+    amg (DistAMGSolver), deflated_amg (subdomain deflation), block
+    (additive-Schwarz ILU), or cpr (distributed CPR; nested
+    precond.pressure.* params for the pressure hierarchy)."""
     from amgcl_tpu.parallel.mesh import make_mesh
     from amgcl_tpu.parallel.dist_amg import DistAMGSolver
     from amgcl_tpu.parallel.deflation import DistDeflatedSolver
@@ -233,8 +238,7 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         return DistDeflatedSolver(A, mesh, precond_params_from_dict(pcfg),
                                   solver)
     if pclass == "block":
-        dtype = pcfg.get("dtype", "float32")
-        dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
+        dtype = _parse_dtype(pcfg.get("dtype", "float32"))
         known = {"class", "dtype", "sweeps", "jacobi_iters"}
         for k in pcfg:
             if k not in known:
@@ -243,6 +247,22 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
             A, mesh, solver, dtype,
             sweeps=int(pcfg.get("sweeps", 5)),
             jacobi_iters=int(pcfg.get("jacobi_iters", 2)))
+    if pclass == "cpr":
+        from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
+        dtype = _parse_dtype(pcfg.get("dtype", "float32"))
+        known = {"class", "dtype", "block_size", "pressure"}
+        for k in pcfg:
+            if k not in known:
+                warnings.warn("unknown parameter precond.%s" % k)
+        # the pressure hierarchy inherits the CPR dtype unless overridden
+        press = dict(pcfg.get("pressure", {}))
+        press.setdefault("dtype", dtype)
+        return DistCPRSolver(
+            A, mesh,
+            block_size=int(pcfg["block_size"]) if "block_size" in pcfg
+            else None,
+            pressure_prm=precond_params_from_dict(press),
+            solver=solver, dtype=dtype)
     raise ValueError("unknown distributed precond.class %r" % pclass)
 
 

@@ -280,3 +280,17 @@ def test_dist_lgmres(mesh8):
     x, info = s(rhs)
     r = rhs - A.spmv(x)
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
+
+
+def test_dist_cpr_runtime_config(mesh8):
+    from amgcl_tpu.models.runtime import make_dist_solver_from_config
+    from tests.test_coupled import reservoir_like
+    A, rhs = reservoir_like(8, 3)
+    s = make_dist_solver_from_config(
+        A, mesh8, {"precond.class": "cpr", "precond.dtype": "float64",
+                   "precond.pressure.coarse_enough": 100,
+                   "precond.pressure.dtype": "float64",
+                   "solver.type": "bicgstab", "solver.tol": 1e-8,
+                   "solver.maxiter": 200})
+    x, info = s(rhs)
+    assert info.resid < 1e-8
