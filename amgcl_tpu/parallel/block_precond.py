@@ -86,12 +86,9 @@ class DistBlockPreconditioner(DistAMGSolver):
         same = (rows // nloc) == (A.col // nloc)
         Abd = A.filter_rows(same)
         # keep unit diagonal on padded/empty rows implicitly via udia guard
-        from amgcl_tpu.relaxation.ilu0 import _chow_patel_build
-        m = Abd.to_scipy().astype(np.float64)
-        m.sort_indices()
-        Lh, Uh, udia = _chow_patel_build(
-            m.indptr, m.indices, m.data, A.nrows, sweeps, jacobi_iters,
-            dtype, return_host=True)
+        from amgcl_tpu.relaxation.ilu0 import ILU0
+        Lh, Uh, udia = ILU0(sweeps=sweeps,
+                            jacobi_iters=jacobi_iters).build_host(Abd)
         dA = build_dist_ell(A, mesh, dtype)
         dL = build_dist_ell(Lh, mesh, dtype)
         dU = build_dist_ell(Uh, mesh, dtype)

@@ -406,13 +406,25 @@ class DistAMGSolver:
     def _build_compiled(self):
         solver = self.solver
         hier_specs = self.hier.specs()
+        n_true = self.n
+        nloc = self.n_pad // self.mesh.shape[ROWS_AXIS]
 
         def body(hier, rhs, x0):
             Aop = _LocalOp(hier.system_A())
+            kw = {}
+            # IDR(s) derives its shadow space from GLOBAL row indices so the
+            # distributed run uses exactly the serial shadow space (see
+            # solver/idrs.py); hand it the shard's global index window.
+            from amgcl_tpu.solver.idrs import IDRs
+            if isinstance(solver, IDRs):
+                kw = dict(
+                    row_index=lax.axis_index(ROWS_AXIS) * nloc
+                    + jnp.arange(nloc),
+                    n_valid=n_true)
             # [:3]: solvers with record_history return an extra element
             x, it, res = solver.solve(
                 Aop, hier.shard_apply, rhs, x0,
-                inner_product=dist_inner_product)[:3]
+                inner_product=dist_inner_product, **kw)[:3]
             return x, it, res
 
         fn = shard_map(

@@ -132,12 +132,18 @@ class ILU0:
     sweeps: int = 5          # Chow-Patel construction sweeps
     jacobi_iters: int = 2    # approximate triangular-solve iterations
 
-    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+    def build(self, A: CSR, dtype=jnp.float32, return_host=False):
         S = A.unblock() if A.is_block else A
         m = S.to_scipy().astype(np.float64)
         m.sort_indices()
         return _chow_patel_build(m.indptr, m.indices, m.data, m.shape[0],
-                                 self.sweeps, self.jacobi_iters, dtype)
+                                 self.sweeps, self.jacobi_iters, dtype,
+                                 return_host=return_host)
+
+    def build_host(self, A: CSR):
+        """(L, U, udia) host factors — the distributed layer shards these
+        with its own halo plans (reference: amgcl/mpi/relaxation/ilu0.hpp)."""
+        return self.build(A, return_host=True)
 
 
 @dataclass
@@ -155,7 +161,10 @@ class ILUT:
     sweeps: int = 6
     jacobi_iters: int = 2
 
-    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+    def build_host(self, A: CSR):
+        return self.build(A, return_host=True)
+
+    def build(self, A: CSR, dtype=jnp.float32, return_host=False):
         from amgcl_tpu.relaxation.spai1 import gather_sparse_entries
         S = A.unblock() if A.is_block else A
         m = S.to_scipy().astype(np.float64)
@@ -205,7 +214,8 @@ class ILUT:
         frows = np.repeat(np.arange(n), np.diff(full.indptr))
         fvals = gather_sparse_entries(m, frows, full.indices)
         return _chow_patel_build(full.indptr, full.indices, fvals, n,
-                                 self.sweeps, self.jacobi_iters, dtype)
+                                 self.sweeps, self.jacobi_iters, dtype,
+                                 return_host=return_host)
 
 
 @dataclass
@@ -219,7 +229,10 @@ class ILUK:
     sweeps: int = 8
     jacobi_iters: int = 2
 
-    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+    def build_host(self, A: CSR):
+        return self.build(A, return_host=True)
+
+    def build(self, A: CSR, dtype=jnp.float32, return_host=False):
         from amgcl_tpu.native import native_iluk_pattern
         from amgcl_tpu.relaxation.spai1 import gather_sparse_entries
         S = A.unblock() if A.is_block else A
@@ -229,12 +242,14 @@ class ILUK:
         got = native_iluk_pattern(base, self.k)
         if got is None:
             return ILUP(p=self.k, sweeps=self.sweeps,
-                        jacobi_iters=self.jacobi_iters).build(A, dtype)
+                        jacobi_iters=self.jacobi_iters).build(
+                            A, dtype, return_host=return_host)
         optr, ocol = got
         frows = np.repeat(np.arange(m.shape[0]), np.diff(optr))
         fvals = gather_sparse_entries(m, frows, ocol)
         return _chow_patel_build(optr, ocol, fvals, m.shape[0],
-                                 self.sweeps, self.jacobi_iters, dtype)
+                                 self.sweeps, self.jacobi_iters, dtype,
+                                 return_host=return_host)
 
 
 @dataclass
@@ -247,7 +262,10 @@ class ILUP:
     sweeps: int = 8
     jacobi_iters: int = 2
 
-    def build(self, A: CSR, dtype=jnp.float32) -> ILU0State:
+    def build_host(self, A: CSR):
+        return self.build(A, return_host=True)
+
+    def build(self, A: CSR, dtype=jnp.float32, return_host=False):
         from amgcl_tpu.relaxation.spai1 import gather_sparse_entries
         S = A.unblock() if A.is_block else A
         m = S.to_scipy().astype(np.float64)
@@ -265,4 +283,4 @@ class ILUP:
         wvals = gather_sparse_entries(m, wrows, widen.indices)
         return _chow_patel_build(widen.indptr, widen.indices, wvals,
                                  m.shape[0], self.sweeps, self.jacobi_iters,
-                                 dtype)
+                                 dtype, return_host=return_host)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -64,9 +65,12 @@ class BiCGStabL:
                 R = R.at[j + 1].set(op(R[j]))
                 x = x + alpha * U[0]
             # -- MR part: minimize ||R[0] - sum_j g_j R[j]|| over j=1..L --
+            # Gram products go through the inner-product seam (vmapped) so
+            # they stay globally reduced inside shard_map; a raw conj(Z)@Z.T
+            # would be shard-local and silently wrong distributed.
             Z = R[1:]                       # (L, n)
-            G = jnp.conj(Z) @ Z.T           # (L, L) Gram
-            rhs_g = jnp.conj(Z) @ R[0]
+            G = jax.vmap(lambda zi: jax.vmap(lambda zj: dot(zi, zj))(Z))(Z)
+            rhs_g = jax.vmap(lambda zi: dot(zi, R[0]))(Z)
             gam = jnp.linalg.solve(
                 G + 1e-300 * jnp.eye(Lp, dtype=dtype), rhs_g)
             x = x + jnp.tensordot(gam, R[:Lp], axes=1)

@@ -2,20 +2,46 @@
 (van Gijzen & Sonneveld 2011 prototype; reference: amgcl/solver/idrs.hpp,
 default s=4, deterministic shadow space).
 
-The shadow space P is a fixed pseudo-random (s, n) block seeded
-deterministically (the reference seeds per-rank the same way); s is static,
-so the inner k-loop unrolls with masked slices instead of dynamic shapes.
+The shadow space P is a fixed pseudo-random (s, n) block generated
+per-COLUMN from the global row index (``jax.random.fold_in`` of a fixed
+key), then orthonormalized with modified Gram-Schmidt routed through the
+inner-product seam.  That makes the shadow space a function of the GLOBAL
+problem only: inside ``shard_map`` each shard hashes its own global row
+indices and the MGS dots psum-reduce, so the distributed run uses exactly
+the serial shadow space (the round-1 version drew P from the local vector
+length — a different space per shard — and its P-dots were shard-local).
+s is static, so the inner k-loop unrolls with masked slices instead of
+dynamic shapes.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
 
-import numpy as np
+import jax
 import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+
+
+def _shadow_block(s, row_index, n_valid, dtype, dot):
+    """Deterministic (s, nloc) shadow block: column j is a hash of the
+    GLOBAL row index j, zeroed on padding rows (>= n_valid), then MGS-
+    orthonormalized with globally-reduced dots."""
+    key = jax.random.PRNGKey(4321)
+    cols = jax.vmap(
+        lambda j: jax.random.normal(jax.random.fold_in(key, j), (s,)))(
+            row_index)                       # (nloc, s)
+    P = cols.T.astype(dtype)
+    if n_valid is not None:
+        P = P * (row_index < n_valid).astype(dtype)[None, :]
+    for i in range(s):
+        for l in range(i):
+            P = P.at[i].add(-dot(P[l], P[i]) * P[l])
+        nrm = jnp.sqrt(jnp.abs(dot(P[i], P[i])))
+        P = P.at[i].set(P[i] / jnp.where(nrm == 0, 1.0, nrm))
+    return P
 
 
 @dataclass
@@ -25,18 +51,19 @@ class IDRs:
     tol: float = 1e-8
     replacement: bool = False   # interface parity; smoothing not needed here
 
-    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+    def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
+              row_index=None, n_valid=None):
         dot = inner_product
         s = self.s
         n = rhs.shape[0]
         dtype = rhs.dtype
         x = jnp.zeros_like(rhs) if x0 is None else x0
 
-        rng = np.random.RandomState(4321)
-        Pm = rng.randn(s, n)
-        # orthonormalize the shadow block on the host
-        Pm, _ = np.linalg.qr(Pm.T)
-        P = jnp.asarray(Pm.T, dtype=dtype)
+        idx = jnp.arange(n) if row_index is None else row_index
+        P = _shadow_block(s, idx, n_valid, dtype, dot)
+        # all shadow-space products below go through the dot seam (vmapped)
+        # so they stay globally reduced inside shard_map
+        pdots = jax.vmap(lambda p, v: dot(p, v), in_axes=(0, None))
 
         norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
         scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
@@ -50,7 +77,7 @@ class IDRs:
 
         def body(st):
             x, r, G, U, M, om, it, res = st
-            f = jnp.conj(P) @ r                       # (s,)
+            f = pdots(P, r)                           # (s,)
             for k in range(s):
                 # solve the lower-right (s-k) system M[k:,k:] c = f[k:],
                 # done as a masked full solve: rows/cols < k act as identity
@@ -65,12 +92,12 @@ class IDRs:
                 g = dev.spmv(A, u)
                 # biorthogonalize against P[0..k-1]
                 for i in range(k):
-                    al = (jnp.conj(P[i]) @ g) / M[i, i]
+                    al = dot(P[i], g) / M[i, i]
                     g = g - al * G[i]
                     u = u - al * U[i]
                 G = G.at[k].set(g)
                 U = U.at[k].set(u)
-                M = M.at[:, k].set(jnp.conj(P) @ g)
+                M = M.at[:, k].set(pdots(P, g))
                 beta = f[k] / jnp.where(M[k, k] == 0, 1.0, M[k, k])
                 r = r - beta * G[k]
                 x = x + beta * U[k]
