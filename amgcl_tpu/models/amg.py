@@ -136,10 +136,17 @@ class AMG:
         z = P.hierarchy.apply(r)      # traceable
     """
 
-    def __init__(self, A: CSR, prm: Optional[AMGParams] = None):
+    def __init__(self, A: CSR, prm: Optional[AMGParams] = None,
+                 device_filter=None):
+        """``device_filter(idx, scalar_size, is_last) -> bool`` optionally
+        skips device realization (matrix move + smoother build) for levels
+        a wrapper will re-shard itself — DistAMGSolver passes one so
+        ILU/GS/SPAI states are not built twice per sharded level. Skipped
+        levels get a ``Level(None, None, None, None)`` placeholder."""
         self.prm = prm or AMGParams()
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
+        self._device_filter = device_filter
         self.host_levels = []   # list of (A, P, R) host CSR per level
         self._build(A)
 
@@ -191,7 +198,11 @@ class AMG:
         host = self.host_levels
         dtype = prm.dtype
         dev_levels = []
-        for (Ai, P, R) in host[:-1]:
+        for i, (Ai, P, R) in enumerate(host[:-1]):
+            if self._device_filter is not None and not self._device_filter(
+                    i, Ai.nrows * Ai.block_size[0], False):
+                dev_levels.append(Level(None, None, None, None))
+                continue
             dev_levels.append(Level(
                 dev.to_device(Ai, prm.matrix_format, dtype),
                 prm.relax.build(Ai, dtype),

@@ -69,3 +69,65 @@ class DummyPreconditioner:
 
     def __repr__(self):
         return "dummy"
+
+
+@register_pytree_node_class
+class NestedHierarchy:
+    """A full inner Krylov solve (solver + inner preconditioner) used as
+    the preconditioner application — the runtime's ``class=nested``
+    composition (reference: amgcl/preconditioner/runtime.hpp:147-158,
+    where nested = make_solver<preconditioner, runtime::solver>).
+
+    The inner iteration runs entirely in-graph (the solvers are
+    ``lax.while_loop`` programs), so the outer Krylov still compiles to one
+    XLA program. Pair with a FLEXIBLE outer solver (fgmres) when the inner
+    solve is iterative — a nested Krylov is a nonstationary operator."""
+
+    def __init__(self, A, inner, solver, inner_dtype):
+        self.A = A                    # device matrix for the inner solve
+        self.inner = inner            # inner preconditioner hierarchy
+        self.solver = solver          # inner Krylov object (static)
+        self.inner_dtype = inner_dtype
+
+    def tree_flatten(self):
+        return (self.A, self.inner), (self.solver, self.inner_dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0], children[1], *aux)
+
+    def apply(self, r):
+        def prec(v):
+            return self.inner.apply(
+                v.astype(self.inner_dtype)).astype(r.dtype)
+
+        return self.solver.solve(self.A, prec, r)[0]
+
+    @property
+    def system_matrix(self):
+        return self.A
+
+
+class NestedPreconditioner:
+    """``precond.class=nested``: wraps an inner preconditioner object (with
+    ``.hierarchy``) and an inner solver into a preconditioner usable by
+    ``make_solver`` / the runtime registry."""
+
+    def __init__(self, A, inner_precond, solver, dtype=None,
+                 matrix_format="auto"):
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        self.A_host = A
+        self.inner = inner_precond
+        inner_dtype = getattr(inner_precond, "dtype", None) \
+            or inner_precond.prm.dtype
+        self.dtype = dtype or inner_dtype
+        hier_A = getattr(inner_precond.hierarchy, "system_matrix", None)
+        A_dev = hier_A if hier_A is not None else dev.to_device(
+            A, matrix_format, self.dtype)
+        self.hierarchy = NestedHierarchy(
+            A_dev, inner_precond.hierarchy, solver, inner_dtype)
+
+    def __repr__(self):
+        return "nested(%s over\n%r)" % (type(self.hierarchy.solver).__name__,
+                                        self.inner)

@@ -189,8 +189,6 @@ def make_solver_from_config(A, prm=None, block_size: int = 1,
     pcfg = cfg.get("precond", {})
     scfg = cfg.get("solver", {})
     pclass = str(pcfg.get("class", "amg"))
-    dtype = pcfg.get("dtype", "float32")
-    dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
     solver = solver_from_params(scfg)
     if block_size > 1:
         from amgcl_tpu.models.block_solver import make_block_solver
@@ -201,12 +199,93 @@ def make_solver_from_config(A, prm=None, block_size: int = 1,
                                  precond_params_from_dict(pcfg), solver)
     if pclass == "amg":
         return make_solver(A, precond_params_from_dict(pcfg), solver)
+    return make_solver(A, precond_from_config(A, pcfg), solver)
+
+
+def precond_from_config(A, pcfg: Dict[str, Any]):
+    """``precond.class``-driven preconditioner construction, recursive for
+    ``class=nested`` (reference: amgcl/preconditioner/runtime.hpp:54-423 —
+    nested wraps a full inner make_solver as the preconditioner, configured
+    by its own ``precond.*`` / ``solver.*`` sub-keys)."""
+    from amgcl_tpu.models.preconditioner import NestedPreconditioner
+
+    pclass = str(pcfg.get("class", "amg"))
+    dtype = pcfg.get("dtype", "float32")
+    dtype = DTYPES[dtype] if isinstance(dtype, str) else dtype
+    if pclass == "amg":
+        return AMG(A, precond_params_from_dict(pcfg))
     if pclass == "relaxation":
         relax = relaxation_from_params(pcfg.get("relax", {}))
-        return make_solver(A, AsPreconditioner(A, relax, dtype), solver)
+        return AsPreconditioner(A, relax, dtype)
     if pclass == "dummy":
-        return make_solver(A, DummyPreconditioner(A, dtype), solver)
+        return DummyPreconditioner(A, dtype)
+    if pclass == "nested":
+        inner = precond_from_config(A, pcfg.get("precond", {}))
+        inner_solver = solver_from_params(pcfg.get("solver", {}))
+        # explicit precond.dtype sets the OUTER working precision; default
+        # inherits the inner preconditioner's dtype
+        return NestedPreconditioner(
+            A, inner, inner_solver,
+            dtype=dtype if "dtype" in pcfg else None)
+    if pclass == "schur":
+        from amgcl_tpu.models.schur import SchurPressureCorrection
+
+        def sub(key):
+            sc = pcfg.get(key, {})
+            prm = precond_params_from_dict(sc.get("precond", {})) \
+                if "precond" in sc else None
+            sol = solver_from_params(sc["solver"]) if "solver" in sc \
+                else None
+            return prm, sol
+
+        uprm, usol = sub("usolver")
+        pprm, psol = sub("psolver")
+        n = A.shape[0] if hasattr(A, "shape") else A.nrows
+        return SchurPressureCorrection(
+            A, _parse_pmask(pcfg, n), usolver_prm=uprm, psolver_prm=pprm,
+            usolver=usol, psolver=psol,
+            simplec_dia=_parse_bool(pcfg.get("simplec_dia", True)),
+            dtype=dtype)
+    if pclass == "cpr":
+        from amgcl_tpu.models.cpr import CPR
+        press = dict(pcfg.get("pressure", {}))
+        relax = relaxation_from_params(pcfg["relax"]) \
+            if "relax" in pcfg else None
+        return CPR(A,
+                   block_size=int(pcfg["block_size"])
+                   if "block_size" in pcfg else None,
+                   pressure_prm=precond_params_from_dict(press)
+                   if press else None,
+                   relax=relax, dtype=dtype)
     raise ValueError("unknown precond.class %r" % pclass)
+
+
+def _parse_bool(v):
+    return v.lower() in ("1", "true", "yes") if isinstance(v, str) else \
+        bool(v)
+
+
+def _parse_pmask(pcfg, n):
+    """pmask as an explicit array, or the reference's ``pmask_pattern``
+    strings: ``%start:stride`` / ``<m`` / ``>m``
+    (amgcl/preconditioner/schur_pressure_correction.hpp:141-166)."""
+    import numpy as np
+    if "pmask" in pcfg:
+        return np.asarray(pcfg["pmask"], dtype=bool)
+    pattern = str(pcfg.get("pmask_pattern", ""))
+    if not pattern:
+        raise ValueError("precond.class=schur needs pmask or pmask_pattern")
+    mask = np.zeros(n, dtype=bool)
+    if pattern[0] == "%":
+        start, stride = pattern[1:].split(":")
+        mask[int(start)::int(stride)] = True
+    elif pattern[0] == "<":
+        mask[:min(int(pattern[1:]), n)] = True
+    elif pattern[0] == ">":
+        mask[int(pattern[1:]):] = True
+    else:
+        raise ValueError("unknown pmask_pattern %r" % pattern)
+    return mask
 
 
 def _parse_dtype(v):

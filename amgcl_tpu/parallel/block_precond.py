@@ -17,7 +17,7 @@ from typing import Any
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
@@ -94,11 +94,10 @@ class DistBlockPreconditioner(DistAMGSolver):
         dU = build_dist_ell(Uh, mesh, dtype)
         ui = np.ones(self.n_pad)
         ui[:A.nrows] = 1.0 / udia
+        from amgcl_tpu.parallel.mesh import put_sharded
         self.hier = BlockILUHierarchy(
             dA, dL, dU,
-            jax.device_put(
-                jnp.asarray(ui.reshape(nd, nloc), dtype=dtype),
-                NamedSharding(mesh, P(ROWS_AXIS, None))),
+            put_sharded(ui.reshape(nd, nloc), mesh, dtype),
             jacobi_iters)
         self._compiled = None
 

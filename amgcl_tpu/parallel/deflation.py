@@ -22,7 +22,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
@@ -117,13 +117,12 @@ class DistDeflatedSolver(DistAMGSolver):
         Einv = np.linalg.pinv(E)
 
         dtype = self.prm.dtype
-        sh = NamedSharding(mesh, P(ROWS_AXIS, None, None))
+        from amgcl_tpu.parallel.mesh import put_sharded
 
         def panel(M):
             pad = np.zeros((self.n_pad, k))
             pad[:n] = M
-            return jax.device_put(
-                jnp.asarray(pad.reshape(nd, nloc, k), dtype=dtype), sh)
+            return put_sharded(pad.reshape(nd, nloc, k), mesh, dtype)
 
         self.hier = DeflatedDistHierarchy(
             self.hier, panel(Z), panel(AZ),

@@ -35,7 +35,7 @@ from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.models.make_solver import SolverInfo
 from amgcl_tpu.solver.cg import CG
-from amgcl_tpu.parallel.mesh import ROWS_AXIS
+from amgcl_tpu.parallel.mesh import ROWS_AXIS, put_sharded
 from amgcl_tpu.parallel.dist_ell import (DistEllMatrix,
     build_dist_ell, pack_rows_ell)
 from amgcl_tpu.parallel.dist_matrix import dist_inner_product
@@ -44,34 +44,65 @@ from amgcl_tpu.parallel.dist_matrix import dist_inner_product
 def _pad_vec(v, nloc, nd, dtype):
     out = np.zeros(nloc * nd, dtype=np.float64)
     out[:len(v)] = np.asarray(v, dtype=np.float64)
-    return jnp.asarray(out, dtype=dtype)
+    return out.astype(np.dtype(dtype))   # stays numpy: see mesh.put_sharded
 
 
 @register_pytree_node_class
 class DistSmoother:
-    """Sharded smoother state: 'diag' (spai0/jacobi scale per row) or
-    'cheb' (Chebyshev polynomial — SpMV-only, scalars static)."""
+    """Sharded smoother state, one of five kinds (reference role: the
+    mpi::relaxation::* wrapper set, amgcl/mpi/relaxation/*.hpp — except
+    these shard the GLOBAL smoother state with halo plans instead of
+    factoring rank-local blocks, so distributed math == serial math):
 
-    def __init__(self, kind, scale=None, theta=0.0, delta=1.0, degree=0):
+      'diag'  — per-row scale (spai0 / damped_jacobi)
+      'bdiag' — per-node block scale (block spai0 / block jacobi);
+                scale is (nd, ncell_loc, b, b) over the scalar row layout
+      'cheb'  — Chebyshev polynomial (SpMV-only, scalars static)
+      'ilu'   — global Chow-Patel factors as halo-plan ELL matrices +
+                sharded inverted U-diagonal; Jacobi tri-solves are plain
+                halo SpMVs (amgcl/relaxation/detail/ilu_solve.hpp:44-129)
+      'gs'    — multicolor Gauss-Seidel: global coloring, masks sharded
+                by row, one halo SpMV per color
+      'spai1' — approximate inverse as a halo-plan ELL matrix
+    """
+
+    def __init__(self, kind, scale=None, theta=0.0, delta=1.0, degree=0,
+                 Ls=None, Us=None, uinv=None, jacobi_iters=2, masks=None,
+                 Msp=None):
         self.kind = kind
-        self.scale = scale          # (nd, nloc) or None
+        self.scale = scale          # (nd, nloc) or None; dinv for 'gs'
         self.theta = float(theta)
         self.delta = float(delta)
         self.degree = int(degree)
+        self.Ls = Ls                # DistEllMatrix (strict lower, 'ilu')
+        self.Us = Us                # DistEllMatrix (strict upper, 'ilu')
+        self.uinv = uinv            # (nd, nloc) inverted U diagonal
+        self.jacobi_iters = int(jacobi_iters)
+        self.masks = masks          # (nd, ncolors, nloc) color masks ('gs')
+        self.Msp = Msp              # DistEllMatrix approx inverse ('spai1')
 
     def tree_flatten(self):
-        return (self.scale,), (self.kind, self.theta, self.delta,
-                               self.degree)
+        return ((self.scale, self.Ls, self.Us, self.uinv, self.masks,
+                 self.Msp),
+                (self.kind, self.theta, self.delta, self.degree,
+                 self.jacobi_iters))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(aux[0], children[0], *aux[1:])
+        kind, theta, delta, degree, jacobi_iters = aux
+        scale, Ls, Us, uinv, masks, Msp = children
+        return cls(kind, scale, theta, delta, degree, Ls, Us, uinv,
+                   jacobi_iters, masks, Msp)
 
     def spec(self):
-        return DistSmoother(self.kind,
-                            None if self.scale is None else P(ROWS_AXIS,
-                                                              None),
-                            self.theta, self.delta, self.degree)
+        mat = lambda m: None if m is None else m.specs()
+        vec = lambda v: None if v is None else P(
+            ROWS_AXIS, *([None] * (v.ndim - 1)))
+        return DistSmoother(self.kind, vec(self.scale), self.theta,
+                            self.delta, self.degree, mat(self.Ls),
+                            mat(self.Us), vec(self.uinv),
+                            self.jacobi_iters, vec(self.masks),
+                            mat(self.Msp))
 
     # -- inside shard_map (Aop wraps the level's halo SpMV) ----------------
 
@@ -82,15 +113,50 @@ class DistSmoother:
                             dinv is not None)
         return st.apply(Aop, f)
 
+    def _ilu(self, f):
+        from amgcl_tpu.relaxation.ilu0 import ilu_jacobi_solve
+        return ilu_jacobi_solve(self.Ls.shard_mv, self.Us.shard_mv,
+                                self.uinv[0], self.jacobi_iters, f)
+
+    def _gs_sweep(self, Aop, f, u, reverse):
+        masks = self.masks[0]
+        dinv = self.scale[0]
+        order = range(masks.shape[0] - 1, -1, -1) if reverse \
+            else range(masks.shape[0])
+        for c in order:
+            u = u + masks[c] * (dinv * (f - Aop.mv(u)))
+        return u
+
+    def _bmul(self, f):
+        b = self.scale.shape[-1]
+        fb = f.reshape(-1, b)
+        return jnp.einsum("nij,nj->ni", self.scale[0], fb).reshape(f.shape)
+
     def apply0(self, Aop, f):
         """One application from a zero initial guess."""
         if self.kind == "cheb":
             return self._cheb(Aop, f)
+        if self.kind == "ilu":
+            return self._ilu(f)
+        if self.kind == "gs":
+            return self._gs_sweep(Aop, f, jnp.zeros_like(f), False)
+        if self.kind == "spai1":
+            return self.Msp.shard_mv(f)
+        if self.kind == "bdiag":
+            return self._bmul(f)
         return self.scale[0] * f
 
-    def sweep(self, Aop, f, u):
+    def sweep(self, Aop, f, u, reverse=False):
         if self.kind == "cheb":
             return u + self._cheb(Aop, f - Aop.mv(u))
+        if self.kind == "ilu":
+            return u + self._ilu(f - Aop.mv(u))
+        if self.kind == "gs":
+            return self._gs_sweep(Aop, f, u, reverse)
+        if self.kind == "spai1":
+            return u + self.Msp.shard_mv(f - Aop.mv(u))
+        if self.kind == "bdiag":
+            return u + self._bmul(f - Aop.mv(u))
         return u + self.scale[0] * (f - Aop.mv(u))
 
 
@@ -229,7 +295,7 @@ class DistHierarchy:
                 uc = uc + self.shard_cycle(i + 1, rc)
             u = u + lv.P_op.shard_mv(uc)
         for _ in range(self.npost):
-            u = sm.sweep(Aop, f, u)
+            u = sm.sweep(Aop, f, u, reverse=True)   # matches apply_post
         return u
 
     def _whole_vector_apply(self, r):
@@ -289,10 +355,72 @@ def _transition_ops(Pt: CSR, Rt: CSR, nd, nloc, mesh, dtype):
         c, v = pack_rows_ell(rrows[sel], Rt.col[sel] - s_ * nloc,
                               Rt.val[sel], nc, K2)
         rc[s_], rv[s_] = c, v
-    sh = NamedSharding(mesh, P(ROWS_AXIS, None, None))
-    put = lambda a, dt: jax.device_put(jnp.asarray(a, dtype=dt), sh)
+    put = lambda a, dt: put_sharded(a, mesh, dt)
     return TransitionOps(put(pc, jnp.int32), put(pv, dtype),
                          put(rc, jnp.int32), put(rv, dtype))
+
+
+def _build_dist_smoother(relax, Ak, Ak_s, dA, mesh, nd, dtype):
+    """Shard one level's smoother state over the mesh. Every registry
+    smoother family is supported with its GLOBAL state (halo-plan ELL
+    factors / masks), so distributed smoothing is bit-for-bit the serial
+    math — unlike the reference, whose mpi wrappers degrade ILU/GS to the
+    rank-local block (amgcl/mpi/relaxation/*.hpp). Unsupported smoother
+    types raise instead of silently degrading."""
+    from amgcl_tpu.relaxation.chebyshev import ChebyshevState
+    from amgcl_tpu.relaxation.ilu0 import ILU0, ILUT, ILUK, ILUP
+    from amgcl_tpu.relaxation.gauss_seidel import GaussSeidel, \
+        greedy_coloring
+    from amgcl_tpu.relaxation.spai1 import Spai1
+
+    n_pad = dA.nloc * nd
+
+    def shard_vec(v, fill=0.0):
+        pad = np.full(n_pad, float(fill))
+        pad[:len(v)] = np.asarray(v, dtype=np.float64)
+        return put_sharded(pad.reshape(nd, dA.nloc), mesh, dtype)
+
+    if isinstance(relax, (ILU0, ILUT, ILUK, ILUP)):
+        Lh, Uh, udia = relax.build_host(Ak)
+        return DistSmoother(
+            "ilu", Ls=build_dist_ell(Lh, mesh, dtype),
+            Us=build_dist_ell(Uh, mesh, dtype),
+            uinv=shard_vec(1.0 / udia, fill=1.0),
+            jacobi_iters=relax.jacobi_iters)
+    if isinstance(relax, GaussSeidel):
+        color = greedy_coloring(Ak_s.to_scipy())
+        nc = int(color.max()) + 1
+        masks = np.zeros((nc, n_pad))
+        masks[color, np.arange(Ak_s.nrows)] = 1.0
+        masks = masks.reshape(nc, nd, dA.nloc).transpose(1, 0, 2)
+        return DistSmoother(
+            "gs", scale=shard_vec(Ak_s.diagonal(invert=True)),
+            masks=put_sharded(masks, mesh, dtype))
+    if isinstance(relax, Spai1):
+        Mh = relax.build_host(Ak)
+        return DistSmoother("spai1", Msp=build_dist_ell(Mh, mesh, dtype))
+
+    st = relax.build(Ak, dtype)
+    if isinstance(st, ChebyshevState):
+        dinv_sh = shard_vec(st.dinv) if st.scale else None
+        return DistSmoother("cheb", dinv_sh, st.theta, st.delta, st.degree)
+    if hasattr(st, "scale") and np.ndim(st.scale) == 1:
+        return DistSmoother("diag", shard_vec(st.scale))
+    if hasattr(st, "scale") and np.ndim(st.scale) == 3:
+        b = int(np.shape(st.scale)[-1])
+        if dA.nloc % b:
+            raise ValueError(
+                "block smoother blocks (b=%d) straddle the shard boundary "
+                "(nloc=%d); choose a mesh with nloc divisible by b"
+                % (b, dA.nloc))
+        M = np.zeros((n_pad // b, b, b))
+        M[:np.shape(st.scale)[0]] = np.asarray(st.scale, dtype=np.float64)
+        return DistSmoother("bdiag", put_sharded(
+            M.reshape(nd, dA.nloc // b, b, b), mesh, dtype))
+    raise ValueError(
+        "smoother %s has no distributed form; use one of damped_jacobi/"
+        "spai0/spai1/chebyshev/gauss_seidel/ilu0/iluk/ilup/ilut"
+        % type(relax).__name__)
 
 
 class _LocalOp:
@@ -320,7 +448,14 @@ class DistAMGSolver:
         dtype = self.prm.dtype
         nd = mesh.shape[ROWS_AXIS]
 
-        host = AMG(A, self.prm)          # serial host-side construction
+        # serial host-side construction; the device filter skips serial
+        # device states for levels this wrapper re-shards itself (they'd be
+        # discarded — e.g. a second Chow-Patel factorization per level).
+        # It mirrors the replicate-split rule below: a level is replicated
+        # iff it is the last, or coarse enough and not the finest.
+        host = AMG(A, self.prm,
+                   device_filter=lambda j, sz, last: last or (
+                       j > 0 and sz < replicate_below))
         self.host_amg = host
         # split: levels at or above `replicate_below` rows stay sharded;
         # the tail is replicated (the merge/repartition analogue) — at
@@ -345,35 +480,8 @@ class DistAMGSolver:
                     Pk.unblock() if Pk.is_block else Pk, mesh, dtype)
                 dR = build_dist_ell(
                     Rk.unblock() if Rk.is_block else Rk, mesh, dtype)
-            st = self.prm.relax.build(Ak, dtype)
-            from amgcl_tpu.relaxation.chebyshev import ChebyshevState
-            if isinstance(st, ChebyshevState):
-                dinv_sh = None
-                if st.scale:
-                    pad = np.zeros(dA.nloc * nd)
-                    pad[:Ak_s.nrows] = np.asarray(st.dinv, dtype=np.float64)
-                    dinv_sh = jax.device_put(
-                        jnp.asarray(pad.reshape(nd, dA.nloc), dtype=dtype),
-                        NamedSharding(mesh, P(ROWS_AXIS, None)))
-                sm = DistSmoother("cheb", dinv_sh, st.theta, st.delta,
-                                  st.degree)
-            else:
-                if hasattr(st, "scale") and np.ndim(st.scale) == 1:
-                    scale = np.asarray(st.scale, dtype=np.float64)
-                else:
-                    import warnings
-                    warnings.warn(
-                        "distributed AMG shards diagonal-type and Chebyshev "
-                        "smoothers; %s falls back to damped Jacobi"
-                        % type(self.prm.relax).__name__)
-                    scale = 0.72 * Ak_s.diagonal(invert=True)
-                pad = np.zeros(dA.nloc * nd)
-                pad[:len(scale)] = scale
-                sm = DistSmoother(
-                    "diag",
-                    jax.device_put(
-                        jnp.asarray(pad.reshape(nd, dA.nloc), dtype=dtype),
-                        NamedSharding(mesh, P(ROWS_AXIS, None))))
+            sm = _build_dist_smoother(self.prm.relax, Ak, Ak_s, dA, mesh,
+                                      nd, dtype)
             levels.append(DistLevel(dA, dP, dR, sm))
 
         # replicated tail = the serial device hierarchy's own levels

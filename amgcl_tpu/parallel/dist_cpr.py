@@ -15,7 +15,7 @@ from typing import Any, Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
@@ -25,24 +25,27 @@ from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.solver.cg import CG
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
 from amgcl_tpu.parallel.dist_ell import build_dist_ell
-from amgcl_tpu.parallel.dist_amg import DistAMGSolver, _LocalOp
+from amgcl_tpu.parallel.dist_amg import (DistAMGSolver, _LocalOp,
+    _build_dist_smoother)
 
 
 @register_pytree_node_class
 class DistCPRHierarchy:
     """A_full: sharded scalar view of the block system; W: (nd, ncell_loc, b)
-    sharded weights; p_hier: distributed pressure hierarchy; scale:
-    (nd, nloc) sharded global-smoother diagonal."""
+    sharded weights; p_hier: distributed pressure hierarchy; smoother:
+    sharded global-stage DistSmoother (any registry smoother — block spai0,
+    ILU, GS, ... — the reference's cpr.hpp relax policy)."""
 
-    def __init__(self, A_full, W, p_hier, scale, block):
+    def __init__(self, A_full, W, p_hier, smoother, block):
         self.A_full = A_full
         self.W = W
         self.p_hier = p_hier
-        self.scale = scale
+        self.smoother = smoother
         self.block = int(block)
 
     def tree_flatten(self):
-        return (self.A_full, self.W, self.p_hier, self.scale), (self.block,)
+        return ((self.A_full, self.W, self.p_hier, self.smoother),
+                (self.block,))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -51,7 +54,7 @@ class DistCPRHierarchy:
     def specs(self):
         return DistCPRHierarchy(
             self.A_full.specs(), P(ROWS_AXIS, None, None),
-            self.p_hier.specs(), P(ROWS_AXIS, None), self.block)
+            self.p_hier.specs(), self.smoother.spec(), self.block)
 
     def shard_apply(self, r):
         b = self.block
@@ -61,7 +64,7 @@ class DistCPRHierarchy:
         x = jnp.zeros_like(rb).at[:, 0].set(dp).reshape(r.shape)
         # global smoothing of the remaining residual
         res = r - self.A_full.shard_mv(x)
-        return x + self.scale[0] * res
+        return x + self.smoother.apply0(_LocalOp(self.A_full), res)
 
     def system_A(self):
         return self.A_full
@@ -93,27 +96,14 @@ class DistCPRSolver(DistAMGSolver):
         App = _pressure_matrix(A, W)
         pprm = pressure_prm or AMGParams(dtype=dtype)
         p_solver = DistAMGSolver(App, mesh, pprm)
-        # global smoother on the scalar view of the block system
+        # global smoother on the full block system, sharded with the same
+        # machinery as the AMG levels (any registry smoother; the block
+        # spai0 default matches the serial CPR exactly)
         As = A.unblock()
         dA = build_dist_ell(As, mesh, dtype)
-        st = (relax or Spai0()).build(A, dtype)
-        if hasattr(st, "scale") and np.ndim(st.scale) == 1:
-            scale = np.asarray(st.scale, dtype=np.float64)
-        else:
-            # scalar spai0 of the unblocked system beats plain Jacobi and
-            # needs no block-state sharding (block-M sharding: round 2)
-            import warnings
-            warnings.warn(
-                "distributed CPR shards diagonal-type global smoothers; "
-                "%s falls back to scalar SPAI-0"
-                % type(relax or Spai0()).__name__)
-            scale = np.asarray(Spai0().build(As, dtype).scale,
-                               dtype=np.float64)
         self.n = As.nrows
         nloc = dA.nloc
         self.n_pad = nloc * nd
-        pad = np.zeros(self.n_pad)
-        pad[:len(scale)] = scale
         # weights padded to the cell partition of the scalar padding:
         # n_pad is a multiple of nd; require it to also tile into b-cells
         if nloc % b:
@@ -131,16 +121,12 @@ class DistCPRSolver(DistAMGSolver):
                 "the block partition (%d rows/shard)" % (first.nloc, nloc))
         Wpad = np.zeros((self.n_pad // b, b))
         Wpad[:A.nrows] = W
-        shard3 = NamedSharding(mesh, P(ROWS_AXIS, None, None))
-        shard2 = NamedSharding(mesh, P(ROWS_AXIS, None))
+        sm = _build_dist_smoother(relax or Spai0(), A, As, dA, mesh, nd,
+                                  dtype)
+        from amgcl_tpu.parallel.mesh import put_sharded
         self.hier = DistCPRHierarchy(
-            dA,
-            jax.device_put(jnp.asarray(
-                Wpad.reshape(nd, nloc // b, b), dtype=dtype), shard3),
-            p_solver.hier,
-            jax.device_put(jnp.asarray(
-                pad.reshape(nd, nloc), dtype=dtype), shard2),
-            b)
+            dA, put_sharded(Wpad.reshape(nd, nloc // b, b), mesh, dtype),
+            p_solver.hier, sm, b)
         self._compiled = None
 
     def __repr__(self):

@@ -19,7 +19,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
@@ -179,10 +179,9 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32) -> DistEllMatrix:
     lc, lv = pack(loc_lists, K1)
     rc, rv = pack(rem_lists, K2)
 
-    mat_sharding = NamedSharding(mesh, P(ROWS_AXIS, None, None))
-    put = lambda a, dt: jax.device_put(jnp.asarray(a, dtype=dt), mat_sharding)
+    from amgcl_tpu.parallel.mesh import put_sharded
+    put = lambda a, dt: put_sharded(a, mesh, dt)
     return DistEllMatrix(
         put(lc, jnp.int32), put(lv, dtype), put(rc, jnp.int32),
-        put(rv, dtype),
-        jax.device_put(jnp.asarray(send_idx), mat_sharding),
+        put(rv, dtype), put(send_idx, jnp.int32),
         (nloc * nd, ncloc * nd), nloc, ncloc)

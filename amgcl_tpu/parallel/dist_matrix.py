@@ -72,7 +72,9 @@ class DistDiaMatrix:
                 "exchange only reaches immediate neighbors; use fewer "
                 "devices or a narrower band" % (out.halo, n // nd))
         sharding = NamedSharding(mesh, P(None, ROWS_AXIS))
-        out.data = jax.device_put(out.data, sharding)
+        # numpy in, sharded out: the direct per-device path, no reshard
+        # compile (see mesh.put_sharded)
+        out.data = jax.device_put(np.asarray(out.data), sharding)
         return out
 
     # -- the per-shard kernel (runs inside shard_map) -----------------------

@@ -7,8 +7,9 @@ replicas) can be layered later; the solver code only names ``rows``.
 
 from __future__ import annotations
 
+import numpy as np
 import jax
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 ROWS_AXIS = "rows"
 
@@ -22,3 +23,19 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
     if n_devices is not None:
         devices = devices[:n_devices]
     return Mesh(list(devices), (ROWS_AXIS,))
+
+
+def put_sharded(a, mesh, dtype=None, axis=ROWS_AXIS):
+    """device_put a HOST array sharded over its leading dim.
+
+    The array must stay numpy until the put: device_put(numpy, sharding)
+    slices on host and lands each shard directly on its device, while
+    device_put(jnp.asarray(...), sharding) commits to one device first and
+    then RESHARDS — which compiles a throwaway XLA program per (shape,
+    sharding) pair and dominated round-1's distributed setup time
+    (4.46s for 32^3/8dev, ~80% pjit compiles)."""
+    a = np.asarray(a)
+    if dtype is not None:
+        a = a.astype(np.dtype(dtype))     # bf16 works via ml_dtypes
+    spec = PartitionSpec(axis, *([None] * (a.ndim - 1)))
+    return jax.device_put(a, NamedSharding(mesh, spec))

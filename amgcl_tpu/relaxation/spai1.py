@@ -68,7 +68,13 @@ class Spai1State:
 
 @dataclass
 class Spai1:
-    def build(self, A: CSR, dtype=jnp.float32) -> Spai1State:
+    def build_host(self, A: CSR) -> CSR:
+        """Host CSR of the approximate inverse — the distributed layer
+        shards it with its own halo plan (reference role:
+        amgcl/mpi/relaxation/spai1.hpp)."""
+        return self.build(A, return_host=True)
+
+    def build(self, A: CSR, dtype=jnp.float32, return_host=False):
         S = A.unblock() if A.is_block else A
         m = S.to_scipy().astype(np.float64)
         m.sort_indices()
@@ -106,4 +112,6 @@ class Spai1:
 
         Mcsr = CSR(m.indptr.copy(), m.indices.copy(),
                    mvals[rows, pos], n)
+        if return_host:
+            return Mcsr
         return Spai1State(dev.to_device(Mcsr, "auto", dtype))

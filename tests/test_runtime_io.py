@@ -67,6 +67,98 @@ def test_runtime_dummy_class():
     assert info.resid < 1e-8
 
 
+def test_runtime_nested_precond():
+    """precond.class=nested: a full inner Krylov (with its own nested
+    preconditioner config) used as the outer preconditioner (reference:
+    amgcl/preconditioner/runtime.hpp:147-158). The outer solver must be
+    flexible since the inner solve is nonstationary."""
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    A, rhs = poisson3d(10)
+    s = make_solver_from_config(A, {
+        "precond.class": "nested",
+        "precond.solver.type": "cg",
+        "precond.solver.maxiter": 4,
+        "precond.solver.tol": 1e-2,
+        "precond.precond.class": "amg",
+        "precond.precond.dtype": "float64",
+        "precond.precond.coarse_enough": 200,
+        "solver.type": "fgmres",
+        "solver.tol": 1e-8, "solver.maxiter": 100})
+    x, info = s(rhs)
+    r = np.linalg.norm(rhs - A.spmv(np.asarray(x))) / np.linalg.norm(rhs)
+    assert r < 1e-7
+    assert "nested" in repr(s)
+
+
+def test_runtime_doubly_nested_precond():
+    """nested-inside-nested exercises the recursion."""
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    A, rhs = poisson3d(8)
+    s = make_solver_from_config(A, {
+        "precond.class": "nested",
+        "precond.solver.type": "preonly",
+        "precond.precond.class": "nested",
+        "precond.precond.solver.type": "cg",
+        "precond.precond.solver.maxiter": 3,
+        "precond.precond.precond.class": "relaxation",
+        "precond.precond.precond.relax.type": "spai0",
+        "precond.precond.precond.dtype": "float64",
+        "solver.type": "fgmres", "solver.tol": 1e-8})
+    x, info = s(rhs)
+    r = np.linalg.norm(rhs - A.spmv(np.asarray(x))) / np.linalg.norm(rhs)
+    assert r < 1e-7
+
+
+def test_runtime_schur_stokes():
+    """Runtime-config Stokes solve: schur pressure correction whose U/P
+    stages are themselves runtime-configured (the VERDICT round-1 ask)."""
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    from tests.test_coupled import stokes_like
+    A, pmask = stokes_like(10)
+    rhs = np.ones(A.nrows)
+    s = make_solver_from_config(A, {
+        "precond.class": "schur",
+        "precond.dtype": "float64",
+        "precond.pmask": pmask,
+        "precond.usolver.precond.dtype": "float64",
+        "precond.usolver.precond.coarse_enough": 200,
+        "precond.psolver.precond.dtype": "float64",
+        "precond.psolver.solver.type": "cg",
+        "precond.psolver.solver.maxiter": 4,
+        "precond.psolver.solver.tol": 1e-2,
+        "solver.type": "fgmres", "solver.tol": 1e-8,
+        "solver.maxiter": 200})
+    x, info = s(rhs)
+    r = np.linalg.norm(rhs - A.spmv(np.asarray(x))) / np.linalg.norm(rhs)
+    assert r < 1e-6
+
+
+def test_runtime_schur_pmask_pattern():
+    """Reference pmask_pattern strings: %start:stride / <m / >m."""
+    from amgcl_tpu.models.runtime import _parse_pmask
+    m = _parse_pmask({"pmask_pattern": "%3:4"}, 8)
+    assert list(np.flatnonzero(m)) == [3, 7]
+    m = _parse_pmask({"pmask_pattern": ">5"}, 8)
+    assert list(np.flatnonzero(m)) == [5, 6, 7]
+    m = _parse_pmask({"pmask_pattern": "<2"}, 8)
+    assert list(np.flatnonzero(m)) == [0, 1]
+
+
+def test_runtime_cpr_class():
+    """Serial CPR selectable from config (precond.class=cpr)."""
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    from tests.test_coupled import reservoir_like
+    A, rhs = reservoir_like(6, 3)
+    s = make_solver_from_config(A, {
+        "precond.class": "cpr", "precond.dtype": "float64",
+        "precond.pressure.dtype": "float64",
+        "precond.pressure.coarse_enough": 100,
+        "solver.type": "bicgstab", "solver.tol": 1e-8,
+        "solver.maxiter": 200})
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+
+
 def test_runtime_unknown_key_warns():
     A, _ = poisson3d(6)
     with pytest.warns(UserWarning, match="unknown parameter"):
