@@ -1,0 +1,149 @@
+"""Python side of the C API (see csrc/c_api.cpp, include/amgcl_tpu.h).
+
+The reference exposes its runtime registry over a plain C ABI
+(/root/reference/lib/amgcl.h:47-157, lib/amgcl.cpp) so Fortran/Delphi/C
+callers can build and apply solvers. The TPU-native equivalent keeps the
+same surface: the shared library embeds CPython, this module does the
+numpy/ctypes marshalling, and the solvers are the ordinary runtime-registry
+compositions running on JAX.
+
+All array arguments arrive as raw addresses (integers) plus lengths; the
+wrappers view them zero-copy with ``np.ctypeslib`` and hand scipy a CSR.
+Handles held by the C side are plain Python objects kept alive in a table.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+
+import numpy as np
+
+# NOTE: the C surface is double* end-to-end, so the embedded interpreter
+# must run with jax_enable_x64 — c_api.cpp sets it during amgcl_tpu_init,
+# BEFORE any JAX program compiles. It is deliberately not set here: an
+# in-process Python import of this module must not flip process-global JAX
+# config behind the host application's back.
+
+_handles = {}
+_next_id = [1]
+
+
+def _register(obj) -> int:
+    h = _next_id[0]
+    _next_id[0] += 1
+    _handles[h] = obj
+    return h
+
+
+def _view(addr, n, ctype):
+    return np.ctypeslib.as_array((ctype * n).from_address(addr))
+
+
+def params_create() -> int:
+    return _register({})
+
+
+def params_set(h: int, name: str, value) -> None:
+    _handles[h][name] = value
+
+
+def params_read_json(h: int, fname: str) -> None:
+    with open(fname) as f:
+        _handles[h].update(json.load(f))
+
+
+def handle_destroy(h: int) -> None:
+    _handles.pop(h, None)
+
+
+def _csr_from_addrs(n, ptr_addr, col_addr, val_addr, one_based):
+    ptr = _view(ptr_addr, n + 1, ctypes.c_int32).astype(np.int64)
+    nnz = int(ptr[-1]) - (1 if one_based else 0)
+    col = _view(col_addr, nnz, ctypes.c_int32).astype(np.int32)
+    val = _view(val_addr, nnz, ctypes.c_double).copy()
+    if one_based:               # Fortran convention (amgcl_*_create_f)
+        ptr = ptr - 1
+        col = col - 1
+    from amgcl_tpu.ops.csr import CSR
+    return CSR(ptr, col, val, n)
+
+
+def _params_for(h) -> dict:
+    prm = dict(_handles.get(h, {}) if h else {})
+    # the C surface is f64 end-to-end (double* in, double* out)
+    prm.setdefault("precond.dtype", "float64")
+    return prm
+
+
+def solver_create(n, ptr_addr, col_addr, val_addr, prm_h,
+                  one_based=False) -> int:
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    A = _csr_from_addrs(n, ptr_addr, col_addr, val_addr, one_based)
+    prm = _params_for(prm_h)
+    prm.setdefault("solver.type", "bicgstab")
+    block_size = int(prm.pop("block_size", 1))
+    solver = make_solver_from_config(A, prm, block_size=block_size)
+    return _register(solver)
+
+
+def precond_create(n, ptr_addr, col_addr, val_addr, prm_h,
+                   one_based=False) -> int:
+    from amgcl_tpu.models.runtime import precond_from_config, _as_dict
+    A = _csr_from_addrs(n, ptr_addr, col_addr, val_addr, one_based)
+    cfg = _as_dict(_params_for(prm_h))
+    return _register(_PrecondApply(precond_from_config(
+        A, cfg.get("precond", {})), n))
+
+
+class _PrecondApply:
+    """One-shot M^-1 application with a jit-compiled hierarchy apply."""
+
+    def __init__(self, precond, n):
+        self.precond = precond
+        self.n = n
+        self._compiled = None
+
+    def __call__(self, r):
+        import jax
+        import jax.numpy as jnp
+        if self._compiled is None:
+            self._compiled = jax.jit(lambda hier, v: hier.apply(v))
+        dtype = getattr(self.precond, "dtype", jnp.float64)
+        z = self._compiled(self.precond.hierarchy,
+                           jnp.asarray(r, dtype=dtype))
+        return np.asarray(z, dtype=np.float64)
+
+
+def precond_apply(h, rhs_addr, x_addr, n) -> None:
+    p = _handles[h]
+    rhs = _view(rhs_addr, n, ctypes.c_double)
+    x = _view(x_addr, n, ctypes.c_double)
+    x[:] = p(np.asarray(rhs))
+
+
+def solver_solve(h, rhs_addr, x_addr, n):
+    """Returns (iters, resid); x_addr holds the initial guess on entry and
+    the solution on exit (reference: amgcl_solver_solve)."""
+    s = _handles[h]
+    rhs = np.asarray(_view(rhs_addr, n, ctypes.c_double))
+    x = _view(x_addr, n, ctypes.c_double)
+    x0 = np.asarray(x).copy()
+    got, info = s(rhs, x0=x0 if np.any(x0) else None)
+    x[:] = np.asarray(got, dtype=np.float64)
+    return int(info.iters), float(info.resid)
+
+
+def handle_n(h) -> int:
+    """Scalar system size of the solver/preconditioner behind a handle."""
+    obj = _handles[h]
+    if isinstance(obj, _PrecondApply):
+        return obj.n
+    if hasattr(obj, "inner"):          # make_block_solver wraps make_solver
+        obj = obj.inner
+    A = obj.A_host
+    return A.nrows * A.block_size[0]
+
+
+def report(h) -> str:
+    return repr(_handles[h])

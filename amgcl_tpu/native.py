@@ -66,6 +66,19 @@ def lib():
             handle.spgemm_symbolic.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 5
             handle.spgemm_numeric.restype = None
             handle.spgemm_numeric.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9
+            handle.spgemm_numeric_f32.restype = None
+            handle.spgemm_numeric_f32.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9
+            handle.spgemm_numeric_block.restype = None
+            handle.spgemm_numeric_block.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9 + [ctypes.c_int64] * 3
+            handle.spgemm_masked.restype = None
+            handle.spgemm_masked.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9
+            for nm in ("ell_pack", "ell_pack_f32"):
+                fn = getattr(handle, nm)
+                fn.restype = None
+                fn.argtypes = [ctypes.c_int64, ctypes.c_void_p,
+                               ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.c_int64, ctypes.c_int64,
+                               ctypes.c_void_p, ctypes.c_void_p]
             handle.filter_count.restype = None
             handle.filter_count.argtypes = [
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -112,24 +125,33 @@ def native_aggregates(A, eps_strong: float):
 
 def native_spgemm(A, B):
     """C = A @ B via the native two-phase hash SpGEMM, or None if
-    unavailable / non-f64-able. Returns (ptr, col, val).
+    unavailable. Returns (ptr, col, val) — val is (nnz,) for scalar inputs
+    or (nnz, br, bc) for block inputs. Covers f64, f32, and block f64/f32
+    values (reference parity: amgcl/detail/spgemm.hpp handles every value
+    type); complex stays on scipy.
 
     Only engaged on multi-core hosts: the OpenMP parallelism is the whole
     point — single-threaded, scipy's SMMP kernel is faster than the hash
     accumulator, so we defer to it there."""
     L = lib()
     force = os.environ.get("AMGCL_TPU_FORCE_NATIVE_SPGEMM") == "1"
-    if L is None or A.is_block or B.is_block \
-            or (L.omp_max_threads() < 2 and not force):
+    if L is None or (L.omp_max_threads() < 2 and not force):
+        return None
+    if A.is_block != B.is_block:
+        return None            # mixed block/scalar: caller unblocks
+    if A.is_block and A.block_size[1] != B.block_size[0]:
         return None
     if A.ncols != B.nrows:
         raise ValueError("spgemm dimension mismatch: %s x %s"
                          % (A.shape, B.shape))
     if np.iscomplexobj(A.val) or np.iscomplexobj(B.val):
         return None
+    f32 = (not A.is_block and A.val.dtype == np.float32
+           and B.val.dtype == np.float32)
+    vdt = np.float32 if f32 else np.float64
     try:
-        aval = np.ascontiguousarray(A.val, dtype=np.float64)
-        bval = np.ascontiguousarray(B.val, dtype=np.float64)
+        aval = np.ascontiguousarray(A.val, dtype=vdt)
+        bval = np.ascontiguousarray(B.val, dtype=vdt)
     except (TypeError, ValueError):
         return None
     aptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
@@ -143,11 +165,41 @@ def native_spgemm(A, B):
     cptr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(rn, out=cptr[1:])
     ccol = np.empty(cptr[-1], dtype=np.int32)
-    cval = np.empty(cptr[-1], dtype=np.float64)
-    L.spgemm_numeric(n, _ptr(aptr), _ptr(acol), _ptr(aval), _ptr(bptr),
-                     _ptr(bcol), _ptr(bval), _ptr(cptr), _ptr(ccol),
-                     _ptr(cval))
+    if A.is_block:
+        br, bk = A.block_size
+        bc = B.block_size[1]
+        cval = np.empty((cptr[-1], br, bc), dtype=np.float64)
+        L.spgemm_numeric_block(
+            n, _ptr(aptr), _ptr(acol), _ptr(aval), _ptr(bptr), _ptr(bcol),
+            _ptr(bval), _ptr(cptr), _ptr(ccol), _ptr(cval), br, bk, bc)
+        return cptr, ccol, cval
+    cval = np.empty(cptr[-1], dtype=vdt)
+    kern = L.spgemm_numeric_f32 if f32 else L.spgemm_numeric
+    kern(n, _ptr(aptr), _ptr(acol), _ptr(aval), _ptr(bptr),
+         _ptr(bcol), _ptr(bval), _ptr(cptr), _ptr(ccol), _ptr(cval))
     return cptr, ccol, cval
+
+
+def native_spgemm_masked(n, aptr, acol, aval, bptr, bcol, bval, tptr, tcol):
+    """tval[q] = (A B)[i, tcol[q]] restricted to the target pattern — the
+    Chow-Patel sweep kernel (no symbolic phase, no full product). Returns
+    the target values array or None when the native library is missing."""
+    L = lib()
+    if L is None:
+        return None
+    aval = np.ascontiguousarray(aval, dtype=np.float64)
+    bval = np.ascontiguousarray(bval, dtype=np.float64)
+    aptr = np.ascontiguousarray(aptr, dtype=np.int64)
+    acol = np.ascontiguousarray(acol, dtype=np.int32)
+    bptr = np.ascontiguousarray(bptr, dtype=np.int64)
+    bcol = np.ascontiguousarray(bcol, dtype=np.int32)
+    tptr = np.ascontiguousarray(tptr, dtype=np.int64)
+    tcol = np.ascontiguousarray(tcol, dtype=np.int32)
+    tval = np.empty(len(tcol), dtype=np.float64)
+    L.spgemm_masked(int(n), _ptr(aptr), _ptr(acol), _ptr(aval), _ptr(bptr),
+                    _ptr(bcol), _ptr(bval), _ptr(tptr), _ptr(tcol),
+                    _ptr(tval))
+    return tval
 
 
 def native_filtered(A, eps_strong):
@@ -174,6 +226,36 @@ def native_filtered(A, eps_strong):
     L.filter_fill(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
                   _ptr(optr), _ptr(ocol), _ptr(oval), _ptr(dinv))
     return optr, ocol, oval, dinv
+
+
+def native_ell_pack(A, K: int, out_dtype):
+    """(cols, vals) dense ELL planes for host CSR ``A``, value cast fused
+    into the pack; None when unavailable. vals is (n, K[, br, bc]) in
+    ``out_dtype`` (f32/f64)."""
+    L = lib()
+    if L is None or np.iscomplexobj(A.val):
+        return None
+    odt = np.dtype(out_dtype)
+    if odt == np.float32:
+        kern = L.ell_pack_f32
+    elif odt == np.float64:
+        kern = L.ell_pack
+    else:
+        return None
+    try:
+        val = np.ascontiguousarray(A.val, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    n = A.nrows
+    br, bc = A.block_size
+    bs = br * bc
+    cols = np.zeros((n, K), dtype=np.int32)
+    shape = (n, K) if bs == 1 else (n, K, br, bc)
+    vals = np.zeros(shape, dtype=odt)
+    kern(n, _ptr(ptr), _ptr(col), _ptr(val), K, bs, _ptr(cols), _ptr(vals))
+    return cols, vals
 
 
 def native_iluk_pattern(A, k: int):

@@ -135,13 +135,10 @@ class CSR:
     def sort_rows(self) -> "CSR":
         """Sort column indices within each row (builtin.hpp:335-344)."""
         if self.is_block:
-            out = self.copy()
-            for i in range(self.nrows):
-                b, e = self.ptr[i], self.ptr[i + 1]
-                o = np.argsort(out.col[b:e], kind="stable")
-                out.col[b:e] = out.col[b:e][o]
-                out.val[b:e] = out.val[b:e][o]
-            return out
+            rows = self.expanded_rows()
+            order = np.lexsort((self.col, rows))   # one pass, no row loop
+            return CSR(self.ptr.copy(), self.col[order],
+                       self.val[order], self.ncols)
         m = self.to_scipy()
         m.sort_indices()
         return CSR(m.indptr, m.indices, m.data, self.ncols)
@@ -166,13 +163,16 @@ class CSR:
 
     def __matmul__(self, other: "CSR") -> "CSR":
         """SpGEMM (builtin.hpp:378-397, detail/spgemm.hpp:62,411). Uses the
-        native OpenMP hash-SpGEMM when available, scipy otherwise."""
-        if not (self.is_block or other.is_block) \
-                and self.dtype == np.float64 and other.dtype == np.float64:
-            from amgcl_tpu.native import native_spgemm
-            got = native_spgemm(self, other)
-            if got is not None:
-                return CSR(got[0], got[1], got[2], other.ncols)
+        native OpenMP hash-SpGEMM when available (f32/f64, scalar or block
+        values — no unblock round-trip), scipy otherwise."""
+        from amgcl_tpu.native import native_spgemm
+        got = native_spgemm(self, other)
+        if got is not None:
+            cval = got[2]
+            want = np.result_type(self.val.dtype, other.val.dtype)
+            if cval.dtype != want:
+                cval = cval.astype(want)
+            return CSR(got[0], got[1], cval, other.ncols)
         if self.is_block or other.is_block:
             br = self.block_size[0]
             bc = other.block_size[1]
@@ -201,14 +201,14 @@ class CSR:
         if self.is_block:
             br, bc = self.block_size
             out = np.zeros((self.nrows, br, bc), dtype=self.dtype)
-            rows = np.repeat(np.arange(self.nrows), self.row_nnz())
+            rows = self.expanded_rows()
             mask = rows == self.col
             out[rows[mask]] = self.val[mask]
             if invert:
                 out = np.linalg.inv(out)
             return out
         d = np.zeros(self.nrows, dtype=self.dtype)
-        rows = np.repeat(np.arange(self.nrows), self.row_nnz())
+        rows = self.expanded_rows()
         mask = rows == self.col
         d[rows[mask]] = self.val[mask]
         if invert:

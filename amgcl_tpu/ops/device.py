@@ -165,16 +165,32 @@ def csr_to_ell(A: CSR, dtype=jnp.float32) -> EllMatrix:
     K = int(nnz_row.max()) if A.nrows else 1
     K = max(_ELL_PAD, -(-K // _ELL_PAD) * _ELL_PAD)
     n = A.nrows
-    cols = np.zeros((n, K), dtype=np.int32)
+    from amgcl_tpu.native import native_ell_pack
+    jdt = jnp.dtype(dtype)
+    got = None
+    if jdt == jnp.dtype(jnp.float32):
+        got = native_ell_pack(A, K, np.float32)
+    elif jdt == jnp.dtype(jnp.float64):
+        got = native_ell_pack(A, K, np.float64)
+    if got is not None:
+        # native pack fuses the dtype cast — jnp.asarray is then zero-cast
+        return EllMatrix(jnp.asarray(got[0]), jnp.asarray(got[1]),
+                         A.shape, A.block_size)
+    rows = A.expanded_rows()
+    # flat scatter beats 2-D fancy indexing ~4x at millions of nonzeros
+    flat_idx = rows * K + (np.arange(A.nnz) - A.ptr[rows])
+    cols = np.zeros(n * K, dtype=np.int32)
+    cols[flat_idx] = A.col
+    cols = cols.reshape(n, K)
     if A.is_block:
         br, bc = A.block_size
-        vals = np.zeros((n, K, br, bc), dtype=A.val.dtype)
+        vals = np.zeros((n * K, br, bc), dtype=A.val.dtype)
+        vals[flat_idx] = A.val
+        vals = vals.reshape(n, K, br, bc)
     else:
-        vals = np.zeros((n, K), dtype=A.val.dtype)
-    rows = A.expanded_rows()
-    pos = np.arange(A.nnz) - A.ptr[rows]
-    cols[rows, pos] = A.col
-    vals[rows, pos] = A.val
+        vals = np.zeros(n * K, dtype=A.val.dtype)
+        vals[flat_idx] = A.val
+        vals = vals.reshape(n, K)
     return EllMatrix(jnp.asarray(cols), jnp.asarray(vals, dtype=dtype),
                      A.shape, A.block_size)
 
@@ -185,7 +201,11 @@ def _dia_offsets(A: CSR) -> np.ndarray:
     off = getattr(A, "_dia_offsets_cache", None)
     if off is None:
         d = A.col.astype(np.int64) - A.expanded_rows()
-        off = np.unique(d)
+        # bincount over the [-(m-1), n-1] diagonal range beats np.unique's
+        # O(nnz log nnz) sort by ~8x on stencil matrices
+        base = A.nrows - 1
+        hits = np.bincount(d + base, minlength=base + A.ncols)
+        off = np.flatnonzero(hits) - base
         A._dia_offsets_cache = off
     return off
 

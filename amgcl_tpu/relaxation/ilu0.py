@@ -94,12 +94,26 @@ def _chow_patel_build(ptr, col, val, n, sweeps, jacobi_iters, dtype,
     uval = np.where(upper, a, 0.0)
     lval = np.where(lower, a / dia[cols], 0.0)
 
+    from amgcl_tpu.native import native_spgemm_masked
+    # rows whose pattern lacks a structural diagonal can't carry the +I
+    # term through lvalI; their (I·U)[i,:] = U[i,:] contribution (= uval on
+    # the pattern) is added explicitly so the masked path matches (L+I)U
+    no_diag = np.bincount(rows[dmask], minlength=n) == 0
     for _ in range(sweeps):
-        L = sp.csr_matrix((lval, cols.copy(), ptr.copy()), shape=(n, n))
-        L = L + sp.identity(n)
-        U = sp.csr_matrix((uval, cols.copy(), ptr.copy()), shape=(n, n))
-        LU = (L @ U).tocsr()
-        lu_on_a = gather_sparse_entries(LU, rows, cols)
+        # (L+I)U evaluated ON the factor pattern: the pattern is fixed
+        # across sweeps, so the masked native kernel skips both the full
+        # product and the key-gather realignment
+        lvalI = np.where(dmask, 1.0, lval)
+        lu_on_a = native_spgemm_masked(n, ptr, cols, lvalI, ptr, cols,
+                                       uval, ptr, cols)
+        if lu_on_a is not None and no_diag.any():
+            lu_on_a = lu_on_a + np.where(no_diag[rows], uval, 0.0)
+        if lu_on_a is None:     # no native library: scipy fallback
+            L = sp.csr_matrix((lval, cols.copy(), ptr.copy()), shape=(n, n))
+            L = L + sp.identity(n)
+            U = sp.csr_matrix((uval, cols.copy(), ptr.copy()), shape=(n, n))
+            LU = (L @ U).tocsr()
+            lu_on_a = gather_sparse_entries(LU, rows, cols)
         udia = np.zeros(n)
         udia[cols[dmask]] = uval[dmask]
         udia = np.where(udia != 0, udia, 1.0)

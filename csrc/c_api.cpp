@@ -1,0 +1,226 @@
+// C ABI over the amgcl_tpu runtime registry (reference parity:
+// /root/reference/lib/amgcl.cpp — opaque handles over the runtime
+// interface). The implementation embeds CPython: handles are integer ids
+// into a table owned by amgcl_tpu.capi, arrays cross zero-copy as raw
+// addresses, and the solves are the ordinary JAX-backed compositions.
+//
+// Build (see tests/test_c_api.py for the exact line):
+//   g++ -O2 -shared -fPIC -std=c++17 -o libamgcl_tpu_c.so c_api.cpp \
+//       $(python3-config --includes --ldflags --embed)
+
+#include <Python.h>
+
+#include <cstdint>
+#include <cstdio>
+
+#include "../include/amgcl_tpu.h"
+
+namespace {
+
+PyObject* g_mod = nullptr;   // amgcl_tpu.capi
+
+struct Gil {
+  PyGILState_STATE st;
+  Gil() : st(PyGILState_Ensure()) {}
+  ~Gil() { PyGILState_Release(st); }
+};
+
+// call capi.<name>(fmt args...) and return the result (new ref, or null
+// with the Python error printed)
+PyObject* call(const char* name, const char* fmt, ...) {
+  if (!g_mod) return nullptr;
+  PyObject* fn = PyObject_GetAttrString(g_mod, name);
+  if (!fn) {
+    PyErr_Print();
+    return nullptr;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  PyObject* out = args ? PyObject_CallObject(fn, args) : nullptr;
+  Py_XDECREF(args);
+  Py_DECREF(fn);
+  if (!out) PyErr_Print();
+  return out;
+}
+
+int64_t call_i64(const char* name, const char* fmt, ...) {
+  if (!g_mod) return 0;
+  PyObject* fn = PyObject_GetAttrString(g_mod, name);
+  if (!fn) {
+    PyErr_Print();
+    return 0;
+  }
+  va_list va;
+  va_start(va, fmt);
+  PyObject* args = Py_VaBuildValue(fmt, va);
+  va_end(va);
+  PyObject* out = args ? PyObject_CallObject(fn, args) : nullptr;
+  Py_XDECREF(args);
+  Py_DECREF(fn);
+  if (!out) {
+    PyErr_Print();
+    return 0;
+  }
+  int64_t v = PyLong_AsLongLong(out);
+  Py_DECREF(out);
+  return v;
+}
+
+intptr_t handle_id(amgclHandle h) { return reinterpret_cast<intptr_t>(h); }
+
+amgclHandle as_handle(int64_t id) {
+  return reinterpret_cast<amgclHandle>(static_cast<intptr_t>(id));
+}
+
+// The C surface takes int32 CSR arrays; capi.py views them by address.
+amgclHandle create(const char* fn_name, int n, const int* ptr,
+                   const int* col, const double* val, amgclHandle prm,
+                   int one_based) {
+  Gil g;
+  int64_t id = call_i64(
+      fn_name, "(iLLLLi)", n, (long long)(intptr_t)ptr,
+      (long long)(intptr_t)col, (long long)(intptr_t)val,
+      (long long)handle_id(prm), one_based);
+  return as_handle(id);
+}
+
+}  // namespace
+
+extern "C" {
+
+int amgcl_tpu_init(void) {
+  if (g_mod) return 0;
+  const bool we_initialized = !Py_IsInitialized();
+  if (we_initialized) Py_InitializeEx(0);
+  {
+    Gil g;
+    // the C surface is f64; enable x64 before any JAX program compiles
+    PyRun_SimpleString(
+        "import jax; jax.config.update('jax_enable_x64', True)");
+    g_mod = PyImport_ImportModule("amgcl_tpu.capi");
+    if (!g_mod) {
+      PyErr_Print();
+      std::fprintf(stderr,
+                   "amgcl_tpu_init: cannot import amgcl_tpu.capi "
+                   "(set PYTHONPATH to the amgcl_tpu checkout)\n");
+      return 1;
+    }
+  }
+  // Py_InitializeEx leaves the GIL held by this thread; release it so C API
+  // calls from ANY thread (each using PyGILState_Ensure) don't deadlock.
+  if (we_initialized) PyEval_SaveThread();
+  return 0;
+}
+
+amgclHandle amgcl_tpu_params_create(void) {
+  Gil g;
+  return as_handle(call_i64("params_create", "()"));
+}
+
+void amgcl_tpu_params_seti(amgclHandle prm, const char* name, int value) {
+  Gil g;
+  Py_XDECREF(call("params_set", "(Lsi)", (long long)handle_id(prm), name,
+                  value));
+}
+
+void amgcl_tpu_params_setf(amgclHandle prm, const char* name, double value) {
+  Gil g;
+  Py_XDECREF(call("params_set", "(Lsd)", (long long)handle_id(prm), name,
+                  value));
+}
+
+void amgcl_tpu_params_sets(amgclHandle prm, const char* name,
+                           const char* value) {
+  Gil g;
+  Py_XDECREF(call("params_set", "(Lss)", (long long)handle_id(prm), name,
+                  value));
+}
+
+void amgcl_tpu_params_read_json(amgclHandle prm, const char* fname) {
+  Gil g;
+  Py_XDECREF(call("params_read_json", "(Ls)", (long long)handle_id(prm),
+                  fname));
+}
+
+void amgcl_tpu_params_destroy(amgclHandle prm) {
+  Gil g;
+  Py_XDECREF(call("handle_destroy", "(L)", (long long)handle_id(prm)));
+}
+
+amgclHandle amgcl_tpu_precond_create(int n, const int* ptr, const int* col,
+                                     const double* val, amgclHandle prm) {
+  return create("precond_create", n, ptr, col, val, prm, 0);
+}
+
+amgclHandle amgcl_tpu_precond_create_f(int n, const int* ptr, const int* col,
+                                       const double* val, amgclHandle prm) {
+  return create("precond_create", n, ptr, col, val, prm, 1);
+}
+
+void amgcl_tpu_precond_apply(amgclHandle p, const double* rhs, double* x) {
+  Gil g;
+  PyObject* n_obj = call("handle_n", "(L)", (long long)handle_id(p));
+  if (!n_obj) return;
+  long long n = PyLong_AsLongLong(n_obj);
+  Py_DECREF(n_obj);
+  Py_XDECREF(call("precond_apply", "(LLLL)", (long long)handle_id(p),
+                  (long long)(intptr_t)rhs, (long long)(intptr_t)x, n));
+}
+
+void amgcl_tpu_precond_report(amgclHandle p) {
+  Gil g;
+  PyObject* s = call("report", "(L)", (long long)handle_id(p));
+  if (s) {
+    std::printf("%s\n", PyUnicode_AsUTF8(s));
+    Py_DECREF(s);
+  }
+}
+
+void amgcl_tpu_precond_destroy(amgclHandle p) {
+  Gil g;
+  Py_XDECREF(call("handle_destroy", "(L)", (long long)handle_id(p)));
+}
+
+amgclHandle amgcl_tpu_solver_create(int n, const int* ptr, const int* col,
+                                    const double* val, amgclHandle prm) {
+  return create("solver_create", n, ptr, col, val, prm, 0);
+}
+
+amgclHandle amgcl_tpu_solver_create_f(int n, const int* ptr, const int* col,
+                                      const double* val, amgclHandle prm) {
+  return create("solver_create", n, ptr, col, val, prm, 1);
+}
+
+struct amgcl_tpu_conv_info amgcl_tpu_solver_solve(amgclHandle s,
+                                                  const double* rhs,
+                                                  double* x) {
+  struct amgcl_tpu_conv_info out = {0, -1.0};
+  Gil g;
+  PyObject* n_obj = call("handle_n", "(L)", (long long)handle_id(s));
+  if (!n_obj) return out;
+  long long n = PyLong_AsLongLong(n_obj);
+  Py_DECREF(n_obj);
+  PyObject* res = call("solver_solve", "(LLLL)", (long long)handle_id(s),
+                       (long long)(intptr_t)rhs, (long long)(intptr_t)x, n);
+  if (res && PyTuple_Check(res) && PyTuple_Size(res) == 2) {
+    out.iterations = (int)PyLong_AsLong(PyTuple_GetItem(res, 0));
+    out.residual = PyFloat_AsDouble(PyTuple_GetItem(res, 1));
+  }
+  Py_XDECREF(res);
+  return out;
+}
+
+void amgcl_tpu_solver_solve_f(amgclHandle s, const double* rhs, double* x,
+                              struct amgcl_tpu_conv_info* cnv) {
+  *cnv = amgcl_tpu_solver_solve(s, rhs, x);
+}
+
+void amgcl_tpu_solver_report(amgclHandle s) { amgcl_tpu_precond_report(s); }
+
+void amgcl_tpu_solver_destroy(amgclHandle s) {
+  amgcl_tpu_precond_destroy(s);
+}
+
+}  // extern "C"

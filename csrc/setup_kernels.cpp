@@ -176,7 +176,92 @@ struct HashAcc {
       h = (h + 1) & mask;
     }
   }
+
+  // accumulate only when the key is already present (masked products)
+  inline void add_if_present(int32_t key, double v) {
+    int64_t h = (static_cast<uint32_t>(key) * 2654435761u) & mask;
+    while (true) {
+      if (keys[h] == key) { vals[h] += v; return; }
+      if (keys[h] == -1) return;
+      h = (h + 1) & mask;
+    }
+  }
+
+  inline double get(int32_t key) const {
+    int64_t h = (static_cast<uint32_t>(key) * 2654435761u) & mask;
+    while (true) {
+      if (keys[h] == key) return vals[h];
+      if (keys[h] == -1) return 0.0;
+      h = (h + 1) & mask;
+    }
+  }
 };
+
+// Block-value accumulator: each slot owns a bs-element dense block.
+struct BlockHashAcc {
+  std::vector<int32_t> keys;
+  std::vector<double> vals;  // (mask+1) * bs
+  std::vector<int64_t> used;
+  int64_t mask = 0;
+  int64_t bs = 1;
+
+  void reset(int64_t cap_hint, int64_t bs_) {
+    int64_t cap = 16;
+    while (cap < cap_hint * 2) cap <<= 1;
+    keys.assign(cap, -1);
+    mask = cap - 1;
+    bs = bs_;
+    vals.assign(cap * bs, 0.0);
+    used.clear();
+  }
+
+  inline double* slot(int32_t key) {
+    int64_t h = (static_cast<uint32_t>(key) * 2654435761u) & mask;
+    while (true) {
+      if (keys[h] == key) return &vals[h * bs];
+      if (keys[h] == -1) {
+        keys[h] = key;
+        used.push_back(h);
+        return &vals[h * bs];
+      }
+      h = (h + 1) & mask;
+    }
+  }
+};
+
+// Shared numeric pass over the value type (f64 / f32 front-ends below).
+template <class T>
+void spgemm_numeric_t(int64_t n, const int64_t* aptr, const int32_t* acol,
+                      const T* aval, const int64_t* bptr,
+                      const int32_t* bcol, const T* bval,
+                      const int64_t* cptr, int32_t* ccol, T* cval) {
+#pragma omp parallel
+  {
+    HashAcc acc;
+    std::vector<int64_t> tmp;
+#pragma omp for schedule(dynamic, 256)
+    for (int64_t i = 0; i < n; ++i) {
+      acc.reset(cptr[i + 1] - cptr[i] + 8);
+      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j) {
+        const int32_t a = acol[j];
+        const double av = static_cast<double>(aval[j]);
+        for (int64_t t = bptr[a]; t < bptr[a + 1]; ++t)
+          acc.add(bcol[t], av * static_cast<double>(bval[t]));
+      }
+      tmp.clear();
+      for (int64_t h = 0; h <= acc.mask; ++h)
+        if (acc.keys[h] != -1) tmp.push_back(h);
+      std::sort(tmp.begin(), tmp.end(),
+                [&](int64_t x, int64_t y) { return acc.keys[x] < acc.keys[y]; });
+      int64_t o = cptr[i];
+      for (int64_t h : tmp) {
+        ccol[o] = acc.keys[h];
+        cval[o] = static_cast<T>(acc.vals[h]);
+        ++o;
+      }
+    }
+  }
+}
 
 }  // namespace
 
@@ -212,32 +297,124 @@ void spgemm_numeric(int64_t n, const int64_t* aptr, const int32_t* acol,
                     const double* aval, const int64_t* bptr,
                     const int32_t* bcol, const double* bval,
                     const int64_t* cptr, int32_t* ccol, double* cval) {
+  spgemm_numeric_t<double>(n, aptr, acol, aval, bptr, bcol, bval, cptr,
+                           ccol, cval);
+}
+
+void spgemm_numeric_f32(int64_t n, const int64_t* aptr, const int32_t* acol,
+                        const float* aval, const int64_t* bptr,
+                        const int32_t* bcol, const float* bval,
+                        const int64_t* cptr, int32_t* ccol, float* cval) {
+  spgemm_numeric_t<float>(n, aptr, acol, aval, bptr, bcol, bval, cptr,
+                          ccol, cval);
+}
+
+// Block-valued numeric pass: aval blocks are (br x bk) row-major, bval
+// (bk x bc), accumulating (br x bc) product blocks. Same symbolic pass as
+// the scalar kernel (the pattern is value-type-free).
+void spgemm_numeric_block(int64_t n, const int64_t* aptr,
+                          const int32_t* acol, const double* aval,
+                          const int64_t* bptr, const int32_t* bcol,
+                          const double* bval, const int64_t* cptr,
+                          int32_t* ccol, double* cval, int64_t br,
+                          int64_t bk, int64_t bc) {
+  const int64_t as = br * bk, bs = bk * bc, cs = br * bc;
 #pragma omp parallel
   {
-    HashAcc acc;
+    BlockHashAcc acc;
     std::vector<int64_t> tmp;
-#pragma omp for schedule(dynamic, 256)
+#pragma omp for schedule(dynamic, 128)
     for (int64_t i = 0; i < n; ++i) {
-      // the symbolic pass already produced the exact per-row nnz
-      acc.reset(cptr[i + 1] - cptr[i] + 8);
+      acc.reset(cptr[i + 1] - cptr[i] + 8, cs);
       for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j) {
         const int32_t a = acol[j];
-        const double av = aval[j];
-        for (int64_t t = bptr[a]; t < bptr[a + 1]; ++t)
-          acc.add(bcol[t], av * bval[t]);
+        const double* Ab = aval + j * as;
+        for (int64_t t = bptr[a]; t < bptr[a + 1]; ++t) {
+          const double* Bb = bval + t * bs;
+          double* Cb = acc.slot(bcol[t]);
+          for (int64_t r = 0; r < br; ++r)
+            for (int64_t k = 0; k < bk; ++k) {
+              const double av = Ab[r * bk + k];
+              if (av == 0.0) continue;
+              const double* Brow = Bb + k * bc;
+              double* Crow = Cb + r * bc;
+              for (int64_t c = 0; c < bc; ++c) Crow[c] += av * Brow[c];
+            }
+        }
       }
-      tmp.clear();
-      for (int64_t h = 0; h <= acc.mask; ++h)
-        if (acc.keys[h] != -1) tmp.push_back(h);
-      // sort by column index
-      std::sort(tmp.begin(), tmp.end(),
-                [&](int64_t x, int64_t y) { return acc.keys[x] < acc.keys[y]; });
+      tmp = acc.used;
+      std::sort(tmp.begin(), tmp.end(), [&](int64_t x, int64_t y) {
+        return acc.keys[x] < acc.keys[y];
+      });
       int64_t o = cptr[i];
       for (int64_t h : tmp) {
         ccol[o] = acc.keys[h];
-        cval[o] = acc.vals[h];
+        std::memcpy(cval + o * cs, &acc.vals[h * cs], cs * sizeof(double));
         ++o;
       }
+    }
+  }
+}
+
+// ELL packing: scatter CSR rows into dense (n, K) column/value planes
+// (the host->device format conversion — the hot part of to_device).
+// The value cast (f64 input -> f32/f64 output) is fused into the pack;
+// both output planes must arrive zeroed. bs = elements per value (1 for
+// scalar, br*bc for block values).
+void ell_pack(int64_t n, const int64_t* ptr, const int32_t* col,
+              const double* val, int64_t K, int64_t bs, int32_t* ocols,
+              double* ovals) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t o = i * K;
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j, ++o) {
+      ocols[o] = col[j];
+      std::memcpy(ovals + o * bs, val + j * bs, bs * sizeof(double));
+    }
+  }
+}
+
+void ell_pack_f32(int64_t n, const int64_t* ptr, const int32_t* col,
+                  const double* val, int64_t K, int64_t bs, int32_t* ocols,
+                  float* ovals) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t o = i * K;
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j, ++o) {
+      ocols[o] = col[j];
+      const double* src = val + j * bs;
+      float* dst = ovals + o * bs;
+      for (int64_t b = 0; b < bs; ++b) dst[b] = static_cast<float>(src[b]);
+    }
+  }
+}
+
+// Pattern-restricted product: tval[q] = sum_k A[i,k] B[k, tcol[q]] for each
+// target entry q of row i — one pass, no symbolic phase, no allocation of
+// the full product. This is the Chow-Patel sweep kernel: (L+I)U evaluated
+// on the factor pattern (reference role: the per-entry inner products of
+// amgcl/relaxation/ilu0_chow_patel.hpp's sweeps).
+void spgemm_masked(int64_t n, const int64_t* aptr, const int32_t* acol,
+                   const double* aval, const int64_t* bptr,
+                   const int32_t* bcol, const double* bval,
+                   const int64_t* tptr, const int32_t* tcol, double* tval) {
+#pragma omp parallel
+  {
+    HashAcc acc;
+#pragma omp for schedule(dynamic, 256)
+    for (int64_t i = 0; i < n; ++i) {
+      const int64_t t0 = tptr[i], t1 = tptr[i + 1];
+      if (t0 == t1) continue;
+      acc.reset(t1 - t0 + 8);
+      for (int64_t q = t0; q < t1; ++q) acc.add(tcol[q], 0.0);
+      for (int64_t j = aptr[i]; j < aptr[i + 1]; ++j) {
+        const int32_t a = acol[j];
+        const double av = aval[j];
+        if (av == 0.0) continue;
+        for (int64_t t = bptr[a]; t < bptr[a + 1]; ++t)
+          acc.add_if_present(bcol[t], av * bval[t]);
+      }
+      for (int64_t q = t0; q < t1; ++q) tval[q] = acc.get(tcol[q]);
     }
   }
 }

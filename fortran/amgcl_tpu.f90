@@ -1,0 +1,73 @@
+! Fortran bindings for the amgcl_tpu C API (include/amgcl_tpu.h), in the
+! iso_c_binding style of the reference's fortran module
+! (/root/reference/fortran/amgcl.f90 — independent declaration set for our
+! own C surface). Use the *_f creators: they take 1-based ptr/col arrays.
+module amgcl_tpu
+    use iso_c_binding
+    implicit none
+
+    type, bind(c) :: conv_info
+        integer(c_int)  :: iterations
+        real(c_double)  :: residual
+    end type
+
+    interface
+        integer(c_int) function amgcl_tpu_init() bind(c)
+            use iso_c_binding
+        end function
+
+        type(c_ptr) function amgcl_tpu_params_create() bind(c)
+            use iso_c_binding
+        end function
+
+        subroutine amgcl_tpu_params_seti(prm, name, val) bind(c)
+            use iso_c_binding
+            type(c_ptr), value :: prm
+            character(c_char), intent(in) :: name(*)
+            integer(c_int), value :: val
+        end subroutine
+
+        subroutine amgcl_tpu_params_setf(prm, name, val) bind(c)
+            use iso_c_binding
+            type(c_ptr), value :: prm
+            character(c_char), intent(in) :: name(*)
+            real(c_double), value :: val
+        end subroutine
+
+        subroutine amgcl_tpu_params_sets(prm, name, val) bind(c)
+            use iso_c_binding
+            type(c_ptr), value :: prm
+            character(c_char), intent(in) :: name(*)
+            character(c_char), intent(in) :: val(*)
+        end subroutine
+
+        subroutine amgcl_tpu_params_destroy(prm) bind(c)
+            use iso_c_binding
+            type(c_ptr), value :: prm
+        end subroutine
+
+        type(c_ptr) function amgcl_tpu_solver_create_f(n, ptr, col, val, &
+                prm) bind(c)
+            use iso_c_binding
+            integer(c_int), value :: n
+            integer(c_int), intent(in) :: ptr(*)
+            integer(c_int), intent(in) :: col(*)
+            real(c_double), intent(in) :: val(*)
+            type(c_ptr), value :: prm
+        end function
+
+        subroutine amgcl_tpu_solver_solve_f(solver, rhs, x, cnv) bind(c)
+            use iso_c_binding
+            import :: conv_info
+            type(c_ptr), value :: solver
+            real(c_double), intent(in) :: rhs(*)
+            real(c_double), intent(inout) :: x(*)
+            type(conv_info), intent(out) :: cnv
+        end subroutine
+
+        subroutine amgcl_tpu_solver_destroy(solver) bind(c)
+            use iso_c_binding
+            type(c_ptr), value :: solver
+        end subroutine
+    end interface
+end module amgcl_tpu

@@ -1,0 +1,68 @@
+/* C API for amgcl_tpu — the TPU-native rendition of the reference's
+ * C shared library (/root/reference/lib/amgcl.h:47-157): opaque handles
+ * over the runtime registry, so C / Fortran callers can configure, build,
+ * and run solvers. The implementation (csrc/c_api.cpp) embeds CPython and
+ * drives the ordinary JAX-backed runtime compositions; arrays cross the
+ * boundary zero-copy.
+ *
+ * All indices are 0-based ints (CSR). The *_f variants accept 1-based
+ * (Fortran) ptr/col arrays. Values are double; solves run f64 end-to-end.
+ */
+#ifndef AMGCL_TPU_H
+#define AMGCL_TPU_H
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef void* amgclHandle;
+
+struct amgcl_tpu_conv_info {
+    int    iterations;
+    double residual;
+};
+
+/* Must be called once before anything else; returns 0 on success.
+ * Initializes the embedded Python runtime (no-op when already inside a
+ * Python process). */
+int amgcl_tpu_init(void);
+
+/* -- parameter lists (dotted keys, e.g. "solver.type" = "cg") ----------- */
+amgclHandle amgcl_tpu_params_create(void);
+void amgcl_tpu_params_seti(amgclHandle prm, const char* name, int value);
+void amgcl_tpu_params_setf(amgclHandle prm, const char* name, double value);
+void amgcl_tpu_params_sets(amgclHandle prm, const char* name,
+                           const char* value);
+void amgcl_tpu_params_read_json(amgclHandle prm, const char* fname);
+void amgcl_tpu_params_destroy(amgclHandle prm);
+
+/* -- preconditioner ----------------------------------------------------- */
+amgclHandle amgcl_tpu_precond_create(int n, const int* ptr, const int* col,
+                                     const double* val, amgclHandle prm);
+amgclHandle amgcl_tpu_precond_create_f(int n, const int* ptr, const int* col,
+                                       const double* val, amgclHandle prm);
+void amgcl_tpu_precond_apply(amgclHandle p, const double* rhs, double* x);
+void amgcl_tpu_precond_report(amgclHandle p);
+void amgcl_tpu_precond_destroy(amgclHandle p);
+
+/* -- solver (preconditioner + Krylov) ----------------------------------- */
+amgclHandle amgcl_tpu_solver_create(int n, const int* ptr, const int* col,
+                                    const double* val, amgclHandle prm);
+amgclHandle amgcl_tpu_solver_create_f(int n, const int* ptr, const int* col,
+                                      const double* val, amgclHandle prm);
+/* x holds the initial guess on entry (zeros = cold start) and the solution
+ * on exit. */
+struct amgcl_tpu_conv_info amgcl_tpu_solver_solve(amgclHandle s,
+                                                  const double* rhs,
+                                                  double* x);
+/* Fortran-friendly variant: conv_info returned via an out parameter. */
+void amgcl_tpu_solver_solve_f(amgclHandle s, const double* rhs, double* x,
+                              struct amgcl_tpu_conv_info* cnv);
+void amgcl_tpu_solver_report(amgclHandle s);
+void amgcl_tpu_solver_destroy(amgclHandle s);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* AMGCL_TPU_H */
