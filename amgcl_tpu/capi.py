@@ -25,13 +25,17 @@ import numpy as np
 # in-process Python import of this module must not flip process-global JAX
 # config behind the host application's back.
 
+import itertools
+
 _handles = {}
-_next_id = [1]
+# itertools.count.__next__ is atomic under the GIL — C API entry points may
+# run on any thread (each takes the GIL independently), so id allocation
+# must not be a read-modify-write pair
+_next_id = itertools.count(1)
 
 
 def _register(obj) -> int:
-    h = _next_id[0]
-    _next_id[0] += 1
+    h = next(_next_id)
     _handles[h] = obj
     return h
 

@@ -22,6 +22,7 @@ class Aggregation:
     over_interp: float = 1.5
     block_size: int = 1
     nullspace: np.ndarray | None = None
+    aggregator: object = None     # optional (A, eps) -> (agg, n_agg) hook
 
     def transfer_operators(self, A: CSR):
         if A.is_block and self.nullspace is not None:
@@ -33,6 +34,9 @@ class Aggregation:
         if bs > 1:
             agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
+        elif self.aggregator is not None:
+            agg, n_agg = self.aggregator(scalar, self.eps_strong)
+            n_pt = scalar.nrows
         else:
             agg, n_agg = plain_aggregates(scalar, self.eps_strong)
             n_pt = scalar.nrows

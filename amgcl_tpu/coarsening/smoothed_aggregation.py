@@ -30,6 +30,10 @@ class SmoothedAggregation:
     power_iters: int = 0          # 0 => Gershgorin bound
     block_size: int = 1           # pointwise aggregation for block systems
     nullspace: np.ndarray | None = None   # (n_scalar, nvec) near-nullspace
+    # optional aggregation override ``(scalar_csr, eps) -> (agg, n_agg)``:
+    # the distributed layer injects the mesh-sharded device MIS here
+    # (parallel/dist_mis.py), replacing the host greedy pass
+    aggregator: object = None
 
     def transfer_operators(self, A: CSR):
         if A.is_block and self.nullspace is not None:
@@ -43,6 +47,9 @@ class SmoothedAggregation:
         if bs > 1:
             agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
+        elif self.aggregator is not None:
+            agg, n_agg = self.aggregator(scalar, self.eps_strong)
+            n_pt = scalar.nrows
         else:
             agg, n_agg = plain_aggregates(scalar, self.eps_strong)
             n_pt = scalar.nrows

@@ -43,7 +43,10 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
     # compare (wide matrices read far to the right of the tile's rows)
     hi = max(max(offsets + (0,)), 0)
     n_pad = -(-n // tile) * tile
-    xp = jnp.zeros(n_pad + base + hi, x.dtype)
+    # wide rectangular operators: x (length m) can exceed the tile window
+    # span, so size the scratch source for BOTH (round-1 advisor finding:
+    # dynamic_update_slice trace failure when m > n_pad + hi)
+    xp = jnp.zeros(max(n_pad + base + hi, m + base), x.dtype)
     xp = jax.lax.dynamic_update_slice(xp, x, (base,))
     dpad = jnp.pad(data, ((0, 0), (0, n_pad - n)))
     ndiag = len(offsets)

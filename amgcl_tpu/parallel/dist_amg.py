@@ -439,11 +439,38 @@ class DistAMGSolver:
     over the mesh, one compiled SPMD program per (structure, params)."""
 
     def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
-                 solver: Any = None, replicate_below: int = 4096):
+                 solver: Any = None, replicate_below: int = 4096,
+                 device_mis: bool = False):
+        """``device_mis=True`` runs the aggregation MIS rounds sharded on
+        the mesh (parallel/dist_mis.py) instead of the host greedy pass —
+        the reference's distributed-PMIS role
+        (amgcl/mpi/coarsening/pmis.hpp), reformulated as halo-plan row-max
+        propagation."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.mesh = mesh
         self.prm = prm or AMGParams()
+        if device_mis:
+            import copy as _copy
+            from amgcl_tpu.parallel.dist_mis import make_mesh_aggregator
+            prm2 = _copy.copy(self.prm)
+            coars = _copy.deepcopy(self.prm.coarsening)
+            if not hasattr(coars, "aggregator"):
+                raise ValueError(
+                    "device_mis needs an aggregation-based coarsening "
+                    "(smoothed_aggregation / aggregation), got %s"
+                    % type(coars).__name__)
+            if A.is_block or getattr(coars, "block_size", 1) > 1:
+                # pointwise (block) aggregation takes a different path that
+                # bypasses the aggregator hook — fail loudly rather than
+                # silently running the host pass
+                raise ValueError(
+                    "device_mis does not support block (pointwise) "
+                    "aggregation yet; unblock the system or drop "
+                    "device_mis")
+            coars.aggregator = make_mesh_aggregator(mesh)
+            prm2.coarsening = coars
+            self.prm = prm2
         self.solver = solver or CG()
         dtype = self.prm.dtype
         nd = mesh.shape[ROWS_AXIS]

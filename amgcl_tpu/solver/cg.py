@@ -20,6 +20,8 @@ class CG:
     maxiter: int = 100
     tol: float = 1e-8
     abstol: float = 0.0
+    ns_search: bool = False  # keep iterating on a zero rhs to find
+    #                          null-space vectors (cg.hpp:90-94,163-168)
     verbose: bool = False   # print residual every 5 iterations (cg.hpp:199)
     record_history: bool = False  # per-iteration relative residuals
 
@@ -71,7 +73,11 @@ class CG:
         state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype), 0, res0,
                  hist0)
         x, r, p, rho, iters, res, hist = lax.while_loop(cond, body, state)
-        x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
+        if not self.ns_search:
+            # ||rhs|| == 0 => the solution is x = 0; with ns_search the
+            # iterates from a nonzero x0 approach a null-space vector
+            # instead (reference cg.hpp:163-168)
+            x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
         if self.record_history:
             return x, iters, res / norm_scale, hist
         return x, iters, res / norm_scale

@@ -116,18 +116,25 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None):
 
 @dataclass
 class GMRES:
-    """Left-preconditioned restarted GMRES(M) (reference default M=30)."""
+    """Restarted GMRES(M) (reference default M=30). ``pside`` selects the
+    preconditioning side (reference: amgcl/solver/precond_side.hpp,
+    gmres.hpp:77-96 — the reference defaults to right; here the historical
+    default is left, with right sharing the flexible machinery: for a
+    constant preconditioner FGMRES *is* right-preconditioned GMRES)."""
     M: int = 30
     maxiter: int = 100
     tol: float = 1e-8
+    pside: str = "left"
 
     flexible = False
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
+        if self.pside not in ("left", "right"):
+            raise ValueError("pside must be 'left' or 'right'")
 
-        if self.flexible:
+        if self.flexible or self.pside == "right":
             def apply_op(v):
                 z = precond(v)
                 return dev.spmv(A, z), z

@@ -85,11 +85,48 @@ def write_binary(path, m):
             f.write(a.tobytes())
 
 
+def read_binary_reference_crs(path):
+    """Reader for the reference toolchain's RAW headerless CRS layout
+    (amgcl/io/binary.hpp:70-122, as written by examples/mm2bin.cpp):
+    [n: u64][ptr: (n+1) x i64][col: nnz x i64][val: nnz x f64].
+    The layout is not self-describing, so plausibility checks guard
+    against misinterpreting arbitrary binaries."""
+    with open(path, "rb") as f:
+        raw_n = f.read(8)
+        if len(raw_n) != 8:
+            raise ValueError("%s: truncated file" % path)
+        n = int(np.frombuffer(raw_n, dtype=np.uint64)[0])
+        import os as _os
+        fsize = _os.fstat(f.fileno()).st_size
+        if n <= 0 or 8 + (n + 1) * 8 > fsize:
+            raise ValueError("%s: not a reference raw CRS file" % path)
+        ptr = np.frombuffer(f.read((n + 1) * 8), dtype=np.int64)
+        nnz = int(ptr[-1])
+        good = (ptr[0] == 0 and nnz >= n and np.all(np.diff(ptr) >= 0)
+                and 8 + (n + 1) * 8 + nnz * 16 == fsize)
+        if not good:
+            raise ValueError("%s: not a reference raw CRS file" % path)
+        col = np.frombuffer(f.read(nnz * 8), dtype=np.int64)
+        val = np.frombuffer(f.read(nnz * 8), dtype=np.float64)
+        if col.min(initial=0) < 0:
+            raise ValueError("%s: negative column index" % path)
+        # the reference layout stores square systems; keep ncols >= n
+        return CSR(ptr, col.astype(np.int32), val.copy(),
+                   max(n, int(col.max(initial=-1)) + 1))
+
+
 def read_binary(path):
-    """Read back what write_binary produced."""
+    """Read back what write_binary produced; falls back to the reference
+    toolchain's raw CRS layout so .bin files produced by mm2bin load too
+    (round-1 advisor finding: the two formats were not interchangeable)."""
     with open(path, "rb") as f:
         if f.read(len(_MAGIC)) != _MAGIC:
-            raise ValueError("%s: not an amgcl_tpu binary file" % path)
+            try:
+                return read_binary_reference_crs(path)
+            except ValueError:
+                raise ValueError(
+                    "%s: neither an amgcl_tpu binary file nor a reference "
+                    "raw CRS file" % path)
         kind = struct.unpack("<B", f.read(1))[0]
         if kind == 1:
             nrows, ncols = struct.unpack("<qq", f.read(16))

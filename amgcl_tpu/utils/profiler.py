@@ -85,5 +85,37 @@ class Profiler:
         return "\n".join(lines)
 
 
+def aggregate(profilers):
+    """min/avg/max of every scope total across a set of profilers — the
+    single-controller rendition of the reference's
+    ``perf_counter::mpi_aggregator`` (amgcl/perf_counter/
+    mpi_aggregator.hpp:43-123, which reduces any counter across ranks).
+    Useful for multi-process launches (jax.distributed) or repeated runs.
+
+    Returns {scope_path: (min, avg, max)} and prints like the reference's
+    aggregated profile when str()-ed via ``format_aggregate``."""
+    totals = {}
+
+    def walk(node, path):
+        for name, ch in node.children.items():
+            p = path + "/" + name if path else name
+            totals.setdefault(p, []).append(ch.total)
+            walk(ch, p)
+
+    for pr in profilers:
+        walk(pr.root, "")
+    return {k: (min(v), sum(v) / len(v), max(v))
+            for k, v in totals.items()}
+
+
+def format_aggregate(agg) -> str:
+    lines = ["Aggregated profile:",
+             "%-40s %10s %10s %10s" % ("", "min", "avg", "max")]
+    for k in sorted(agg):
+        mn, av, mx = agg[k]
+        lines.append("%-40s %9.3fs %9.3fs %9.3fs" % (k, mn, av, mx))
+    return "\n".join(lines)
+
+
 #: module-level default profiler, like the reference's global ``prof``
 prof = Profiler()

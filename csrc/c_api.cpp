@@ -27,17 +27,14 @@ struct Gil {
 
 // call capi.<name>(fmt args...) and return the result (new ref, or null
 // with the Python error printed)
-PyObject* call(const char* name, const char* fmt, ...) {
+PyObject* vcall(const char* name, const char* fmt, va_list va) {
   if (!g_mod) return nullptr;
   PyObject* fn = PyObject_GetAttrString(g_mod, name);
   if (!fn) {
     PyErr_Print();
     return nullptr;
   }
-  va_list va;
-  va_start(va, fmt);
   PyObject* args = Py_VaBuildValue(fmt, va);
-  va_end(va);
   PyObject* out = args ? PyObject_CallObject(fn, args) : nullptr;
   Py_XDECREF(args);
   Py_DECREF(fn);
@@ -45,24 +42,20 @@ PyObject* call(const char* name, const char* fmt, ...) {
   return out;
 }
 
-int64_t call_i64(const char* name, const char* fmt, ...) {
-  if (!g_mod) return 0;
-  PyObject* fn = PyObject_GetAttrString(g_mod, name);
-  if (!fn) {
-    PyErr_Print();
-    return 0;
-  }
+PyObject* call(const char* name, const char* fmt, ...) {
   va_list va;
   va_start(va, fmt);
-  PyObject* args = Py_VaBuildValue(fmt, va);
+  PyObject* out = vcall(name, fmt, va);
   va_end(va);
-  PyObject* out = args ? PyObject_CallObject(fn, args) : nullptr;
-  Py_XDECREF(args);
-  Py_DECREF(fn);
-  if (!out) {
-    PyErr_Print();
-    return 0;
-  }
+  return out;
+}
+
+int64_t call_i64(const char* name, const char* fmt, ...) {
+  va_list va;
+  va_start(va, fmt);
+  PyObject* out = vcall(name, fmt, va);
+  va_end(va);
+  if (!out) return 0;
   int64_t v = PyLong_AsLongLong(out);
   Py_DECREF(out);
   return v;

@@ -125,6 +125,24 @@ def test_pallas_dia_spmv_rect_interpret():
     assert np.allclose(np.asarray(y), R.spmv(np.asarray(x)))
 
 
+def test_pallas_dia_spmv_wide_banded_interpret():
+    """Wide operator with a NARROW band: x is longer than the tile window
+    span, which used to fail at trace time (round-1 advisor finding) —
+    xp must be sized for max(window span, len(x))."""
+    import scipy.sparse as sp
+    from amgcl_tpu.ops.csr import CSR
+    from amgcl_tpu.ops.pallas_spmv import dia_spmv
+    n, m = 100, 300
+    R = CSR.from_scipy(sp.diags(
+        [np.ones(n), 0.5 * np.ones(n), 0.25 * np.ones(n)],
+        [0, 5, 20], shape=(n, m), format="csr"))
+    M = dev.csr_to_dia(R, jnp.float64)
+    assert max(M.offsets) + (-(-n // 128) * 128) < m   # the failing regime
+    x = jnp.asarray(np.random.RandomState(2).rand(m))
+    y = dia_spmv(M.offsets, M.data, x, tile=128, interpret=True)
+    assert np.allclose(np.asarray(y), R.spmv(np.asarray(x)))
+
+
 def test_pallas_dia_spmv_wide_interpret():
     """Wide (ncols > nrows) matrices read beyond the tile — regression for
     the undersized VMEM window."""

@@ -257,6 +257,92 @@ def test_as_preconditioner_and_dummy_repr():
     assert info.resid < 1e-8
 
 
+def test_binary_reference_raw_crs(tmp_path):
+    """.bin files in the reference toolchain's headerless layout
+    (amgcl/io/binary.hpp:70-122) load through read_binary."""
+    import struct
+    from amgcl_tpu.utils.io import read_binary
+    A, _ = poisson3d(6)
+    p = tmp_path / "ref.bin"
+    with open(p, "wb") as f:
+        f.write(struct.pack("<Q", A.nrows))
+        f.write(A.ptr.astype(np.int64).tobytes())
+        f.write(A.col.astype(np.int64).tobytes())
+        f.write(A.val.astype(np.float64).tobytes())
+    B = read_binary(str(p))
+    assert B.nrows == A.nrows and B.nnz == A.nnz
+    assert np.array_equal(B.col, A.col) and np.allclose(B.val, A.val)
+    # garbage is still rejected with a clear error
+    bad = tmp_path / "junk.bin"
+    bad.write_bytes(b"\x01\x02\x03\x04" * 10)
+    with pytest.raises(ValueError, match="neither"):
+        read_binary(str(bad))
+
+
+def test_cg_ns_search():
+    """ns_search keeps iterating on a zero rhs from a nonzero x0 — the
+    iterate approaches a null-space vector (reference cg.hpp:90,163)."""
+    import scipy.sparse as sp
+    from amgcl_tpu.ops.csr import CSR
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    # singular: 1-D Neumann Laplacian (nullspace = constants)
+    n = 64
+    T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                 [-1, 0, 1]).tolil()
+    T[0, 0] = 1.0
+    T[-1, -1] = 1.0
+    A = CSR.from_scipy(T.tocsr())
+    s = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=32),
+                    CG(maxiter=200, tol=1e-10, ns_search=True))
+    x0 = np.random.RandomState(0).rand(n)
+    x, info = s(np.zeros(n), x0=x0)
+    x = np.asarray(x)
+    assert np.linalg.norm(x) > 1e-8            # did NOT collapse to zero
+    # normalized iterate is (close to) the constant null-space vector
+    v = x / np.linalg.norm(x)
+    assert np.std(v) < 1e-4 * np.abs(v).mean() + 1e-6
+
+
+def test_gmres_right_side():
+    """pside='right' converges and reports the UNpreconditioned residual
+    (right preconditioning does not change the residual norm)."""
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.gmres import GMRES
+    A, rhs = poisson3d(10)
+    s = make_solver(A, AMGParams(dtype=jnp.float64),
+                    GMRES(M=20, maxiter=100, tol=1e-8, pside="right"))
+    x, info = s(rhs)
+    r = np.linalg.norm(rhs - A.spmv(np.asarray(x))) / np.linalg.norm(rhs)
+    assert r < 1e-7
+    with pytest.raises(ValueError, match="pside"):
+        GMRES(pside="middle").solve(None, None, jnp.zeros(4))
+
+
+def test_profiler_aggregate():
+    """mpi_aggregator equivalent: min/avg/max of scope totals across
+    profilers (amgcl/perf_counter/mpi_aggregator.hpp:43-123)."""
+    import time as _time
+    from amgcl_tpu.utils.profiler import Profiler, aggregate, \
+        format_aggregate
+    ps = []
+    for d in (0.001, 0.003):
+        p = Profiler()
+        with p.scope("setup"):
+            _time.sleep(d)
+            with p.scope("inner"):
+                _time.sleep(d)
+        ps.append(p)
+    agg = aggregate(ps)
+    mn, av, mx = agg["setup"]
+    assert mn <= av <= mx and mn > 0
+    assert "setup/inner" in agg
+    out = format_aggregate(agg)
+    assert "min" in out and "setup" in out
+
+
 def test_profiler_tree():
     from amgcl_tpu.utils.profiler import Profiler
     prof = Profiler()
