@@ -382,9 +382,12 @@ def _build_dist_smoother(relax, Ak, Ak_s, dA, mesh, nd, dtype):
 
     if isinstance(relax, (ILU0, ILUT, ILUK, ILUP)):
         Lh, Uh, udia = relax.build_host(Ak)
+        # factor partitions must match the level's (possibly shrunk) one
         return DistSmoother(
-            "ilu", Ls=build_dist_ell(Lh, mesh, dtype),
-            Us=build_dist_ell(Uh, mesh, dtype),
+            "ilu", Ls=build_dist_ell(Lh, mesh, dtype, nloc=dA.nloc,
+                                     ncloc=dA.nloc),
+            Us=build_dist_ell(Uh, mesh, dtype, nloc=dA.nloc,
+                              ncloc=dA.nloc),
             uinv=shard_vec(1.0 / udia, fill=1.0),
             jacobi_iters=relax.jacobi_iters)
     if isinstance(relax, GaussSeidel):
@@ -398,7 +401,8 @@ def _build_dist_smoother(relax, Ak, Ak_s, dA, mesh, nd, dtype):
             masks=put_sharded(masks, mesh, dtype))
     if isinstance(relax, Spai1):
         Mh = relax.build_host(Ak)
-        return DistSmoother("spai1", Msp=build_dist_ell(Mh, mesh, dtype))
+        return DistSmoother("spai1", Msp=build_dist_ell(
+            Mh, mesh, dtype, nloc=dA.nloc, ncloc=dA.nloc))
 
     st = relax.build(Ak, dtype)
     if isinstance(st, ChebyshevState):
@@ -440,12 +444,15 @@ class DistAMGSolver:
 
     def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
                  solver: Any = None, replicate_below: int = 4096,
-                 device_mis: bool = False):
+                 device_mis: bool = False, min_per_shard: int = 0):
         """``device_mis=True`` runs the aggregation MIS rounds sharded on
         the mesh (parallel/dist_mis.py) instead of the host greedy pass —
         the reference's distributed-PMIS role
         (amgcl/mpi/coarsening/pmis.hpp), reformulated as halo-plan row-max
-        propagation."""
+        propagation.
+
+        ``min_per_shard`` concentrates mid-size sharded levels on fewer
+        shards (the repartition-merge analogue, see the level loop)."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.mesh = mesh
@@ -495,18 +502,33 @@ class DistAMGSolver:
                       if sz < replicate_below and j > 0),
                      len(sizes) - 1)
         self._split = t
+        # mid-size level shrink (reference: mpi::partition::merge,
+        # merge.hpp:47-137 with min_per_proc): a level whose even spread
+        # would drop below `min_per_shard` rows/shard is concentrated on
+        # the first ceil(n / min_per_shard) shards instead — fewer halo
+        # pairs, bigger per-shard blocks, same SPMD program
+        def lvl_nloc(n_scalar):
+            base = -(-n_scalar // nd)
+            return max(base, min(int(min_per_shard), n_scalar)) \
+                if min_per_shard else base
+
+        nlocs = [lvl_nloc(h[0].nrows * h[0].block_size[0])
+                 for h in host.host_levels[:t]]
         levels = []
         for k, (Ak, Pk, Rk) in enumerate(host.host_levels[:t]):
             Ak_s = Ak.unblock() if Ak.is_block else Ak
-            dA = build_dist_ell(Ak_s, mesh, dtype)
+            dA = build_dist_ell(Ak_s, mesh, dtype, nloc=nlocs[k],
+                                ncloc=nlocs[k])
             dP = dR = None
             # the last sharded level's transfers become the transition ops,
             # so don't build (then discard) distributed versions of them
             if Pk is not None and k != t - 1:
                 dP = build_dist_ell(
-                    Pk.unblock() if Pk.is_block else Pk, mesh, dtype)
+                    Pk.unblock() if Pk.is_block else Pk, mesh, dtype,
+                    nloc=nlocs[k], ncloc=nlocs[k + 1])
                 dR = build_dist_ell(
-                    Rk.unblock() if Rk.is_block else Rk, mesh, dtype)
+                    Rk.unblock() if Rk.is_block else Rk, mesh, dtype,
+                    nloc=nlocs[k + 1], ncloc=nlocs[k])
             sm = _build_dist_smoother(self.prm.relax, Ak, Ak_s, dA, mesh,
                                       nd, dtype)
             levels.append(DistLevel(dA, dP, dR, sm))

@@ -413,6 +413,32 @@ def test_dist_amg_device_mis_rejects_block(mesh8):
                       device_mis=True)
 
 
+def test_dist_amg_min_per_shard(mesh8):
+    """Mid-size level shrink (the repartition-merge analogue): identical
+    math to the full spread — same iterations, same quality."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(16)     # 4096 rows: level 1 ~ 500 rows over 8 shards
+    # replicate_below=300 keeps level 1 SHARDED (it would otherwise fall
+    # into the replicated tail and the shrink would never engage)
+    mk = lambda **kw: DistAMGSolver(
+        A, mesh8, AMGParams(dtype=jnp.float64, coarse_enough=100),
+        CG(maxiter=100, tol=1e-8), replicate_below=300, **kw)
+    s_spread = mk()
+    s_shrink = mk(min_per_shard=256)   # level 1 concentrates on 2 shards
+    assert len(s_shrink.hier.levels) >= 2, "level 1 must stay sharded"
+    lvl1_spread = s_spread.hier.levels[1].A
+    lvl1_shrink = s_shrink.hier.levels[1].A
+    assert lvl1_spread.nloc < 256      # even spread really is finer
+    assert lvl1_shrink.nloc == 256     # ... and the shrink really engaged
+    x1, i1 = s_spread(rhs)
+    x2, i2 = s_shrink(rhs)
+    assert i1.iters == i2.iters
+    r2 = np.linalg.norm(rhs - A.spmv(x2)) / np.linalg.norm(rhs)
+    assert r2 < 1e-7
+
+
 def test_dist_cpr_runtime_config(mesh8):
     from amgcl_tpu.models.runtime import make_dist_solver_from_config
     from tests.test_coupled import reservoir_like
