@@ -594,15 +594,17 @@ class DistAMGSolver:
     def __call__(self, rhs, x0=None):
         dtype = self.prm.dtype
         nd = self.mesh.shape[ROWS_AXIS]
-        vec = NamedSharding(self.mesh, P(ROWS_AXIS))
-        rhs_p = jax.device_put(
-            _pad_vec(np.asarray(rhs), self.n_pad // nd, nd, dtype), vec)
-        x0_p = jnp.zeros_like(rhs_p) if x0 is None else jax.device_put(
-            _pad_vec(np.asarray(x0), self.n_pad // nd, nd, dtype), vec)
+        rhs_p = put_sharded(
+            _pad_vec(np.asarray(rhs), self.n_pad // nd, nd, dtype),
+            self.mesh)
+        x0_p = jnp.zeros_like(rhs_p) if x0 is None else put_sharded(
+            _pad_vec(np.asarray(x0), self.n_pad // nd, nd, dtype),
+            self.mesh)
         if self._compiled is None:
             self._compiled = self._build_compiled()
         x, it, res = self._compiled(self.hier, rhs_p, x0_p)
-        return np.asarray(x)[:self.n], SolverInfo(int(it), float(res))
+        from amgcl_tpu.parallel.mesh import host_full
+        return host_full(x)[:self.n], SolverInfo(int(it), float(res))
 
     def __repr__(self):
         return ("DistAMGSolver over %d devices\n%r"

@@ -73,8 +73,9 @@ class DistDiaMatrix:
                 "devices or a narrower band" % (out.halo, n // nd))
         sharding = NamedSharding(mesh, P(None, ROWS_AXIS))
         # numpy in, sharded out: the direct per-device path, no reshard
-        # compile (see mesh.put_sharded)
-        out.data = jax.device_put(np.asarray(out.data), sharding)
+        # compile, multi-controller-safe (see mesh.put_with_sharding)
+        from amgcl_tpu.parallel.mesh import put_with_sharding
+        out.data = put_with_sharding(np.asarray(out.data), sharding)
         return out
 
     # -- the per-shard kernel (runs inside shard_map) -----------------------

@@ -71,10 +71,12 @@ def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
     inverted diagonal; identity preconditioning when None.
 
     Returns (x, iters, rel_resid) with x sharded over rows."""
+    from amgcl_tpu.parallel.mesh import put_with_sharding
     vec = NamedSharding(mesh, P(ROWS_AXIS))
-    rhs = jax.device_put(rhs, vec)
-    x0 = jnp.zeros_like(rhs) if x0 is None else jax.device_put(x0, vec)
-    dinv = jnp.ones_like(rhs) if dinv is None else jax.device_put(dinv, vec)
+    rhs = put_with_sharding(rhs, vec)
+    x0 = jnp.zeros_like(rhs) if x0 is None else put_with_sharding(x0, vec)
+    dinv = jnp.ones_like(rhs) if dinv is None else put_with_sharding(dinv,
+                                                                     vec)
     fn = _compiled_dist_cg(mesh, A.offsets, A.shape, int(maxiter), float(tol))
     x, it, res = fn(A.data, rhs, x0, dinv)
     return x, int(it), float(res)

@@ -26,16 +26,40 @@ def make_mesh(n_devices: int | None = None, devices=None) -> Mesh:
 
 
 def put_sharded(a, mesh, dtype=None, axis=ROWS_AXIS):
-    """device_put a HOST array sharded over its leading dim.
+    """Place a HOST array on the mesh sharded over its leading dim.
 
-    The array must stay numpy until the put: device_put(numpy, sharding)
-    slices on host and lands each shard directly on its device, while
-    device_put(jnp.asarray(...), sharding) commits to one device first and
-    then RESHARDS — which compiles a throwaway XLA program per (shape,
-    sharding) pair and dominated round-1's distributed setup time
-    (4.46s for 32^3/8dev, ~80% pjit compiles)."""
+    The array must stay numpy until the placement: per-shard host slices
+    land directly on their devices, while device_put(jnp.asarray(...),
+    sharding) commits to one device first and then RESHARDS — which
+    compiles a throwaway XLA program per (shape, sharding) pair and
+    dominated round-1's distributed setup time (4.46s for 32^3/8dev,
+    ~80% pjit compiles).
+
+    ``make_array_from_callback`` (vs plain device_put of the numpy array)
+    also works under MULTI-CONTROLLER meshes: each process materializes
+    only its addressable shards, so the same setup code drives a
+    multi-host `jax.distributed` mesh (see parallel/multihost.py)."""
     a = np.asarray(a)
     if dtype is not None:
         a = a.astype(np.dtype(dtype))     # bf16 works via ml_dtypes
     spec = PartitionSpec(axis, *([None] * (a.ndim - 1)))
-    return jax.device_put(a, NamedSharding(mesh, spec))
+    return jax.make_array_from_callback(
+        a.shape, NamedSharding(mesh, spec), lambda idx: a[idx])
+
+
+def put_with_sharding(a, sharding):
+    """Place a host numpy array under an arbitrary NamedSharding via the
+    per-shard callback path (multi-controller-safe; no reshard compile)."""
+    a = np.asarray(a)
+    return jax.make_array_from_callback(a.shape, sharding,
+                                        lambda idx: a[idx])
+
+
+def host_full(x) -> np.ndarray:
+    """A row-sharded global array as full numpy on EVERY process: plain
+    np.asarray single-controller, process_allgather under
+    jax.distributed (where each process only holds its own shards)."""
+    if jax.process_count() == 1:
+        return np.asarray(x)
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

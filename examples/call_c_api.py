@@ -19,7 +19,10 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 def embed_flags():
-    cfg = shutil.which("python3-config")
+    # prefer the RUNNING interpreter's config (sys.executable-config, then
+    # sysconfig): a bare python3-config from PATH may belong to a
+    # different Python and embed the wrong libpython
+    cfg = shutil.which(sys.executable + "-config")
     if cfg:
         got = subprocess.run([cfg, "--includes", "--ldflags", "--embed"],
                              capture_output=True, text=True)

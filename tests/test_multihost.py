@@ -1,0 +1,109 @@
+"""REAL multi-process distributed execution: two controller processes,
+Gloo CPU collectives, one global 4-device mesh — the jax.distributed
+rendition of the reference's MPI scale-out (SURVEY.md §5.8). The worker
+script builds a DistAMGSolver over the global mesh and solves the Poisson
+fixture; the test asserts convergence AND iteration parity with a
+single-process mesh of the same size (multi-controller must not change
+the math)."""
+
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_WORKER = r"""
+import os, sys
+pid, nproc, port = int(sys.argv[1]), int(sys.argv[2]), sys.argv[3]
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+sys.path.insert(0, @REPO@)
+from amgcl_tpu.parallel import multihost
+multihost.initialize("127.0.0.1:" + port, nproc, pid)
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp
+import numpy as np
+from amgcl_tpu.utils.sample_problem import poisson3d
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.solver.cg import CG
+
+assert jax.process_count() == nproc
+mesh = multihost.global_mesh()
+assert mesh.devices.size == 2 * nproc
+A, rhs = poisson3d(12)
+s = DistAMGSolver(A, mesh, AMGParams(dtype=jnp.float64, coarse_enough=300),
+                  CG(maxiter=100, tol=1e-8))
+x, info = s(rhs)
+r = np.linalg.norm(rhs - A.spmv(x)) / np.linalg.norm(rhs)
+assert r < 1e-7, r
+print("RESULT %d iters=%d resid=%.3e" % (pid, info.iters, r), flush=True)
+""".replace("@REPO@", repr(REPO))
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_two_process_dist_amg():
+    port = str(_free_port())
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "JAX_PLATFORMS",
+                        "XLA_FLAGS")}
+    procs = [subprocess.Popen(
+        [sys.executable, "-c", _WORKER, str(pid), "2", port],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for pid in range(2)]
+    outs = []
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=420)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("multi-process run timed out")
+        outs.append(out)
+    for pid, out in enumerate(outs):
+        assert procs[pid].returncode == 0, out[-2000:]
+        assert "RESULT %d" % pid in out, out[-2000:]
+    # iteration parity: both processes agree, and match a single-process
+    # 4-device mesh of the same problem
+    iters = sorted(int(o.split("iters=")[1].split()[0]) for o in outs)
+    assert iters[0] == iters[1]
+
+    probe = subprocess.run(
+        [sys.executable, "-c", r"""
+import os, sys
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=4"
+sys.path.insert(0, @REPO@)
+import jax
+jax.config.update("jax_enable_x64", True)
+import jax.numpy as jnp, numpy as np
+from amgcl_tpu.utils.sample_problem import poisson3d
+from amgcl_tpu.parallel.mesh import make_mesh
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.solver.cg import CG
+A, rhs = poisson3d(12)
+s = DistAMGSolver(A, make_mesh(4), AMGParams(dtype=jnp.float64,
+                                             coarse_enough=300),
+                  CG(maxiter=100, tol=1e-8))
+x, info = s(rhs)
+print("ITERS", info.iters)
+""".replace("@REPO@", repr(REPO))], capture_output=True, text=True, env=env,
+        timeout=420)
+    assert probe.returncode == 0, probe.stdout + probe.stderr
+    single = int(probe.stdout.split("ITERS")[1].split()[0])
+    assert iters[0] == single
