@@ -42,8 +42,10 @@ from amgcl_tpu.parallel.dist_matrix import dist_inner_product
 
 
 def _pad_vec(v, nloc, nd, dtype):
-    out = np.zeros(nloc * nd, dtype=np.float64)
-    out[:len(v)] = np.asarray(v, dtype=np.float64)
+    host_dt = np.complex128 if jnp.issubdtype(
+        jnp.dtype(dtype), jnp.complexfloating) else np.float64
+    out = np.zeros(nloc * nd, dtype=host_dt)
+    out[:len(v)] = np.asarray(v, dtype=host_dt)
     return out.astype(np.dtype(dtype))   # stays numpy: see mesh.put_sharded
 
 
@@ -333,7 +335,8 @@ def _transition_ops(Pt: CSR, Rt: CSR, nd, nloc, mesh, dtype):
     prows = Pt.expanded_rows()
     K1 = max(int(Pt.row_nnz().max()), 1) if Pt.nnz else 1
     pc = np.zeros((nd, nloc, K1), dtype=np.int32)
-    pv = np.zeros((nd, nloc, K1), dtype=np.float64)
+    vdt = np.result_type(Pt.val.dtype, np.float64)
+    pv = np.zeros((nd, nloc, K1), dtype=vdt)
     for s_ in range(nd):
         r0, r1 = min(s_ * nloc, n_f), min((s_ + 1) * nloc, n_f)
         lo, hi = int(Pt.ptr[r0]), int(Pt.ptr[r1])
@@ -349,7 +352,7 @@ def _transition_ops(Pt: CSR, Rt: CSR, nd, nloc, mesh, dtype):
         if sel.any():
             K2 = max(K2, int(np.bincount(rrows[sel], minlength=nc).max()))
     rc = np.zeros((nd, nc, K2), dtype=np.int32)
-    rv = np.zeros((nd, nc, K2), dtype=np.float64)
+    rv = np.zeros((nd, nc, K2), dtype=vdt)
     for s_ in range(nd):
         sel = owner == s_
         c, v = pack_rows_ell(rrows[sel], Rt.col[sel] - s_ * nloc,
@@ -376,8 +379,9 @@ def _build_dist_smoother(relax, Ak, Ak_s, dA, mesh, nd, dtype):
     n_pad = dA.nloc * nd
 
     def shard_vec(v, fill=0.0):
-        pad = np.full(n_pad, float(fill))
-        pad[:len(v)] = np.asarray(v, dtype=np.float64)
+        host_dt = np.result_type(np.asarray(v).dtype, np.float64)
+        pad = np.full(n_pad, fill, dtype=host_dt)
+        pad[:len(v)] = np.asarray(v, dtype=host_dt)
         return put_sharded(pad.reshape(nd, dA.nloc), mesh, dtype)
 
     if isinstance(relax, (ILU0, ILUT, ILUK, ILUP)):
@@ -417,8 +421,9 @@ def _build_dist_smoother(relax, Ak, Ak_s, dA, mesh, nd, dtype):
                 "block smoother blocks (b=%d) straddle the shard boundary "
                 "(nloc=%d); choose a mesh with nloc divisible by b"
                 % (b, dA.nloc))
-        M = np.zeros((n_pad // b, b, b))
-        M[:np.shape(st.scale)[0]] = np.asarray(st.scale, dtype=np.float64)
+        vdt = np.result_type(np.asarray(st.scale).dtype, np.float64)
+        M = np.zeros((n_pad // b, b, b), dtype=vdt)
+        M[:np.shape(st.scale)[0]] = np.asarray(st.scale, dtype=vdt)
         return DistSmoother("bdiag", put_sharded(
             M.reshape(nd, dA.nloc // b, b, b), mesh, dtype))
     raise ValueError(

@@ -86,9 +86,11 @@ def _ell_mv(cols, vals, x):
 def pack_rows_ell(rr, cc, vv, nrows, K):
     """Pack (row, col, val) triples into dense (nrows, K) ELL arrays —
     the shared per-shard packing used by the halo plan and the
-    sharded/replicated transition operators."""
+    sharded/replicated transition operators. The value plane keeps the
+    input's dtype (complex stays complex)."""
+    vv = np.asarray(vv)
     cols = np.zeros((nrows, K), dtype=np.int32)
-    vals = np.zeros((nrows, K), dtype=np.float64)
+    vals = np.zeros((nrows, K), dtype=np.result_type(vv.dtype, np.float64))
     if len(rr):
         order = np.argsort(rr, kind="stable")
         rr, cc, vv = rr[order], cc[order], vv[order]
@@ -185,7 +187,8 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32, nloc=None,
 
     def pack(lists, K):
         cols = np.zeros((nd, nloc, K), dtype=np.int32)
-        vals = np.zeros((nd, nloc, K), dtype=np.float64)
+        vals = np.zeros((nd, nloc, K),
+                        dtype=np.result_type(A.val.dtype, np.float64))
         for s, (rr, cc, vv) in enumerate(lists):
             cols[s], vals[s] = pack_rows_ell(rr, cc, vv, nloc, K)
         return cols, vals

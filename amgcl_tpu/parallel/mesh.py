@@ -43,8 +43,7 @@ def put_sharded(a, mesh, dtype=None, axis=ROWS_AXIS):
     if dtype is not None:
         a = a.astype(np.dtype(dtype))     # bf16 works via ml_dtypes
     spec = PartitionSpec(axis, *([None] * (a.ndim - 1)))
-    return jax.make_array_from_callback(
-        a.shape, NamedSharding(mesh, spec), lambda idx: a[idx])
+    return put_with_sharding(a, NamedSharding(mesh, spec))
 
 
 def put_with_sharding(a, sharding):
