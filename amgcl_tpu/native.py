@@ -72,6 +72,8 @@ def lib():
             handle.spgemm_numeric_block.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9 + [ctypes.c_int64] * 3
             handle.spgemm_masked.restype = None
             handle.spgemm_masked.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 9
+            handle.spai0_diag.restype = None
+            handle.spai0_diag.argtypes = [ctypes.c_int64] +                 [ctypes.c_void_p] * 4
             for nm in ("ell_pack", "ell_pack_f32"):
                 fn = getattr(handle, nm)
                 fn.restype = None
@@ -256,6 +258,23 @@ def native_ell_pack(A, K: int, out_dtype):
     vals = np.zeros(shape, dtype=odt)
     kern(n, _ptr(ptr), _ptr(col), _ptr(val), K, bs, _ptr(cols), _ptr(vals))
     return cols, vals
+
+
+def native_spai0_diag(A):
+    """The SPAI-0 diagonal m_i = a_ii / sum_j a_ij^2 in one native pass,
+    or None when unavailable (scalar f64-able values only)."""
+    L = lib()
+    if L is None or A.is_block or np.iscomplexobj(A.val):
+        return None
+    try:
+        val = np.ascontiguousarray(A.val, dtype=np.float64)
+    except (TypeError, ValueError):
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    m = np.empty(A.nrows, dtype=np.float64)
+    L.spai0_diag(A.nrows, _ptr(ptr), _ptr(col), _ptr(val), _ptr(m))
+    return m
 
 
 def native_iluk_pattern(A, k: int):

@@ -38,8 +38,11 @@ class Spai0:
             M = np.swapaxes(M, 1, 2)
             M[zero_row] = 0.0
             return ScaledResidualSmoother(jnp.asarray(M, dtype=dtype), br)
-        rows = A.expanded_rows()
-        sq = (np.abs(A.val) ** 2).real.astype(np.float64)
-        denom = np.bincount(rows, weights=sq, minlength=A.nrows)
-        m = A.diagonal() / np.where(denom != 0, denom, 1.0)
+        from amgcl_tpu.native import native_spai0_diag
+        m = native_spai0_diag(A)
+        if m is None:
+            rows = A.expanded_rows()
+            sq = (np.abs(A.val) ** 2).real.astype(np.float64)
+            denom = np.bincount(rows, weights=sq, minlength=A.nrows)
+            m = A.diagonal() / np.where(denom != 0, denom, 1.0)
         return ScaledResidualSmoother(jnp.asarray(m, dtype=dtype))

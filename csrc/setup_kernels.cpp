@@ -389,6 +389,23 @@ void ell_pack_f32(int64_t n, const int64_t* ptr, const int32_t* col,
   }
 }
 
+// SPAI-0 diagonal: m_i = a_ii / sum_j a_ij^2 (one fused pass; the
+// reference's spai0.hpp row loop, here the hot part of the default
+// smoother's host build).
+void spai0_diag(int64_t n, const int64_t* ptr, const int32_t* col,
+                const double* val, double* m) {
+#pragma omp parallel for schedule(static)
+  for (int64_t i = 0; i < n; ++i) {
+    double dia = 0.0, ss = 0.0;
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      const double v = val[j];
+      ss += v * v;
+      if (col[j] == i) dia = v;
+    }
+    m[i] = ss != 0.0 ? dia / ss : 0.0;
+  }
+}
+
 // Pattern-restricted product: tval[q] = sum_k A[i,k] B[k, tcol[q]] for each
 // target entry q of row i — one pass, no symbolic phase, no allocation of
 // the full product. This is the Chow-Patel sweep kernel: (L+I)U evaluated
