@@ -96,7 +96,8 @@ class CPR:
             jnp.asarray(W, dtype=dtype),
             self.p_amg.hierarchy, smoother, b)
 
-    def _weights(self, A: CSR, **kw) -> np.ndarray:
+    @staticmethod
+    def _weights(A: CSR, **kw) -> np.ndarray:
         """Quasi-IMPES: first row of each diagonal block's inverse
         (decouples the pressure equation from the other unknowns)."""
         Dinv = A.diagonal(invert=True)
@@ -116,7 +117,8 @@ class CPRDRS(CPR):
 
     weighting = "drs"
 
-    def _weights(self, A: CSR, eps_dd: float = 0.2, **kw) -> np.ndarray:
+    @staticmethod
+    def _weights(A: CSR, eps_dd: float = 0.2, **kw) -> np.ndarray:
         b = A.block_size[0]
         n = A.nrows
         rows = np.repeat(np.arange(n), A.row_nnz())

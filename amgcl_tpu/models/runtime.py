@@ -247,16 +247,27 @@ def precond_from_config(A, pcfg: Dict[str, Any]):
             simplec_dia=_parse_bool(pcfg.get("simplec_dia", True)),
             dtype=dtype)
     if pclass == "cpr":
-        from amgcl_tpu.models.cpr import CPR
+        from amgcl_tpu.models.cpr import CPR, CPRDRS
+        known = {"class", "dtype", "block_size", "pressure", "relax",
+                 "weighting", "eps_dd"}
+        for k in pcfg:
+            if k not in known:
+                warnings.warn("unknown parameter precond.%s" % k)
         press = dict(pcfg.get("pressure", {}))
         relax = relaxation_from_params(pcfg["relax"]) \
             if "relax" in pcfg else None
-        return CPR(A,
+        weighting = str(pcfg.get("weighting", "quasi_impes"))
+        if weighting not in ("quasi_impes", "drs"):
+            raise ValueError("weighting must be 'quasi_impes' or 'drs'")
+        cls = CPRDRS if weighting == "drs" else CPR
+        wkw = {"eps_dd": float(pcfg["eps_dd"])} \
+            if "eps_dd" in pcfg and weighting == "drs" else {}
+        return cls(A,
                    block_size=int(pcfg["block_size"])
                    if "block_size" in pcfg else None,
                    pressure_prm=precond_params_from_dict(press)
                    if press else None,
-                   relax=relax, dtype=dtype)
+                   relax=relax, dtype=dtype, **wkw)
     raise ValueError("unknown precond.class %r" % pclass)
 
 
@@ -329,19 +340,26 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
     if pclass == "cpr":
         from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
         dtype = _parse_dtype(pcfg.get("dtype", "float32"))
-        known = {"class", "dtype", "block_size", "pressure"}
+        known = {"class", "dtype", "block_size", "pressure", "weighting",
+                 "eps_dd", "relax"}
         for k in pcfg:
             if k not in known:
                 warnings.warn("unknown parameter precond.%s" % k)
         # the pressure hierarchy inherits the CPR dtype unless overridden
         press = dict(pcfg.get("pressure", {}))
         press.setdefault("dtype", dtype)
+        wkw = {}
+        if "eps_dd" in pcfg:
+            wkw["eps_dd"] = float(pcfg["eps_dd"])
+        relax = relaxation_from_params(pcfg["relax"]) \
+            if "relax" in pcfg else None
         return DistCPRSolver(
             A, mesh,
             block_size=int(pcfg["block_size"]) if "block_size" in pcfg
             else None,
             pressure_prm=precond_params_from_dict(press),
-            solver=solver, dtype=dtype)
+            solver=solver, relax=relax, dtype=dtype,
+            weighting=str(pcfg.get("weighting", "quasi_impes")), **wkw)
     raise ValueError("unknown distributed precond.class %r" % pclass)
 
 

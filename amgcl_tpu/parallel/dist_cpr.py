@@ -20,7 +20,7 @@ from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.models.amg import AMGParams
-from amgcl_tpu.models.cpr import _pressure_matrix
+from amgcl_tpu.models.cpr import CPR, CPRDRS, _pressure_matrix
 from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.solver.cg import CG
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
@@ -77,7 +77,11 @@ class DistCPRSolver(DistAMGSolver):
     def __init__(self, A, mesh, block_size: Optional[int] = None,
                  pressure_prm: Optional[AMGParams] = None,
                  solver: Any = None, relax: Any = None,
-                 dtype=jnp.float32):
+                 dtype=jnp.float32, weighting: str = "quasi_impes",
+                 **wkw):
+        """``weighting``: 'quasi_impes' (cpr.hpp) or 'drs' (cpr_drs.hpp
+        dynamic row sums, with e.g. ``eps_dd``) — the same weight policies
+        as the serial CPR/CPRDRS."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         if not A.is_block:
@@ -87,12 +91,19 @@ class DistCPRSolver(DistAMGSolver):
         b = A.block_size[0]
         self.mesh = mesh
         self.solver = solver or CG()
+        self.weighting = weighting
         nd = mesh.shape[ROWS_AXIS]
         from types import SimpleNamespace
         self.prm = SimpleNamespace(dtype=dtype)
 
-        # pressure stage: distributed AMG on the quasi-IMPES reduced matrix
-        W = A.diagonal(invert=True)[:, 0, :]
+        # pressure stage: distributed AMG on the weight-reduced matrix
+        # (same weight policies as the serial CPR/CPRDRS)
+        if weighting == "quasi_impes":
+            W = CPR._weights(A)
+        elif weighting == "drs":
+            W = CPRDRS._weights(A, **wkw)
+        else:
+            raise ValueError("weighting must be 'quasi_impes' or 'drs'")
         App = _pressure_matrix(A, W)
         pprm = pressure_prm or AMGParams(dtype=dtype)
         p_solver = DistAMGSolver(App, mesh, pprm)

@@ -3,9 +3,11 @@ polynomial update (Sleijpen–Fokkema), curing the omega-breakdowns of plain
 BiCGStab on strongly non-symmetric/indefinite problems (reference:
 amgcl/solver/bicgstabl.hpp, default L=2).
 
-Left-preconditioned: the recurrence runs on op = M∘A with preconditioned
-residuals; L is static, so the inner BiCG/MR parts unroll into straight-line
-XLA code over an (L+1, n) stacked residual basis.
+``pside`` selects the preconditioning side (default right, matching the
+reference): right runs the recurrence on op = A∘M in correction form with
+TRUE residuals tracked; left runs on op = M∘A with preconditioned
+residuals. L is static, so the inner BiCG/MR parts unroll into
+straight-line XLA code over an (L+1, n) stacked residual basis.
 """
 
 from __future__ import annotations
@@ -24,21 +26,36 @@ class BiCGStabL:
     L: int = 2
     maxiter: int = 100
     tol: float = 1e-8
+    pside: str = "right"  # the reference default (bicgstabl.hpp:137)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
         Lp = self.L
-        x = jnp.zeros_like(rhs) if x0 is None else x0
+        if self.pside not in ("left", "right"):
+            raise ValueError("pside must be 'left' or 'right'")
+        right = self.pside == "right"
+        x_init = jnp.zeros_like(rhs) if x0 is None else x0
 
-        def op(v):
-            return precond(dev.spmv(A, v))
+        if right:
+            # recurrence runs on op = A∘M in y-space from y = 0 (correction
+            # form); x = x0 + M y at the end. The tracked residuals are the
+            # TRUE residuals of the original system.
+            def op(v):
+                return dev.spmv(A, precond(v))
 
-        b_p = precond(rhs)
+            b_p = rhs
+            r0 = dev.residual(rhs, A, x_init)
+            x = jnp.zeros_like(rhs)
+        else:
+            def op(v):
+                return precond(dev.spmv(A, v))
+
+            b_p = precond(rhs)
+            r0 = b_p - op(x_init)
+            x = x_init
         norm_rhs = jnp.sqrt(jnp.abs(dot(b_p, b_p)))
         scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
         eps = self.tol * scale
-
-        r0 = b_p - op(x)
         rhat = r0
         n = rhs.shape[0]
         dtype = rhs.dtype
@@ -86,4 +103,6 @@ class BiCGStabL:
         st = (x, R0, U0, one, jnp.zeros((), dtype), one, 0,
               jnp.sqrt(jnp.abs(dot(r0, r0))))
         x, R, U, rho, alpha, omega, it, res = lax.while_loop(cond, body, st)
+        if right:
+            x = x_init + precond(x)
         return x, it, res / scale

@@ -439,6 +439,42 @@ def test_dist_amg_min_per_shard(mesh8):
     assert r2 < 1e-7
 
 
+def test_dist_cpr_drs(mesh8):
+    """Distributed CPR with dynamic row-sum weights (cpr_drs.hpp role):
+    same weight policy as serial CPRDRS, iteration parity vs 1 device."""
+    from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+    from tests.test_coupled import reservoir_like
+    A, rhs = reservoir_like(8, 3)
+    s8 = DistCPRSolver(A, mesh8, solver=BiCGStab(maxiter=200, tol=1e-8),
+                       dtype=jnp.float64, weighting="drs")
+    x8, i8 = s8(rhs)
+    r8 = np.linalg.norm(rhs - A.spmv(x8)) / np.linalg.norm(rhs)
+    assert r8 < 1e-6
+    s1 = DistCPRSolver(A, make_mesh(1), solver=BiCGStab(maxiter=200,
+                                                        tol=1e-8),
+                       dtype=jnp.float64, weighting="drs")
+    _, i1 = s1(rhs)
+    assert i8.iters == i1.iters
+
+
+def test_dist_amg_ruge_stuben(mesh8):
+    """Classic RS coarsening through the distributed hierarchy (host
+    setup, sharded solve) — coarsening policy and distribution compose."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.coarsening.ruge_stuben import RugeStuben
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(12)
+    s = DistAMGSolver(A, mesh8,
+                      AMGParams(dtype=jnp.float64, coarse_enough=300,
+                                coarsening=RugeStuben()),
+                      CG(maxiter=100, tol=1e-8))
+    x, info = s(rhs)
+    r = np.linalg.norm(rhs - A.spmv(x)) / np.linalg.norm(rhs)
+    assert r < 1e-7
+
+
 def test_dist_amg_complex(mesh8):
     """Complex value type through the whole distributed stack: halo ELL
     SpMVs, conjugated psum dots, replicated complex coarse solve

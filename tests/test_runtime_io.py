@@ -159,6 +159,23 @@ def test_runtime_cpr_class():
     assert info.resid < 1e-8
 
 
+def test_runtime_cpr_drs_weighting():
+    """precond.weighting=drs selects CPRDRS in the SERIAL runtime path
+    (the distributed path honors the same keys)."""
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    from tests.test_coupled import reservoir_like
+    A, rhs = reservoir_like(6, 3)
+    s = make_solver_from_config(A, {
+        "precond.class": "cpr", "precond.dtype": "float64",
+        "precond.weighting": "drs", "precond.eps_dd": 0.3,
+        "precond.pressure.dtype": "float64",
+        "solver.type": "bicgstab", "solver.tol": 1e-8,
+        "solver.maxiter": 200})
+    assert "drs" in repr(s)
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+
+
 def test_runtime_unknown_key_warns():
     A, _ = poisson3d(6)
     with pytest.warns(UserWarning, match="unknown parameter"):

@@ -97,6 +97,23 @@ def test_dist_cg_compile_cache():
     assert after.misses == before + 1 and after.hits >= 2
 
 
+def test_bicgstabl_right_side():
+    """pside='right': true-residual tracking, converges to the same
+    quality as left (reference default side, bicgstabl.hpp:137)."""
+    from amgcl_tpu.solver.bicgstabl import BiCGStabL
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    A, rhs = poisson3d(10)
+    s = make_solver(A, AMGParams(dtype=jnp.float64),
+                    BiCGStabL(L=2, maxiter=200, tol=1e-8, pside="right"))
+    x, info = s(rhs)
+    r = np.linalg.norm(rhs - A.spmv(np.asarray(x))) / np.linalg.norm(rhs)
+    assert r < 1e-7
+    # warm start must also work in correction form
+    x2, info2 = s(rhs, x0=np.asarray(x))
+    assert info2.iters <= 2
+
+
 def test_lgmres_bicgstabl_idrs():
     from amgcl_tpu.solver.lgmres import LGMRES
     from amgcl_tpu.solver.bicgstabl import BiCGStabL
