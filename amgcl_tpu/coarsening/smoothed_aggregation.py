@@ -34,6 +34,13 @@ class SmoothedAggregation:
     # the distributed layer injects the mesh-sharded device MIS here
     # (parallel/dist_mis.py), replacing the host greedy pass
     aggregator: object = None
+    # TPU gathers are ~100x slower than streaming ops, so transfer operators
+    # are applied matrix-free (P = (I - wD^-1 Af) T with T implicit) instead
+    # of as stored gather matrices; when the operator is a tensor-product
+    # stencil, grid-aligned aggregation keeps every coarse level a stencil
+    # (DIA, zero gathers). See ops/structured.py.
+    structured: bool = True       # detect grids + grid-aligned aggregation
+    implicit_transfers: bool = True
 
     def transfer_operators(self, A: CSR):
         if A.is_block and self.nullspace is not None:
@@ -44,7 +51,26 @@ class SmoothedAggregation:
                 "columns, which does not tile into the block structure")
         scalar = A.unblock() if A.is_block else A
         bs = A.block_size[0] if A.is_block else self.block_size
-        if bs > 1:
+        # filtered matrix: drop weak off-diagonal entries, lump onto the
+        # diagonal — needed for P-smoothing below AND (computed first) for
+        # the strength-aware grid aggregation decision
+        Af, Df_inv = _filtered(scalar, self.eps_strong)
+        grid = None
+        if (self.structured and bs == 1 and not A.is_block
+                and self.nullspace is None and self.aggregator is None):
+            from amgcl_tpu.ops.structured import (
+                detect_grid_csr, grid_aggregates, strength_blocks)
+            grid = detect_grid_csr(scalar)
+            if grid is not None:
+                # semicoarsen: only aggregate along strong axes; no strong
+                # axis at all means the grid path would stall -> MIS
+                gblocks = strength_blocks(Af, grid)
+                if gblocks is None:
+                    grid = None
+        if grid is not None:
+            agg, n_agg, coarse_dims, blocks = grid_aggregates(grid, gblocks)
+            n_pt = scalar.nrows
+        elif bs > 1:
             agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
         elif self.aggregator is not None:
@@ -60,8 +86,6 @@ class SmoothedAggregation:
             n_pt, agg, n_agg, self.nullspace, bs)
         Pt = P_tent.unblock() if P_tent.is_block else P_tent
 
-        # filtered matrix: drop weak off-diagonal entries, lump onto diagonal
-        Af, Df_inv = _filtered(scalar, self.eps_strong)
         rho = spectral_radius(Af, self.power_iters, scale=True)
         omega = self.relax * (4.0 / 3.0) / max(rho, 1e-30)
 
@@ -72,13 +96,31 @@ class SmoothedAggregation:
         if A.is_block:
             P = P.to_block(bs)
             R = R.to_block(bs)
+        elif (self.implicit_transfers and bs == 1
+                and self.nullspace is None):
+            # device realization applies P/R matrix-free through this spec
+            # instead of packing gather-heavy ELL matrices (ops/structured.py)
+            M = CSR(DA.ptr, DA.col, DA.val * omega, DA.ncols)
+            spec = {"M": M}
+            if grid is not None:
+                spec.update(fine=grid, block=blocks, coarse=coarse_dims)
+                self._next_grid = coarse_dims
+            else:
+                spec.update(agg=agg, n_agg=n_agg)
+            P._implicit_spec = spec
+            R._implicit_spec = spec
         # parameter decay between levels (reference halves eps_strong)
         self.eps_strong *= 0.5
         self.nullspace = Bc
         return P, R
 
     def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
-        return galerkin(A, P, R)
+        Ac = galerkin(A, P, R)
+        g = getattr(self, "_next_grid", None)
+        if g is not None:
+            Ac._grid_dims = tuple(g)   # next level detects the grid for free
+            self._next_grid = None
+        return Ac
 
 
 def _filtered(A: CSR, eps_strong: float):

@@ -203,11 +203,19 @@ class AMG:
                     i, Ai.nrows * Ai.block_size[0], False):
                 dev_levels.append(Level(None, None, None, None))
                 continue
+            spec = getattr(P, "_implicit_spec", None)
+            if spec is not None:
+                # matrix-free smoothed transfers: no gather-heavy device P/R
+                from amgcl_tpu.ops.structured import build_implicit_transfers
+                P_dev, R_dev = build_implicit_transfers(
+                    spec, dtype, prm.matrix_format)
+            else:
+                P_dev = dev.to_device(P, "ell", dtype)
+                R_dev = dev.to_device(R, "ell", dtype)
             dev_levels.append(Level(
                 dev.to_device(Ai, prm.matrix_format, dtype),
                 prm.relax.build(Ai, dtype),
-                dev.to_device(P, "ell", dtype),
-                dev.to_device(R, "ell", dtype)))
+                P_dev, R_dev))
         Alast = host[-1][0]
         n_last = Alast.nrows * Alast.block_size[0]
         if prm.direct_coarse and n_last > max(4 * prm.coarse_enough, 20000):

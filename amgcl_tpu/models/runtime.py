@@ -260,8 +260,7 @@ def precond_from_config(A, pcfg: Dict[str, Any]):
         if weighting not in ("quasi_impes", "drs"):
             raise ValueError("weighting must be 'quasi_impes' or 'drs'")
         cls = CPRDRS if weighting == "drs" else CPR
-        wkw = {"eps_dd": float(pcfg["eps_dd"])} \
-            if "eps_dd" in pcfg and weighting == "drs" else {}
+        wkw = _drs_kwargs(pcfg, weighting)
         return cls(A,
                    block_size=int(pcfg["block_size"])
                    if "block_size" in pcfg else None,
@@ -269,6 +268,21 @@ def precond_from_config(A, pcfg: Dict[str, Any]):
                    if press else None,
                    relax=relax, dtype=dtype, **wkw)
     raise ValueError("unknown precond.class %r" % pclass)
+
+
+def _drs_kwargs(pcfg, weighting):
+    """DRS weighting knobs from a CPR config dict; warns (once per call
+    site) when a DRS-only key is set under a different weighting. Shared by
+    the serial and distributed CPR config paths so the policy cannot
+    diverge."""
+    if "eps_dd" not in pcfg:
+        return {}
+    if weighting != "drs":
+        warnings.warn(
+            "precond.eps_dd only applies to weighting=drs; ignored "
+            "under weighting=%s" % weighting)
+        return {}
+    return {"eps_dd": float(pcfg["eps_dd"])}
 
 
 def _parse_bool(v):
@@ -348,9 +362,8 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         # the pressure hierarchy inherits the CPR dtype unless overridden
         press = dict(pcfg.get("pressure", {}))
         press.setdefault("dtype", dtype)
-        wkw = {}
-        if "eps_dd" in pcfg:
-            wkw["eps_dd"] = float(pcfg["eps_dd"])
+        weighting = str(pcfg.get("weighting", "quasi_impes"))
+        wkw = _drs_kwargs(pcfg, weighting)
         relax = relaxation_from_params(pcfg["relax"]) \
             if "relax" in pcfg else None
         return DistCPRSolver(
@@ -359,7 +372,7 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
             else None,
             pressure_prm=precond_params_from_dict(press),
             solver=solver, relax=relax, dtype=dtype,
-            weighting=str(pcfg.get("weighting", "quasi_impes")), **wkw)
+            weighting=weighting, **wkw)
     raise ValueError("unknown distributed precond.class %r" % pclass)
 
 

@@ -95,6 +95,15 @@ def lib():
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p,
                 ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            handle.dia_mark.restype = None
+            handle.dia_mark.argtypes = [
+                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                ctypes.c_void_p]
+            for nm in ("dia_pack_f64_f32", "dia_pack_f64_f64",
+                       "dia_pack_f32_f32"):
+                fn = getattr(handle, nm)
+                fn.restype = None
+                fn.argtypes = [ctypes.c_int64] + [ctypes.c_void_p] * 5
             _LIB = handle
         return _LIB or None
 
@@ -297,3 +306,44 @@ def native_iluk_pattern(A, k: int):
             return optr, ocol[:got]
         budget *= 2
     raise MemoryError("iluk pattern did not fit after retries")
+
+
+def native_dia_offsets(A):
+    """Distinct diagonal offsets of a scalar CSR via the parallel native
+    mark pass, or None when unavailable."""
+    L = lib()
+    if L is None or A.is_block:
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    base = A.nrows - 1
+    hits = np.zeros(base + A.ncols, dtype=np.uint8)
+    L.dia_mark(A.nrows, _ptr(ptr), _ptr(col), _ptr(hits))
+    return np.flatnonzero(hits) - base
+
+
+def native_dia_pack(A, offsets, out_dtype):
+    """(ndiag, nrows) diagonal-major array for the device DIA format, with
+    the host-f64 -> device dtype cast fused into the scatter. Returns None
+    when the native library or the dtype pair is unsupported."""
+    L = lib()
+    out_dtype = np.dtype(out_dtype)
+    if L is None or A.is_block:
+        return None
+    pair = (np.dtype(A.val.dtype), out_dtype)
+    fn = {(np.dtype(np.float64), np.dtype(np.float32)): L.dia_pack_f64_f32,
+          (np.dtype(np.float64), np.dtype(np.float64)): L.dia_pack_f64_f64,
+          (np.dtype(np.float32), np.dtype(np.float32)): L.dia_pack_f32_f32,
+          }.get(pair)
+    if fn is None:
+        return None
+    ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
+    col = np.ascontiguousarray(A.col, dtype=np.int32)
+    val = np.ascontiguousarray(A.val)
+    base = A.nrows - 1
+    slot = np.zeros(base + A.ncols, dtype=np.int32)
+    slot[np.asarray(offsets) + base] = np.arange(len(offsets),
+                                                 dtype=np.int32)
+    out = np.zeros((len(offsets), A.nrows), dtype=out_dtype)
+    fn(A.nrows, _ptr(ptr), _ptr(col), _ptr(val), _ptr(slot), _ptr(out))
+    return out

@@ -8,9 +8,12 @@ into VMEM once, then applies every diagonal with static slices from VMEM —
 guaranteed single-read of x and stream-through of the diagonal data
 (pallas guide: Async DMA / double-buffering patterns).
 
-The kernel is opt-in via ``AMGCL_TPU_PALLAS=1`` (bench flips it on) and
-falls back transparently to the XLA path elsewhere; correctness is covered
-in interpret mode on CPU.
+The kernel is the DEFAULT on TPU for <=32-bit dtypes (``AMGCL_TPU_PALLAS=0``
+opts out; f64 always takes the XLA path — Mosaic's f64 vector support is
+partial). Measured on v5e at 128^3 Poisson: the composed V-cycle drops from
+36ms (XLA, whose many-diagonal DIA products fall off the fusion path and
+pay per-kernel launch overhead) to 5.9ms. Correctness is additionally
+covered in interpret mode on CPU.
 """
 
 from __future__ import annotations
@@ -24,7 +27,11 @@ import jax.numpy as jnp
 
 
 def pallas_enabled() -> bool:
-    return os.environ.get("AMGCL_TPU_PALLAS", "0") == "1"
+    """Default ON (the kernel is 6x faster than XLA's lowering for the
+    composed V-cycle on v5e — many-diagonal DIA products fall off XLA's
+    fusion path inside large programs and pay ~60us launch overhead per
+    diagonal). AMGCL_TPU_PALLAS=0 opts out."""
+    return os.environ.get("AMGCL_TPU_PALLAS", "1") != "0"
 
 
 @functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
@@ -34,6 +41,11 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    # Mosaic requires 1-D DMA slice starts/shapes aligned to the
+    # 1024-element tiling, so the row tile must be a multiple of it on
+    # real hardware (interpret mode has no such constraint)
+    if tile % 1024 and not interpret:
+        raise ValueError("tile must be a multiple of 1024, got %d" % tile)
     n = data.shape[1]
     m = x.shape[0]
     lo = min(offsets + (0,))
@@ -43,14 +55,17 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
     # compare (wide matrices read far to the right of the tile's rows)
     hi = max(max(offsets + (0,)), 0)
     n_pad = -(-n // tile) * tile
+    ndiag = len(offsets)
+    # Mosaic requires 1-D DMA slice shapes (and starts) aligned to the
+    # 1024-element tiling; tile is a multiple of 1024, so round the halo
+    # window up and size the padded x so the last tile's window is in range
+    win = -(-(tile + base + hi) // 1024) * 1024
     # wide rectangular operators: x (length m) can exceed the tile window
     # span, so size the scratch source for BOTH (round-1 advisor finding:
     # dynamic_update_slice trace failure when m > n_pad + hi)
-    xp = jnp.zeros(max(n_pad + base + hi, m + base), x.dtype)
+    xp = jnp.zeros(max(n_pad - tile + win, m + base), x.dtype)
     xp = jax.lax.dynamic_update_slice(xp, x, (base,))
     dpad = jnp.pad(data, ((0, 0), (0, n_pad - n)))
-    ndiag = len(offsets)
-    win = tile + base + hi
 
     def kernel(x_hbm, d_ref, o_ref, scratch, sem):
         i = pl.program_id(0)
@@ -70,7 +85,11 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pltpu.ANY),            # x stays in HBM
-            pl.BlockSpec((ndiag, tile), lambda i: (0, i)),   # diagonal tiles
+            # np.int32 keeps the index map i32 under jax_enable_x64 — a bare
+            # Python 0 traces as i64 there and Mosaic cannot legalize the
+            # mixed-width func.return
+            pl.BlockSpec((ndiag, tile),
+                         lambda i: (np.int32(0), i)),        # diagonal tiles
         ],
         out_specs=pl.BlockSpec((tile,), lambda i: (i,)),
         out_shape=jax.ShapeDtypeStruct((n_pad,), jnp.result_type(

@@ -1,0 +1,385 @@
+"""Structure detection and gather-free transfer operators.
+
+TPU gathers run at ~130M elem/s (measured on v5e) while DIA SpMV and
+reshape/reduce ops run at HBM bandwidth — a ~100x gap. The single biggest
+lever for AMG cycle time on TPU is therefore eliminating gathers from the
+transfer operators and level SpMVs. Two pieces live here:
+
+1. **Grid detection** (:func:`detect_grid`): recognise when a matrix is a
+   tensor-product stencil (index = z*d1*d0 + y*d0 + x, every nonzero offset
+   decomposes as dx + d0*dy + d0*d1*dz with a small radius). The reference
+   is purely algebraic and never does this; on TPU it is the difference
+   between gather-bound ELL SpMV and pure-VPU DIA SpMV on every level,
+   because grid-aligned aggregation (below) keeps all Galerkin coarse
+   operators stencil-structured.
+
+2. **Implicit smoothed-aggregation transfers**: smoothed aggregation's
+   prolongation is P = (I − ω D⁻¹ A_f) · T (reference:
+   amgcl/coarsening/smoothed_aggregation.hpp:202-243). Instead of storing P
+   as an explicit gather-heavy device matrix, apply it matrix-free:
+   ``P x = u − M u`` with ``u = T x`` and ``M = ω D⁻¹ A_f`` a stencil (DIA)
+   matrix. For grid-aligned aggregates T is pure reshape/broadcast/reduce —
+   zero gathers end to end.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+from amgcl_tpu.ops.csr import CSR
+
+
+# -- grid detection ---------------------------------------------------------
+
+def _decompose_1d(offsets, stride, radius):
+    """Split each offset o into (residue, quotient) with o = residue +
+    stride*quotient and |residue| <= radius. Returns the quotient set or
+    None if any offset has no valid decomposition."""
+    quotients = set()
+    for o in offsets:
+        q0 = int(round(o / stride))
+        ok = False
+        for q in (q0 - 1, q0, q0 + 1):
+            r = o - q * stride
+            if abs(r) <= radius:
+                quotients.add(q)
+                ok = True
+                break
+        if not ok:
+            return None
+    return quotients
+
+
+def detect_grid(offsets, n, max_radius=2, min_dim=3):
+    """Infer tensor-product grid dims from a matrix's diagonal offsets.
+
+    Returns ``(d2, d1, d0)`` with ``d2*d1*d0 == n`` and every offset
+    decomposable as ``dx + d0*dy + d0*d1*dz`` (|dx|,|dy|,|dz| <= radius),
+    or None. C-order: row index = z*d1*d0 + y*d0 + x. 2-D grids come back
+    as (1, d1, d0), 1-D as (1, 1, n)."""
+    offs = sorted(set(int(o) for o in offsets))
+    if not offs or n < min_dim:
+        return None
+    pos = [o for o in offs if o > 0]
+    for radius in range(1, max_radius + 1):
+        # pure-x stencil: 1-D grid
+        if all(abs(o) <= radius for o in offs):
+            return (1, 1, n)
+        beyond = [o for o in pos if o > radius]
+        if not beyond:
+            continue
+        # the smallest non-x offset is d0*1 + dx for some |dx| <= radius
+        for dx in range(-radius, radius + 1):
+            d0 = beyond[0] + dx
+            if d0 <= radius or d0 < min_dim or n % d0:
+                continue
+            qs = _decompose_1d(offs, d0, radius)
+            if qs is None:
+                continue
+            qs.discard(0)
+            if all(abs(q) <= radius for q in qs):
+                # every non-x offset is a pure y step: 2-D grid
+                d1 = n // d0
+                if d1 >= min_dim:
+                    return (1, d1, d0)
+                continue
+            qpos = sorted(q for q in qs if q > radius)
+            if not qpos:
+                # one-sided z coupling (e.g. upwind): all beyond-radius
+                # quotients negative — mirror them to find the z stride
+                qpos = sorted(-q for q in qs if q < -radius)
+            found = None
+            for dy in range(-radius, radius + 1):
+                d1 = qpos[0] + dy
+                if d1 <= radius or d1 < min_dim or n % (d0 * d1):
+                    continue
+                d2 = n // (d0 * d1)
+                if d2 < min_dim:
+                    continue
+                zs = _decompose_1d(qs, d1, radius)
+                if zs is None:
+                    continue
+                zs.discard(0)
+                if all(abs(z) <= radius for z in zs):
+                    found = (d2, d1, d0)
+                    break
+            if found:
+                return found
+    return None
+
+
+def detect_grid_csr(A: CSR, max_radius=2):
+    """Grid dims for a CSR matrix (square, scalar), via its distinct
+    diagonal offsets; cached on the matrix."""
+    if A.is_block or A.nrows != A.ncols:
+        return None
+    hint = getattr(A, "_grid_dims", None)
+    if hint is not None:
+        return tuple(hint)
+    from amgcl_tpu.ops.device import _dia_offsets
+    offs = _dia_offsets(A)
+    if len(offs) > (2 * max_radius + 1) ** 3:
+        return None
+    g = detect_grid(offs, A.nrows, max_radius)
+    if g is not None:
+        A._grid_dims = g
+    return g
+
+
+def _offset_axis(o, dims, radius=2):
+    """Axis index (0=z, 1=y, 2=x) if offset o is purely along one grid
+    axis, else None."""
+    d2, d1, d0 = dims
+    dz = int(round(o / (d0 * d1))) if d2 > 1 else 0
+    dz = max(-radius, min(radius, dz))
+    rem = o - dz * d0 * d1
+    dy = int(round(rem / d0)) if d1 > 1 else 0
+    dy = max(-radius, min(radius, dy))
+    dx = rem - dy * d0
+    if abs(dx) > radius:
+        return None
+    live = (dz != 0) + (dy != 0) + (dx != 0)
+    if live != 1:
+        return None
+    return 0 if dz else (1 if dy else 2)
+
+
+def strength_blocks(Af, dims, block=2, threshold=0.5):
+    """Per-axis aggregation blocks from the strength-filtered matrix.
+
+    Grid-aligned aggregation must still honor strength of connection, or
+    anisotropic problems regress badly (2-D Poisson with 1e-3 anisotropy:
+    105 CG iters boxing 2x2 blindly vs ~15 respecting strength). The
+    structured answer is semicoarsening: aggregate along an axis only when
+    most rows kept a strong neighbor in that direction after filtering.
+    Returns a per-axis block tuple, or None when no axis is strong (grid
+    aggregation would stall — caller falls back to MIS aggregates)."""
+    rows = Af.expanded_rows()
+    d = Af.col.astype(np.int64) - rows
+    base = Af.nrows - 1
+    counts = np.bincount(d + base, minlength=base + Af.ncols)
+    offsets = np.flatnonzero(counts) - base
+    axis_count = [0.0, 0.0, 0.0]
+    for o in offsets:
+        if o == 0:
+            continue
+        ax = _offset_axis(int(o), dims)
+        if ax is not None:
+            axis_count[ax] += counts[o + base]
+    n = Af.nrows
+    blocks = tuple(
+        min(block, dims[k])
+        if dims[k] > 1 and axis_count[k] >= threshold * n else 1
+        for k in range(3))
+    if all(b == 1 for b in blocks):
+        return None
+    return blocks
+
+
+def grid_aggregates(dims, blocks=None, block=2):
+    """Grid-aligned aggregation: fine point (z,y,x) joins aggregate
+    (z//b2, y//b1, x//b0), ids in C-order on the coarse grid.
+
+    Returns (agg ids (n,), n_agg, coarse_dims, blocks). ``blocks`` comes
+    from :func:`strength_blocks` (semicoarsening-aware); without it, dims
+    of size 1 get block 1 and others get ``block``. 2x2x2 measured best:
+    at 64^3 Poisson it converges in 11 CG iters vs 21 for 3x3x3 (MIS
+    distance-2 gives 11-13), and the extra (cheap, all-DIA) level costs
+    far less on TPU than the halved iteration count saves."""
+    dims = tuple(int(d) for d in dims)
+    if blocks is None:
+        blocks = tuple(1 if d == 1 else min(block, d) for d in dims)
+    coarse = tuple(-(-d // b) for d, b in zip(dims, blocks))
+    d2, d1, d0 = dims
+    b2, b1, b0 = blocks
+    c2, c1, c0 = coarse
+    iz = (np.arange(d2) // b2).astype(np.int32)
+    iy = (np.arange(d1) // b1).astype(np.int32)
+    ix = (np.arange(d0) // b0).astype(np.int32)
+    agg = (iz[:, None, None] * (c1 * c0) + iy[None, :, None] * c0
+           + ix[None, None, :]).ravel()
+    return agg, c2 * c1 * c0, coarse, blocks
+
+
+# -- device-side implicit transfer operators --------------------------------
+
+@register_pytree_node_class
+class GridTentative:
+    """Piecewise-constant tentative prolongation over grid-aligned blocks.
+
+    Both directions are pure reshape/broadcast/pad/reduce — no gathers.
+    ``mv`` prolongs (coarse -> fine), ``rmv`` restricts (fine -> coarse,
+    the exact transpose). Matches tentative_prolongation's all-ones P
+    (reference: amgcl/coarsening/tentative_prolongation.hpp:150-163)."""
+
+    def __init__(self, fine, block, coarse):
+        self.fine = tuple(int(d) for d in fine)
+        self.block = tuple(int(b) for b in block)
+        self.coarse = tuple(int(c) for c in coarse)
+        nf = int(np.prod(self.fine))
+        nc = int(np.prod(self.coarse))
+        self.shape = (nf, nc)
+
+    def tree_flatten(self):
+        return (), (self.fine, self.block, self.coarse)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*aux)
+
+    def mv(self, x):
+        (f2, f1, f0), (b2, b1, b0), (c2, c1, c0) = \
+            self.fine, self.block, self.coarse
+        u = x.reshape(c2, 1, c1, 1, c0, 1)
+        u = jnp.broadcast_to(u, (c2, b2, c1, b1, c0, b0))
+        u = u.reshape(c2 * b2, c1 * b1, c0 * b0)
+        return u[:f2, :f1, :f0].reshape(-1)
+
+    def rmv(self, y):
+        (f2, f1, f0), (b2, b1, b0), (c2, c1, c0) = \
+            self.fine, self.block, self.coarse
+        yp = jnp.pad(y.reshape(f2, f1, f0),
+                     ((0, c2 * b2 - f2), (0, c1 * b1 - f1),
+                      (0, c0 * b0 - f0)))
+        yp = yp.reshape(c2, b2, c1, b1, c0, b0)
+        return yp.sum(axis=(1, 3, 5)).reshape(-1)
+
+    def bytes(self):
+        return 0
+
+
+@register_pytree_node_class
+class AggTentative:
+    """Tentative prolongation over arbitrary aggregates (unstructured MIS).
+
+    ``mv`` is one gather of n_fine ids — ~K-fold cheaper than an explicit
+    ELL P (K gathered entries per fine row). ``rmv`` permutes entries into
+    aggregate order and segment-sums; with x64 available the sums come from
+    a float64 inclusive scan differenced at segment boundaries (error
+    ~eps64 * global prefix — negligible), otherwise from a sorted
+    scatter-add, because an f32 prefix-sum difference loses the segment
+    sums inside the global prefix magnitude once n is large (at n~3e7 of
+    one-signed values the f32 scan saturates and tail segments come back
+    exactly zero)."""
+
+    def __init__(self, agg, perm, bounds, seg_ids, shape):
+        self.agg = agg          # (nf,) int32 aggregate id, -1 = excluded
+        self.perm = perm        # (nk,) fine indices sorted by aggregate
+        self.bounds = bounds    # (nc+1,) segment boundaries into perm
+        self.seg_ids = seg_ids  # (nk,) sorted aggregate id per kept entry
+        self.shape = (int(shape[0]), int(shape[1]))
+
+    @classmethod
+    def build(cls, agg: np.ndarray, n_agg: int):
+        agg = np.asarray(agg, dtype=np.int32)
+        keep = np.flatnonzero(agg >= 0)
+        perm = keep[np.argsort(agg[keep], kind="stable")]
+        counts = np.bincount(agg[keep], minlength=n_agg)
+        bounds = np.concatenate([[0], np.cumsum(counts)]).astype(np.int32)
+        return cls(jnp.asarray(agg), jnp.asarray(perm.astype(np.int32)),
+                   jnp.asarray(bounds), jnp.asarray(agg[perm]),
+                   (len(agg), n_agg))
+
+    def tree_flatten(self):
+        return (self.agg, self.perm, self.bounds, self.seg_ids), \
+            (self.shape,)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux[0])
+
+    def mv(self, x):
+        u = jnp.take(x, jnp.clip(self.agg, 0), axis=0)
+        return jnp.where(self.agg >= 0, u, 0).astype(x.dtype)
+
+    def rmv(self, y):
+        ys = jnp.take(y, self.perm, axis=0)
+        if jax.config.jax_enable_x64:
+            wide = jnp.complex128 if jnp.issubdtype(
+                y.dtype, jnp.complexfloating) else jnp.float64
+            c = jnp.cumsum(ys.astype(wide))
+            c = jnp.concatenate([jnp.zeros((1,), c.dtype), c])
+            out = c[self.bounds[1:]] - c[self.bounds[:-1]]
+            return out.astype(y.dtype)
+        return jax.ops.segment_sum(
+            ys, self.seg_ids, num_segments=self.shape[1],
+            indices_are_sorted=True)
+
+    def bytes(self):
+        return sum(a.size * a.dtype.itemsize
+                   for a in (self.agg, self.perm, self.bounds,
+                             self.seg_ids))
+
+
+@register_pytree_node_class
+class ImplicitSmoothedP:
+    """P = (I − M) T applied matrix-free; M = ω D⁻¹ A_f on device."""
+
+    def __init__(self, T, M):
+        self.T = T
+        self.M = M
+        self.shape = (T.shape[0], T.shape[1])
+
+    @property
+    def dtype(self):
+        return self.M.dtype
+
+    def tree_flatten(self):
+        return (self.T, self.M), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def mv(self, x):
+        u = self.T.mv(x)
+        return u - self.M.mv(u)
+
+    def bytes(self):
+        return self.T.bytes() + self.M.bytes()
+
+
+@register_pytree_node_class
+class ImplicitSmoothedR:
+    """R = Pᵀ = Tᵀ (I − Mᵀ); Mt is M's transpose packed for the device."""
+
+    def __init__(self, T, Mt):
+        self.T = T
+        self.Mt = Mt
+        self.shape = (T.shape[1], T.shape[0])
+
+    @property
+    def dtype(self):
+        return self.Mt.dtype
+
+    def tree_flatten(self):
+        return (self.T, self.Mt), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def mv(self, y):
+        return self.T.rmv(y - self.Mt.mv(y))
+
+    def bytes(self):
+        return self.T.bytes() + self.Mt.bytes()
+
+
+def build_implicit_transfers(spec, dtype, matrix_format="auto"):
+    """Realise a coarsening's implicit-transfer spec on the device.
+
+    spec keys: 'M' (host CSR, = ω D⁻¹ A_f); either 'fine'/'block'/'coarse'
+    grid dims (grid-aligned aggregates) or 'agg'/'n_agg' (arbitrary
+    aggregates). Returns (P_dev, R_dev)."""
+    from amgcl_tpu.ops import device as dev
+    if "fine" in spec:
+        T = GridTentative(spec["fine"], spec["block"], spec["coarse"])
+    else:
+        T = AggTentative.build(spec["agg"], spec["n_agg"])
+    M = dev.to_device(spec["M"], matrix_format, dtype)
+    Mt = dev.to_device(spec["M"].transpose(), matrix_format, dtype)
+    return ImplicitSmoothedP(T, M), ImplicitSmoothedR(T, Mt)

@@ -82,6 +82,14 @@ class DistCPRSolver(DistAMGSolver):
         """``weighting``: 'quasi_impes' (cpr.hpp) or 'drs' (cpr_drs.hpp
         dynamic row sums, with e.g. ``eps_dd``) — the same weight policies
         as the serial CPR/CPRDRS."""
+        bad = set(wkw) - {"eps_dd"}
+        if bad:
+            raise TypeError("unexpected keyword arguments: %s"
+                            % ", ".join(sorted(bad)))
+        if wkw and weighting != "drs":
+            import warnings
+            warnings.warn("eps_dd only applies to weighting='drs'; ignored "
+                          "under weighting=%r" % weighting)
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         if not A.is_block:
