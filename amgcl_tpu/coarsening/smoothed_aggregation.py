@@ -70,6 +70,7 @@ class SmoothedAggregation:
         if grid is not None:
             agg, n_agg, coarse_dims, blocks = grid_aggregates(grid, gblocks)
             n_pt = scalar.nrows
+            self._next_grid = coarse_dims
         elif bs > 1:
             agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
@@ -104,7 +105,6 @@ class SmoothedAggregation:
             spec = {"M": M}
             if grid is not None:
                 spec.update(fine=grid, block=blocks, coarse=coarse_dims)
-                self._next_grid = coarse_dims
             else:
                 spec.update(agg=agg, n_agg=n_agg)
             P._implicit_spec = spec

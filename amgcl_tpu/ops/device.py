@@ -269,7 +269,7 @@ def dia_efficiency(A: CSR):
 
 
 def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
-              max_diags: int = 40, max_fill: float = 1.5,
+              max_diags: int = None, max_fill: float = None,
               dense_cutoff: int = 2048):
     """Move a host matrix to the device in a TPU-friendly format.
 
@@ -284,12 +284,15 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
     if fmt == "dia":
         return csr_to_dia(A, dtype)
     if fmt == "auto" and not A.is_block:
-        if jax.default_backend() == "tpu":
-            # measured on v5e: gathers run ~130M elem/s while DIA streams at
-            # HBM bandwidth — DIA wins over ELL even at large fill, so accept
-            # many more diagonals on TPU (bounded by a 2 GB data guard)
-            max_diags = max(max_diags, 512)
-            max_fill = max(max_fill, 16.0)
+        on_tpu = jax.default_backend() == "tpu"
+        # measured on v5e: gathers run ~130M elem/s while DIA streams at
+        # HBM bandwidth — DIA wins over ELL even at large fill, so accept
+        # many more diagonals on TPU (bounded by a 2 GB data guard); an
+        # explicit caller-supplied cap is honored as-is
+        if max_diags is None:
+            max_diags = 512 if on_tpu else 40
+        if max_fill is None:
+            max_fill = 16.0 if on_tpu else 1.5
         nd, fill = dia_efficiency(A)
         if (nd <= max_diags and fill <= max_fill
                 and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):

@@ -18,13 +18,44 @@ import time
 
 import numpy as np
 
+_T0 = time.time()
+_STAGES = []           # (name, start_ts) — progress stamps for the watchdog
+_PARTIAL = {}          # results already secured; emitted even on a wedge
 
-def _device_watchdog(timeout_s: float = 240.0):
-    """The axon TPU tunnel can wedge so that backend init blocks forever
-    (observed in this image). Probe device init in a thread; on timeout,
-    emit a diagnostic JSON line and hard-exit instead of hanging the
-    driver."""
+
+def _stage(name):
+    _STAGES.append((name, time.time()))
+
+
+def _watchdog(init_timeout_s: float = 240.0, total_timeout_s: float = None):
+    """The axon TPU tunnel can wedge at ANY point — backend init, a
+    compile, or an execute can block forever (both failure modes observed
+    in this image). Two deadlines, both emitting a diagnostic JSON line
+    and hard-exiting instead of hanging the driver:
+
+    - init: jax.devices() must return within ``init_timeout_s``;
+    - total: the whole bench must finish within ``total_timeout_s``
+      (env AMGCL_TPU_BENCH_DEADLINE, default 1500s), with the error
+      naming the last stage reached so a wedge mid-compile is
+      distinguishable from a wedge at init."""
+    if total_timeout_s is None:
+        total_timeout_s = float(os.environ.get(
+            "AMGCL_TPU_BENCH_DEADLINE", "1500"))
     done = threading.Event()
+
+    def bail(err):
+        import sys
+        stamps = {n: round(t - _T0, 1) for n, t in _STAGES}
+        out = {
+            "metric": "poisson3d_128_sa_cg_spai0_solve_time",
+            "value": None, "unit": "s", "vs_baseline": None,
+            "error": err, "stages_reached": stamps,
+        }
+        # a wedge after the headline solve still reports the real number
+        out.update(_PARTIAL)
+        print(json.dumps(out))
+        sys.stdout.flush()
+        os._exit(2)
 
     def probe():
         import jax
@@ -33,16 +64,19 @@ def _device_watchdog(timeout_s: float = 240.0):
 
     t = threading.Thread(target=probe, daemon=True)
     t.start()
-    if not done.wait(timeout_s):
-        import sys
-        print(json.dumps({
-            "metric": "poisson3d_128_sa_cg_spai0_solve_time",
-            "value": None, "unit": "s", "vs_baseline": None,
-            "error": "device backend init timed out after %.0fs "
-                     "(TPU tunnel unreachable)" % timeout_s,
-        }))
-        sys.stdout.flush()
-        os._exit(2)
+
+    def total_guard():
+        left = total_timeout_s - (time.time() - _T0)
+        if left > 0:
+            time.sleep(left)
+        last = _STAGES[-1][0] if _STAGES else "start"
+        bail("bench wedged during '%s' (%.0fs deadline; TPU tunnel "
+             "stalled mid-run)" % (last, total_timeout_s))
+
+    threading.Thread(target=total_guard, daemon=True).start()
+    if not done.wait(init_timeout_s):
+        bail("device backend init timed out after %.0fs "
+             "(TPU tunnel unreachable)" % init_timeout_s)
 
 
 def _bench_levels(solver):
@@ -119,7 +153,8 @@ def _bench_levels(solver):
 
 
 def main():
-    _device_watchdog()
+    _stage("device init")
+    _watchdog()
     import jax
     # x64 so the refinement's outer residual really is float64 (the
     # correction solves stay float32)
@@ -131,10 +166,12 @@ def main():
     from amgcl_tpu.solver.cg import CG
 
     n = 128
+    _stage("problem gen")
     t0 = time.perf_counter()
     A, rhs = poisson3d(n)
     t_gen = time.perf_counter() - t0
 
+    _stage("hierarchy setup")
     t0 = time.perf_counter()
     solver = make_solver(A, AMGParams(dtype=jnp.float32),
                          CG(maxiter=100, tol=1e-6), refine=3)
@@ -160,10 +197,19 @@ def main():
     # whichever is faster
     on_tpu = jax.default_backend() == "tpu"
     primary_path = "pallas" if on_tpu and pallas_enabled() else "xla"
+    _stage("solve compile+run (%s)" % primary_path)
     t_solve, x, info = timed(primary_path)
     spmv_path = primary_path
+    baseline = 0.55 * (n / 150.0) ** 3   # K80 CUDA solve, size-scaled
+    _PARTIAL.update({
+        "value": round(t_solve, 4),
+        "vs_baseline": round(baseline / t_solve, 3),
+        "iters": int(info.iters), "resid": float(info.resid),
+        "setup_s": round(t_setup, 3), "gen_s": round(t_gen, 3),
+        "spmv_path": spmv_path, "device": str(jax.devices()[0])})
     t_xla = None
     if on_tpu and primary_path == "pallas":
+        _stage("solve compile+run (xla compare)")
         saved = os.environ.get("AMGCL_TPU_PALLAS")
         os.environ["AMGCL_TPU_PALLAS"] = "0"
         solver._compiled = None
@@ -182,30 +228,25 @@ def main():
 
     true_res = float(np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64)))
                      / np.linalg.norm(rhs))
+    _PARTIAL.update({
+        "value": round(t_solve, 4),
+        "vs_baseline": round(baseline / t_solve, 3),
+        "iters": int(info.iters), "resid": float(info.resid),
+        "true_resid": true_res, "spmv_path": spmv_path,
+        "xla_solve_s": round(t_xla, 4) if t_xla else None})
 
     levels = None
     if jax.default_backend() == "tpu" or os.environ.get(
             "AMGCL_TPU_BENCH_LEVELS") == "1":
+        _stage("per-level timings")
         try:
             levels = _bench_levels(solver)
         except Exception as e:       # per-level timing must never kill the
             levels = [{"error": repr(e)}]   # headline number
-    baseline = 0.55 * (n / 150.0) ** 3   # K80 CUDA solve, size-scaled
-    print(json.dumps({
-        "metric": "poisson3d_128_sa_cg_spai0_solve_time",
-        "value": round(t_solve, 4),
-        "unit": "s",
-        "vs_baseline": round(baseline / t_solve, 3),
-        "iters": int(info.iters),
-        "resid": float(info.resid),
-        "true_resid": true_res,
-        "setup_s": round(t_setup, 3),
-        "gen_s": round(t_gen, 3),
-        "spmv_path": spmv_path,
-        "xla_solve_s": round(t_xla, 4) if t_xla else None,
-        "levels": levels,
-        "device": str(jax.devices()[0]),
-    }))
+    out = {"metric": "poisson3d_128_sa_cg_spai0_solve_time", "unit": "s"}
+    out.update(_PARTIAL)
+    out["levels"] = levels
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

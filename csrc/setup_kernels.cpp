@@ -598,8 +598,12 @@ void dia_mark(int64_t n, const int64_t* ptr, const int32_t* col,
   const int64_t base = n - 1;
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i)
-    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      // rows sharing a diagonal write the same flag byte concurrently;
+      // an atomic relaxed store keeps it defined under the memory model
+#pragma omp atomic write
       hits[col[j] - i + base] = 1;
+    }
 }
 
 // slot: (nrows + ncols - 1) int32 diagonal->row lookup; out: (ndiag * n),
