@@ -41,6 +41,14 @@ class SmoothedAggregation:
     # (DIA, zero gathers). See ops/structured.py.
     structured: bool = True       # detect grids + grid-aligned aggregation
     implicit_transfers: bool = True
+    # build the hierarchy itself on diagonals (ops/stencil.py): the whole
+    # transfer construction AND the Galerkin product become vectorized
+    # per-diagonal passes — no SpGEMM, no transposes, no DIA repacking.
+    # DistAMG disables this (it shards explicit CSR transfer operators).
+    stencil_setup: bool = True
+    # dtype for the stencil setup algebra; AMG._build sets float32 here
+    # when the device hierarchy is <= 32-bit (halves setup memory traffic)
+    setup_dtype: object = None
 
     def transfer_operators(self, A: CSR):
         if A.is_block and self.nullspace is not None:
@@ -51,6 +59,19 @@ class SmoothedAggregation:
                 "columns, which does not tile into the block structure")
         scalar = A.unblock() if A.is_block else A
         bs = A.block_size[0] if A.is_block else self.block_size
+        if (self.stencil_setup and self.structured
+                and self.implicit_transfers and bs == 1 and not A.is_block
+                and self.nullspace is None and self.aggregator is None):
+            from amgcl_tpu.ops.structured import detect_grid_csr
+            from amgcl_tpu.ops.stencil import stencil_transfer_operators
+            grid = detect_grid_csr(scalar)
+            if grid is not None:
+                got = stencil_transfer_operators(
+                    scalar, grid, self.eps_strong, self.relax,
+                    self.power_iters, self.setup_dtype)
+                if got is not None:
+                    self.eps_strong *= 0.5
+                    return got
         # filtered matrix: drop weak off-diagonal entries, lump onto the
         # diagonal — needed for P-smoothing below AND (computed first) for
         # the strength-aware grid aggregation decision
@@ -115,6 +136,10 @@ class SmoothedAggregation:
         return P, R
 
     def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+        from amgcl_tpu.ops.stencil import (
+            StencilTransfer, stencil_coarse_operator)
+        if isinstance(P, StencilTransfer):
+            return stencil_coarse_operator(A, P)
         Ac = galerkin(A, P, R)
         g = getattr(self, "_next_grid", None)
         if g is not None:
@@ -126,7 +151,7 @@ class SmoothedAggregation:
 def _filtered(A: CSR, eps_strong: float):
     """(A_f, D_f^{-1}): strength-filtered matrix and its inverted diagonal.
     Weak off-diagonal entries are removed and added to the diagonal."""
-    if A.dtype == np.float64:
+    if A.dtype in (np.float64, np.float32):
         from amgcl_tpu.native import native_filtered
         got = native_filtered(A, eps_strong)
         if got is not None:

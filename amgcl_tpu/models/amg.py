@@ -156,6 +156,15 @@ class AMG:
         prm = self.prm
         import copy
         coarsening = copy.deepcopy(prm.coarsening)
+        if getattr(coarsening, "setup_dtype", False) is None:
+            # a <=32-bit device hierarchy lets the stencil setup algebra
+            # run in float32 — same convergence, half the memory traffic
+            try:
+                if jnp.dtype(prm.dtype).itemsize <= 4 and not \
+                        jnp.issubdtype(prm.dtype, jnp.complexfloating):
+                    coarsening.setup_dtype = np.float32
+            except TypeError:
+                pass
         host = []
         Acur = A
         while (Acur.nrows * Acur.block_size[0] > prm.coarse_enough

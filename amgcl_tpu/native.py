@@ -81,20 +81,24 @@ def lib():
                                ctypes.c_void_p, ctypes.c_void_p,
                                ctypes.c_int64, ctypes.c_int64,
                                ctypes.c_void_p, ctypes.c_void_p]
-            handle.filter_count.restype = None
-            handle.filter_count.argtypes = [
-                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
-                ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p]
+            for nm in ("filter_count", "filter_count_f32"):
+                fn = getattr(handle, nm)
+                fn.restype = None
+                fn.argtypes = [
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p]
             handle.iluk_symbolic.restype = ctypes.c_int64
             handle.iluk_symbolic.argtypes = [
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
                 ctypes.c_int64, ctypes.c_int64, ctypes.c_void_p,
                 ctypes.c_void_p]
-            handle.filter_fill.restype = None
-            handle.filter_fill.argtypes = [
-                ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
-                ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p,
-                ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
+            for nm in ("filter_fill", "filter_fill_f32"):
+                fn = getattr(handle, nm)
+                fn.restype = None
+                fn.argtypes = [
+                    ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_double, ctypes.c_void_p,
+                    ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p]
             handle.dia_mark.restype = None
             handle.dia_mark.argtypes = [
                 ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p,
@@ -104,6 +108,11 @@ def lib():
                 fn = getattr(handle, nm)
                 fn.restype = None
                 fn.argtypes = [ctypes.c_int64] + [ctypes.c_void_p] * 5
+            for nm in ("dia_fnma_batch_f64", "dia_fnma_batch_f32"):
+                fn = getattr(handle, nm)
+                fn.restype = None
+                fn.argtypes = [ctypes.c_int64, ctypes.c_int64] + \
+                    [ctypes.c_void_p] * 7
             _LIB = handle
         return _LIB or None
 
@@ -214,28 +223,32 @@ def native_spgemm_masked(n, aptr, acol, aval, bptr, bcol, bval, tptr, tcol):
 
 
 def native_filtered(A, eps_strong):
-    """(ptr, col, val, dinv) of the strength-filtered lumped matrix, or
-    None if unavailable."""
+    """(ptr, col, val, dinv) of the strength-filtered lumped matrix in the
+    matrix's own value dtype (f64/f32), or None if unavailable."""
     L = lib()
     if L is None or A.is_block or np.iscomplexobj(A.val):
         return None
-    try:
-        val = np.ascontiguousarray(A.val, dtype=np.float64)
-    except (TypeError, ValueError):
+    vdt = np.dtype(A.val.dtype)
+    if vdt == np.float64:
+        count_fn, fill_fn = L.filter_count, L.filter_fill
+    elif vdt == np.float32:
+        count_fn, fill_fn = L.filter_count_f32, L.filter_fill_f32
+    else:
         return None
+    val = np.ascontiguousarray(A.val)
     ptr = np.ascontiguousarray(A.ptr, dtype=np.int64)
     col = np.ascontiguousarray(A.col, dtype=np.int32)
     n = A.nrows
     rn = np.empty(n, dtype=np.int64)
-    L.filter_count(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
-                   _ptr(rn))
+    count_fn(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
+             _ptr(rn))
     optr = np.zeros(n + 1, dtype=np.int64)
     np.cumsum(rn, out=optr[1:])
     ocol = np.empty(optr[-1], dtype=np.int32)
-    oval = np.empty(optr[-1], dtype=np.float64)
-    dinv = np.empty(n, dtype=np.float64)
-    L.filter_fill(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
-                  _ptr(optr), _ptr(ocol), _ptr(oval), _ptr(dinv))
+    oval = np.empty(optr[-1], dtype=vdt)
+    dinv = np.empty(n, dtype=vdt)
+    fill_fn(n, _ptr(ptr), _ptr(col), _ptr(val), float(eps_strong),
+            _ptr(optr), _ptr(ocol), _ptr(oval), _ptr(dinv))
     return optr, ocol, oval, dinv
 
 
@@ -347,3 +360,34 @@ def native_dia_pack(A, offsets, out_dtype):
     out = np.zeros((len(offsets), A.nrows), dtype=out_dtype)
     fn(A.nrows, _ptr(ptr), _ptr(col), _ptr(val), _ptr(slot), _ptr(out))
     return out
+
+
+def native_dia_fnma_batch(abase, a_idx, bbase, b_idx, shifts, obase,
+                          out_idx):
+    """All pair products of one diagonal-Galerkin stage in a single call:
+    ``obase[out_idx[p]] -= abase[a_idx[p]] * shift(bbase[b_idx[p]],
+    shifts[p])``. Pairs sharing an output row must be contiguous (the
+    OpenMP split is per output row). Returns False when unavailable."""
+    L = lib()
+    if L is None:
+        return False
+    dt = np.dtype(obase.dtype)
+    if abase.dtype != dt or bbase.dtype != dt:
+        return False
+    if dt == np.float64:
+        fn = L.dia_fnma_batch_f64
+    elif dt == np.float32:
+        fn = L.dia_fnma_batch_f32
+    else:
+        return False
+    for a in (abase, bbase, obase):
+        if not a.flags.c_contiguous:
+            return False
+    n = obase.shape[1]
+    a_idx = np.ascontiguousarray(a_idx, dtype=np.int64)
+    b_idx = np.ascontiguousarray(b_idx, dtype=np.int64)
+    shifts = np.ascontiguousarray(shifts, dtype=np.int64)
+    out_idx = np.ascontiguousarray(out_idx, dtype=np.int64)
+    fn(n, len(a_idx), _ptr(abase), _ptr(a_idx), _ptr(bbase), _ptr(b_idx),
+       _ptr(shifts), _ptr(obase), _ptr(out_idx))
+    return True

@@ -240,6 +240,14 @@ def _dia_struct(A: CSR):
 def csr_to_dia(A: CSR, dtype=jnp.float32) -> DiaMatrix:
     """Pack a host scalar CSR into device DIA format."""
     assert not A.is_block
+    pre = getattr(A, "_dia_prepacked", None)
+    if pre is not None:
+        # stencil-setup levels are born in DIA layout (ops/stencil.py):
+        # the move is a cast + transfer, no scatter
+        offs, data = pre
+        return DiaMatrix(list(offs),
+                         jnp.asarray(np.asarray(data, np.dtype(dtype))),
+                         A.shape)
     offsets = _dia_offsets(A)
     from amgcl_tpu.native import native_dia_pack
     data = native_dia_pack(A, offsets, np.dtype(dtype))
@@ -277,6 +285,15 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
     matrix is banded enough (zero-gather SpMV), dense below a size cutoff,
     ELL otherwise. This is the host→device boundary of the setup phase
     (reference: amgcl/amg.hpp:356-364 `copy_matrix`)."""
+    from amgcl_tpu.ops.stencil import HostDia
+    if isinstance(A, HostDia):
+        # stencil-setup smoother operators live in DIA layout already
+        flat = A.flat_offsets()
+        order = np.argsort(flat)
+        return DiaMatrix(
+            [flat[k] for k in order],
+            jnp.asarray(np.asarray(A.data[order], np.dtype(dtype))),
+            A.shape)
     if fmt == "dense" or (fmt == "auto" and not A.is_block
                           and max(A.shape) <= dense_cutoff
                           and A.nnz > 0.02 * A.shape[0] * A.shape[1]):

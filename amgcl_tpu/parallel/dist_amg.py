@@ -483,6 +483,14 @@ class DistAMGSolver:
             coars.aggregator = make_mesh_aggregator(mesh)
             prm2.coarsening = coars
             self.prm = prm2
+        if getattr(self.prm.coarsening, "stencil_setup", False):
+            # the stencil setup path returns implicit transfer proxies;
+            # this wrapper shards explicit CSR P/R, so keep the CSR route
+            import copy as _copy
+            prm2 = _copy.copy(self.prm)
+            prm2.coarsening = _copy.deepcopy(self.prm.coarsening)
+            prm2.coarsening.stencil_setup = False
+            self.prm = prm2
         self.solver = solver or CG()
         dtype = self.prm.dtype
         nd = mesh.shape[ROWS_AXIS]

@@ -439,40 +439,48 @@ void spgemm_masked(int64_t n, const int64_t* aptr, const int32_t* acol,
 // Strength-filtered matrix with weak-entry lumping (the SA "filtered"
 // operator): strong entries (|a_ij|^2 > eps^2 |a_ii a_jj|) and diagonals
 // are kept, weak off-diagonals removed and added to the diagonal.
-// Pass 1 counts kept entries per row; pass 2 fills.
-void filter_count(int64_t n, const int64_t* ptr, const int32_t* col,
-                  const double* val, double eps, int64_t* row_nnz) {
+// Pass 1 counts kept entries per row; pass 2 fills. f64 and f32 value
+// variants share the templates below (templates cannot carry C linkage,
+// so the block is closed around them).
+}  // extern "C"
+
+template <typename V>
+static void filter_count_impl(int64_t n, const int64_t* ptr,
+                              const int32_t* col, const V* val, double eps,
+                              int64_t* row_nnz) {
   std::vector<double> dia(n, 0.0);
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i)
     for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
-      if (col[j] == i) dia[i] = val[j] < 0 ? -val[j] : val[j];
+      if (col[j] == i) dia[i] = val[j] < 0 ? -double(val[j]) : val[j];
   const double e2 = eps * eps;
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     int64_t cnt = 0;
     for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
       const int32_t c = col[j];
-      if (c == i || val[j] * val[j] > e2 * dia[i] * dia[c]) ++cnt;
+      if (c == i || double(val[j]) * val[j] > e2 * dia[i] * dia[c]) ++cnt;
     }
     row_nnz[i] = cnt;
   }
 }
 
-void filter_fill(int64_t n, const int64_t* ptr, const int32_t* col,
-                 const double* val, double eps, const int64_t* optr,
-                 int32_t* ocol, double* oval, double* dinv) {
+template <typename V>
+static void filter_fill_impl(int64_t n, const int64_t* ptr,
+                             const int32_t* col, const V* val, double eps,
+                             const int64_t* optr, int32_t* ocol, V* oval,
+                             V* dinv) {
   std::vector<double> dia(n, 0.0);
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i)
     for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
-      if (col[j] == i) dia[i] = val[j] < 0 ? -val[j] : val[j];
+      if (col[j] == i) dia[i] = val[j] < 0 ? -double(val[j]) : val[j];
   const double e2 = eps * eps;
 #pragma omp parallel for schedule(static)
   for (int64_t i = 0; i < n; ++i) {
     int64_t o = optr[i];
     int64_t dpos = -1;
-    double lump = 0.0;
+    V lump = 0;
     for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
       const int32_t c = col[j];
       if (c == i) {
@@ -480,7 +488,7 @@ void filter_fill(int64_t n, const int64_t* ptr, const int32_t* col,
         ocol[o] = c;
         oval[o] = val[j];
         ++o;
-      } else if (val[j] * val[j] > e2 * dia[i] * dia[c]) {
+      } else if (double(val[j]) * val[j] > e2 * dia[i] * dia[c]) {
         ocol[o] = c;
         oval[o] = val[j];
         ++o;
@@ -488,13 +496,37 @@ void filter_fill(int64_t n, const int64_t* ptr, const int32_t* col,
         lump += val[j];
       }
     }
-    double d = 0.0;
+    V d = 0;
     if (dpos >= 0) {
       oval[dpos] += lump;
       d = oval[dpos];
     }
-    dinv[i] = d != 0.0 ? 1.0 / d : 1.0;
+    dinv[i] = d != 0 ? V(1) / d : V(1);
   }
+}
+
+extern "C" {
+
+void filter_count(int64_t n, const int64_t* ptr, const int32_t* col,
+                  const double* val, double eps, int64_t* row_nnz) {
+  filter_count_impl(n, ptr, col, val, eps, row_nnz);
+}
+
+void filter_count_f32(int64_t n, const int64_t* ptr, const int32_t* col,
+                      const float* val, double eps, int64_t* row_nnz) {
+  filter_count_impl(n, ptr, col, val, eps, row_nnz);
+}
+
+void filter_fill(int64_t n, const int64_t* ptr, const int32_t* col,
+                 const double* val, double eps, const int64_t* optr,
+                 int32_t* ocol, double* oval, double* dinv) {
+  filter_fill_impl(n, ptr, col, val, eps, optr, ocol, oval, dinv);
+}
+
+void filter_fill_f32(int64_t n, const int64_t* ptr, const int32_t* col,
+                     const float* val, double eps, const int64_t* optr,
+                     int32_t* ocol, float* oval, float* dinv) {
+  filter_fill_impl(n, ptr, col, val, eps, optr, ocol, oval, dinv);
 }
 
 }  // extern "C"
@@ -635,6 +667,60 @@ void dia_pack_f32_f32(int64_t n, const int64_t* ptr, const int32_t* col,
   for (int64_t i = 0; i < n; ++i)
     for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j)
       out[(int64_t)slot[col[j] - i + base] * n + i] = val[j];
+}
+
+// -- stencil Galerkin inner kernel ------------------------------------------
+// All pair products of one diagonal-space Galerkin stage in a single
+// call: out[i] -= a[i] * b[i + s] over the valid index range, fused into
+// one memory pass (ops/stencil.py stencil_galerkin).
+// Batched variant: all pair products of one Galerkin stage in a single
+// call (no per-pair ctypes overhead), parallel over output diagonals so
+// no two threads touch the same output row. a_idx/b_idx/out_idx select
+// rows of the (ndiag, n) diagonal-major arrays; pairs sharing out_idx
+// must be contiguous and the out rows pre-initialized.
+
+void dia_fnma_batch_f64(int64_t n, int64_t npairs, const double* abase,
+                        const int64_t* a_idx, const double* bbase,
+                        const int64_t* b_idx, const int64_t* shifts,
+                        double* obase, const int64_t* out_idx) {
+#pragma omp parallel
+  {
+#pragma omp for schedule(dynamic, 1)
+    for (int64_t p0 = 0; p0 < npairs; ++p0) {
+      if (p0 > 0 && out_idx[p0 - 1] == out_idx[p0]) continue;
+      for (int64_t p = p0; p < npairs && out_idx[p] == out_idx[p0]; ++p) {
+        const double* a = abase + a_idx[p] * n;
+        const double* b = bbase + b_idx[p] * n;
+        double* out = obase + out_idx[p] * n;
+        const int64_t s = shifts[p];
+        const int64_t lo = s < 0 ? -s : 0;
+        const int64_t hi = s > 0 ? n - s : n;
+        for (int64_t i = lo; i < hi; ++i) out[i] -= a[i] * b[i + s];
+      }
+    }
+  }
+}
+
+void dia_fnma_batch_f32(int64_t n, int64_t npairs, const float* abase,
+                        const int64_t* a_idx, const float* bbase,
+                        const int64_t* b_idx, const int64_t* shifts,
+                        float* obase, const int64_t* out_idx) {
+#pragma omp parallel
+  {
+#pragma omp for schedule(dynamic, 1)
+    for (int64_t p0 = 0; p0 < npairs; ++p0) {
+      if (p0 > 0 && out_idx[p0 - 1] == out_idx[p0]) continue;
+      for (int64_t p = p0; p < npairs && out_idx[p] == out_idx[p0]; ++p) {
+        const float* a = abase + a_idx[p] * n;
+        const float* b = bbase + b_idx[p] * n;
+        float* out = obase + out_idx[p] * n;
+        const int64_t s = shifts[p];
+        const int64_t lo = s < 0 ? -s : 0;
+        const int64_t hi = s > 0 ? n - s : n;
+        for (int64_t i = lo; i < hi; ++i) out[i] -= a[i] * b[i + s];
+      }
+    }
+  }
 }
 
 }  // extern "C"

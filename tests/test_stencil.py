@@ -1,0 +1,158 @@
+"""Stencil (host-DIA) setup algebra: equivalence with the generic CSR
+setup path (ops/stencil.py vs coarsening/smoothed_aggregation.py's
+SpGEMM route)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from amgcl_tpu.utils.sample_problem import poisson3d, convection_diffusion_2d
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops.structured import detect_grid_csr
+from amgcl_tpu.ops import stencil as st
+from amgcl_tpu.coarsening.smoothed_aggregation import (
+    SmoothedAggregation, _filtered)
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.solver.cg import CG
+
+
+def _host_dia(n=8, **kw):
+    A, _ = poisson3d(n, **kw)
+    grid = detect_grid_csr(A)
+    assert grid is not None
+    return A, st.host_dia_from_csr(A, grid)
+
+
+def test_pack_roundtrip():
+    A, Ad = _host_dia()
+    d = abs(st.HostDia(list(Ad.offsets3), Ad.data, Ad.dims).to_csr()
+            .to_scipy() - A.to_scipy())
+    assert d.nnz == 0 or d.max() == 0.0
+
+
+def test_transpose_matches_scipy():
+    A, Ad = _host_dia()
+    d = abs(Ad.transpose().to_csr().to_scipy() - A.to_scipy().T)
+    assert d.nnz == 0 or d.max() == 0.0
+
+
+def test_dia_matmul_matches_scipy():
+    A, Ad = _host_dia()
+    d = abs(st.dia_matmul(Ad, Ad).to_csr().to_scipy()
+            - A.to_scipy() @ A.to_scipy())
+    assert d.nnz == 0 or d.max() < 1e-12
+
+
+def test_filtered_matches_csr_filter():
+    A, Ad = _host_dia(n=8, anisotropy=1e-3)
+    Af_c, Dinv_c = _filtered(A, 0.08)
+    Af_d, Dinv_d = st.filtered_dia(Ad, 0.08)
+    d = abs(Af_d.to_csr().to_scipy() - Af_c.to_scipy())
+    assert d.nnz == 0 or d.max() < 1e-14
+    np.testing.assert_allclose(Dinv_d, Dinv_c, rtol=1e-14)
+    rho_d = st.gershgorin_scaled(Af_d, Dinv_d)
+    from amgcl_tpu.ops.csr import spectral_radius
+    assert abs(rho_d - spectral_radius(Af_c, 0, scale=True)) < 1e-12
+
+
+@pytest.mark.parametrize("gen,kw", [
+    (poisson3d, {}),                       # 8^3, grid-aligned 2x2x2
+    (poisson3d, {"anisotropy": 1e-3}),     # semicoarsening blocks
+    (convection_diffusion_2d, {}),         # 2-D, nonsymmetric
+])
+def test_coarse_operator_matches_csr_path(gen, kw):
+    A, _ = gen(12, **kw)
+    sa_csr = SmoothedAggregation(stencil_setup=False)
+    P1, R1 = sa_csr.transfer_operators(A)
+    Ac1 = sa_csr.coarse_operator(A, P1, R1)
+    sa_st = SmoothedAggregation()
+    P2, R2 = sa_st.transfer_operators(A)
+    assert isinstance(P2, st.StencilTransfer)
+    Ac2 = sa_st.coarse_operator(A, P2, R2)
+    assert Ac1.nnz == Ac2.nnz
+    d = abs(Ac1.to_scipy() - Ac2.to_scipy())
+    scale = max(abs(Ac1.val).max(), 1)
+    assert d.nnz == 0 or d.max() < 1e-11 * scale
+
+
+def test_odd_dims_partial_blocks():
+    A, _ = poisson3d(9)        # 9 = 2*4+1: ragged edge blocks in collapse
+    sa_csr = SmoothedAggregation(stencil_setup=False)
+    Ac1 = sa_csr.coarse_operator(A, *sa_csr.transfer_operators(A))
+    sa_st = SmoothedAggregation()
+    Ac2 = sa_st.coarse_operator(A, *sa_st.transfer_operators(A))
+    d = abs(Ac1.to_scipy() - Ac2.to_scipy())
+    assert d.nnz == 0 or d.max() < 1e-11
+
+
+def test_numpy_fallback_matches_native(monkeypatch):
+    A, _ = poisson3d(10)
+    sa = SmoothedAggregation()
+    Ac_native = sa.coarse_operator(A, *sa.transfer_operators(A))
+    import amgcl_tpu.native as native
+    monkeypatch.setattr(native, "native_dia_fnma_batch",
+                        lambda *a, **k: False)
+    A2, _ = poisson3d(10)
+    sa2 = SmoothedAggregation()
+    Ac_np = sa2.coarse_operator(A2, *sa2.transfer_operators(A2))
+    d = abs(Ac_native.to_scipy() - Ac_np.to_scipy())
+    assert d.nnz == 0 or d.max() < 1e-12
+
+
+def test_solve_iteration_parity():
+    A, rhs = poisson3d(16)
+    iters = []
+    for stencil in (False, True):
+        prm = AMGParams(dtype=jnp.float64,
+                        coarsening=SmoothedAggregation(
+                            stencil_setup=stencil))
+        solve = make_solver(A, prm, CG(maxiter=100, tol=1e-8))
+        x, info = solve(np.asarray(rhs))
+        tr = float(np.linalg.norm(rhs - A.spmv(np.asarray(x)))
+                   / np.linalg.norm(rhs))
+        assert tr < 1e-7
+        iters.append(int(info.iters))
+    assert iters[0] == iters[1]
+
+
+def test_rebuild_reuses_stencil_transfers():
+    A, rhs = poisson3d(16)
+    amg = AMG(A, AMGParams(dtype=jnp.float64))
+    assert isinstance(amg.host_levels[0][1], st.StencilTransfer)
+    A2, _ = poisson3d(16)
+    A2 = CSR(A2.ptr, A2.col, A2.val * 2.0, A2.ncols)
+    amg.rebuild(A2)
+    # rebuilt coarse operator reflects the new values (Galerkin is linear
+    # in A for fixed P): Ac_new = 2 * Ac_old
+    ref = AMG(poisson3d(16)[0], AMGParams(dtype=jnp.float64)) \
+        .host_levels[1][0]
+    d = abs(amg.host_levels[1][0].to_scipy() - 2.0 * ref.to_scipy())
+    assert d.nnz == 0 or d.max() < 1e-11
+
+
+def test_f32_setup_dtype_convergence():
+    A, rhs = poisson3d(16)
+    solve = make_solver(A, AMGParams(dtype=jnp.float32),
+                        CG(maxiter=100, tol=1e-6), refine=2)
+    # the f32 hierarchy was built with float32 stencil algebra
+    lvl1 = solve.precond if hasattr(solve, "precond") else None
+    x, info = solve(jnp.asarray(rhs, jnp.float32))
+    tr = float(np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64)))
+               / np.linalg.norm(rhs))
+    assert tr < 1e-5
+
+
+def test_wide_stencils_fall_back_to_csr_route():
+    # a radius-2 1-D operator on a 3-D grid index space exceeds the
+    # 13-diagonal gate only when offsets decompose; here just assert the
+    # coarse (27-diagonal) second level takes the generic CSR route
+    A, _ = poisson3d(16)
+    sa = SmoothedAggregation()
+    P, R = sa.transfer_operators(A)
+    Ac = sa.coarse_operator(A, P, R)
+    # level-1 operator is a 27-point stencil -> generic path (explicit CSR)
+    P2, R2 = sa.transfer_operators(Ac)
+    assert not isinstance(P2, st.StencilTransfer)
+    assert hasattr(P2, "val")
