@@ -23,6 +23,10 @@ class Aggregation:
     block_size: int = 1
     nullspace: np.ndarray | None = None
     aggregator: object = None     # optional (A, eps) -> (agg, n_agg) hook
+    # grid-aligned aggregation + diagonal-space setup on detected
+    # tensor-product stencils (ops/stencil.py); DistAMG disables it
+    stencil_setup: bool = True
+    setup_dtype: object = None
 
     def transfer_operators(self, A: CSR):
         if A.is_block and self.nullspace is not None:
@@ -31,6 +35,18 @@ class Aggregation:
                 "unblock the matrix first (reference: coarsening::as_scalar)")
         scalar = A.unblock() if A.is_block else A
         bs = A.block_size[0] if A.is_block else self.block_size
+        if (self.stencil_setup and bs == 1 and not A.is_block
+                and self.nullspace is None and self.aggregator is None):
+            from amgcl_tpu.ops.structured import detect_grid_csr
+            from amgcl_tpu.ops.stencil import (
+                stencil_plain_transfer_operators)
+            grid = detect_grid_csr(scalar)
+            if grid is not None:
+                got = stencil_plain_transfer_operators(
+                    scalar, grid, self.eps_strong, self.setup_dtype)
+                if got is not None:
+                    self.eps_strong *= 0.5
+                    return got
         if bs > 1:
             agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
@@ -52,4 +68,8 @@ class Aggregation:
         return P, R
 
     def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+        from amgcl_tpu.ops.stencil import (
+            StencilTransfer, stencil_coarse_operator)
+        if isinstance(P, StencilTransfer):
+            return stencil_coarse_operator(A, P, 1.0 / self.over_interp)
         return scaled_galerkin(A, P, R, 1.0 / self.over_interp)

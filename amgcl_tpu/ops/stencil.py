@@ -515,12 +515,48 @@ def stencil_transfer_operators(A: CSR, grid, eps_strong, relax_omega,
     return P, R
 
 
-def stencil_coarse_operator(A: CSR, P: StencilTransfer) -> CSR:
+def stencil_plain_transfer_operators(A: CSR, grid, eps_strong,
+                                     setup_dtype=None):
+    """Plain (non-smoothed) aggregation transfers on the grid: P = T
+    directly (reference: amgcl/coarsening/aggregation.hpp:71-160). Returns
+    (P, R) proxies or None (caller falls back to the greedy-MIS route)."""
+    if A.is_block or np.iscomplexobj(A.val):
+        return None
+    Ad = host_dia_from_csr(A, grid, setup_dtype)
+    if Ad is None or len(Ad.offsets3) > 13:
+        return None
+    Af, Dinv = filtered_dia(Ad, eps_strong)
+    blocks = strength_axes(Af)
+    if blocks is None:
+        return None
+    coarse = tuple(-(-d // b) for d, b in zip(grid, blocks))
+    nc = int(np.prod(coarse))
+    spec = {"M": None, "dtype": Ad.dtype, "fine": grid, "block": blocks,
+            "coarse": coarse}
+    return (StencilTransfer(spec, (A.nrows, nc)),
+            StencilTransfer(spec, (nc, A.nrows)))
+
+
+def stencil_coarse_operator(A: CSR, P: StencilTransfer,
+                            scale=None) -> CSR:
     """Galerkin product for the stencil path; the result CSR carries its
-    grid dims and prepacked DIA data for a transfer-only device move."""
+    grid dims and prepacked DIA data for a transfer-only device move.
+    ``spec["M"] is None`` is the plain-aggregation case (P = T): the
+    product degenerates to the parity collapse of A itself. ``scale``
+    applies the over-interpolation correction (scaled Galerkin)."""
     spec = P._implicit_spec
-    Ad = host_dia_from_csr(A, spec["fine"], spec["M"].dtype)
+    dt = spec["M"].dtype if spec["M"] is not None else spec.get("dtype")
+    Ad = host_dia_from_csr(A, spec["fine"], dt)
     if Ad is None:
         raise ValueError("matrix does not match the transfer grid")
-    Ac = stencil_galerkin(Ad, spec["M"], spec["block"], spec["coarse"])
+    if spec["M"] is None:
+        collapse = _TCollapse(Ad.dims, spec["block"], spec["coarse"],
+                              Ad.dtype)
+        for k, o in enumerate(Ad.offsets3):
+            collapse.add(o, Ad.data[k])
+        Ac = collapse.result()
+    else:
+        Ac = stencil_galerkin(Ad, spec["M"], spec["block"], spec["coarse"])
+    if scale is not None and scale != 1.0:
+        Ac = HostDia(Ac.offsets3, Ac.data * Ac.dtype.type(scale), Ac.dims)
     return Ac.to_csr()

@@ -315,6 +315,50 @@ class AggTentative:
 
 
 @register_pytree_node_class
+class TentativeP:
+    """P = T (plain, non-smoothed aggregation)."""
+
+    def __init__(self, T):
+        self.T = T
+        self.shape = (T.shape[0], T.shape[1])
+
+    def tree_flatten(self):
+        return (self.T,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def mv(self, x):
+        return self.T.mv(x)
+
+    def bytes(self):
+        return self.T.bytes()
+
+
+@register_pytree_node_class
+class TentativeR:
+    """R = Tᵀ (plain, non-smoothed aggregation)."""
+
+    def __init__(self, T):
+        self.T = T
+        self.shape = (T.shape[1], T.shape[0])
+
+    def tree_flatten(self):
+        return (self.T,), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(children[0])
+
+    def mv(self, y):
+        return self.T.rmv(y)
+
+    def bytes(self):
+        return self.T.bytes()
+
+
+@register_pytree_node_class
 class ImplicitSmoothedP:
     """P = (I − M) T applied matrix-free; M = ω D⁻¹ A_f on device."""
 
@@ -380,6 +424,8 @@ def build_implicit_transfers(spec, dtype, matrix_format="auto"):
         T = GridTentative(spec["fine"], spec["block"], spec["coarse"])
     else:
         T = AggTentative.build(spec["agg"], spec["n_agg"])
+    if spec.get("M") is None:
+        return TentativeP(T), TentativeR(T)     # plain aggregation: P = T
     M = dev.to_device(spec["M"], matrix_format, dtype)
     Mt = dev.to_device(spec["M"].transpose(), matrix_format, dtype)
     return ImplicitSmoothedP(T, M), ImplicitSmoothedR(T, Mt)

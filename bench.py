@@ -18,6 +18,11 @@ import time
 
 import numpy as np
 
+# AMGCL_TPU_BENCH_N overrides the problem size (default 128; 150 compares
+# against the K80 baseline at its native size instead of volume-scaled)
+_N = int(os.environ.get("AMGCL_TPU_BENCH_N", "128"))
+_METRIC = "poisson3d_%d_sa_cg_spai0_solve_time" % _N
+
 _T0 = time.time()
 _STAGES = []           # (name, start_ts) — progress stamps for the watchdog
 _PARTIAL = {}          # results already secured; emitted even on a wedge
@@ -47,7 +52,7 @@ def _watchdog(init_timeout_s: float = 240.0, total_timeout_s: float = None):
         import sys
         stamps = {n: round(t - _T0, 1) for n, t in _STAGES}
         out = {
-            "metric": "poisson3d_128_sa_cg_spai0_solve_time",
+            "metric": _METRIC,
             "value": None, "unit": "s", "vs_baseline": None,
             "error": err, "stages_reached": stamps,
         }
@@ -165,7 +170,7 @@ def main():
     from amgcl_tpu.models.amg import AMGParams
     from amgcl_tpu.solver.cg import CG
 
-    n = 128
+    n = _N
     _stage("problem gen")
     t0 = time.perf_counter()
     A, rhs = poisson3d(n)
@@ -243,7 +248,7 @@ def main():
             levels = _bench_levels(solver)
         except Exception as e:       # per-level timing must never kill the
             levels = [{"error": repr(e)}]   # headline number
-    out = {"metric": "poisson3d_128_sa_cg_spai0_solve_time", "unit": "s"}
+    out = {"metric": _METRIC, "unit": "s"}
     out.update(_PARTIAL)
     out["levels"] = levels
     print(json.dumps(out))

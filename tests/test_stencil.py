@@ -156,3 +156,38 @@ def test_wide_stencils_fall_back_to_csr_route():
     P2, R2 = sa.transfer_operators(Ac)
     assert not isinstance(P2, st.StencilTransfer)
     assert hasattr(P2, "val")
+
+
+def test_plain_aggregation_stencil_matches_explicit():
+    from amgcl_tpu.coarsening.aggregation import Aggregation
+    from amgcl_tpu.coarsening.tentative import tentative_prolongation
+    from amgcl_tpu.coarsening.galerkin import scaled_galerkin
+    from amgcl_tpu.ops.structured import grid_aggregates
+
+    A, _ = poisson3d(12)
+    ag = Aggregation()
+    P, R = ag.transfer_operators(A)
+    assert isinstance(P, st.StencilTransfer)
+    Ac = ag.coarse_operator(A, P, R)
+    grid = detect_grid_csr(A)
+    agg, n_agg, _, _ = grid_aggregates(grid, P._implicit_spec["block"])
+    Pe, _ = tentative_prolongation(A.nrows, agg, n_agg, None, 1)
+    Ace = scaled_galerkin(A, Pe, Pe.transpose(), 1 / 1.5)
+    d = abs(Ac.to_scipy() - Ace.to_scipy())
+    assert d.nnz == 0 or d.max() < 1e-12
+
+
+def test_plain_aggregation_stencil_converges():
+    from amgcl_tpu.coarsening.aggregation import Aggregation
+    A, rhs = poisson3d(16)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64,
+                                     coarsening=Aggregation()),
+                        CG(maxiter=200, tol=1e-8))
+    x, info = solve(np.asarray(rhs))
+    tr = float(np.linalg.norm(rhs - A.spmv(np.asarray(x)))
+               / np.linalg.norm(rhs))
+    assert tr < 1e-7
+    # device transfers are the tentative-only implicit pair
+    lv = solve.precond.hierarchy.levels[0]
+    assert type(lv.P).__name__ == "TentativeP"
+    assert type(lv.R).__name__ == "TentativeR"
