@@ -28,46 +28,52 @@ class Aggregation:
     stencil_setup: bool = True
     setup_dtype: object = None
 
-    def transfer_operators(self, A: CSR):
-        if A.is_block and self.nullspace is not None:
+    def transfer_operators(self, A: CSR, ctx: dict | None = None):
+        """``ctx`` carries per-build state (eps_strong decay, coarse
+        nullspace) across levels; the policy object is never mutated."""
+        ctx = ctx if ctx is not None else {}
+        eps_strong = ctx.get("eps_strong", self.eps_strong)
+        nullspace = ctx.get("nullspace", self.nullspace)
+        setup_dtype = ctx.get("setup_dtype", self.setup_dtype)
+        if A.is_block and nullspace is not None:
             raise NotImplementedError(
                 "near-nullspace with block value types is not supported; "
                 "unblock the matrix first (reference: coarsening::as_scalar)")
         scalar = A.unblock() if A.is_block else A
         bs = A.block_size[0] if A.is_block else self.block_size
+        ctx["eps_strong"] = eps_strong * 0.5
         if (self.stencil_setup and bs == 1 and not A.is_block
-                and self.nullspace is None and self.aggregator is None):
+                and nullspace is None and self.aggregator is None):
             from amgcl_tpu.ops.structured import detect_grid_csr
             from amgcl_tpu.ops.stencil import (
                 stencil_plain_transfer_operators)
             grid = detect_grid_csr(scalar)
             if grid is not None:
                 got = stencil_plain_transfer_operators(
-                    scalar, grid, self.eps_strong, self.setup_dtype)
+                    scalar, grid, eps_strong, setup_dtype)
                 if got is not None:
-                    self.eps_strong *= 0.5
                     return got
         if bs > 1:
-            agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
+            agg, n_agg = pointwise_aggregates(A, eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
         elif self.aggregator is not None:
-            agg, n_agg = self.aggregator(scalar, self.eps_strong)
+            agg, n_agg = self.aggregator(scalar, eps_strong)
             n_pt = scalar.nrows
         else:
-            agg, n_agg = plain_aggregates(scalar, self.eps_strong)
+            agg, n_agg = plain_aggregates(scalar, eps_strong)
             n_pt = scalar.nrows
         if n_agg == 0:
             raise ValueError("empty coarse level (all rows isolated)")
-        P, Bc = tentative_prolongation(n_pt, agg, n_agg, self.nullspace, bs)
+        P, Bc = tentative_prolongation(n_pt, agg, n_agg, nullspace, bs)
         R = P.transpose()
         if A.is_block and not P.is_block:
             P = P.to_block(bs)
             R = R.to_block(bs)
-        self.eps_strong *= 0.5
-        self.nullspace = Bc
+        ctx["nullspace"] = Bc
         return P, R
 
-    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR,
+                        ctx: dict | None = None) -> CSR:
         from amgcl_tpu.ops.stencil import (
             StencilTransfer, stencil_coarse_operator)
         if isinstance(P, StencilTransfer):

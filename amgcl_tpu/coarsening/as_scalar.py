@@ -17,14 +17,18 @@ from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
 class AsScalar:
     base: Any = field(default_factory=SmoothedAggregation)
 
-    def transfer_operators(self, A: CSR):
+    def transfer_operators(self, A: CSR, ctx: dict | None = None):
         bs = A.block_size[0] if A.is_block else 1
         scalar = A.unblock() if A.is_block else A
-        if bs > 1 and hasattr(self.base, "block_size"):
+        base = self.base
+        if bs > 1 and hasattr(base, "block_size") \
+                and base.block_size != bs:
             # group whole block-nodes so the scalar coarse space tiles back
-            # into bs×bs blocks (pointwise aggregation over block nodes)
-            self.base.block_size = bs
-        P, R = self.base.transfer_operators(scalar)
+            # into bs×bs blocks (pointwise aggregation over block nodes);
+            # reconfigure a COPY — the wrapped policy object stays unmutated
+            from dataclasses import replace as _dc_replace
+            base = _dc_replace(base, block_size=bs)
+        P, R = base.transfer_operators(scalar, ctx)
         if bs > 1:
             if P.ncols % bs:
                 raise ValueError(
@@ -34,5 +38,6 @@ class AsScalar:
             R = R.to_block(bs)
         return P, R
 
-    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
-        return self.base.coarse_operator(A, P, R)
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR,
+                        ctx: dict | None = None) -> CSR:
+        return self.base.coarse_operator(A, P, R, ctx)

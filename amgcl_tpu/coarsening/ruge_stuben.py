@@ -80,7 +80,8 @@ class RugeStuben:
     do_trunc: bool = True
     eps_trunc: float = 0.2
 
-    def transfer_operators(self, A: CSR):
+    def transfer_operators(self, A: CSR, ctx: dict | None = None):
+        # RS keeps no cross-level state; ctx is accepted for API uniformity
         if A.is_block:
             raise NotImplementedError(
                 "ruge_stuben supports scalar value types only (as in the "
@@ -139,6 +140,7 @@ class RugeStuben:
         Pc = CSR.from_scipy(P)
         return Pc, Pc.transpose()
 
-    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR,
+                        ctx: dict | None = None) -> CSR:
         from amgcl_tpu.coarsening.galerkin import galerkin
         return galerkin(A, P, R)

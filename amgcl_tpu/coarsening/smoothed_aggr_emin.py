@@ -32,25 +32,31 @@ class SmoothedAggrEMin:
     block_size: int = 1
     nullspace: np.ndarray | None = None
 
-    def transfer_operators(self, A: CSR):
-        if A.is_block and self.nullspace is not None:
+    def transfer_operators(self, A: CSR, ctx: dict | None = None):
+        """``ctx`` carries per-build state (eps_strong decay, coarse
+        nullspace) across levels; the policy object is never mutated."""
+        ctx = ctx if ctx is not None else {}
+        eps_strong = ctx.get("eps_strong", self.eps_strong)
+        nullspace = ctx.get("nullspace", self.nullspace)
+        if A.is_block and nullspace is not None:
             raise NotImplementedError(
                 "near-nullspace with block value types is not supported")
         scalar = A.unblock() if A.is_block else A
         bs = A.block_size[0] if A.is_block else self.block_size
+        ctx["eps_strong"] = eps_strong * 0.5
         if bs > 1:
-            agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
+            agg, n_agg = pointwise_aggregates(A, eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
         else:
-            agg, n_agg = plain_aggregates(scalar, self.eps_strong)
+            agg, n_agg = plain_aggregates(scalar, eps_strong)
             n_pt = scalar.nrows
         if n_agg == 0:
             raise ValueError("empty coarse level (all rows isolated)")
         P_tent, Bc = tentative_prolongation(
-            n_pt, agg, n_agg, self.nullspace, bs)
+            n_pt, agg, n_agg, nullspace, bs)
         Pt = (P_tent.unblock() if P_tent.is_block else P_tent).to_scipy()
 
-        Af, Dfi = _filtered(scalar, self.eps_strong)
+        Af, Dfi = _filtered(scalar, eps_strong)
         Afs = Af.to_scipy()
         AP = (Afs @ Pt).tocsr()
         K = AP.multiply(Dfi[:, None]).tocsr()          # D^-1 A P
@@ -67,9 +73,9 @@ class SmoothedAggrEMin:
         if A.is_block:
             Pc = Pc.to_block(bs)
             R = R.to_block(bs)
-        self.eps_strong *= 0.5
-        self.nullspace = Bc
+        ctx["nullspace"] = Bc
         return Pc, R
 
-    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR,
+                        ctx: dict | None = None) -> CSR:
         return galerkin(A, P, R)

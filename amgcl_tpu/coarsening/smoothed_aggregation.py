@@ -50,8 +50,16 @@ class SmoothedAggregation:
     # when the device hierarchy is <= 32-bit (halves setup memory traffic)
     setup_dtype: object = None
 
-    def transfer_operators(self, A: CSR):
-        if A.is_block and self.nullspace is not None:
+    def transfer_operators(self, A: CSR, ctx: dict | None = None):
+        """``ctx`` carries per-build state across levels (eps_strong decay,
+        coarse nullspace, grid-dims propagation). The policy object itself
+        is never mutated, so one params object can drive any number of
+        builds; callers that omit ``ctx`` get a pure single-level call."""
+        ctx = ctx if ctx is not None else {}
+        eps_strong = ctx.get("eps_strong", self.eps_strong)
+        nullspace = ctx.get("nullspace", self.nullspace)
+        setup_dtype = ctx.get("setup_dtype", self.setup_dtype)
+        if A.is_block and nullspace is not None:
             raise NotImplementedError(
                 "near-nullspace with block value types is not supported; "
                 "use a scalar matrix (as the reference does via "
@@ -59,26 +67,27 @@ class SmoothedAggregation:
                 "columns, which does not tile into the block structure")
         scalar = A.unblock() if A.is_block else A
         bs = A.block_size[0] if A.is_block else self.block_size
+        # parameter decay between levels (reference halves eps_strong)
+        ctx["eps_strong"] = eps_strong * 0.5
         if (self.stencil_setup and self.structured
                 and self.implicit_transfers and bs == 1 and not A.is_block
-                and self.nullspace is None and self.aggregator is None):
+                and nullspace is None and self.aggregator is None):
             from amgcl_tpu.ops.structured import detect_grid_csr
             from amgcl_tpu.ops.stencil import stencil_transfer_operators
             grid = detect_grid_csr(scalar)
             if grid is not None:
                 got = stencil_transfer_operators(
-                    scalar, grid, self.eps_strong, self.relax,
-                    self.power_iters, self.setup_dtype)
+                    scalar, grid, eps_strong, self.relax,
+                    self.power_iters, setup_dtype)
                 if got is not None:
-                    self.eps_strong *= 0.5
                     return got
         # filtered matrix: drop weak off-diagonal entries, lump onto the
         # diagonal — needed for P-smoothing below AND (computed first) for
         # the strength-aware grid aggregation decision
-        Af, Df_inv = _filtered(scalar, self.eps_strong)
+        Af, Df_inv = _filtered(scalar, eps_strong)
         grid = None
         if (self.structured and bs == 1 and not A.is_block
-                and self.nullspace is None and self.aggregator is None):
+                and nullspace is None and self.aggregator is None):
             from amgcl_tpu.ops.structured import (
                 detect_grid_csr, grid_aggregates, strength_blocks)
             grid = detect_grid_csr(scalar)
@@ -91,21 +100,21 @@ class SmoothedAggregation:
         if grid is not None:
             agg, n_agg, coarse_dims, blocks = grid_aggregates(grid, gblocks)
             n_pt = scalar.nrows
-            self._next_grid = coarse_dims
+            ctx["next_grid"] = coarse_dims
         elif bs > 1:
-            agg, n_agg = pointwise_aggregates(A, self.eps_strong, bs)
+            agg, n_agg = pointwise_aggregates(A, eps_strong, bs)
             n_pt = A.nrows if A.is_block else A.nrows // bs
         elif self.aggregator is not None:
-            agg, n_agg = self.aggregator(scalar, self.eps_strong)
+            agg, n_agg = self.aggregator(scalar, eps_strong)
             n_pt = scalar.nrows
         else:
-            agg, n_agg = plain_aggregates(scalar, self.eps_strong)
+            agg, n_agg = plain_aggregates(scalar, eps_strong)
             n_pt = scalar.nrows
         if n_agg == 0:
             raise ValueError("empty coarse level (all rows isolated)")
 
         P_tent, Bc = tentative_prolongation(
-            n_pt, agg, n_agg, self.nullspace, bs)
+            n_pt, agg, n_agg, nullspace, bs)
         Pt = P_tent.unblock() if P_tent.is_block else P_tent
 
         rho = spectral_radius(Af, self.power_iters, scale=True)
@@ -119,7 +128,7 @@ class SmoothedAggregation:
             P = P.to_block(bs)
             R = R.to_block(bs)
         elif (self.implicit_transfers and bs == 1
-                and self.nullspace is None):
+                and nullspace is None):
             # device realization applies P/R matrix-free through this spec
             # instead of packing gather-heavy ELL matrices (ops/structured.py)
             M = CSR(DA.ptr, DA.col, DA.val * omega, DA.ncols)
@@ -130,21 +139,19 @@ class SmoothedAggregation:
                 spec.update(agg=agg, n_agg=n_agg)
             P._implicit_spec = spec
             R._implicit_spec = spec
-        # parameter decay between levels (reference halves eps_strong)
-        self.eps_strong *= 0.5
-        self.nullspace = Bc
+        ctx["nullspace"] = Bc
         return P, R
 
-    def coarse_operator(self, A: CSR, P: CSR, R: CSR) -> CSR:
+    def coarse_operator(self, A: CSR, P: CSR, R: CSR,
+                        ctx: dict | None = None) -> CSR:
         from amgcl_tpu.ops.stencil import (
             StencilTransfer, stencil_coarse_operator)
         if isinstance(P, StencilTransfer):
             return stencil_coarse_operator(A, P)
         Ac = galerkin(A, P, R)
-        g = getattr(self, "_next_grid", None)
+        g = None if ctx is None else ctx.pop("next_grid", None)
         if g is not None:
             Ac._grid_dims = tuple(g)   # next level detects the grid for free
-            self._next_grid = None
         return Ac
 
 

@@ -154,15 +154,18 @@ class AMG:
 
     def _build(self, A: CSR):
         prm = self.prm
-        import copy
-        coarsening = copy.deepcopy(prm.coarsening)
+        coarsening = prm.coarsening
+        # per-build state (eps_strong decay, coarse nullspace, grid dims)
+        # lives in this context dict, NOT on the policy object — building
+        # twice from one params object produces identical hierarchies
+        ctx = {}
         if getattr(coarsening, "setup_dtype", False) is None:
             # a <=32-bit device hierarchy lets the stencil setup algebra
             # run in float32 — same convergence, half the memory traffic
             try:
                 if jnp.dtype(prm.dtype).itemsize <= 4 and not \
                         jnp.issubdtype(prm.dtype, jnp.complexfloating):
-                    coarsening.setup_dtype = np.float32
+                    ctx["setup_dtype"] = np.float32
             except TypeError:
                 pass
         host = []
@@ -170,12 +173,12 @@ class AMG:
         while (Acur.nrows * Acur.block_size[0] > prm.coarse_enough
                and len(host) + 1 < prm.max_levels):
             try:
-                P, R = coarsening.transfer_operators(Acur)
+                P, R = coarsening.transfer_operators(Acur, ctx)
             except ValueError:
                 break
             if P.ncols == 0 or P.ncols >= Acur.ncols:
                 break  # coarsening stalled
-            Ac = coarsening.coarse_operator(Acur, P, R)
+            Ac = coarsening.coarse_operator(Acur, P, R, ctx)
             host.append((Acur, P, R))
             Acur = Ac
         host.append((Acur, None, None))

@@ -191,12 +191,19 @@ class make_solver:
             self._compiled = jax.jit(self._solve_fn)
         got = self._compiled(self.A_dev, self.A_dev64,
                              self.precond.hierarchy, rhs, x0)
-        x, iters, resid = got[:3]
+        x = got[0]
+        # ONE device->host round trip for everything the SolverInfo needs —
+        # separate int()/float()/np.asarray() conversions each pay a full
+        # device sync, which through a remote-device tunnel costs tens of
+        # ms apiece and dominated the measured solve time
+        want_hist = len(got) > 3 and got[3] is not None
+        fetched = jax.device_get(got[1:5] if want_hist else got[1:3])
+        iters, resid = fetched[0], fetched[1]
         hist = None
-        if len(got) > 3 and got[3] is not None:
+        if want_hist:
             # slice by the recorded count — NaN filtering would also drop
             # genuine NaN residuals from a breakdown
-            hist = np.asarray(got[3])[:int(got[4])]
+            hist = np.asarray(fetched[2])[:int(fetched[3])]
         return x, SolverInfo(int(iters), float(resid), hist)
 
     def __repr__(self):

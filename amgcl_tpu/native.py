@@ -388,6 +388,14 @@ def native_dia_fnma_batch(abase, a_idx, bbase, b_idx, shifts, obase,
     b_idx = np.ascontiguousarray(b_idx, dtype=np.int64)
     shifts = np.ascontiguousarray(shifts, dtype=np.int64)
     out_idx = np.ascontiguousarray(out_idx, dtype=np.int64)
+    # the OpenMP split parallelizes over contiguous out_idx groups; a
+    # caller interleaving output rows would race two threads on one row —
+    # cheap O(npairs) check beats a silent wrong coarse operator
+    if len(out_idx) and np.count_nonzero(np.diff(out_idx)) \
+            != len(np.unique(out_idx)) - 1:
+        raise ValueError(
+            "native_dia_fnma_batch requires pairs sharing an output row "
+            "to be contiguous in out_idx")
     fn(n, len(a_idx), _ptr(abase), _ptr(a_idx), _ptr(bbase), _ptr(b_idx),
        _ptr(shifts), _ptr(obase), _ptr(out_idx))
     return True

@@ -277,7 +277,7 @@ def dia_efficiency(A: CSR):
 
 
 def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
-              max_diags: int = None, max_fill: float = None,
+              max_diags: int | None = None, max_fill: float | None = None,
               dense_cutoff: int = 2048):
     """Move a host matrix to the device in a TPU-friendly format.
 
@@ -300,6 +300,14 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
         return DenseMatrix(jnp.asarray(A.to_dense(), dtype=dtype))
     if fmt == "dia":
         return csr_to_dia(A, dtype)
+    if fmt == "well" and not A.is_block:
+        from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
+        W = csr_to_windowed_ell(A, dtype)
+        if W is None:
+            raise ValueError(
+                "windowed-ELL format needs banded column locality; apply "
+                "a Cuthill-McKee reorder first (utils/adapters.Reordered)")
+        return W
     if fmt == "auto" and not A.is_block:
         on_tpu = jax.default_backend() == "tpu"
         # measured on v5e: gathers run ~130M elem/s while DIA streams at
@@ -314,6 +322,16 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
         if (nd <= max_diags and fill <= max_fill
                 and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
             return csr_to_dia(A, dtype)
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+            # unstructured but banded (e.g. after Cuthill-McKee): windowed
+            # ELL replaces the HBM-serialized gather with per-tile VMEM
+            # windows (ops/unstructured.py). Auto-selection keeps a tighter
+            # VMEM budget than the explicit 'well' format so the window +
+            # pipeline tiles cannot blow VMEM at solver-jit time
+            from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
+            W = csr_to_windowed_ell(A, dtype, max_win_bytes=4 << 20)
+            if W is not None:
+                return W
     return csr_to_ell(A, dtype)
 
 

@@ -84,7 +84,7 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
         kernel,
         grid=grid,
         in_specs=[
-            pl.BlockSpec(memory_space=pltpu.ANY),            # x stays in HBM
+            pl.BlockSpec(memory_space=pl.ANY),               # x stays in HBM
             # np.int32 keeps the index map i32 under jax_enable_x64 — a bare
             # Python 0 traces as i64 there and Mosaic cannot legalize the
             # mixed-width func.return

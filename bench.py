@@ -4,96 +4,382 @@ configuration (docs/benchmarks.rst:60-79, BASELINE.json configs[0]).
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline", ...}.
 
-Baseline: the reference's CUDA backend on a Tesla K80 solves the 150^3
-problem in 0.55 s (BASELINE.md; docs/smem_data/poisson/amgcl-cuda.txt:1).
-Scaled to 128^3 by problem size that is 0.55*(128/150)^3 = 0.342 s, the
-number a single TPU chip must beat. vs_baseline = baseline_time / our_time
-(>1 means faster than the K80 reference).
+Baselines (BASELINE.md; docs/smem_data/poisson/amgcl-cuda.txt:1): the
+reference's CUDA backend on a Tesla K80 solves the 150^3 problem in 0.55 s
+and sets it up in 1.33 s. Volume-scaled to N^3: solve 0.55*(N/150)^3,
+setup 1.33*(N/150)^3. vs_baseline = baseline_time / our_time (>1 = faster
+than the K80 reference).
+
+Architecture (round-3 rework): the axon TPU tunnel comes and goes — backend
+init, a compile, or an execute can block forever. So this file is a
+SUPERVISOR that never imports jax itself: it probes device init in a
+subprocess, retries for the WHOLE deadline, runs the measurement in a
+killable WORKER subprocess, persists every good TPU run to
+BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
+
+    python bench.py                 # supervisor (what the driver runs)
+    python bench.py --worker        # one measurement pass (internal)
+    python bench.py --opportunistic # background loop: bench whenever the
+                                    # tunnel is alive, refresh last-good
 """
 
 import json
 import os
+import subprocess
+import sys
 import threading
 import time
 
-import numpy as np
-
-# AMGCL_TPU_BENCH_N overrides the problem size (default 128; 150 compares
-# against the K80 baseline at its native size instead of volume-scaled)
+_REPO = os.path.dirname(os.path.abspath(__file__))
+_LAST_GOOD_PATH = os.path.join(_REPO, "BENCH_LAST_GOOD.json")
 _N = int(os.environ.get("AMGCL_TPU_BENCH_N", "128"))
 _METRIC = "poisson3d_%d_sa_cg_spai0_solve_time" % _N
 
+# HBM peak bandwidth per chip by device_kind substring (GB/s) — public
+# figures; used only for the hbm_frac observability field.
+_HBM_PEAK_GBPS = [
+    ("v6", 1640.0), ("v5p", 2765.0), ("v5 lite", 819.0), ("v5e", 819.0),
+    ("v5", 2765.0), ("v4", 1228.0), ("v3", 900.0), ("v2", 700.0),
+]
+
+
+def _git_head():
+    try:
+        return subprocess.run(
+            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10).stdout.strip()
+    except Exception:
+        return None
+
+
+def _load_last_good():
+    try:
+        with open(_LAST_GOOD_PATH) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def _save_last_good(out):
+    rec = dict(out)
+    rec["ts"] = time.time()
+    rec["ts_iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    rec["commit"] = _git_head()
+    tmp = _LAST_GOOD_PATH + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(rec, f)
+    os.replace(tmp, _LAST_GOOD_PATH)
+    return rec
+
+
+def _last_good_fields():
+    lg = _load_last_good()
+    if not lg:
+        return {}
+    return {"last_good": {
+        "value": lg.get("value"), "vs_baseline": lg.get("vs_baseline"),
+        "setup_s": lg.get("setup_s"),
+        "setup_vs_baseline": lg.get("setup_vs_baseline"),
+        "iters": lg.get("iters"), "device": lg.get("device"),
+        "achieved_gbps": lg.get("achieved_gbps"),
+        "hbm_frac": lg.get("hbm_frac"),
+        "ts": lg.get("ts"), "ts_iso": lg.get("ts_iso"),
+        "commit": lg.get("commit"),
+    }}
+
+
+# ===========================================================================
+# supervisor
+# ===========================================================================
+
+def probe_platform(timeout_s):
+    """Initialize jax in a throwaway subprocess. Returns 'tpu'/'cpu'/... or
+    None if init wedged or crashed — the tunnel hang never touches us."""
+    code = ("import jax\n"
+            "d = jax.devices()[0]\n"
+            "print('PLATFORM=' + d.platform)\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=timeout_s)
+    except (subprocess.TimeoutExpired, OSError):
+        return None
+    if r.returncode != 0:
+        return None
+    for line in r.stdout.splitlines():
+        if line.startswith("PLATFORM="):
+            return line.split("=", 1)[1].strip()
+    return None
+
+
+def run_worker(budget_s, extra_env=None):
+    """Run one measurement pass in a killable subprocess.
+
+    Returns (result_dict_or_None, stages, error_str_or_None). The worker
+    streams '@@stage <t> <name>' lines; its final line is the JSON."""
+    env = dict(os.environ)
+    env.update(extra_env or {})
+    # the worker's own internal watchdog fires just before we would kill it,
+    # so a mid-run wedge still yields a JSON line with stage stamps
+    env["AMGCL_TPU_BENCH_DEADLINE"] = str(max(int(budget_s) - 15, 60))
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--worker"],
+        stdout=subprocess.PIPE, stderr=subprocess.DEVNULL, text=True,
+        env=env)
+    lines = []
+    done = threading.Event()
+
+    def reader():
+        for line in proc.stdout:
+            lines.append(line.rstrip("\n"))
+        done.set()
+
+    th = threading.Thread(target=reader, daemon=True)
+    th.start()
+    done.wait(budget_s)
+    if proc.poll() is None:
+        proc.kill()
+        proc.wait()
+        done.wait(5)
+    stages, result = {}, None
+    for line in lines:
+        if line.startswith("@@stage "):
+            _, t, name = line.split(" ", 2)
+            stages[name] = float(t)
+        elif line.startswith("{"):
+            try:
+                result = json.loads(line)
+            except ValueError:
+                pass
+    if result is None:
+        last = max(stages, key=stages.get) if stages else "start"
+        return None, stages, ("worker wedged during '%s' (killed at %.0fs)"
+                              % (last, budget_s))
+    return result, stages, result.get("error")
+
+
+def main_supervisor():
+    t0 = time.time()
+    deadline = float(os.environ.get("AMGCL_TPU_BENCH_DEADLINE", "1500"))
+    attempts = []
+    # time reserved at the tail for a CPU-forced fallback measurement if
+    # the tunnel never comes up (clearly labeled device=cpu — NOT the
+    # headline claim, but proof the harness measures end to end; the
+    # last-good TPU fields ride along either way)
+    cpu_reserve = min(600.0, deadline * 0.4)
+
+    def remaining():
+        return deadline - (time.time() - t0)
+
+    def emit(out):
+        print(json.dumps(out))
+        sys.stdout.flush()
+
+    def finish(result):
+        if result.get("device_platform") == "tpu" \
+                and result.get("value") is not None:
+            _save_last_good(result)
+        result.update(_last_good_fields())
+        result["init_retries"] = len(attempts)
+        emit(result)
+
+    tpu_fails = 0
+    while remaining() > cpu_reserve + 90:
+        plat = probe_platform(min(90, remaining() - 30))
+        if plat == "tpu":
+            budget = remaining() - (cpu_reserve if remaining()
+                                    > cpu_reserve + 400 else 30)
+            result, stages, err = run_worker(budget)
+            if result is not None and result.get("value") is not None:
+                finish(result)
+                return
+            attempts.append("t+%ds: %s"
+                            % (time.time() - t0, err or "worker failed"))
+            # a fast deterministic worker crash (not a tunnel wedge) would
+            # otherwise spin subprocess churn for the whole deadline
+            tpu_fails += 1
+            if tpu_fails >= 3:
+                break
+            time.sleep(min(30, max(remaining() - cpu_reserve - 60, 0)))
+        else:
+            attempts.append("t+%ds: %s" % (
+                time.time() - t0,
+                "init wedged" if plat is None else "platform=" + plat))
+            time.sleep(min(20, max(remaining() - cpu_reserve - 60, 0)))
+
+    # tail: the tunnel never produced a number — run the same measurement
+    # CPU-forced so the emitted line still carries a real, labeled value
+    budget = remaining() - 20
+    if budget > 120:
+        result, stages, err = run_worker(budget, {
+            "AMGCL_TPU_FORCE_CPU": "1",
+            "AMGCL_TPU_BENCH_N": os.environ.get(
+                "AMGCL_TPU_BENCH_CPU_N",
+                os.environ.get("AMGCL_TPU_BENCH_N", "96"))})
+        if result is not None and result.get("value") is not None:
+            result["fallback"] = "cpu (TPU tunnel unreachable all deadline)"
+            finish(result)
+            return
+        attempts.append("cpu fallback: %s" % (err or "worker failed"))
+
+    out = {"metric": _METRIC, "value": None, "unit": "s",
+           "vs_baseline": None,
+           "error": "no successful measurement within the %.0fs deadline"
+                    % deadline,
+           "init_retry_log": attempts[-12:]}
+    out.update(_last_good_fields())
+    emit(out)
+
+
+# ===========================================================================
+# opportunistic background mode
+# ===========================================================================
+
+def main_opportunistic():
+    """Loop forever: whenever the tunnel answers, run one measurement and
+    refresh BENCH_LAST_GOOD.json; append every outcome to a jsonl log.
+    Run with nohup/background during a build round so any alive-window of
+    the tunnel produces a stored artifact."""
+    log_path = os.path.join(_REPO, "BENCH_OPPORTUNISTIC.jsonl")
+    period = float(os.environ.get("AMGCL_TPU_OPP_PERIOD", "900"))
+    while True:
+        t0 = time.time()
+        plat = probe_platform(90)
+        rec = {"ts": time.time(), "platform": plat}
+        if plat == "tpu":
+            result, stages, err = run_worker(900)
+            if result is not None and result.get("value") is not None \
+                    and result.get("device_platform") == "tpu":
+                _save_last_good(result)
+                rec["result"] = result
+            else:
+                rec["error"] = err or "worker failed"
+                rec["stages"] = stages
+        with open(log_path, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+        time.sleep(max(period - (time.time() - t0), 30))
+
+
+# ===========================================================================
+# worker: one measurement pass (runs under the supervisor's knife)
+# ===========================================================================
+
 _T0 = time.time()
-_STAGES = []           # (name, start_ts) — progress stamps for the watchdog
-_PARTIAL = {}          # results already secured; emitted even on a wedge
+_STAGES = []
+_PARTIAL = {}
 
 
 def _stage(name):
     _STAGES.append((name, time.time()))
+    print("@@stage %.1f %s" % (time.time() - _T0, name))
+    sys.stdout.flush()
 
 
-def _watchdog(init_timeout_s: float = 240.0, total_timeout_s: float = None):
-    """The axon TPU tunnel can wedge at ANY point — backend init, a
-    compile, or an execute can block forever (both failure modes observed
-    in this image). Two deadlines, both emitting a diagnostic JSON line
-    and hard-exiting instead of hanging the driver:
+def _worker_watchdog():
+    """In-process total deadline: emit a diagnostic JSON naming the last
+    stage reached, then hard-exit. The supervisor kills us slightly later
+    regardless; this path preserves partial results."""
+    total = float(os.environ.get("AMGCL_TPU_BENCH_DEADLINE", "1500"))
 
-    - init: jax.devices() must return within ``init_timeout_s``;
-    - total: the whole bench must finish within ``total_timeout_s``
-      (env AMGCL_TPU_BENCH_DEADLINE, default 1500s), with the error
-      naming the last stage reached so a wedge mid-compile is
-      distinguishable from a wedge at init."""
-    if total_timeout_s is None:
-        total_timeout_s = float(os.environ.get(
-            "AMGCL_TPU_BENCH_DEADLINE", "1500"))
-    done = threading.Event()
-
-    def bail(err):
-        import sys
-        stamps = {n: round(t - _T0, 1) for n, t in _STAGES}
-        out = {
-            "metric": _METRIC,
-            "value": None, "unit": "s", "vs_baseline": None,
-            "error": err, "stages_reached": stamps,
-        }
-        # a wedge after the headline solve still reports the real number
+    def guard():
+        left = total - (time.time() - _T0)
+        if left > 0:
+            time.sleep(left)
+        last = _STAGES[-1][0] if _STAGES else "start"
+        out = {"metric": _METRIC, "value": None, "unit": "s",
+               "vs_baseline": None,
+               "error": "bench wedged during '%s' (%.0fs worker deadline)"
+                        % (last, total),
+               "stages_reached": {n: round(t - _T0, 1) for n, t in _STAGES}}
         out.update(_PARTIAL)
         print(json.dumps(out))
         sys.stdout.flush()
         os._exit(2)
 
-    def probe():
-        import jax
-        jax.devices()
-        done.set()
+    threading.Thread(target=guard, daemon=True).start()
 
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
 
-    def total_guard():
-        left = total_timeout_s - (time.time() - _T0)
-        if left > 0:
-            time.sleep(left)
-        last = _STAGES[-1][0] if _STAGES else "start"
-        bail("bench wedged during '%s' (%.0fs deadline; TPU tunnel "
-             "stalled mid-run)" % (last, total_timeout_s))
+def _dispatch_overhead(reps=5):
+    """Median wall time of an already-compiled trivial dispatch + scalar
+    fetch — the per-call cost floor imposed by the (possibly tunneled)
+    runtime, subtracted from chained measurements."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    f = jax.jit(lambda s: s * 2.0)
+    x = jnp.float32(1.0)
+    float(f(x))
+    ts = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        float(f(x))
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts))
 
-    threading.Thread(target=total_guard, daemon=True).start()
-    if not done.wait(init_timeout_s):
-        bail("device backend init timed out after %.0fs "
-             "(TPU tunnel unreachable)" % init_timeout_s)
+
+def _timed_chain(fn, reps, repeats, overhead):
+    """Time ``reps`` data-dependent applications of fn inside ONE jitted
+    scan, fetching a single scalar — so per-dispatch tunnel sync (which a
+    locally-attached device would not pay) amortizes away. Returns median
+    per-application seconds."""
+    import jax
+    import numpy as np
+    from jax import lax
+
+    def many():
+        def body(c, _):
+            return fn(c), None
+        out, _ = lax.scan(body, fn(None), None, length=reps - 1)
+        return out.sum()
+
+    f = jax.jit(many)
+    float(f())                      # compile + warm
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(f())
+        ts.append(time.perf_counter() - t0)
+    return (float(np.median(ts)) - overhead) / reps
+
+
+def _traffic_model(solver, npre, npost, pre_cycles):
+    """Approximate HBM bytes moved per CG iteration (documented model, not
+    a measurement): per level, each smoother application and the residual
+    stream the operator once plus a few vector passes; transfers stream
+    once per cycle; the fine level adds the CG body's SpMV and ~14 vector
+    passes (dots/axpbys). Used for achieved_gbps / hbm_frac."""
+    def mat_bytes(m):
+        try:
+            return int(m.bytes())
+        except Exception:
+            return 0
+
+    levels = solver.precond.hierarchy.levels
+    itemsize = 4
+    per_cycle = 0
+    for i, lv in enumerate(levels):
+        n = lv.A.shape[0] if lv.A is not None else 0
+        a = mat_bytes(lv.A)
+        vec = n * itemsize
+        if i < len(levels) - 1:
+            per_cycle += (npre + npost) * (a + 4 * vec)   # smoother sweeps
+            per_cycle += a + 2 * vec                       # residual
+            per_cycle += mat_bytes(lv.R) + mat_bytes(lv.P) + 4 * vec
+        else:
+            per_cycle += 2 * a + 4 * vec                   # coarse solve-ish
+    n0 = levels[0].A.shape[0]
+    per_iter = pre_cycles * per_cycle + mat_bytes(levels[0].A) \
+        + 14 * n0 * itemsize
+    return per_iter
 
 
 def _bench_levels(solver):
     """Per-level SpMV timings: XLA lowering vs the Pallas DIA kernel where
-    the level is DIA-formatted (VERDICT round-1 ask: per-level
-    kernel-vs-XLA numbers so format/kernel choices are measured, not
-    guessed). Each measurement chains 50 SpMVs inside ONE jitted scan and
-    fetches a scalar, because per-dispatch sync overhead through the axon
-    tunnel (~70ms) swamps a single op and block_until_ready does not
-    actually block there. Returns a list of dicts."""
+    the level is DIA-formatted. Chains 50 SpMVs inside ONE jitted scan and
+    fetches a scalar (per-dispatch sync through the axon tunnel swamps a
+    single op). Returns a list of dicts."""
     import jax
     import jax.numpy as jnp
+    import numpy as np
     from jax import lax
     from amgcl_tpu.ops.device import DiaMatrix
     from amgcl_tpu.ops.pallas_spmv import dia_spmv
@@ -108,11 +394,11 @@ def _bench_levels(solver):
             return out.sum()
 
         f = jax.jit(many)
-        v = float(f(x))                       # compile + warm
+        float(f(x))                       # compile + warm
         ts = []
         for _ in range(5):
             t0 = time.perf_counter()
-            v = float(f(x))
+            float(f(x))
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts))
 
@@ -141,8 +427,6 @@ def _bench_levels(solver):
                "xla_us": round(max(t_x - overhead, 0.0) / reps * 1e6, 1)}
         if isinstance(M, DiaMatrix):
             offs = tuple(M.offsets)
-            # interpret mode off-TPU keeps the CPU smoke path alive; its
-            # timings are meaningless and marked as such
             interp = jax.default_backend() != "tpu"
             row["ndiag"] = len(offs)
             row["pallas_us"] = round(max(timeit(
@@ -157,20 +441,99 @@ def _bench_levels(solver):
     return out
 
 
-def main():
+def _bench_unstructured(on_tpu):
+    """Unstructured SpMV comparison (VERDICT r2 item 3): FE-style matrix at
+    poisson3Db's profile (BASELINE config 2), RCM-reordered; times the
+    plain-ELL jnp.take path vs the windowed-ELL paths (ops/unstructured.py)
+    with 50 chained SpMVs per measurement."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from amgcl_tpu.ops.csr import CSR
+    from amgcl_tpu.ops import device as dev
+    from amgcl_tpu.ops.unstructured import (
+        csr_to_windowed_ell, fe_like_problem, kernel_supported)
+    from amgcl_tpu.utils.adapters import cuthill_mckee, permute
+
+    cache = os.path.join(_REPO, ".bench_fe_cache.npz")
+    n_target = int(os.environ.get("AMGCL_TPU_BENCH_UNSTRUCT_N", "85623"))
+    A = None
+    if os.path.exists(cache):
+        try:
+            z = np.load(cache)
+            if int(z["n"]) == n_target:
+                A = CSR(z["ptr"], z["col"], z["val"], int(z["n"]))
+        except Exception:
+            A = None
+    if A is None:
+        A, _ = fe_like_problem(n=n_target)
+        A = permute(A, cuthill_mckee(A))
+        np.savez(cache, ptr=A.ptr, col=A.col, val=A.val, n=A.nrows)
+
+    reps = 50
+    x = jnp.asarray(np.random.RandomState(0).rand(A.nrows), jnp.float32)
+
+    def timeit(fn):
+        def many(x0):
+            def body(c, _):
+                return fn(c) * 0.5 + x0, None
+            out, _ = lax.scan(body, x0, None, length=reps)
+            return out.sum()
+        f = jax.jit(many)
+        float(f(x))
+        ts = []
+        for _ in range(5):
+            t0 = time.perf_counter()
+            float(f(x))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) / reps * 1e6       # us per spmv
+
+    out = {"n": A.nrows, "nnz": A.nnz}
+    E = dev.csr_to_ell(A, jnp.float32)
+    out["ell_take_us"] = round(timeit(E.mv), 1)
+    W = csr_to_windowed_ell(A, jnp.float32)
+    if W is not None:
+        out["win"] = W.win
+        out["well_xla_us"] = round(timeit(W._mv_xla), 1)
+        if on_tpu and kernel_supported():
+            from amgcl_tpu.ops.unstructured import windowed_ell_spmv
+            out["well_pallas_us"] = round(timeit(
+                lambda v: windowed_ell_spmv(
+                    W.window_starts, W.cols_local, W.vals, v,
+                    W.win, W.shape[0])), 1)
+            out["speedup_vs_take"] = round(
+                out["ell_take_us"] / out["well_pallas_us"], 2)
+        elif on_tpu:
+            out["well_pallas_us"] = None
+            out["note"] = "in-kernel gather not legalized on this backend"
+    return out
+
+
+def main_worker():
     _stage("device init")
-    _watchdog()
+    _worker_watchdog()
+    import numpy as np
+    if os.environ.get("AMGCL_TPU_FORCE_CPU") == "1":
+        # supervisor's tail fallback: never touch the (wedged) tunnel
+        from amgcl_tpu.utils.axon_guard import force_cpu_backend
+        force_cpu_backend()
     import jax
     # x64 so the refinement's outer residual really is float64 (the
     # correction solves stay float32)
     jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
+    dev0 = jax.devices()[0]
+    on_tpu = jax.default_backend() == "tpu"
     from amgcl_tpu.utils.sample_problem import poisson3d
     from amgcl_tpu.models.make_solver import make_solver
     from amgcl_tpu.models.amg import AMGParams
     from amgcl_tpu.solver.cg import CG
 
     n = _N
+    solve_base = 0.55 * (n / 150.0) ** 3    # K80 CUDA, volume-scaled
+    setup_base = 1.33 * (n / 150.0) ** 3
+
     _stage("problem gen")
     t0 = time.perf_counter()
     A, rhs = poisson3d(n)
@@ -178,81 +541,103 @@ def main():
 
     _stage("hierarchy setup")
     t0 = time.perf_counter()
-    solver = make_solver(A, AMGParams(dtype=jnp.float32),
-                         CG(maxiter=100, tol=1e-6), refine=3)
+    prm = AMGParams(dtype=jnp.float32)
+    solver = make_solver(A, prm, CG(maxiter=100, tol=1e-6), refine=3)
     t_setup = time.perf_counter() - t0
+    _PARTIAL.update({
+        "setup_s": round(t_setup, 3),
+        "setup_vs_baseline": round(setup_base / t_setup, 3),
+        "gen_s": round(t_gen, 3),
+        "device": str(dev0), "device_platform": dev0.platform,
+        "device_kind": getattr(dev0, "device_kind", None)})
 
     rhs_dev = jnp.asarray(rhs, dtype=jnp.float32)
+    x0 = jnp.zeros_like(rhs_dev)
 
-    def timed(tag):
-        x, info = solver(rhs_dev)           # warmup/compile
-        jax.block_until_ready(x)
-        times = []
-        for _ in range(3):
-            t0 = time.perf_counter()
-            x, info = solver(rhs_dev)
-            jax.block_until_ready(x)
-            times.append(time.perf_counter() - t0)
-        return float(np.median(times)), x, info
+    _stage("dispatch overhead probe")
+    overhead = _dispatch_overhead()
+    _PARTIAL["dispatch_overhead_s"] = round(overhead, 4)
 
-    import os
-    from amgcl_tpu.ops.pallas_spmv import pallas_enabled
-    # Pallas DIA kernel is the default on TPU (AMGCL_TPU_PALLAS=0 opts
-    # out); also time the pure-XLA lowering for the record and keep
-    # whichever is faster
-    on_tpu = jax.default_backend() == "tpu"
-    primary_path = "pallas" if on_tpu and pallas_enabled() else "xla"
-    _stage("solve compile+run (%s)" % primary_path)
-    t_solve, x, info = timed(primary_path)
-    spmv_path = primary_path
-    baseline = 0.55 * (n / 150.0) ** 3   # K80 CUDA solve, size-scaled
-    _PARTIAL.update({
-        "value": round(t_solve, 4),
-        "vs_baseline": round(baseline / t_solve, 3),
-        "iters": int(info.iters), "resid": float(info.resid),
-        "setup_s": round(t_setup, 3), "gen_s": round(t_gen, 3),
-        "spmv_path": spmv_path, "device": str(jax.devices()[0])})
-    t_xla = None
-    if on_tpu and primary_path == "pallas":
-        _stage("solve compile+run (xla compare)")
-        saved = os.environ.get("AMGCL_TPU_PALLAS")
-        os.environ["AMGCL_TPU_PALLAS"] = "0"
-        solver._compiled = None
-        try:
-            t_xla, x2, info2 = timed("xla")
-            if t_xla < t_solve:
-                t_solve, x, info, spmv_path = t_xla, x2, info2, "xla"
-        except Exception:
-            pass
-        finally:
-            if saved is None:
-                del os.environ["AMGCL_TPU_PALLAS"]
-            else:
-                os.environ["AMGCL_TPU_PALLAS"] = saved
-            solver._compiled = None
+    # one plain call for convergence info + per-call wall time (includes
+    # dispatch/sync and the single-round-trip info fetch)
+    _stage("solve compile+run")
+    x, info = solver(rhs_dev)               # compile + warm
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    x, info = solver(rhs_dev)
+    jax.block_until_ready(x)
+    wall_per_call = time.perf_counter() - t0
 
     true_res = float(np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64)))
                      / np.linalg.norm(rhs))
     _PARTIAL.update({
-        "value": round(t_solve, 4),
-        "vs_baseline": round(baseline / t_solve, 3),
+        "value": round(wall_per_call, 4),
+        "vs_baseline": round(solve_base / wall_per_call, 3),
+        "wall_per_call_s": round(wall_per_call, 4),
         "iters": int(info.iters), "resid": float(info.resid),
-        "true_resid": true_res, "spmv_path": spmv_path,
-        "xla_solve_s": round(t_xla, 4) if t_xla else None})
+        "true_resid": true_res})
+
+    # amortized timing: chain solves inside one scan so per-dispatch tunnel
+    # latency (absent on a locally-attached device) does not pollute the
+    # device-time measurement — this is the headline number
+    _stage("solve chained timing")
+    reps = 4 if on_tpu else 2
+
+    def one(c):
+        r = rhs_dev if c is None else rhs_dev + 0 * c
+        got = solver._solve_fn(solver.A_dev, solver.A_dev64,
+                               solver.precond.hierarchy, r, x0)
+        return got[0].astype(jnp.float32)
+
+    try:
+        t_solve = _timed_chain(one, reps, 3 if on_tpu else 2, overhead)
+        t_solve = max(t_solve, 1e-9)
+    except Exception:
+        t_solve = wall_per_call
+    _PARTIAL.update({
+        "value": round(t_solve, 4),
+        "vs_baseline": round(solve_base / t_solve, 3)})
+
+    # bandwidth observability: documented traffic model / measured time
+    per_iter_bytes = _traffic_model(solver, prm.npre, prm.npost,
+                                    prm.pre_cycles)
+    iters = max(int(info.iters), 1)
+    achieved = per_iter_bytes * iters / t_solve / 1e9
+    _PARTIAL["model_bytes_per_iter"] = int(per_iter_bytes)
+    _PARTIAL["achieved_gbps"] = round(achieved, 1)
+    kind = (getattr(dev0, "device_kind", "") or "").lower()
+    for key, peak in _HBM_PEAK_GBPS:
+        if key in kind:
+            _PARTIAL["hbm_peak_gbps"] = peak
+            _PARTIAL["hbm_frac"] = round(achieved / peak, 3)
+            break
 
     levels = None
-    if jax.default_backend() == "tpu" or os.environ.get(
-            "AMGCL_TPU_BENCH_LEVELS") == "1":
+    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_LEVELS") == "1":
         _stage("per-level timings")
         try:
             levels = _bench_levels(solver)
         except Exception as e:       # per-level timing must never kill the
             levels = [{"error": repr(e)}]   # headline number
+        _PARTIAL["levels"] = levels
+    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_UNSTRUCT") == "1":
+        _stage("unstructured spmv")
+        try:
+            _PARTIAL["unstructured"] = _bench_unstructured(on_tpu)
+        except Exception as e:
+            _PARTIAL["unstructured"] = {"error": repr(e)}
     out = {"metric": _METRIC, "unit": "s"}
     out.update(_PARTIAL)
-    out["levels"] = levels
+    if levels is not None:
+        out["levels"] = levels
     print(json.dumps(out))
+    sys.stdout.flush()
 
 
 if __name__ == "__main__":
-    main()
+    if "--worker" in sys.argv:
+        main_worker()
+    elif "--opportunistic" in sys.argv:
+        main_opportunistic()
+    else:
+        main_supervisor()

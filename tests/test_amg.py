@@ -176,3 +176,43 @@ def test_memory_report():
     amg = AMG(A, AMGParams(dtype=jnp.float64))
     assert amg.bytes() > 0
     assert "Memory footprint:" in repr(amg)
+
+
+def _level_payload(lv):
+    """Comparable numeric payload of a host level operator (CSR or HostDia)."""
+    A = lv[0]
+    if hasattr(A, "val"):
+        return np.asarray(A.val)
+    return np.asarray(A.data)     # HostDia (stencil setup)
+
+
+def test_build_twice_from_one_params_is_identical():
+    """Per-build coarsening state lives in a build context, not on the
+    policy object — two builds from ONE params object must be bitwise
+    identical, and the policy's own fields must stay untouched
+    (round-2 review item: transfer_operators used to mutate self)."""
+    A, _ = poisson3d(16)
+    for coarsening_cls in (SmoothedAggregation, Aggregation):
+        coarsening = coarsening_cls()
+        prm = AMGParams(coarsening=coarsening, dtype=jnp.float64,
+                        coarse_enough=100)
+        amg1 = AMG(A, prm)
+        amg2 = AMG(A, prm)
+        assert coarsening.eps_strong == coarsening_cls().eps_strong
+        assert coarsening.nullspace is None
+        assert len(amg1.host_levels) == len(amg2.host_levels)
+        for l1, l2 in zip(amg1.host_levels, amg2.host_levels):
+            np.testing.assert_array_equal(_level_payload(l1),
+                                          _level_payload(l2))
+
+
+def test_direct_transfer_operators_call_is_pure():
+    """Calling transfer_operators without a ctx twice gives identical
+    results — no hidden eps_strong decay on the object."""
+    A, _ = poisson3d(12)
+    sa = SmoothedAggregation(stencil_setup=False, structured=False,
+                             implicit_transfers=False)
+    P1, _ = sa.transfer_operators(A)
+    P2, _ = sa.transfer_operators(A)
+    assert sa.eps_strong == SmoothedAggregation().eps_strong
+    np.testing.assert_array_equal(np.asarray(P1.val), np.asarray(P2.val))

@@ -134,13 +134,13 @@ def test_device_mis_aggregates():
     # spot-check hierarchy quality through a real solve
 
     class DeviceAggSA(SmoothedAggregation):
-        def transfer_operators(self, A):
+        def transfer_operators(self, A, ctx=None):
             # route aggregation through the device path, keep SA smoothing
             import amgcl_tpu.coarsening.smoothed_aggregation as sa
             orig = sa.plain_aggregates
             sa.plain_aggregates = lambda M, e: aggregates_on_device(M, e)
             try:
-                return super().transfer_operators(A)
+                return super().transfer_operators(A, ctx)
             finally:
                 sa.plain_aggregates = orig
 

@@ -150,10 +150,11 @@ def test_wide_stencils_fall_back_to_csr_route():
     # coarse (27-diagonal) second level takes the generic CSR route
     A, _ = poisson3d(16)
     sa = SmoothedAggregation()
-    P, R = sa.transfer_operators(A)
-    Ac = sa.coarse_operator(A, P, R)
+    ctx = {}   # per-build state (eps_strong decay) lives in the context
+    P, R = sa.transfer_operators(A, ctx)
+    Ac = sa.coarse_operator(A, P, R, ctx)
     # level-1 operator is a 27-point stencil -> generic path (explicit CSR)
-    P2, R2 = sa.transfer_operators(Ac)
+    P2, R2 = sa.transfer_operators(Ac, ctx)
     assert not isinstance(P2, st.StencilTransfer)
     assert hasattr(P2, "val")
 

@@ -1,0 +1,83 @@
+"""Windowed-ELL unstructured SpMV: packing, XLA path, Pallas interpret
+path, and an end-to-end AMG solve on an FE-style irregular matrix
+(reference capability: general-sparsity device SpMV,
+amgcl/backend/cuda.hpp:60-843)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops.unstructured import (
+    WindowedEllMatrix, csr_to_windowed_ell, windowed_ell_spmv,
+    fe_like_problem, _TILE, _WIN_ALIGN)
+from amgcl_tpu.utils.adapters import cuthill_mckee, permute
+
+
+def _small_fe(n=3000, seed=1):
+    A, rhs = fe_like_problem(n=n, nnz_target=n * 18, seed=seed)
+    return A, rhs
+
+
+def test_windowed_ell_matches_host_spmv():
+    A, _ = _small_fe()
+    perm = cuthill_mckee(A)
+    Ap = permute(A, perm)
+    W = csr_to_windowed_ell(Ap, jnp.float64)
+    assert W is not None
+    x = np.random.RandomState(0).rand(A.nrows)
+    y_ref = Ap.spmv(x)
+    y = np.asarray(W._mv_xla(jnp.asarray(x)))
+    np.testing.assert_allclose(y, y_ref, rtol=1e-12)
+
+
+def test_windowed_ell_pallas_interpret_matches():
+    A, _ = _small_fe(n=2500, seed=2)
+    perm = cuthill_mckee(A)
+    Ap = permute(A, perm)
+    W = csr_to_windowed_ell(Ap, jnp.float32)
+    x = np.random.RandomState(1).rand(A.nrows).astype(np.float32)
+    y_ref = Ap.spmv(x.astype(np.float64))
+    y = np.asarray(windowed_ell_spmv(
+        W.window_starts, W.cols_local, W.vals, jnp.asarray(x),
+        W.win, W.shape[0], interpret=True))
+    np.testing.assert_allclose(y, y_ref, rtol=2e-4)
+
+
+def test_rcm_shrinks_windows():
+    A, _ = _small_fe(n=4000, seed=3)
+    W_raw = csr_to_windowed_ell(A, jnp.float32)
+    perm = cuthill_mckee(A)
+    W_rcm = csr_to_windowed_ell(permute(A, perm), jnp.float32)
+    assert W_rcm is not None
+    # RCM must genuinely shrink the per-tile column span on a kNN graph
+    # (review r3: the pre-fix window computation made this vacuous)
+    if W_raw is not None:
+        assert W_rcm.win < W_raw.win
+    assert W_rcm.win < 4000 // _TILE * _WIN_ALIGN + 2 * _WIN_ALIGN
+
+
+def test_to_device_auto_picks_windowed_for_banded_irregular():
+    A, _ = _small_fe(n=4096, seed=4)
+    Ap = permute(A, cuthill_mckee(A))
+    M = dev.to_device(Ap, "auto", jnp.float32, dense_cutoff=256)
+    # irregular (not DIA-eligible at CPU thresholds) but banded -> windowed
+    assert isinstance(M, WindowedEllMatrix)
+    x = np.random.RandomState(2).rand(A.nrows)
+    np.testing.assert_allclose(
+        np.asarray(M.mv(jnp.asarray(x, dtype=jnp.float32))),
+        Ap.spmv(x), rtol=2e-4)
+
+
+def test_amg_solve_fe_like():
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = _small_fe(n=5000, seed=5)
+    Ap = permute(A, cuthill_mckee(A))
+    rhs_p = rhs[cuthill_mckee(A)]
+    solve = make_solver(Ap, AMGParams(dtype=jnp.float64), CG(tol=1e-8))
+    x, info = solve(rhs_p)
+    r = rhs_p - Ap.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs_p) < 1e-6
