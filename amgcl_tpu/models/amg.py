@@ -154,11 +154,46 @@ class AMG:
 
     def _build(self, A: CSR):
         prm = self.prm
+        self._device_built = False
+        self._dev_prefix = []
+        n_prefix = 0
+        eps_override = None
+        if self._device_filter is None:
+            # whole-hierarchy device setup for stencil problems: every
+            # level's filter/smoother/Galerkin runs on the accelerator and
+            # the level operators are born device-resident
+            # (ops/stencil_device.py); None -> host path, same numerics
+            from amgcl_tpu.ops import stencil_device as sdev
+            if sdev.enabled():
+                got = sdev.device_build(A, prm)
+                if got is not None:
+                    self._device_built = True
+                    meta_rows = [(m_, None, None) for m_ in got["meta"]]
+                    # keep the REAL fine-level CSR in row 0 — consumers
+                    # (pyamgcl_compat, adapters) read host_levels[0][0]
+                    # as the system matrix
+                    meta_rows[0] = (A, None, None)
+                    if got["leftover"] is None:
+                        self.hierarchy = Hierarchy(
+                            got["levels"], got["coarse"], prm.npre,
+                            prm.npost, prm.ncycle, prm.pre_cycles)
+                        self.host_levels = meta_rows
+                        return
+                    # hybrid: SA stencil growth moved past the
+                    # diagonal-pair regime — continue with the classic
+                    # (SpGEMM) loop from the downloaded coarse level
+                    self._dev_prefix = got["levels"]
+                    self._meta_prefix = meta_rows[:-1]
+                    n_prefix = len(self._dev_prefix)
+                    A = got["leftover"]
+                    eps_override = got["eps_next"]
         coarsening = prm.coarsening
         # per-build state (eps_strong decay, coarse nullspace, grid dims)
         # lives in this context dict, NOT on the policy object — building
         # twice from one params object produces identical hierarchies
         ctx = {}
+        if eps_override is not None:
+            ctx["eps_strong"] = eps_override
         if getattr(coarsening, "setup_dtype", False) is None:
             # a <=32-bit device hierarchy lets the stencil setup algebra
             # run in float32 — same convergence, half the memory traffic
@@ -171,7 +206,7 @@ class AMG:
         host = []
         Acur = A
         while (Acur.nrows * Acur.block_size[0] > prm.coarse_enough
-               and len(host) + 1 < prm.max_levels):
+               and n_prefix + len(host) + 1 < prm.max_levels):
             try:
                 P, R = coarsening.transfer_operators(Acur, ctx)
             except ValueError:
@@ -182,7 +217,7 @@ class AMG:
             host.append((Acur, P, R))
             Acur = Ac
         host.append((Acur, None, None))
-        self.host_levels = host
+        self.host_levels = (self._meta_prefix + host) if n_prefix else host
         self._coarse_op = coarsening.coarse_operator
         self._to_device_levels()
 
@@ -196,6 +231,11 @@ class AMG:
             A = CSR.from_scipy(A)
         if A.shape != self.host_levels[0][0].shape:
             raise ValueError("rebuild requires the same matrix dimensions")
+        if getattr(self, "_device_built", False):
+            # device-built hierarchies redo the whole (cheap, on-device)
+            # build; the transfer structure is re-derived identically
+            self._build(A)
+            return
         host = []
         Acur = A
         for (_, P, R) in self.host_levels[:-1]:
@@ -210,7 +250,13 @@ class AMG:
         host = self.host_levels
         dtype = prm.dtype
         dev_levels = []
+        prefix = getattr(self, "_dev_prefix", [])
         for i, (Ai, P, R) in enumerate(host[:-1]):
+            if i < len(prefix):
+                # device-built level (ops/stencil_device.py) — already
+                # device-resident, host row is bookkeeping metadata only
+                dev_levels.append(prefix[i])
+                continue
             if self._device_filter is not None and not self._device_filter(
                     i, Ai.nrows * Ai.block_size[0], False):
                 dev_levels.append(Level(None, None, None, None))

@@ -245,6 +245,8 @@ def precond_from_config(A, pcfg: Dict[str, Any]):
             A, _parse_pmask(pcfg, n), usolver_prm=uprm, psolver_prm=pprm,
             usolver=usol, psolver=psol,
             simplec_dia=_parse_bool(pcfg.get("simplec_dia", True)),
+            approx_schur=_parse_bool(pcfg.get("approx_schur", False)),
+            adjust_p=int(pcfg.get("adjust_p", 1)),
             dtype=dtype)
     if pclass == "cpr":
         from amgcl_tpu.models.cpr import CPR, CPRDRS

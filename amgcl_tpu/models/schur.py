@@ -11,12 +11,17 @@ the preconditioner applies
     p = Psolve( fp − Kpu · Usolve(fu) )
     u = Usolve( fu − Kup · p )
 
-where Psolve runs on the approximate Schur complement
-S = Kpp − Kpu · diag(Kuu)⁻¹ · Kup (the ``approx_schur``/``simplec_dia``
-options choose the diagonal approximation) and Usolve on Kuu. Both inner
-solvers are full make_solver stacks whose solve loops trace into the outer
-program; the u/p split is a pair of device gathers with host-precomputed
-index maps (the reference's pmask scatter).
+where Psolve solves with the Schur complement S = Kpp − Kpu Kuu⁻¹ Kup
+applied MATRIX-FREE (schur_pressure_correction.hpp:258-283):
+
+- ``approx_schur``: the inner Kuu⁻¹ inside S·x is replaced by the diagonal
+  approximation M = dia(Kuu)⁻¹ (one vmul instead of a nested usolver call);
+- ``simplec_dia``: M uses the row-sum of |Kuu| (SIMPLEC) instead of the
+  diagonal (hpp:429-441);
+- ``adjust_p``: which matrix the pressure AMG is BUILT on (hpp:443-496):
+  0 = Kpp, 1 = Kpp − dia(Kpu M Kup) (default), 2 = Kpp − Kpu M Kup.
+  For 1 the subtracted diagonal Ld is added back in S·x; for 2 the S·x
+  base uses the unmodified Kpp (hpp:264-271).
 """
 
 from __future__ import annotations
@@ -34,6 +39,81 @@ from amgcl_tpu.solver.cg import CG
 from amgcl_tpu.solver.preonly import PreOnly
 
 
+def kuu_dinv(Kuu: CSR, simplec_dia: bool) -> np.ndarray:
+    """Inverted Kuu diagonal approximation M (hpp:429-441): SIMPLEC row
+    |·| sums or the plain diagonal."""
+    if simplec_dia:
+        duu = np.asarray(abs(Kuu.to_scipy()).sum(axis=1)).ravel()
+    else:
+        duu = Kuu.diagonal().real
+    return 1.0 / np.where(duu != 0, duu, 1.0)
+
+
+def schur_pressure_build(Kpp_s, Kpu_s, Kup_s, dinv, adjust_p):
+    """(p_build, Ld): the matrix the pressure hierarchy is built on and,
+    for adjust_p=1, the subtracted diagonal (hpp:443-496). Shared by the
+    serial and distributed constructors. adjust_p=1 computes
+    diag(Kpu M Kup) without the SpGEMM: diag_i = Σ_k Kpu[i,k]·M[k]·Kup[k,i]
+    is an elementwise product of Kpu·M with Kupᵀ row-summed."""
+    import scipy.sparse as sp
+    if adjust_p == 1:
+        Ldv = np.asarray(
+            Kpu_s.multiply(dinv[None, :])
+            .multiply(Kup_s.T.tocsr()).sum(axis=1)).ravel()
+        return (Kpp_s - sp.diags(Ldv)).tocsr(), Ldv
+    if adjust_p == 2:
+        return (Kpp_s - (Kpu_s.multiply(dinv[None, :]) @ Kup_s)).tocsr(), \
+            None
+    return Kpp_s.tocsr(), None
+
+
+@register_pytree_node_class
+class SchurOperator:
+    """Matrix-free Schur complement: y = S x (the operator the psolver
+    iterates with; reference spmv at schur_pressure_correction.hpp:258-283).
+    ``base`` is Kpp (possibly diagonally adjusted), ``Ld`` restores the
+    adjust_p=1 diagonal, ``M`` is the inverted (simplec) Kuu diagonal."""
+
+    def __init__(self, base, Ld, Kup, Kpu, M, u_hier, usolver,
+                 approx_schur):
+        self.base = base
+        self.Ld = Ld
+        self.Kup = Kup
+        self.Kpu = Kpu
+        self.M = M
+        self.u_hier = u_hier
+        self.usolver = usolver
+        self.approx_schur = bool(approx_schur)
+        self.shape = base.shape
+
+    def tree_flatten(self):
+        return ((self.base, self.Ld, self.Kup, self.Kpu, self.M,
+                 self.u_hier), (self.usolver, self.approx_schur))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children[:6], aux[0], aux[1])
+
+    @property
+    def dtype(self):
+        return self.base.dtype
+
+    def mv(self, x):
+        y = self.base.mv(x)
+        if self.Ld is not None:
+            y = y + self.Ld * x
+        t = dev.spmv(self.Kup, x)
+        if self.approx_schur:
+            u = self.M * t
+        else:
+            u = self.usolver.solve(self.u_hier.system_matrix,
+                                   self.u_hier.apply, t)[0]
+        return y - dev.spmv(self.Kpu, u)
+
+    def bytes(self):
+        return 0
+
+
 @register_pytree_node_class
 class SchurHierarchy:
     """Traceable preconditioner state for the Schur correction."""
@@ -44,7 +124,7 @@ class SchurHierarchy:
         self.Kuu = Kuu
         self.Kup = Kup
         self.Kpu = Kpu
-        self.S = S
+        self.S = S                  # SchurOperator (matrix-free)
         self.u_hier = u_hier
         self.p_hier = p_hier
         self.u_idx = u_idx
@@ -90,15 +170,19 @@ class SchurPressureCorrection:
     ``psolver_prm``: AMGParams for the two inner hierarchies.
     ``usolver``/``psolver``: inner Krylov objects — default a single
     preconditioner application (PreOnly), the reference's typical nested
-    configuration; ``simplec_dia`` uses the row-sum magnitude instead of
-    the diagonal for the Schur approximation."""
+    configuration. ``simplec_dia``/``approx_schur``/``adjust_p`` follow
+    the reference's params (see module docstring)."""
 
     def __init__(self, A, pmask, usolver_prm: Optional[AMGParams] = None,
                  psolver_prm: Optional[AMGParams] = None,
                  usolver: Any = None, psolver: Any = None,
-                 simplec_dia: bool = True, dtype=jnp.float32):
+                 simplec_dia: bool = True, approx_schur: bool = False,
+                 adjust_p: int = 1, dtype=jnp.float32):
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
+        if adjust_p not in (0, 1, 2):
+            raise ValueError("adjust_p must be 0, 1 or 2 (got %r)"
+                             % (adjust_p,))
         pmask = np.asarray(pmask, dtype=bool)
         if pmask.shape != (A.nrows,):
             raise ValueError("pmask must have one entry per row (%d), got %s"
@@ -109,40 +193,50 @@ class SchurPressureCorrection:
                 "correction needs a proper 2x2 split"
                 % (int(pmask.sum()), A.nrows))
         self.dtype = dtype
+        self.approx_schur = bool(approx_schur)
+        self.adjust_p = int(adjust_p)
         m = A.to_scipy()
         ui = np.flatnonzero(~pmask)
         pi = np.flatnonzero(pmask)
         Kuu = CSR.from_scipy(m[ui][:, ui].tocsr())
         Kup = CSR.from_scipy(m[ui][:, pi].tocsr())
         Kpu = CSR.from_scipy(m[pi][:, ui].tocsr())
-        Kpp = CSR.from_scipy(m[pi][:, pi].tocsr())
+        Kpp_s = m[pi][:, pi].tocsr()
 
-        # approximate Schur complement (host, sparse):
-        # S = Kpp - Kpu * Duu^-1 * Kup
-        if simplec_dia:
-            # SIMPLEC: row-sum of |Kuu| (reference prm.simplec_dia)
-            duu = np.asarray(abs(Kuu.to_scipy()).sum(axis=1)).ravel()
-        else:
-            duu = Kuu.diagonal().real
-        dinv = 1.0 / np.where(duu != 0, duu, 1.0)
-        Sm = Kpp.to_scipy() - (Kpu.to_scipy()
-                               .multiply(dinv[None, :]) @ Kup.to_scipy())
-        S = CSR.from_scipy(Sm.tocsr())
+        dinv = kuu_dinv(Kuu, simplec_dia)
+
+        # pressure-side build matrix per adjust_p (hpp:443-496)
+        p_build, Ldv = schur_pressure_build(
+            Kpp_s, Kpu.to_scipy(), Kup.to_scipy(), dinv, adjust_p)
+        Ld_dev = None if Ldv is None else jnp.asarray(Ldv, dtype=dtype)
+        # S·x base: the adjusted matrix for adjust_p=1 (Ld restores it),
+        # the unmodified Kpp otherwise (hpp:264-271)
+        Kpp_base = p_build if adjust_p == 1 else Kpp_s
+        p_build.sort_indices()
+        P_build = CSR.from_scipy(p_build)
 
         uprm = usolver_prm or AMGParams(dtype=dtype)
         pprm = psolver_prm or AMGParams(dtype=dtype)
         self.u_amg = AMG(Kuu, uprm)
-        self.p_amg = AMG(S, pprm)
+        self.p_amg = AMG(P_build, pprm)
+        usol = usolver or PreOnly()
+        psol = psolver or PreOnly()
+        Kup_dev = dev.to_device(Kup, "ell", dtype)
+        Kpu_dev = dev.to_device(Kpu, "ell", dtype)
+        Kpp_base.sort_indices()
+        S_op = SchurOperator(
+            dev.to_device(CSR.from_scipy(Kpp_base), "auto", dtype),
+            Ld_dev, Kup_dev, Kpu_dev,
+            jnp.asarray(dinv, dtype=dtype),
+            self.u_amg.hierarchy, usol, approx_schur)
         self.hierarchy = SchurHierarchy(
             dev.to_device(A, "auto", dtype),
             dev.to_device(Kuu, "auto", dtype),
-            dev.to_device(Kup, "ell", dtype),
-            dev.to_device(Kpu, "ell", dtype),
-            dev.to_device(S, "auto", dtype),
+            Kup_dev, Kpu_dev, S_op,
             self.u_amg.hierarchy, self.p_amg.hierarchy,
             jnp.asarray(ui, dtype=jnp.int32),
             jnp.asarray(pi, dtype=jnp.int32),
-            usolver or PreOnly(), psolver or PreOnly())
+            usol, psol)
 
     def __repr__(self):
         return ("schur_pressure_correction\n[ U ]\n%r\n[ P ]\n%r"

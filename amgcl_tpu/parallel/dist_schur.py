@@ -78,9 +78,16 @@ class DistSchurSolver(DistAMGSolver):
     def __init__(self, A, mesh, pmask, usolver_prm: Optional[AMGParams] = None,
                  psolver_prm: Optional[AMGParams] = None,
                  solver: Any = None, simplec_dia: bool = True,
-                 dtype=jnp.float32):
+                 adjust_p: int = 2, dtype=jnp.float32):
+        """``adjust_p`` picks the matrix the pressure hierarchy is built on
+        (reference: schur_pressure_correction.hpp:443-496): 0 = Kpp,
+        1 = Kpp − dia(Kpu M Kup), 2 = Kpp − Kpu M Kup (full product —
+        the historical default here; the distributed psolve is a single
+        AMG cycle, so the build matrix IS the p-side operator)."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
+        if adjust_p not in (0, 1, 2):
+            raise ValueError("adjust_p must be 0, 1 or 2")
         pmask = np.asarray(pmask, dtype=bool)
         if pmask.shape != (A.nrows,) or not pmask.any() or pmask.all():
             raise ValueError("pmask must split the rows into two nonempty "
@@ -98,14 +105,11 @@ class DistSchurSolver(DistAMGSolver):
         Kup = CSR.from_scipy(m[ui][:, pi].tocsr())
         Kpu = CSR.from_scipy(m[pi][:, ui].tocsr())
         Kpp = CSR.from_scipy(m[pi][:, pi].tocsr())
-        if simplec_dia:
-            duu = np.asarray(abs(Kuu.to_scipy()).sum(axis=1)).ravel()
-        else:
-            duu = Kuu.diagonal().real
-        dinv = 1.0 / np.where(duu != 0, duu, 1.0)
-        S = CSR.from_scipy((Kpp.to_scipy() - (Kpu.to_scipy()
-                            .multiply(dinv[None, :]) @ Kup.to_scipy()))
-                           .tocsr())
+        from amgcl_tpu.models.schur import kuu_dinv, schur_pressure_build
+        dinv = kuu_dinv(Kuu, simplec_dia)
+        Sm, _ = schur_pressure_build(
+            Kpp.to_scipy(), Kpu.to_scipy(), Kup.to_scipy(), dinv, adjust_p)
+        S = CSR.from_scipy(Sm)
 
         self.u_solver = DistAMGSolver(Kuu, mesh,
                                       usolver_prm or AMGParams(dtype=dtype))

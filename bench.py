@@ -519,6 +519,16 @@ def main_worker():
         from amgcl_tpu.utils.axon_guard import force_cpu_backend
         force_cpu_backend()
     import jax
+    # persistent compilation cache: opportunistic runs during the round
+    # pre-warm every per-level setup program and the solve program, so a
+    # later driver-invoked run at the same shapes skips compilation
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(_REPO, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
     # x64 so the refinement's outer residual really is float64 (the
     # correction solves stay float32)
     jax.config.update("jax_enable_x64", True)

@@ -86,3 +86,44 @@ def test_cpr_rejects_scalar():
     A, _ = poisson3d(6)
     with pytest.raises(ValueError, match="block"):
         CPR(A)
+
+
+@pytest.mark.parametrize("approx_schur,adjust_p", [
+    (True, 0), (True, 1), (True, 2), (False, 1), (False, 2)])
+def test_schur_param_variants(approx_schur, adjust_p):
+    """approx_schur / adjust_p parity (reference:
+    schur_pressure_correction.hpp:106-130, 258-283, 443-496)."""
+    A, pmask = stokes_like(10)
+    rhs = np.ones(A.nrows)
+    pre = SchurPressureCorrection(
+        A, pmask,
+        usolver_prm=AMGParams(dtype=jnp.float64, coarse_enough=100),
+        psolver_prm=AMGParams(dtype=jnp.float64, coarse_enough=100),
+        # an actual inner p-Krylov so the matrix-free S operator (and thus
+        # approx_schur) is exercised, not just the build matrix
+        psolver=FGMRES(maxiter=8, tol=1e-2),
+        approx_schur=approx_schur, adjust_p=adjust_p,
+        dtype=jnp.float64)
+    solve = make_solver(A, pre, FGMRES(maxiter=300, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_schur_runtime_params():
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    A, pmask = stokes_like(8)
+    rhs = np.ones(A.nrows)
+    solve = make_solver_from_config(A, {
+        "precond.class": "schur",
+        "precond.approx_schur": "true",
+        "precond.adjust_p": "0",
+        "precond.simplec_dia": "false",
+        "precond.dtype": "float64",
+        "precond.pmask_pattern": ">%d" % int((~pmask).sum()),
+        "solver.type": "fgmres", "solver.maxiter": "300",
+        "solver.tol": "1e-8"})
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
