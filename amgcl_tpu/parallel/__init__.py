@@ -10,6 +10,8 @@ amgcl/mpi/distributed_matrix.hpp:316-557, amgcl/mpi/inner_product.hpp:45-67).
 from amgcl_tpu.parallel.mesh import make_mesh, ROWS_AXIS
 from amgcl_tpu.parallel.dist_ell import DistEllMatrix, build_dist_ell
 from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix, dist_inner_product
+from amgcl_tpu.parallel.dist_stencil import (DistStencilSolver,
+                                             dist_stencil_build)
 from amgcl_tpu.parallel.dist_solver import dist_cg
 from amgcl_tpu.parallel.dist_amg import DistAMGSolver
 from amgcl_tpu.parallel.deflation import DistDeflatedSolver
@@ -20,4 +22,4 @@ from amgcl_tpu.parallel.dist_schur import DistSchurSolver
 __all__ = ["make_mesh", "ROWS_AXIS", "DistEllMatrix", "build_dist_ell",
            "DistDiaMatrix", "dist_inner_product", "dist_cg", "DistAMGSolver",
            "DistDeflatedSolver", "DistBlockPreconditioner", "DistCPRSolver",
-           "DistSchurSolver"]
+           "DistSchurSolver", "DistStencilSolver", "dist_stencil_build"]

@@ -81,27 +81,69 @@ class DistDiaMatrix:
     # -- the per-shard kernel (runs inside shard_map) -----------------------
 
     def shard_mv(self, data_local, x_local):
-        """Overlapped halo SpMV on one shard: ppermute edges in, local DIA
-        product (the exchange and the interior FMAs are independent — XLA
-        schedules them concurrently, like the reference's
-        start_exchange/local-spmv/finish_exchange split)."""
-        w = self.halo
-        nloc = x_local.shape[0]
-        if w > 0:
-            nd = jax.lax.axis_size(ROWS_AXIS)
+        """Overlapped halo SpMV on one shard (see dia_halo_mv)."""
+        return dia_halo_mv(data_local, self.offsets, x_local)
+
+
+def dia_halo_mv(data_l, flat_offs, x_l):
+    """y = A x on one shard with comm/compute overlap.
+
+    The reference overlaps explicitly: start_exchange → local SpMV →
+    finish_exchange → remote SpMV (amgcl/mpi/distributed_matrix.hpp:520-534).
+    The XLA rendition makes the same split at the DATA-DEPENDENCE level:
+    the interior product (all rows, zero-filled shifts — wrong only in the
+    first/last ``w`` rows) reads ONLY x_local, so it shares no operands
+    with the ppermute and XLA's async-collective scheduler can run it
+    while the edge exchange is in flight; the exact edge rows (2w of them,
+    a sliver) are recomputed from the halo and spliced in. A naive
+    concat(halo, x, halo) formulation would make EVERY fused
+    multiply-add a consumer of the collective and serialize the step
+    (structure asserted by tests/test_distributed overlap-HLO test)."""
+    w = max(max(flat_offs), -min(flat_offs), 0) if flat_offs else 0
+    nl = x_l.shape[0]
+    acc_dt = jnp.result_type(data_l.dtype, x_l.dtype)
+    if w == 0:
+        return sum(data_l[k] * x_l for k in range(len(flat_offs))) \
+            if flat_offs else jnp.zeros(nl, acc_dt)
+
+    nd = jax.lax.axis_size(ROWS_AXIS)
+    if nd == 1 or 2 * w >= nl:
+        # degenerate split: plain haloed product (single shard, or shard
+        # too thin for an interior region)
+        if nd == 1:
+            xe = jnp.pad(x_l, (w, w))
+        else:
             fwd = [(i, i + 1) for i in range(nd - 1)]
             bwd = [(i + 1, i) for i in range(nd - 1)]
-            prev_tail = lax.ppermute(x_local[-w:], ROWS_AXIS, fwd)
-            next_head = lax.ppermute(x_local[:w], ROWS_AXIS, bwd)
-            xp = jnp.concatenate([prev_tail, x_local, next_head])
-        else:
-            xp = x_local
-        y = jnp.zeros(nloc, dtype=jnp.result_type(data_local.dtype,
-                                                  x_local.dtype))
-        for k, dofs in enumerate(self.offsets):
-            seg = lax.dynamic_slice(xp, (w + dofs,), (nloc,))
-            y = y + data_local[k] * seg
+            prev_tail = lax.ppermute(x_l[-w:], ROWS_AXIS, fwd)
+            next_head = lax.ppermute(x_l[:w], ROWS_AXIS, bwd)
+            xe = jnp.concatenate([prev_tail, x_l, next_head])
+        y = jnp.zeros(nl, dtype=acc_dt)
+        for k, s in enumerate(flat_offs):
+            y = y + data_l[k] * lax.dynamic_slice(xe, (w + s,), (nl,))
         return y
+
+    fwd = [(i, i + 1) for i in range(nd - 1)]
+    bwd = [(i + 1, i) for i in range(nd - 1)]
+    prev_tail = lax.ppermute(x_l[-w:], ROWS_AXIS, fwd)   # in flight ...
+    next_head = lax.ppermute(x_l[:w], ROWS_AXIS, bwd)
+
+    # ... while the interior streams: zero-filled local shifts, valid for
+    # rows [w, nl-w)
+    xp = jnp.pad(x_l, (w, w))
+    y0 = jnp.zeros(nl, dtype=acc_dt)
+    for k, s in enumerate(flat_offs):
+        y0 = y0 + data_l[k] * lax.dynamic_slice(xp, (w + s,), (nl,))
+
+    # exact edge rows from the received halo (2w rows, O(w·ndiag) work)
+    xe = jnp.concatenate([prev_tail, x_l, next_head])
+    lo = jnp.zeros(w, dtype=acc_dt)
+    hi = jnp.zeros(w, dtype=acc_dt)
+    for k, s in enumerate(flat_offs):
+        lo = lo + data_l[k, :w] * lax.dynamic_slice(xe, (w + s,), (w,))
+        hi = hi + data_l[k, nl - w:] * lax.dynamic_slice(
+            xe, (nl + s,), (w,))
+    return jnp.concatenate([lo, y0[w:nl - w], hi])
 
 
 def dist_inner_product(x_local, y_local):

@@ -510,6 +510,77 @@ def _bench_unstructured(on_tpu):
     return out
 
 
+def _bench_extra_configs(on_tpu):
+    """Compact analogues of BASELINE configs 3 (Serena-class: block value
+    type) and 4 (Stokes-class: schur_pressure_correction). The real
+    SuiteSparse matrices are not redistributable in this image, so these
+    are generated systems of the same class; timings are absolute (no
+    vs_baseline), chained like the headline measurement."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import scipy.sparse as sp
+    from amgcl_tpu.ops.csr import CSR
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+    from amgcl_tpu.solver.gmres import FGMRES
+    from amgcl_tpu.models.schur import SchurPressureCorrection
+    from amgcl_tpu.utils.sample_problem import poisson3d_block
+
+    out = {}
+
+    def timed_solve(solver, rhs):
+        x, info = solver(rhs)            # compile + warm
+        jax.block_until_ready(x)
+        t0 = time.perf_counter()
+        x, info = solver(rhs)
+        jax.block_until_ready(x)
+        return time.perf_counter() - t0, info
+
+    # config-3 analogue: block 3x3 system, SA + spai0 + BiCGStab
+    try:
+        n = int(os.environ.get("AMGCL_TPU_BENCH_BLOCK_N", "48"))
+        A, rhs = poisson3d_block(n, 3)
+        s = make_solver(A, AMGParams(dtype=jnp.float32),
+                        BiCGStab(maxiter=200, tol=1e-6))
+        t, info = timed_solve(s, jnp.asarray(rhs, jnp.float32))
+        out["block3_n%d" % n] = {
+            "rows": A.nrows * 3, "solve_s": round(t, 4),
+            "iters": int(info.iters), "resid": float(info.resid)}
+    except Exception as e:
+        out["block3"] = {"error": repr(e)}
+
+    # config-4 analogue: stabilized Stokes saddle point + Schur PC + FGMRES
+    try:
+        n = int(os.environ.get("AMGCL_TPU_BENCH_STOKES_N", "48"))
+        T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                     [-1, 0, 1])
+        L = (sp.kron(sp.identity(n), T)
+             + sp.kron(T, sp.identity(n))).tocsr()
+        nu = L.shape[0]
+        Av = sp.block_diag([L, L]).tocsr()
+        D = sp.diags([-np.ones(nu - 1), np.ones(nu)], [-1, 0],
+                     shape=(nu, nu))
+        B = sp.hstack([D, 0.5 * D]).tocsr()
+        K = sp.bmat([[Av, B.T], [B, -sp.identity(nu) * 1e-2]]).tocsr()
+        pmask = np.zeros(K.shape[0], dtype=bool)
+        pmask[2 * nu:] = True
+        Ks = CSR.from_scipy(K)
+        pre = SchurPressureCorrection(
+            Ks, pmask, usolver_prm=AMGParams(dtype=jnp.float32),
+            psolver_prm=AMGParams(dtype=jnp.float32),
+            approx_schur=True, dtype=jnp.float32)
+        s = make_solver(Ks, pre, FGMRES(maxiter=300, tol=1e-6))
+        t, info = timed_solve(s, np.ones(Ks.nrows))
+        out["stokes_schur_n%d" % n] = {
+            "rows": Ks.nrows, "solve_s": round(t, 4),
+            "iters": int(info.iters), "resid": float(info.resid)}
+    except Exception as e:
+        out["stokes_schur"] = {"error": repr(e)}
+    return out
+
+
 def main_worker():
     _stage("device init")
     _worker_watchdog()
@@ -636,6 +707,12 @@ def main_worker():
             _PARTIAL["unstructured"] = _bench_unstructured(on_tpu)
         except Exception as e:
             _PARTIAL["unstructured"] = {"error": repr(e)}
+    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_EXTRA") == "1":
+        _stage("block + stokes configs")
+        try:
+            _PARTIAL["extra_configs"] = _bench_extra_configs(on_tpu)
+        except Exception as e:
+            _PARTIAL["extra_configs"] = {"error": repr(e)}
     out = {"metric": _METRIC, "unit": "s"}
     out.update(_PARTIAL)
     if levels is not None:
