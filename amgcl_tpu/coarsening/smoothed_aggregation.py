@@ -151,7 +151,10 @@ class SmoothedAggregation:
         Ac = galerkin(A, P, R)
         g = None if ctx is None else ctx.pop("next_grid", None)
         if g is not None:
-            Ac._grid_dims = tuple(g)   # next level detects the grid for free
+            # detect_grid_csr validates prod(dims) == nrows on read, so a
+            # stale hint (ctx reused with a different coarse operator) is
+            # discarded there rather than corrupting grid detection
+            Ac._grid_dims = tuple(g)
         return Ac
 
 

@@ -117,7 +117,7 @@ def detect_grid_csr(A: CSR, max_radius=2):
     if A.is_block or A.nrows != A.ncols:
         return None
     hint = getattr(A, "_grid_dims", None)
-    if hint is not None:
+    if hint is not None and int(np.prod(hint)) == A.nrows:
         return tuple(hint)
     from amgcl_tpu.ops.device import _dia_offsets
     offs = _dia_offsets(A)

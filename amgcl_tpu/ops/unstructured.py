@@ -84,7 +84,8 @@ class WindowedEllMatrix:
         if (pallas_enabled() and jax.default_backend() == "tpu"
                 and jnp.dtype(self.dtype).itemsize <= 4
                 and jnp.dtype(x.dtype).itemsize <= 4
-                and kernel_supported()):
+                and kernel_supported(self.win, self.cols_local.shape[2],
+                                     self.dtype)):
             return windowed_ell_spmv(
                 self.window_starts, self.cols_local, self.vals, x,
                 self.win, self.shape[0])
@@ -108,32 +109,33 @@ class WindowedEllMatrix:
                 + self.window_starts.size * 4)
 
 
-_KERNEL_OK = None
+_KERNEL_OK = {}
 
 
-def kernel_supported() -> bool:
-    """Probe-compile the windowed kernel once per process on the current
-    backend: the in-kernel VMEM gather needs Mosaic support that may vary
-    by TPU generation. mv() cannot use try/except — inside an outer jit a
-    legalization failure only surfaces at the OUTER compile — so the path
-    choice is made here, eagerly, with a tiny instance."""
-    global _KERNEL_OK
-    if _KERNEL_OK is None:
+def kernel_supported(win: int = 2 << 20, K: int = 4,
+                     dtype=jnp.float32) -> bool:
+    """Probe-compile the windowed kernel on the current backend for THIS
+    matrix's VMEM footprint (window size, tile width K, value dtype): the
+    in-kernel gather needs Mosaic support that may vary by TPU
+    generation, and VMEM-pressure failures depend on the window scratch
+    plus the (tile, K) cols/vals blocks. mv() cannot use try/except —
+    inside an outer jit a legalization failure only surfaces at the
+    OUTER compile — so the path choice is made here, eagerly. Results
+    are cached per (win, K, dtype)."""
+    key = (int(win), int(K), jnp.dtype(dtype).name)
+    if key not in _KERNEL_OK:
         try:
-            # probe with a realistic 4 MB window so VMEM-pressure failures
-            # surface here, not at solver-jit time
-            win = 1 << 20
             starts = jnp.zeros(1, jnp.int32)
-            cols = jnp.zeros((1, _TILE, 4), jnp.int32)
-            vals = jnp.zeros((1, _TILE, 4), jnp.float32)
-            x = jnp.zeros(win, jnp.float32)
+            cols = jnp.zeros((1, _TILE, int(K)), jnp.int32)
+            vals = jnp.zeros((1, _TILE, int(K)), dtype)
+            x = jnp.zeros(int(win), jnp.float32)
             jax.jit(functools.partial(
-                windowed_ell_spmv, win=win, n_out=_TILE)
+                windowed_ell_spmv, win=int(win), n_out=_TILE)
             ).lower(starts, cols, vals, x).compile()
-            _KERNEL_OK = True
+            _KERNEL_OK[key] = True
         except Exception:
-            _KERNEL_OK = False
-    return _KERNEL_OK
+            _KERNEL_OK[key] = False
+    return _KERNEL_OK[key]
 
 
 @functools.partial(jax.jit,

@@ -107,6 +107,21 @@ def dia_halo_mv(data_l, flat_offs, x_l):
             if flat_offs else jnp.zeros(nl, acc_dt)
 
     nd = jax.lax.axis_size(ROWS_AXIS)
+    if nd > 1 and w > nl:
+        # Diagonal reach exceeds one neighbour slab: a single ring
+        # exchange cannot supply the halo (x_l[-w:] would clamp to nl
+        # elements and silently misalign every subsequent slice).  Only
+        # reachable on very thin coarse slabs, so assembling the global
+        # vector is cheap — gather it and slice at the shard's global
+        # row offset.
+        xg = lax.all_gather(x_l, ROWS_AXIS, tiled=True)
+        base = lax.axis_index(ROWS_AXIS) * nl
+        xe = jnp.pad(xg, (w, w))
+        y = jnp.zeros(nl, dtype=acc_dt)
+        for k, s in enumerate(flat_offs):
+            y = y + data_l[k] * lax.dynamic_slice(xe, (w + base + s,),
+                                                  (nl,))
+        return y
     if nd == 1 or 2 * w >= nl:
         # degenerate split: plain haloed product (single shard, or shard
         # too thin for an interior region)

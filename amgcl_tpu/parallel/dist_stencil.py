@@ -435,6 +435,17 @@ def dist_stencil_build(A: CSR, mesh, prm, rep_coarse_enough: int = 3000):
         if (n <= rep_coarse_enough or len(offs) > _MAX_DIAGS
                 or d2 % (2 * nd) != 0 or lz % 2 != 0):
             break
+        # Halo-width guard: _halo_extend ships w elements across ONE ring
+        # hop, so w must not exceed the local slab (w > nl would make
+        # arr[:, -w:] silently clamp, and a coupling reaching past the
+        # immediate neighbour needs rows one ring hop cannot supply).  All
+        # halo widths used inside _sharded_level_setup derive from
+        # |flat(o)| over offs / af_offs / mt_offs, whose magnitudes
+        # coincide with offs + the main diagonal.
+        nl_guard = lz * dims[1] * dims[2]
+        hmax_l = max(max(abs(_flat(o, dims)) for o in offs), 1)
+        if hmax_l > nl_guard:
+            break
         blocks = tuple(2 if d > 1 else 1 for d in dims)
         coarse = tuple(-(-d // b) for d, b in zip(dims, blocks))
 

@@ -496,7 +496,8 @@ def _bench_unstructured(on_tpu):
     if W is not None:
         out["win"] = W.win
         out["well_xla_us"] = round(timeit(W._mv_xla), 1)
-        if on_tpu and kernel_supported():
+        if on_tpu and kernel_supported(W.win, W.cols_local.shape[2],
+                                       W.vals.dtype):
             from amgcl_tpu.ops.unstructured import windowed_ell_spmv
             out["well_pallas_us"] = round(timeit(
                 lambda v: windowed_ell_spmv(

@@ -106,3 +106,30 @@ def test_overlapped_dia_mv_matches_reference_product(mesh8):
         out_specs=P(ROWS_AXIS), check_vma=False)
     y = np.asarray(jax.jit(fn)(M.data, jnp.asarray(x)))
     np.testing.assert_allclose(y, A.spmv(x), rtol=1e-12)
+
+
+def test_dia_halo_mv_reach_beyond_neighbour(mesh8):
+    """w > nl: a diagonal reaching past the immediate neighbour slab must
+    fall back to the gather path, not silently clamp (round-3 advice)."""
+    rng = np.random.default_rng(0)
+    nd, nl = 8, 4
+    n = nd * nl
+    offs = (0, 6)            # reach 6 > nl=4: crosses TWO shards
+    data = rng.standard_normal((len(offs), n)).astype(np.float32)
+    x = rng.standard_normal(n).astype(np.float32)
+    # dense reference with zero-filled shift semantics
+    want = np.zeros(n, np.float32)
+    for k, s in enumerate(offs):
+        src = np.zeros(n, np.float32)
+        if s >= 0:
+            src[: n - s] = x[s:]
+        else:
+            src[-s:] = x[: n + s]
+        want += data[k] * src
+
+    fn = shard_map(
+        lambda d, v: dia_halo_mv(d, offs, v),
+        mesh=mesh8, in_specs=(P(None, ROWS_AXIS), P(ROWS_AXIS)),
+        out_specs=P(ROWS_AXIS), check_vma=False)
+    got = jax.jit(fn)(jnp.asarray(data), jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(got), want, rtol=2e-5, atol=2e-5)
