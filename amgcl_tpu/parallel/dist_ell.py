@@ -125,23 +125,50 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32, nloc=None,
             "partition override too small: %d rows/shard x %d shards < %d "
             "rows (or %d cols/shard < %d cols) — rows would be dropped"
             % (nloc, nd, n, ncloc, m))
-
     rows = np.repeat(np.arange(n), A.row_nnz())
-    owner = np.minimum(A.col // ncloc, nd - 1).astype(np.int64)
-    row_shard = np.minimum(rows // nloc, nd - 1).astype(np.int64)
-    is_local = owner == row_shard
+    triples = []
+    for s in range(nd):
+        # clamp: trailing shards may lie entirely in the padded range
+        r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
+        lo, hi = int(A.ptr[r0]), int(A.ptr[r1])
+        triples.append((rows[lo:hi] - r0, A.col[lo:hi], A.val[lo:hi]))
+    return build_dist_ell_strips(triples, mesh, (n, m), dtype, nloc, ncloc)
+
+
+def build_dist_ell_strips(triples, mesh, shape, dtype=jnp.float32,
+                          nloc=None, ncloc=None) -> DistEllMatrix:
+    """Same plan + packing as :func:`build_dist_ell`, but consuming
+    per-shard (rows_rel, cols_global, vals) triples directly — the
+    strip-parallel setup path (parallel/dist_setup.py) never assembles a
+    global CSR, so host peak memory stays one strip + its halo."""
+    nd = mesh.shape[ROWS_AXIS]
+    n, m = shape
+    nloc = -(-n // nd) if nloc is None else int(nloc)
+    ncloc = -(-m // nd) if ncloc is None else int(ncloc)
 
     # halo needs: for each (dst, src) pair the sorted unique global columns.
-    # One lexsort/group-by over the remote entries only — O(nnz_rem log),
-    # independent of the device count.
-    rem = np.flatnonzero(~is_local)
-    key_dst = row_shard[rem]
-    key_src = owner[rem]
-    key_col = A.col[rem].astype(np.int64)
-    # single source of the composite key: trip derives from rem_keys, and
-    # the same array drives the searchsorted position lookup below
-    rem_keys = (key_dst * nd + key_src) * (ncloc * nd) + key_col
-    trip = np.unique(rem_keys)
+    # Work is O(nnz_rem log) over BOUNDARY entries only.
+    rem_keys_per = []
+    splits = []
+    K1 = 1
+    K2 = 1
+    for s, (rr, cc, vv) in enumerate(triples):
+        owner = np.minimum(np.asarray(cc) // ncloc, nd - 1).astype(np.int64)
+        lm = owner == s
+        rem = ~lm
+        keys = ((np.int64(s) * nd + owner[rem]) * (ncloc * nd)
+                + np.asarray(cc)[rem].astype(np.int64))
+        rem_keys_per.append(keys)
+        splits.append(lm)
+        rl = np.asarray(rr)[lm]
+        if len(rl):
+            K1 = max(K1, int(np.bincount(rl).max()))
+        rm_ = np.asarray(rr)[rem]
+        if len(rm_):
+            K2 = max(K2, int(np.bincount(rm_).max()))
+
+    trip = np.unique(np.concatenate(rem_keys_per)) if rem_keys_per else \
+        np.zeros(0, np.int64)
     t_pair = trip // (ncloc * nd)
     t_dst = t_pair // nd
     t_src = t_pair % nd
@@ -158,47 +185,32 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32, nloc=None,
     send_idx = np.zeros((nd, nd, C), dtype=np.int32)
     send_idx[t_src, t_dst, grp_idx] = (t_col - t_src * ncloc).astype(np.int32)
 
-    # remote entry -> halo buffer position (buffer = concat over src of C
-    # padded slots): one searchsorted maps every entry at once.
-    loc_in_trip = np.searchsorted(trip, rem_keys)
-    halo_pos_full = np.zeros(A.nnz, dtype=np.int32)
-    halo_pos_full[rem] = (t_src[loc_in_trip] * C
-                          + grp_idx[loc_in_trip]).astype(np.int32)
+    # per-shard ELL packing; placement is per-part (no global host array)
+    val_dt = np.result_type(
+        *([np.asarray(t[2]).dtype for t in triples] + [np.float64]))
+    lcs, lvs, rcs, rvs = [], [], [], []
+    for s, (rr, cc, vv) in enumerate(triples):
+        rr = np.asarray(rr)
+        cc = np.asarray(cc)
+        vv = np.asarray(vv)
+        lm = splits[s]
+        rem = ~lm
+        c1, v1 = pack_rows_ell(rr[lm], cc[lm] - s * ncloc, vv[lm],
+                               nloc, K1)
+        # remote entry -> halo buffer position (buffer = concat over src of
+        # C padded slots)
+        loc_in_trip = np.searchsorted(trip, rem_keys_per[s])
+        halo_pos = (t_src[loc_in_trip] * C + grp_idx[loc_in_trip]) \
+            .astype(np.int32)
+        c2, v2 = pack_rows_ell(rr[rem], halo_pos, vv[rem], nloc, K2)
+        lcs.append(c1)
+        lvs.append(v1.astype(val_dt))
+        rcs.append(c2)
+        rvs.append(v2.astype(val_dt))
 
-    # per-shard ELL packing
-    K1 = 1
-    K2 = 1
-    loc_lists = []
-    rem_lists = []
-    for s in range(nd):
-        # clamp: trailing shards may lie entirely in the padded range
-        r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
-        lo, hi = int(A.ptr[r0]), int(A.ptr[r1])
-        rr = rows[lo:hi] - r0
-        cc = A.col[lo:hi]
-        vv = A.val[lo:hi]
-        lm = is_local[lo:hi]
-        loc_lists.append((rr[lm], cc[lm] - s * ncloc, vv[lm]))
-        rem_lists.append((rr[~lm], halo_pos_full[lo:hi][~lm], vv[~lm]))
-        if len(rr[lm]):
-            K1 = max(K1, int(np.bincount(rr[lm]).max()))
-        if len(rr[~lm]):
-            K2 = max(K2, int(np.bincount(rr[~lm]).max()))
-
-    def pack(lists, K):
-        cols = np.zeros((nd, nloc, K), dtype=np.int32)
-        vals = np.zeros((nd, nloc, K),
-                        dtype=np.result_type(A.val.dtype, np.float64))
-        for s, (rr, cc, vv) in enumerate(lists):
-            cols[s], vals[s] = pack_rows_ell(rr, cc, vv, nloc, K)
-        return cols, vals
-
-    lc, lv = pack(loc_lists, K1)
-    rc, rv = pack(rem_lists, K2)
-
-    from amgcl_tpu.parallel.mesh import put_sharded
-    put = lambda a, dt: put_sharded(a, mesh, dt)
+    from amgcl_tpu.parallel.mesh import put_sharded_parts
+    put = lambda parts, dt: put_sharded_parts(parts, mesh, dt)
     return DistEllMatrix(
-        put(lc, jnp.int32), put(lv, dtype), put(rc, jnp.int32),
-        put(rv, dtype), put(send_idx, jnp.int32),
+        put(lcs, jnp.int32), put(lvs, dtype), put(rcs, jnp.int32),
+        put(rvs, dtype), put([send_idx[s] for s in range(nd)], jnp.int32),
         (nloc * nd, ncloc * nd), nloc, ncloc)

@@ -46,6 +46,26 @@ def put_sharded(a, mesh, dtype=None, axis=ROWS_AXIS):
     return put_with_sharding(a, NamedSharding(mesh, spec))
 
 
+def put_sharded_parts(parts, mesh, dtype=None, axis=ROWS_AXIS):
+    """Per-shard host blocks -> one sharded array with leading dim
+    ``len(parts)``, WITHOUT materializing the concatenation: the callback
+    serves each device its own block, so host peak memory stays one part
+    (strip-parallel setup relies on this; under multi-controller each
+    process only ever sees its own parts)."""
+    nd = len(parts)
+    p0 = np.asarray(parts[0])
+    dt = np.dtype(dtype) if dtype is not None else p0.dtype
+    shape = (nd,) + p0.shape
+    spec = PartitionSpec(axis, *([None] * p0.ndim))
+
+    def cb(idx):
+        s = idx[0].start
+        return np.asarray(parts[0 if s is None else s], dtype=dt)[None]
+
+    return jax.make_array_from_callback(
+        shape, NamedSharding(mesh, spec), cb)
+
+
 def put_with_sharding(a, sharding):
     """Place a host numpy array under an arbitrary NamedSharding via the
     per-shard callback path (multi-controller-safe; no reshard compile)."""

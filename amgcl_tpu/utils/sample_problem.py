@@ -72,3 +72,24 @@ def convection_diffusion_2d(n: int, eps: float = 1e-2, dtype=np.float64):
     A = sp.csr_matrix(A.astype(dtype))
     A.sort_indices()
     return CSR.from_scipy(A), np.ones(n * n, dtype=dtype)
+
+
+def stokes_like(n: int):
+    """Stabilized Stokes-type saddle point [A Bt; B -eps M]: A the 2D
+    vector Laplacian, B a discrete divergence — the coupled-system fixture
+    for Schur pressure correction (reference examples: the cpr/schur docs
+    systems). Returns (CSR, pressure mask)."""
+    T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                 [-1, 0, 1])
+    L = (sp.kron(sp.identity(n), T) + sp.kron(T, sp.identity(n))).tocsr()
+    nu = L.shape[0]
+    A = sp.block_diag([L, L]).tocsr()            # two velocity components
+    D = sp.diags([-np.ones(nu - 1), np.ones(nu)], [-1, 0],
+                 shape=(nu, nu))
+    B = sp.hstack([D, 0.5 * D]).tocsr()          # (np_, 2nu)
+    eps = 1e-2
+    M = sp.identity(nu) * eps
+    K = sp.bmat([[A, B.T], [B, -M]]).tocsr()
+    pmask = np.zeros(K.shape[0], dtype=bool)
+    pmask[2 * nu:] = True
+    return CSR.from_scipy(K), pmask

@@ -12,26 +12,7 @@ from amgcl_tpu.models.schur import SchurPressureCorrection
 from amgcl_tpu.models.cpr import CPR, CPRDRS
 from amgcl_tpu.solver.gmres import FGMRES
 from amgcl_tpu.solver.bicgstab import BiCGStab
-from amgcl_tpu.utils.sample_problem import poisson3d
-
-
-def stokes_like(n):
-    """Stabilized Stokes-type saddle point: [A Bt; B -eps M] with A the
-    2D vector Laplacian and B a discrete divergence."""
-    T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
-                 [-1, 0, 1])
-    L = (sp.kron(sp.identity(n), T) + sp.kron(T, sp.identity(n))).tocsr()
-    nu = L.shape[0]
-    A = sp.block_diag([L, L]).tocsr()            # two velocity components
-    D = sp.diags([-np.ones(nu - 1), np.ones(nu)], [-1, 0],
-                 shape=(nu, nu))
-    B = sp.hstack([D, 0.5 * D]).tocsr()          # (np_, 2nu)
-    eps = 1e-2
-    M = sp.identity(nu) * eps
-    K = sp.bmat([[A, B.T], [B, -M]]).tocsr()
-    pmask = np.zeros(K.shape[0], dtype=bool)
-    pmask[2 * nu:] = True
-    return CSR.from_scipy(K), pmask
+from amgcl_tpu.utils.sample_problem import poisson3d, stokes_like
 
 
 def test_schur_pressure_correction():
