@@ -136,30 +136,38 @@ def build_dist_ell(A: CSR, mesh, dtype=jnp.float32, nloc=None,
 
 
 def build_dist_ell_strips(triples, mesh, shape, dtype=jnp.float32,
-                          nloc=None, ncloc=None) -> DistEllMatrix:
+                          nloc=None, ncloc=None,
+                          comm=None) -> DistEllMatrix:
     """Same plan + packing as :func:`build_dist_ell`, but consuming
     per-shard (rows_rel, cols_global, vals) triples directly — the
     strip-parallel setup path (parallel/dist_setup.py) never assembles a
-    global CSR, so host peak memory stays one strip + its halo."""
+    global CSR, so host peak memory stays one strip + its halo.
+
+    ``comm`` (a dist_setup comm object) makes the halo-plan union global
+    under multi-controller: entries for non-owned shards may be None, the
+    boundary keys are allgathered (they are O(surface), not O(nnz)), and
+    every process derives the identical plan."""
     nd = mesh.shape[ROWS_AXIS]
     n, m = shape
     nloc = -(-n // nd) if nloc is None else int(nloc)
     ncloc = -(-m // nd) if ncloc is None else int(ncloc)
+    my_shards = list(range(nd)) if comm is None else list(comm.my_shards)
 
     # halo needs: for each (dst, src) pair the sorted unique global columns.
     # Work is O(nnz_rem log) over BOUNDARY entries only.
-    rem_keys_per = []
-    splits = []
+    rem_keys_per = [None] * nd
+    splits = [None] * nd
     K1 = 1
     K2 = 1
-    for s, (rr, cc, vv) in enumerate(triples):
+    for s in my_shards:
+        rr, cc, vv = triples[s]
         owner = np.minimum(np.asarray(cc) // ncloc, nd - 1).astype(np.int64)
         lm = owner == s
         rem = ~lm
         keys = ((np.int64(s) * nd + owner[rem]) * (ncloc * nd)
                 + np.asarray(cc)[rem].astype(np.int64))
-        rem_keys_per.append(keys)
-        splits.append(lm)
+        rem_keys_per[s] = keys
+        splits[s] = lm
         rl = np.asarray(rr)[lm]
         if len(rl):
             K1 = max(K1, int(np.bincount(rl).max()))
@@ -167,8 +175,14 @@ def build_dist_ell_strips(triples, mesh, shape, dtype=jnp.float32,
         if len(rm_):
             K2 = max(K2, int(np.bincount(rm_).max()))
 
-    trip = np.unique(np.concatenate(rem_keys_per)) if rem_keys_per else \
-        np.zeros(0, np.int64)
+    if comm is not None and len(my_shards) != nd:
+        all_keys = comm.allgather_concat(rem_keys_per)
+        K1 = int(comm.max_scalar([K1]))
+        K2 = int(comm.max_scalar([K2]))
+    else:
+        all_keys = np.concatenate(rem_keys_per) if rem_keys_per else \
+            np.zeros(0, np.int64)
+    trip = np.unique(all_keys)
     t_pair = trip // (ncloc * nd)
     t_dst = t_pair // nd
     t_src = t_pair % nd
@@ -187,9 +201,14 @@ def build_dist_ell_strips(triples, mesh, shape, dtype=jnp.float32,
 
     # per-shard ELL packing; placement is per-part (no global host array)
     val_dt = np.result_type(
-        *([np.asarray(t[2]).dtype for t in triples] + [np.float64]))
-    lcs, lvs, rcs, rvs = [], [], [], []
-    for s, (rr, cc, vv) in enumerate(triples):
+        *([np.asarray(triples[s][2]).dtype for s in my_shards]
+          + [np.float64]))
+    lcs = [None] * nd
+    lvs = [None] * nd
+    rcs = [None] * nd
+    rvs = [None] * nd
+    for s in my_shards:
+        rr, cc, vv = triples[s]
         rr = np.asarray(rr)
         cc = np.asarray(cc)
         vv = np.asarray(vv)
@@ -203,10 +222,10 @@ def build_dist_ell_strips(triples, mesh, shape, dtype=jnp.float32,
         halo_pos = (t_src[loc_in_trip] * C + grp_idx[loc_in_trip]) \
             .astype(np.int32)
         c2, v2 = pack_rows_ell(rr[rem], halo_pos, vv[rem], nloc, K2)
-        lcs.append(c1)
-        lvs.append(v1.astype(val_dt))
-        rcs.append(c2)
-        rvs.append(v2.astype(val_dt))
+        lcs[s] = c1
+        lvs[s] = v1.astype(val_dt)
+        rcs[s] = c2
+        rvs[s] = v2.astype(val_dt)
 
     from amgcl_tpu.parallel.mesh import put_sharded_parts
     put = lambda parts, dt: put_sharded_parts(parts, mesh, dt)

@@ -56,49 +56,51 @@ __all__ = [
 class LocalComm:
     """Single-controller realization of the strip-exchange primitives.
 
-    Every method takes/returns PER-SHARD lists. A multi-controller comm
-    implements the same five methods where each process holds only the
-    entries at its own index and the rest move over jax.distributed
-    (parallel/multihost.py)."""
+    Every method takes/returns PER-SHARD lists (index = shard id).
+    :class:`MultihostComm` implements the same interface where each
+    process holds only its own shards' entries (``None`` elsewhere) and
+    the data moves over jax.distributed."""
 
     def __init__(self, nd: int):
         self.nd = int(nd)
+        self.my_shards = list(range(self.nd))
 
     def max_scalar(self, per_shard) -> float:
-        """Global max of one scalar per shard (MPI_Allreduce MAX)."""
-        return float(max(per_shard))
+        """Global max of one scalar per owned shard (MPI_Allreduce MAX)."""
+        return float(max(v for v in per_shard if v is not None))
 
     def exscan_sum(self, counts):
         """Exclusive prefix sum of one int per shard + the total
-        (MPI_Exscan + Allreduce SUM)."""
+        (MPI_Exscan + Allreduce SUM). ``counts`` is globally known (it is
+        derived from allgathered data) so this is local arithmetic."""
         c = np.asarray(counts, dtype=np.int64)
         offs = np.concatenate([[0], np.cumsum(c)[:-1]])
         return list(offs), int(c.sum())
 
-    def alltoall_triples(self, buckets):
-        """buckets[src][dst] = (rows, cols, vals) destined for shard dst;
-        returns per-dst concatenations (the reference's Isend/Irecv triple
-        exchange, distributed_matrix.hpp:559-716)."""
-        nd = self.nd
-        out = []
-        for d in range(nd):
-            rs, cs, vs = [], [], []
-            for s in range(nd):
-                r, c, v = buckets[s][d]
-                rs.append(np.asarray(r))
-                cs.append(np.asarray(c))
-                vs.append(np.asarray(v))
-            out.append((np.concatenate(rs), np.concatenate(cs),
-                        np.concatenate(vs)))
-        return out
+    def alltoall(self, buckets):
+        """buckets[src][dst] = (rows, cols, vals) destined for shard dst,
+        for each OWNED src (None elsewhere); returns recv[dst][src] for
+        each owned dst (the reference's Isend/Irecv triple exchange,
+        distributed_matrix.hpp:559-716)."""
+        return [[buckets[s][d] for s in range(self.nd)]
+                for d in range(self.nd)]
+
+    def allgather_concat(self, per_shard):
+        """Concatenate one 1-D array per owned shard across every shard
+        (MPI_Allgatherv); every caller sees the same global array."""
+        return np.concatenate([np.asarray(per_shard[s])
+                               for s in range(self.nd)])
 
     def fetch_rows(self, strips, nloc, gids_per_shard):
         """Remote-row fetch (the reference's SpGEMM prologue,
-        distributed_matrix.hpp:856-940): for each requesting shard, the
-        scipy CSR stack of global rows ``gids`` (sorted unique) served by
-        their owners."""
+        distributed_matrix.hpp:856-940): for each owned requesting shard,
+        the scipy CSR stack of global rows ``gids`` (sorted unique) served
+        by their owners."""
         out = []
         for gids in gids_per_shard:
+            if gids is None:
+                out.append(None)
+                continue
             gids = np.asarray(gids)
             if len(gids) == 0:
                 out.append(None)
@@ -113,15 +115,21 @@ class LocalComm:
         return out
 
     def fetch_vals(self, vals_per_shard, nloc, gids_per_shard):
-        """Same as fetch_rows for one value per global row."""
+        """Same as fetch_rows for one value per global row (duplicate and
+        unsorted ids allowed)."""
         out = []
+        ref_dt = np.asarray(
+            next(v for v in vals_per_shard if v is not None)).dtype
         for gids in gids_per_shard:
+            if gids is None:
+                out.append(None)
+                continue
             gids = np.asarray(gids)
             if len(gids) == 0:
-                out.append(np.zeros(0))
+                out.append(np.zeros(0, ref_dt))
                 continue
             owner = np.minimum(gids // nloc, self.nd - 1)
-            res = np.empty(len(gids), np.asarray(vals_per_shard[0]).dtype)
+            res = np.empty(len(gids), ref_dt)
             for o in range(self.nd):
                 sel = owner == o
                 if sel.any():
@@ -129,6 +137,248 @@ class LocalComm:
                         vals_per_shard[o])[gids[sel] - o * nloc]
             out.append(res)
         return out
+
+
+class MultihostComm(LocalComm):
+    """Multi-controller realization over ``jax.distributed``: each process
+    holds only its addressable shards' strips; small reductions ride
+    ``process_allgather`` and the bulk triple exchange is ONE device
+    ``all_to_all`` over the rows mesh, so no process ever materializes
+    another process's strip (reference role: the Isend/Irecv exchanges of
+    distributed_matrix.hpp; ingestion pattern of
+    examples/mpi/mpi_solver.cpp:190-238)."""
+
+    def __init__(self, mesh):
+        import jax
+        self.mesh = mesh
+        self.nd = int(mesh.shape[ROWS_AXIS])
+        pid = jax.process_index()
+        devs = list(np.asarray(mesh.devices).reshape(-1))
+        self.my_shards = [i for i, d in enumerate(devs)
+                          if d.process_index == pid]
+
+    # -- small fixed-shape allreduce helpers --------------------------------
+
+    def _allgather_np(self, arr, combine):
+        from jax.experimental import multihost_utils
+        g = multihost_utils.process_allgather(np.asarray(arr))
+        return combine(np.asarray(g), axis=0)
+
+    def max_scalar(self, per_shard) -> float:
+        vals = [v for v in per_shard if v is not None]
+        loc = max(vals) if vals else -np.inf
+        return float(self._allgather_np(np.float64(loc), np.max))
+
+    def _allgather_var(self, arr):
+        """Allgatherv of one variable-length 1-D array per process.
+        Lengths ride a separate int64 gather — never the payload dtype,
+        which could not represent large counts exactly (float32 payloads
+        round above 2^24)."""
+        from jax.experimental import multihost_utils
+        arr = np.asarray(arr)
+        lens = np.asarray(
+            multihost_utils.process_allgather(np.int64(arr.shape[0])))
+        lens = lens.reshape(-1)
+        n = int(lens.max())
+        if n == 0:
+            return arr
+        pad = np.zeros(n, dtype=arr.dtype)
+        pad[:arr.shape[0]] = arr
+        g = np.asarray(multihost_utils.process_allgather(pad))
+        return np.concatenate([g[p, :int(lens[p])]
+                               for p in range(g.shape[0])])
+
+    def allgather_concat(self, per_shard):
+        loc = np.concatenate(
+            [np.asarray(per_shard[s]) for s in self.my_shards]) \
+            if self.my_shards else np.zeros(0, np.int64)
+        return self._allgather_var(loc)
+
+    # -- bulk exchange: ONE device all_to_all over the mesh -----------------
+
+    def alltoall(self, buckets):
+        import jax
+
+        nd = self.nd
+        # global max chunk + value dtype agreement
+        loc_max = max((len(buckets[s][d][0]) for s in self.my_shards
+                       for d in range(nd)), default=0)
+        C = max(int(self._allgather_np(np.int64(loc_max), np.max)), 1)
+        # round up to the next power of two: the payload is zero-padded
+        # anyway, and a quantized C bounds _compiled_alltoall's distinct
+        # jit compilations to ~log2(range) instead of one per exchange
+        C = 1 << (C - 1).bit_length()
+        has_cplx = any(np.asarray(buckets[s][d][2]).dtype.kind == "c"
+                       for s in self.my_shards for d in range(nd))
+        has_cplx = bool(self._allgather_np(np.int64(has_cplx), np.max))
+        vdt = np.complex128 if has_cplx else np.float64
+
+        idx_parts = [None] * nd
+        val_parts = [None] * nd
+        cnt = np.zeros((nd, nd), np.int64)
+        for s in self.my_shards:
+            ip = np.zeros((nd, C, 2), np.int64)
+            vp = np.zeros((nd, C), vdt)
+            for d in range(nd):
+                r, c, v = buckets[s][d]
+                k = len(np.asarray(r))
+                cnt[s, d] = k
+                if k:
+                    ip[d, :k, 0] = np.asarray(r)
+                    ip[d, :k, 1] = np.asarray(c)
+                    vp[d, :k] = np.asarray(v)
+            idx_parts[s] = ip
+            val_parts[s] = vp
+        cnt = self._allgather_np(cnt, np.sum)     # zeros elsewhere
+        idx_sh = put_sharded_parts(idx_parts, self.mesh, jnp.int64)
+        val_sh = put_sharded_parts(
+            val_parts, self.mesh,
+            jnp.complex128 if has_cplx else jnp.float64)
+        fn = _compiled_alltoall(self.mesh, C, "c" if has_cplx else "f")
+        idx_r, val_r = fn(idx_sh, val_sh)
+        # read back the addressable shards only
+        got_i = {sh.index[0].start or 0: np.asarray(sh.data)[0]
+                 for sh in idx_r.addressable_shards}
+        got_v = {sh.index[0].start or 0: np.asarray(sh.data)[0]
+                 for sh in val_r.addressable_shards}
+        out = [None] * nd
+        for d in self.my_shards:
+            seg = []
+            for s in range(nd):
+                k = int(cnt[s, d])
+                seg.append((got_i[d][s, :k, 0], got_i[d][s, :k, 1],
+                            got_v[d][s, :k]))
+            out[d] = seg
+        return out
+
+    # -- fetch = route requests, serve, route responses ---------------------
+
+    def _route_requests(self, nloc, gids_per_shard):
+        nd = self.nd
+        req = [None] * nd
+        uniq = [None] * nd
+        for s in self.my_shards:
+            gids = np.asarray(gids_per_shard[s]) \
+                if gids_per_shard[s] is not None else np.zeros(0, np.int64)
+            u = np.unique(gids)
+            uniq[s] = u
+            owner = np.minimum(u // nloc, nd - 1) if len(u) else u
+            bk = []
+            for o in range(nd):
+                sel = u[owner == o] if len(u) else u
+                bk.append((sel, np.zeros(len(sel), np.int64),
+                           np.zeros(len(sel))))
+            req[s] = bk
+        return req, uniq
+
+    def fetch_vals(self, vals_per_shard, nloc, gids_per_shard):
+        nd = self.nd
+        req, uniq = self._route_requests(nloc, gids_per_shard)
+        recv_req = self.alltoall(req)
+        resp = [None] * nd
+        for o in self.my_shards:
+            vals_o = np.asarray(vals_per_shard[o])
+            bk = []
+            for s in range(nd):
+                want = np.asarray(recv_req[o][s][0], np.int64)
+                served = vals_o[want - o * nloc] if len(want) else \
+                    np.zeros(0, vals_o.dtype)
+                bk.append((want, np.zeros(len(want), np.int64), served))
+            resp[o] = bk
+        recv = self.alltoall(resp)
+        out = [None] * nd
+        for s in self.my_shards:
+            gids = np.asarray(gids_per_shard[s]) \
+                if gids_per_shard[s] is not None else None
+            if gids is None or len(gids) == 0:
+                out[s] = np.zeros(0) if gids is not None else None
+                continue
+            got_g = np.concatenate([np.asarray(recv[s][o][0], np.int64)
+                                    for o in range(nd)])
+            got_v = np.concatenate([np.asarray(recv[s][o][2])
+                                    for o in range(nd)])
+            order = np.argsort(got_g)
+            pos = order[np.searchsorted(got_g[order], gids)]
+            vals = got_v[pos]
+            if not np.iscomplexobj(np.asarray(vals_per_shard[
+                    self.my_shards[0]])):
+                vals = vals.real
+            # integer payloads (aggregate ids) ride the float channel;
+            # values are exact integers well below 2^53
+            if np.asarray(vals_per_shard[self.my_shards[0]]).dtype.kind \
+                    in "iu":
+                vals = np.rint(vals.real).astype(np.int64)
+            out[s] = vals
+        return out
+
+    def fetch_rows(self, strips, nloc, gids_per_shard):
+        nd = self.nd
+        req, uniq = self._route_requests(nloc, gids_per_shard)
+        recv_req = self.alltoall(req)
+        resp = [None] * nd
+        for o in self.my_shards:
+            S = strips[o]
+            bk = []
+            for s in range(nd):
+                want = np.asarray(recv_req[o][s][0], np.int64)
+                if len(want):
+                    sub = S[want - o * nloc].tocoo()
+                    gid_of = want[sub.row]
+                    bk.append((gid_of, sub.col.astype(np.int64), sub.data))
+                else:
+                    bk.append((np.zeros(0, np.int64), np.zeros(0, np.int64),
+                               np.zeros(0)))
+            resp[o] = bk
+        recv = self.alltoall(resp)
+        ncols = None
+        for s in self.my_shards:
+            ncols = strips[s].shape[1]
+            break
+        out = [None] * nd
+        for s in self.my_shards:
+            gids = gids_per_shard[s]
+            if gids is None or len(np.asarray(gids)) == 0:
+                out[s] = None
+                continue
+            gids = np.asarray(gids)
+            gg = np.concatenate([np.asarray(recv[s][o][0], np.int64)
+                                 for o in range(nd)])
+            cc = np.concatenate([np.asarray(recv[s][o][1], np.int64)
+                                 for o in range(nd)])
+            vv = np.concatenate([np.asarray(recv[s][o][2])
+                                 for o in range(nd)])
+            if not any(np.iscomplexobj(np.asarray(strips[t].data))
+                       for t in self.my_shards):
+                vv = vv.real
+            rows_rel = np.searchsorted(gids, gg)   # gids sorted unique
+            M = sp.coo_matrix((vv, (rows_rel, cc)),
+                              shape=(len(gids), ncols)).tocsr()
+            M.sum_duplicates()
+            M.sort_indices()
+            out[s] = M
+        return out
+
+
+import functools
+
+
+@functools.lru_cache(maxsize=64)
+def _compiled_alltoall(mesh, C, kind):
+    """One jitted shard_map all_to_all for (nd, nd, C, ...) payloads."""
+    import jax
+    from jax import lax, shard_map
+    from jax.sharding import PartitionSpec as P
+
+    def run(idx, val):
+        i = lax.all_to_all(idx[0], ROWS_AXIS, 0, 0, tiled=False)
+        v = lax.all_to_all(val[0], ROWS_AXIS, 0, 0, tiled=False)
+        return i[None], v[None]
+
+    fn = shard_map(run, mesh=mesh,
+                   in_specs=(P(ROWS_AXIS), P(ROWS_AXIS)),
+                   out_specs=(P(ROWS_AXIS), P(ROWS_AXIS)),
+                   check_vma=False)
+    return jax.jit(fn)
 
 
 # ===========================================================================
@@ -155,8 +405,9 @@ def strip_transpose(strips, nloc_in, nloc_out, shape_out, comm: LocalComm):
     distributed_matrix.hpp:559-716): entry (i, j, v) of strip s is routed to
     the owner of row j in the OUTPUT partition and lands as (j, i, v)."""
     nd = comm.nd
-    buckets = []
-    for s, S in enumerate(strips):
+    buckets = [None] * nd
+    for s in comm.my_shards:
+        S = strips[s]
         r0 = s * nloc_in
         rows_g = np.repeat(np.arange(S.shape[0]), np.diff(S.indptr)) + r0
         dst = np.minimum(S.indices // nloc_out, nd - 1)
@@ -164,18 +415,20 @@ def strip_transpose(strips, nloc_in, nloc_out, shape_out, comm: LocalComm):
         for d in range(nd):
             sel = dst == d
             bk.append((S.indices[sel], rows_g[sel], S.data[sel]))
-        buckets.append(bk)
-    recv = comm.alltoall_triples(buckets)
+        buckets[s] = bk
+    recv = comm.alltoall(buckets)
     n_out, m_out = shape_out
-    out = []
-    for d in range(nd):
+    out = [None] * nd
+    for d in comm.my_shards:
         r0, r1 = min(d * nloc_out, n_out), min((d + 1) * nloc_out, n_out)
-        rr, cc, vv = recv[d]
+        rr = np.concatenate([np.asarray(t[0]) for t in recv[d]])
+        cc = np.concatenate([np.asarray(t[1]) for t in recv[d]])
+        vv = np.concatenate([np.asarray(t[2]) for t in recv[d]])
         T = sp.coo_matrix((vv, (rr - r0, cc)),
                           shape=(r1 - r0, m_out)).tocsr()
         T.sum_duplicates()
         T.sort_indices()
-        out.append(T)
+        out[d] = T
     return out
 
 
@@ -184,13 +437,19 @@ def strip_spgemm(A_strips, B_strips, nloc_B, comm: LocalComm):
     partition: fetch the B rows each strip's columns touch, then multiply
     locally (reference: distributed_matrix.hpp:856-1066). Returns C strips
     on A's row partition."""
-    ucols = [np.unique(S.indices) if S.nnz else np.zeros(0, np.int64)
-             for S in A_strips]
+    nd = comm.nd
+    ucols = [None] * nd
+    ncols_B = None
+    for s in comm.my_shards:
+        S = A_strips[s]
+        ucols[s] = np.unique(S.indices) if S.nnz else np.zeros(0, np.int64)
+        ncols_B = B_strips[s].shape[1]
     B_sub = comm.fetch_rows(B_strips, nloc_B, ucols)
-    out = []
-    for s, S in enumerate(A_strips):
+    out = [None] * nd
+    for s in comm.my_shards:
+        S = A_strips[s]
         if S.nnz == 0 or B_sub[s] is None:
-            out.append(sp.csr_matrix((S.shape[0], B_strips[0].shape[1])))
+            out[s] = sp.csr_matrix((S.shape[0], ncols_B))
             continue
         # remap columns into the fetched row block
         pos = np.searchsorted(ucols[s], S.indices)
@@ -199,7 +458,7 @@ def strip_spgemm(A_strips, B_strips, nloc_B, comm: LocalComm):
         C = (Sl @ B_sub[s]).tocsr()
         C.sum_duplicates()
         C.sort_indices()
-        out.append(C)
+        out[s] = C
     return out
 
 
@@ -207,17 +466,18 @@ def strip_spgemm(A_strips, B_strips, nloc_B, comm: LocalComm):
 # per-level SA construction on strips
 # ===========================================================================
 
-def _strip_diag(strips, nloc):
+def _strip_diag(strips, nloc, my_shards=None):
     """Per-strip diagonal values (value at (i, r0+i))."""
-    out = []
-    for s, S in enumerate(strips):
+    out = [None] * len(strips)
+    for s in (range(len(strips)) if my_shards is None else my_shards):
+        S = strips[s]
         r0 = s * nloc
         m_s = S.shape[0]
         rows = np.repeat(np.arange(m_s), np.diff(S.indptr))
         d = np.zeros(m_s, S.data.dtype)
         hit = S.indices == rows + r0
         d[rows[hit]] = S.data[hit]
-        out.append(d)
+        out[s] = d
     return out
 
 
@@ -225,12 +485,18 @@ def _strip_filtered(strips, nloc, eps, comm):
     """Strength filter + weak-entry lumping per strip (the serial
     ``smoothed_aggregation._filtered`` with halo diagonal fetch).
     Returns (Af_strips, Dfinv_strips, strong_offdiag_masks, ucols, dj)."""
-    dloc = _strip_diag(strips, nloc)
-    ucols = [np.unique(S.indices) if S.nnz else np.zeros(0, np.int64)
-             for S in strips]
+    nd = comm.nd
+    dloc = _strip_diag(strips, nloc, comm.my_shards)
+    ucols = [None] * nd
+    for s in comm.my_shards:
+        S = strips[s]
+        ucols[s] = np.unique(S.indices) if S.nnz else np.zeros(0, np.int64)
     dj_per = comm.fetch_vals(dloc, nloc, ucols)
-    Af, Dfinv, strong_masks = [], [], []
-    for s, S in enumerate(strips):
+    Af = [None] * nd
+    Dfinv = [None] * nd
+    strong_masks = [None] * nd
+    for s in comm.my_shards:
+        S = strips[s]
         r0 = s * nloc
         m_s = S.shape[0]
         rows = np.repeat(np.arange(m_s), np.diff(S.indptr))
@@ -256,9 +522,9 @@ def _strip_filtered(strips, nloc, eps, comm):
         F.data[fdia] += removed[frows[fdia]]
         dF = np.zeros(m_s, F.data.dtype)
         dF[frows[fdia]] = F.data[fdia]
-        Af.append(F)
-        Dfinv.append(np.where(dF != 0, 1.0 / np.where(dF != 0, dF, 1), 1.0))
-        strong_masks.append((strong & ~is_dia, rows))
+        Af[s] = F
+        Dfinv[s] = np.where(dF != 0, 1.0 / np.where(dF != 0, dF, 1), 1.0)
+        strong_masks[s] = (strong & ~is_dia, rows)
     return Af, Dfinv, strong_masks, ucols
 
 
@@ -275,61 +541,60 @@ def _strip_mis_aggregates(strips, strong_masks, n, nloc, mesh, comm,
     nd = comm.nd
     # symmetrized strength adjacency, strip-wise: local strong pattern OR
     # its routed transpose
-    pat = []
-    for s, S in enumerate(strips):
+    pat = [None] * nd
+    for s in comm.my_shards:
+        S = strips[s]
         mask, rows = strong_masks[s]
-        P_ = sp.csr_matrix(
+        pat[s] = sp.csr_matrix(
             (np.ones(int(mask.sum()), np.int8),
              (rows[mask], S.indices[mask])), shape=S.shape)
-        pat.append(P_)
     patT = strip_transpose(pat, nloc, nloc, (n, n), comm)
-    triples = []
-    for s in range(nd):
+    triples = [None] * nd
+    for s in comm.my_shards:
         G = ((pat[s] + patT[s]) > 0).astype(np.float32).tocsr()
         G.sort_indices()
         rows = np.repeat(np.arange(G.shape[0]), np.diff(G.indptr))
-        triples.append((rows, G.indices.astype(np.int64), G.data))
-    dS = build_dist_ell_strips(triples, mesh, (n, n), jnp.float32)
+        triples[s] = (rows, G.indices.astype(np.int64), G.data)
+    dS = build_dist_ell_strips(triples, mesh, (n, n), jnp.float32,
+                               nloc=nloc, comm=comm)
 
     prio_full = _priority(n).astype(np.int32)
-    prio_parts = []
-    for s in range(nd):
+    prio_parts = [None] * nd
+    for s in comm.my_shards:
         r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
         p = np.zeros(dS.nloc, np.int32)
         p[: r1 - r0] = prio_full[r0:r1]
-        prio_parts.append(p)
+        prio_parts[s] = p
     prio_sh = put_sharded_parts(prio_parts, mesh, jnp.int32)
     fn = _compiled_mis(mesh, dS.shape, dS.nloc, dS.ncloc, int(rounds))
-    key_g = np.asarray(jax.device_get(fn(dS, prio_sh)))
+    from amgcl_tpu.parallel.mesh import host_full
+    key_g = np.asarray(host_full(fn(dS, prio_sh)))
 
-    # per-owner contiguous coarse numbering from the exclusive prefix of
-    # root counts (root <=> key == own priority)
+    # Coarse numbering: every process derives the same global cid map from
+    # the (allgathered) MIS keys — O(n) ints, the same cost class as the
+    # priority permutation itself. Roots (key == own priority) are numbered
+    # per-owner contiguous, so coarse blocks stay aligned with the fine
+    # blocks that produced them; captured rows adopt their root's cid via
+    # the priority-inverse.
     inv = np.empty(n, np.int64)
     inv[prio_full - 1] = np.arange(n)
-    keys, cid_root, root_counts = [], [], []
+    keyv = key_g[: nd * dS.nloc].reshape(nd, dS.nloc)
+    cid_full = np.full(n, -1, np.int64)
+    nc = 0
     for s in range(nd):
         r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
-        k = key_g[s * dS.nloc: s * dS.nloc + (r1 - r0)]
-        keys.append(k)
-        roots = k == prio_full[r0:r1]
-        root_counts.append(int(np.count_nonzero(roots & (k > 0))))
-    offs, nc = comm.exscan_sum(root_counts)
-    for s in range(nd):
-        r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
-        k = keys[s]
+        k = keyv[s, : r1 - r0]
         roots = (k == prio_full[r0:r1]) & (k > 0)
-        cid = np.full(r1 - r0, -1, np.int64)
-        cid[roots] = offs[s] + np.arange(int(np.count_nonzero(roots)))
-        cid_root.append(cid)
-    # captured rows adopt their root's cid: root row = inv[key-1], fetch
-    # its cid from the owner
-    agg = []
-    root_rows = [inv[np.maximum(keys[s], 1) - 1] for s in range(nd)]
-    fetched = comm.fetch_vals(cid_root, nloc, root_rows)
-    for s in range(nd):
-        a = np.where(keys[s] > 0, fetched[s], -1)
-        agg.append(a.astype(np.int64))
-    return agg, nc
+        idx = np.flatnonzero(roots) + r0
+        cid_full[idx] = nc + np.arange(len(idx))
+        nc += len(idx)
+    agg = [None] * nd
+    for s in comm.my_shards:
+        r0, r1 = min(s * nloc, n), min((s + 1) * nloc, n)
+        k = keyv[s, : r1 - r0]
+        root_row = inv[np.maximum(k, 1) - 1]
+        agg[s] = np.where(k > 0, cid_full[root_row], -1).astype(np.int64)
+    return agg, int(nc)
 
 
 def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
@@ -354,23 +619,26 @@ def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
     nloc_c = -(-nc // nd)
 
     # omega = relax * 4/3 / rho(Df^-1 Af), Gershgorin (builtin.hpp:775-820)
-    rho_loc = []
-    for s in range(nd):
-        absrow = np.abs(Af[s]).sum(axis=1)
-        absrow = np.asarray(absrow).ravel()
-        rho_loc.append(float(np.max(np.abs(Dfinv[s]) * absrow))
-                       if len(absrow) else 0.0)
+    rho_loc = [None] * nd
+    for s in comm.my_shards:
+        absrow = np.asarray(np.abs(Af[s]).sum(axis=1)).ravel()
+        rho_loc[s] = float(np.max(np.abs(Dfinv[s]) * absrow)) \
+            if len(absrow) else 0.0
     rho = comm.max_scalar(rho_loc)
     omega = relax * (4.0 / 3.0) / max(rho, 1e-30)
 
     # P strip: row i of (I - omega Df^-1 Af) P_tent. P_tent[j] = e_{agg_j}
     # for agg_j >= 0, so P entries come straight from Af entries:
     # coef_ij = delta_ij - omega * Dfinv_i * Af_ij, col = agg_j.
-    agg_cols = [np.unique(F.indices) if F.nnz else np.zeros(0, np.int64)
-                for F in Af]
+    agg_cols = [None] * nd
+    for s in comm.my_shards:
+        F = Af[s]
+        agg_cols[s] = np.unique(F.indices) if F.nnz \
+            else np.zeros(0, np.int64)
     agg_j_per = comm.fetch_vals(agg, nloc, agg_cols)
-    P_strips = []
-    for s, F in enumerate(Af):
+    P_strips = [None] * nd
+    for s in comm.my_shards:
+        F = Af[s]
         r0 = s * nloc
         m_s = F.shape[0]
         rows = np.repeat(np.arange(m_s), np.diff(F.indptr))
@@ -383,31 +651,33 @@ def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
             (coef[live], (rows[live], aj[live])), shape=(m_s, nc)).tocsr()
         Pm.sum_duplicates()
         Pm.sort_indices()
-        P_strips.append(Pm)
+        P_strips[s] = Pm
 
     # Ac = P^T (A P): local product per strip, triples routed to the coarse
     # owner (this is the distributed Galerkin SpGEMM,
     # distributed_matrix.hpp:856-1066 + mpi/amg.hpp:163-330)
     AP = strip_spgemm(strips, P_strips, nloc, comm)
-    buckets = []
-    for s in range(nd):
+    buckets = [None] * nd
+    for s in comm.my_shards:
         L = (P_strips[s].T.tocsr() @ AP[s]).tocoo()   # (nc, nc) local part
         dst = np.minimum(L.row // nloc_c, nd - 1)
         bk = []
         for d in range(nd):
             sel = dst == d
             bk.append((L.row[sel], L.col[sel], L.data[sel]))
-        buckets.append(bk)
-    recv = comm.alltoall_triples(buckets)
-    Ac_strips = []
-    for d in range(nd):
+        buckets[s] = bk
+    recv = comm.alltoall(buckets)
+    Ac_strips = [None] * nd
+    for d in comm.my_shards:
         r0, r1 = min(d * nloc_c, nc), min((d + 1) * nloc_c, nc)
-        rr, cc, vv = recv[d]
+        rr = np.concatenate([np.asarray(t[0]) for t in recv[d]])
+        cc = np.concatenate([np.asarray(t[1]) for t in recv[d]])
+        vv = np.concatenate([np.asarray(t[2]) for t in recv[d]])
         Ac = sp.coo_matrix((vv, (rr - r0, cc)),
                            shape=(r1 - r0, nc)).tocsr()
         Ac.sum_duplicates()
         Ac.sort_indices()
-        Ac_strips.append(Ac)
+        Ac_strips[d] = Ac
     return P_strips, Ac_strips, nc, nloc_c
 
 
@@ -424,50 +694,58 @@ def _strip_smoother(relax, strips, n, nloc, mesh, comm, dtype):
     from amgcl_tpu.relaxation.jacobi import DampedJacobi
     from amgcl_tpu.relaxation.chebyshev import Chebyshev
 
+    nd = comm.nd
+
     def parts_of(vec_strips, fill=0.0):
         host_dt = np.result_type(
-            *([np.asarray(v).dtype for v in vec_strips] + [np.float64]))
-        out = []
-        for s in range(nd):
+            *([np.asarray(vec_strips[s]).dtype for s in comm.my_shards]
+              + [np.float64]))
+        out = [None] * nd
+        for s in comm.my_shards:
             p = np.full(nloc, fill, host_dt)
             v = vec_strips[s]
             p[:len(v)] = v
-            out.append(p)
+            out[s] = p
         return put_sharded_parts(out, mesh, dtype)
 
     def invsafe(d):
         return np.where(d != 0, 1.0 / np.where(d != 0, d, 1), 1.0)
 
-    nd = comm.nd
     if isinstance(relax, Spai0):
         # m_i = a_ii / sum_j |a_ij|^2 (spai0.hpp:49-117) — row-local
-        dia = _strip_diag(strips, nloc)
-        sc = []
-        for s, S in enumerate(strips):
+        dia = _strip_diag(strips, nloc, comm.my_shards)
+        sc = [None] * nd
+        for s in comm.my_shards:
+            S = strips[s]
             rows = np.repeat(np.arange(S.shape[0]), np.diff(S.indptr))
             denom = np.bincount(rows, weights=(np.abs(S.data) ** 2).real,
                                 minlength=S.shape[0])
-            sc.append(dia[s] / np.where(denom != 0, denom, 1.0))
+            sc[s] = dia[s] / np.where(denom != 0, denom, 1.0)
         return DistSmoother("diag", parts_of(sc))
     if isinstance(relax, DampedJacobi):
-        sc = [relax.damping * invsafe(d) for d in _strip_diag(strips, nloc)]
+        dia = _strip_diag(strips, nloc, comm.my_shards)
+        sc = [None if dia[s] is None else relax.damping * invsafe(dia[s])
+              for s in range(nd)]
         return DistSmoother("diag", parts_of(sc))
     if isinstance(relax, Chebyshev):
         if relax.power_iters:
             raise ValueError(
                 "strip setup supports Gershgorin chebyshev only "
                 "(power_iters=0)")
-        dia = _strip_diag(strips, nloc) if relax.scale else None
-        loc = []
-        for s, S in enumerate(strips):
-            absrow = np.asarray(np.abs(S).sum(axis=1)).ravel()
+        dia = _strip_diag(strips, nloc, comm.my_shards) if relax.scale \
+            else None
+        loc = [None] * nd
+        for s in comm.my_shards:
+            absrow = np.asarray(np.abs(strips[s]).sum(axis=1)).ravel()
             if relax.scale:
                 absrow = np.abs(invsafe(dia[s])) * absrow
-            loc.append(float(absrow.max()) if len(absrow) else 0.0)
+            loc[s] = float(absrow.max()) if len(absrow) else 0.0
         rho = comm.max_scalar(loc)
         a, b = rho * relax.lower, rho
-        dinv_sh = parts_of([invsafe(d) for d in dia]) if relax.scale \
-            else None
+        dinv_sh = None
+        if relax.scale:
+            dinv_sh = parts_of(
+                [None if d is None else invsafe(d) for d in dia])
         return DistSmoother("cheb", dinv_sh, theta=(a + b) / 2,
                             delta=(b - a) / 2, degree=relax.degree)
     raise ValueError(
@@ -476,21 +754,36 @@ def _strip_smoother(relax, strips, n, nloc, mesh, comm, dtype):
         % type(relax).__name__)
 
 
-def _strips_to_dist_ell(strips, mesh, shape, dtype, nloc, ncloc):
+def _strips_to_dist_ell(strips, mesh, shape, dtype, nloc, ncloc, comm):
     from amgcl_tpu.parallel.dist_ell import build_dist_ell_strips
-    triples = []
-    for S in strips:
+    triples = [None] * comm.nd
+    for s in comm.my_shards:
+        S = strips[s]
         rows = np.repeat(np.arange(S.shape[0]), np.diff(S.indptr))
-        triples.append((rows, S.indices.astype(np.int64), S.data))
-    return build_dist_ell_strips(triples, mesh, shape, dtype, nloc, ncloc)
+        triples[s] = (rows, S.indices.astype(np.int64), S.data)
+    return build_dist_ell_strips(triples, mesh, shape, dtype, nloc, ncloc,
+                                 comm=comm)
 
 
-def _gather_strips(strips, shape):
+def _gather_strips(strips, shape, nloc, comm):
     """Assemble strips into one host CSR (used ONLY at the replicated-tail
-    boundary, where the level is already small)."""
-    M = sp.vstack(strips, format="csr") if strips else \
-        sp.csr_matrix(shape)
-    M = sp.csr_matrix(M, shape=shape)
+    boundary, where the level is already small). Under multi-controller
+    the tail triples are allgathered through the public comm interface —
+    every process then runs the same replicated serial build."""
+    nd = comm.nd
+    rr = [None] * nd
+    cc = [None] * nd
+    vv = [None] * nd
+    for s in comm.my_shards:
+        S = strips[s].tocoo()
+        rr[s] = S.row.astype(np.int64) + s * nloc
+        cc[s] = S.col.astype(np.int64)
+        vv[s] = S.data
+    rr = comm.allgather_concat(rr)
+    cc = comm.allgather_concat(cc)
+    vv = comm.allgather_concat(vv)
+    M = sp.coo_matrix((vv, (rr, cc)), shape=shape).tocsr()
+    M.sum_duplicates()
     M.sort_indices()
     return CSR(M.indptr.astype(np.int64), M.indices.astype(np.int32),
                M.data, shape[1])
@@ -511,7 +804,10 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
                                              TransitionOps)
 
     nd = mesh.shape[ROWS_AXIS]
-    comm = comm or LocalComm(nd)
+    if comm is None:
+        import jax
+        comm = MultihostComm(mesh) if jax.process_count() > 1 \
+            else LocalComm(nd)
     c = prm.coarsening
     if not isinstance(c, SmoothedAggregation):
         raise ValueError("strip setup implements smoothed_aggregation; "
@@ -525,9 +821,12 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
     nloc = -(-n // nd)
     sizes = [n]
     levels = []
-    stats = {"peak_strip_nnz": max(S.nnz for S in strips),
+
+    def owned_peak(ss):
+        return max((ss[s].nnz for s in comm.my_shards), default=0)
+
+    stats = {"peak_strip_nnz": owned_peak(strips),
              "level_strip_nnz": []}
-    P_prev = R_prev = None
 
     while (n >= replicate_below and n > prm.coarse_enough
            and len(levels) + 1 < prm.max_levels
@@ -539,13 +838,13 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
             break       # coarsening stalled: serial build breaks too
         if nc >= n:
             break
-        dA = _strips_to_dist_ell(strips, mesh, (n, n), dtype, nloc, nloc)
+        dA = _strips_to_dist_ell(strips, mesh, (n, n), dtype, nloc, nloc,
+                                 comm)
         sm = _strip_smoother(prm.relax, strips, n, nloc, mesh, comm, dtype)
         levels.append([dA, sm, P_s, nloc, n])
-        stats["level_strip_nnz"].append(max(S.nnz for S in strips))
-        stats["peak_strip_nnz"] = max(
-            stats["peak_strip_nnz"],
-            max(S.nnz for S in Ac_s) if Ac_s else 0)
+        stats["level_strip_nnz"].append(owned_peak(strips))
+        stats["peak_strip_nnz"] = max(stats["peak_strip_nnz"],
+                                      owned_peak(Ac_s))
         strips, n, nloc = Ac_s, nc, nloc_c
         eps *= 0.5
         sizes.append(n)
@@ -559,11 +858,11 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
             nloc_next = levels[k + 1][3]
             n_next = levels[k + 1][4]
             dP = _strips_to_dist_ell(P_s, mesh, (n_k, n_next), dtype,
-                                     nloc_k, nloc_next)
+                                     nloc_k, nloc_next, comm)
             R_s = strip_transpose(P_s, nloc_k, nloc_next, (n_next, n_k),
                                   comm)
             dR = _strips_to_dist_ell(R_s, mesh, (n_next, n_k), dtype,
-                                     nloc_next, nloc_k)
+                                     nloc_next, nloc_k, comm)
         dist_levels.append(DistLevel(dA, dP, dR, sm))
 
     # replicated serial tail from the gathered coarse strips
@@ -573,7 +872,7 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
     prm_tail.coarsening.aggregator = None
     # the user's depth bound covers sharded + replicated levels together
     prm_tail.max_levels = max(prm.max_levels - len(levels), 1)
-    A_tail = _gather_strips(strips, (n, n))
+    A_tail = _gather_strips(strips, (n, n), nloc, comm)
     rep_amg = AMG(A_tail, prm_tail)
     rep = SerialHierarchy(rep_amg.hierarchy.levels,
                           rep_amg.hierarchy.coarse,
@@ -586,22 +885,27 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
         # R per shard = (P strip)^T — column-restricted by construction
         _, _, P_s, nloc_b, n_b = levels[-1]
         K1 = max(1, int(comm.max_scalar(
-            [int(np.diff(S.indptr).max()) if S.nnz else 0 for S in P_s])))
+            [None if P_s[s] is None else
+             (int(np.diff(P_s[s].indptr).max()) if P_s[s].nnz else 0)
+             for s in range(nd)])))
         K2 = max(1, int(comm.max_scalar(
-            [int((S.T.tocsr()).getnnz(axis=1).max()) if S.nnz else 0
-             for S in P_s])))
-        pc_parts, pv_parts, rc_parts, rv_parts = [], [], [], []
+            [None if P_s[s] is None else
+             (int((P_s[s].T.tocsr()).getnnz(axis=1).max())
+              if P_s[s].nnz else 0) for s in range(nd)])))
+        pc_parts = [None] * nd
+        pv_parts = [None] * nd
+        rc_parts = [None] * nd
+        rv_parts = [None] * nd
         from amgcl_tpu.parallel.dist_ell import pack_rows_ell
-        for s, S in enumerate(P_s):
+        for s in comm.my_shards:
+            S = P_s[s]
             rows = np.repeat(np.arange(S.shape[0]), np.diff(S.indptr))
-            cgl, vgl = pack_rows_ell(rows, S.indices, S.data, nloc_b, K1)
-            pc_parts.append(cgl)
-            pv_parts.append(vgl)
+            pc_parts[s], pv_parts[s] = pack_rows_ell(
+                rows, S.indices, S.data, nloc_b, K1)
             T = S.T.tocsr()
             trows = np.repeat(np.arange(T.shape[0]), np.diff(T.indptr))
-            crl, vrl = pack_rows_ell(trows, T.indices, T.data, n, K2)
-            rc_parts.append(crl)
-            rv_parts.append(vrl)
+            rc_parts[s], rv_parts[s] = pack_rows_ell(
+                trows, T.indices, T.data, n, K2)
         put = lambda parts, dt: put_sharded_parts(parts, mesh, dt)
         trans = TransitionOps(put(pc_parts, jnp.int32),
                               put(pv_parts, dtype),
@@ -609,7 +913,7 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
                               put(rv_parts, dtype))
     else:
         top_A = _strips_to_dist_ell(strips, mesh, (n, n), dtype, nloc,
-                                    nloc)
+                                    nloc, comm)
 
     hier = DistHierarchy(dist_levels, rep, trans, top_A, prm.npre,
                          prm.npost, prm.ncycle, prm.pre_cycles)
@@ -627,23 +931,33 @@ class StripAMGSolver:
                  solver: Any = None, n: Optional[int] = None,
                  replicate_below: int = 4096, comm=None,
                  mis_rounds: int = 40):
+        import jax
         from amgcl_tpu.models.amg import AMGParams
         self.mesh = mesh
         self.prm = prm or AMGParams()
         from amgcl_tpu.solver.cg import CG
         self.solver = solver or CG()
         nd = mesh.shape[ROWS_AXIS]
+        if comm is None:
+            comm = MultihostComm(mesh) if jax.process_count() > 1 \
+                else LocalComm(nd)
         if isinstance(A_or_strips, (list, tuple)):
             strips = list(A_or_strips)
             if n is None:
                 raise ValueError("pass n= (global rows) with strips")
             if len(strips) != nd:
-                raise ValueError("need one strip per mesh device")
+                raise ValueError(
+                    "need one strip slot per mesh device (None for "
+                    "shards owned by other processes)")
             # the whole strip algebra assumes the ceil(n/nd) row blocks of
             # build_dist_ell (owner = row // nloc); a floor-based MPI-style
             # split would silently misalign every diagonal and halo plan
             nloc0 = -(-int(n) // nd)
-            for s, S in enumerate(strips):
+            for s in comm.my_shards:
+                S = strips[s]
+                if S is None:
+                    raise ValueError("strip %d is owned by this process "
+                                     "but is None" % s)
                 want = min((s + 1) * nloc0, int(n)) - min(s * nloc0, int(n))
                 if S.shape[0] != want:
                     raise ValueError(
@@ -655,6 +969,9 @@ class StripAMGSolver:
         else:
             strips, _ = split_strips(A_or_strips, nd)
             n = sum(S.shape[0] for S in strips)
+            if len(comm.my_shards) != nd:
+                strips = [strips[s] if s in set(comm.my_shards) else None
+                          for s in range(nd)]
         self.hier, self.sizes, self.stats = strip_sa_hierarchy(
             strips, n, mesh, self.prm, comm=comm,
             replicate_below=replicate_below, mis_rounds=mis_rounds)

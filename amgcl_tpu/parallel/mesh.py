@@ -49,11 +49,12 @@ def put_sharded(a, mesh, dtype=None, axis=ROWS_AXIS):
 def put_sharded_parts(parts, mesh, dtype=None, axis=ROWS_AXIS):
     """Per-shard host blocks -> one sharded array with leading dim
     ``len(parts)``, WITHOUT materializing the concatenation: the callback
-    serves each device its own block, so host peak memory stays one part
-    (strip-parallel setup relies on this; under multi-controller each
-    process only ever sees its own parts)."""
+    serves each device its own block, so host peak memory stays one part.
+    Under multi-controller, entries for non-addressable shards may be
+    ``None`` — the callback is only invoked for this process's shards
+    (strip-parallel setup relies on both properties)."""
     nd = len(parts)
-    p0 = np.asarray(parts[0])
+    p0 = np.asarray(next(p for p in parts if p is not None))
     dt = np.dtype(dtype) if dtype is not None else p0.dtype
     shape = (nd,) + p0.shape
     spec = PartitionSpec(axis, *([None] * p0.ndim))
