@@ -31,26 +31,32 @@ from amgcl_tpu.relaxation.spai0 import Spai0
 
 @register_pytree_node_class
 class CPRHierarchy:
-    def __init__(self, A_full, W, p_hier, smoother, block):
+    def __init__(self, A_full, W, p_hier, smoother, block, np_cells=None):
         self.A_full = A_full
-        self.W = W               # (n_cells, b) decoupling weights
+        self.W = W               # (np_cells, b) decoupling weights
         self.p_hier = p_hier
         self.smoother = smoother
         self.block = int(block)
+        # pressure stage covers the leading np_cells cells only
+        # (params.active_rows, cpr.hpp:194 — trailing rows, e.g. appended
+        # well equations, see only the global stage)
+        self.np_cells = None if np_cells is None else int(np_cells)
 
     def tree_flatten(self):
-        return (self.A_full, self.W, self.p_hier, self.smoother), (self.block,)
+        return ((self.A_full, self.W, self.p_hier, self.smoother),
+                (self.block, self.np_cells))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, aux[0])
+        return cls(*children, *aux)
 
     def apply(self, r):
         b = self.block
         rb = r.reshape(-1, b)
-        rp = jnp.einsum("nb,nb->n", self.W, rb)
+        npc = rb.shape[0] if self.np_cells is None else self.np_cells
+        rp = jnp.einsum("nb,nb->n", self.W, rb[:npc])
         dp = self.p_hier.apply(rp)
-        x = jnp.zeros_like(rb).at[:, 0].set(dp).reshape(r.shape)
+        x = jnp.zeros_like(rb).at[:npc, 0].set(dp).reshape(r.shape)
         # global smoothing of the remaining residual
         s = self.smoother.apply(self.A_full, r - dev.spmv(self.A_full, x))
         return x + s
@@ -60,23 +66,38 @@ class CPRHierarchy:
         return self.A_full
 
 
-def _pressure_matrix(A: CSR, W: np.ndarray) -> CSR:
-    """App_ij = w_i · A_ij[:, 0] over the block pattern."""
-    app = np.einsum("eb,eb->e",
-                    W[A.expanded_rows()],
-                    A.val[:, :, 0])
-    return CSR(A.ptr.copy(), A.col.copy(), app, A.ncols)
+def _pressure_matrix(A: CSR, W: np.ndarray, np_cells=None) -> CSR:
+    """App_ij = w_i · A_ij[:, 0] over the block pattern, restricted to the
+    leading ``np_cells`` cells when active_rows limits the pressure
+    system (cpr.hpp:194-253: columns beyond N are skipped)."""
+    if np_cells is None or np_cells == A.nrows:
+        app = np.einsum("eb,eb->e",
+                        W[A.expanded_rows()],
+                        A.val[:, :, 0])
+        return CSR(A.ptr.copy(), A.col.copy(), app, A.ncols)
+    rows = A.expanded_rows()
+    sel = (rows < np_cells) & (A.col < np_cells)
+    r = rows[sel]
+    c = A.col[sel]
+    app = np.einsum("eb,eb->e", W[r], A.val[sel][:, :, 0])
+    ptr = np.concatenate(
+        [[0], np.cumsum(np.bincount(r, minlength=np_cells))])
+    return CSR(ptr.astype(np.int64), c.astype(np.int32), app, np_cells)
 
 
 class CPR:
     """make_solver-compatible preconditioner; ``A`` is a block CSR (or a
-    scalar CSR plus ``block_size``)."""
+    scalar CSR plus ``block_size``). ``active_rows`` (scalar rows, a
+    multiple of the block size) limits the pressure stage to the leading
+    sub-block — the reference's params.active_rows for systems with
+    trailing non-reservoir equations (cpr.hpp:85-106)."""
 
     weighting = "quasi_impes"
 
     def __init__(self, A, block_size: Optional[int] = None,
                  pressure_prm: Optional[AMGParams] = None,
-                 relax: Any = None, dtype=jnp.float32, **wkw):
+                 relax: Any = None, dtype=jnp.float32,
+                 active_rows: int = 0, **wkw):
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         if not A.is_block:
@@ -86,48 +107,91 @@ class CPR:
         self.A_host = A
         self.dtype = dtype
         b = A.block_size[0]
-        W = self._weights(A, **wkw)
-        App = _pressure_matrix(A, W)
+        if active_rows:
+            if active_rows % b:
+                raise ValueError(
+                    "active_rows=%d is not a multiple of the block size %d"
+                    % (active_rows, b))
+            np_cells = active_rows // b
+            if not 0 < np_cells <= A.nrows:
+                raise ValueError("active_rows out of range")
+        else:
+            np_cells = A.nrows
+        self.np_cells = np_cells
+        W = self._weights(A, np_cells=np_cells, **wkw)
+        App = _pressure_matrix(A, W, np_cells)
         pprm = pressure_prm or AMGParams(dtype=dtype)
         self.p_amg = AMG(App, pprm)
         smoother = (relax or Spai0()).build(A, dtype)
         self.hierarchy = CPRHierarchy(
             dev.to_device(A, "ell", dtype),
             jnp.asarray(W, dtype=dtype),
-            self.p_amg.hierarchy, smoother, b)
+            self.p_amg.hierarchy, smoother, b,
+            None if np_cells == A.nrows else np_cells)
 
     @staticmethod
-    def _weights(A: CSR, **kw) -> np.ndarray:
+    def _weights(A: CSR, np_cells=None, **kw) -> np.ndarray:
         """Quasi-IMPES: first row of each diagonal block's inverse
         (decouples the pressure equation from the other unknowns)."""
         Dinv = A.diagonal(invert=True)
-        return Dinv[:, 0, :]
+        W = Dinv[:, 0, :]
+        return W if np_cells is None else W[:np_cells]
 
     def __repr__(self):
         return "cpr(%s)\n[ P ]\n%r" % (self.weighting, self.p_amg)
 
 
 class CPRDRS(CPR):
-    """CPR with dynamic row-sum weights (reference: cpr_drs.hpp): instead of
-    the diagonal-block inverse, the pressure equation is formed from a
-    weighted sum of the cell's equations, with weights from the column sums
-    of each unknown over the cell row — rows whose pressure coupling is not
-    diagonally dominated (ratio below ``eps_dd``) fall back to the plain
-    first-equation extraction."""
+    """CPR with dynamic row-sum weights (reference: cpr_drs.hpp:240-320):
+    the pressure equation is a delta-weighted sum of the cell's equations.
+    Per cell, equation i > 0 contributes (delta=1) unless either test
+    fails:
+
+    - **diagonal dominance** (``eps_dd``): its own-cell pressure coupling
+      a_dia[i] falls below eps_dd x the sum of its off-cell pressure
+      couplings;
+    - **pressure sum** (``eps_ps``): the pressure equation's total
+      coupling to unknown i falls below eps_ps x |a_dia[0]|.
+
+    User ``weights`` (length active scalar rows) scale every delta,
+    including the pressure equation's own."""
 
     weighting = "drs"
 
     @staticmethod
-    def _weights(A: CSR, eps_dd: float = 0.2, **kw) -> np.ndarray:
+    def _weights(A: CSR, eps_dd: float = 0.2, eps_ps: float = 0.02,
+                 weights=None, np_cells=None, **kw) -> np.ndarray:
         b = A.block_size[0]
-        n = A.nrows
-        rows = np.repeat(np.arange(n), A.row_nnz())
-        # column sums per unknown over each cell row: how strongly each
-        # in-cell equation couples to global pressure
-        colsum = np.zeros((n, b))
-        np.add.at(colsum, rows, np.abs(A.val[:, :, 0]))
-        dia = np.abs(A.diagonal()[:, :, 0])
-        dd = dia / np.where(colsum > 0, colsum, 1.0)
-        w = np.where(dd >= eps_dd, 1.0, 0.0)
-        w[:, 0] = 1.0                       # always keep the pressure row
-        return w
+        n = A.nrows if np_cells is None else int(np_cells)
+        rows = A.expanded_rows()
+        if n == A.nrows:
+            sel = slice(None)
+        else:
+            sel = (rows < n) & (A.col < n)
+        r = rows[sel]
+        c = A.col[sel]
+        V = A.val[sel]
+        dia = r == c
+        # a_dia[i]: SIGNED own-cell pressure coupling of equation i;
+        # a_off[i]: sum |off-cell pressure couplings| of equation i;
+        # a_top[c]: the pressure equation's total |coupling| to unknown c
+        # (cpr_drs.hpp:248-290)
+        a_dia = np.zeros((n, b))
+        a_dia[r[dia]] = V[dia][:, :, 0].real
+        a_off = np.zeros((n, b))
+        np.add.at(a_off, r[~dia], np.abs(V[~dia][:, :, 0]))
+        a_top = np.zeros((n, b))
+        np.add.at(a_top, r, np.abs(V[:, 0, :]))
+        delta = np.ones((n, b))
+        if weights is not None:
+            w = np.asarray(weights, dtype=np.float64).ravel()
+            if w.size != n * b:
+                raise ValueError(
+                    "weights must have one entry per active scalar row "
+                    "(%d); got %d" % (n * b, w.size))
+            delta = delta * w.reshape(n, b)
+        drop = np.zeros((n, b), dtype=bool)
+        drop[:, 1:] |= a_dia[:, 1:] < eps_dd * a_off[:, 1:]
+        drop[:, 1:] |= a_top[:, 1:] < eps_ps * np.abs(a_dia[:, :1])
+        delta[drop] = 0.0
+        return delta

@@ -251,7 +251,7 @@ def precond_from_config(A, pcfg: Dict[str, Any]):
     if pclass == "cpr":
         from amgcl_tpu.models.cpr import CPR, CPRDRS
         known = {"class", "dtype", "block_size", "pressure", "relax",
-                 "weighting", "eps_dd"}
+                 "weighting", "eps_dd", "eps_ps", "weights", "active_rows"}
         for k in pcfg:
             if k not in known:
                 warnings.warn("unknown parameter precond.%s" % k)
@@ -268,23 +268,33 @@ def precond_from_config(A, pcfg: Dict[str, Any]):
                    if "block_size" in pcfg else None,
                    pressure_prm=precond_params_from_dict(press)
                    if press else None,
-                   relax=relax, dtype=dtype, **wkw)
+                   relax=relax, dtype=dtype,
+                   active_rows=int(pcfg.get("active_rows", 0)), **wkw)
     raise ValueError("unknown precond.class %r" % pclass)
 
 
 def _drs_kwargs(pcfg, weighting):
-    """DRS weighting knobs from a CPR config dict; warns (once per call
-    site) when a DRS-only key is set under a different weighting. Shared by
-    the serial and distributed CPR config paths so the policy cannot
-    diverge."""
-    if "eps_dd" not in pcfg:
+    """DRS weighting knobs from a CPR config dict (eps_dd / eps_ps /
+    weights — cpr_drs.hpp:88-120); warns when a DRS-only key is set under
+    a different weighting. Shared by the serial and distributed CPR config
+    paths so the policy cannot diverge."""
+    drs_keys = [k for k in ("eps_dd", "eps_ps", "weights") if k in pcfg]
+    if not drs_keys:
         return {}
     if weighting != "drs":
         warnings.warn(
-            "precond.eps_dd only applies to weighting=drs; ignored "
-            "under weighting=%s" % weighting)
+            "precond.%s only applies to weighting=drs; ignored "
+            "under weighting=%s" % ("/".join(drs_keys), weighting))
         return {}
-    return {"eps_dd": float(pcfg["eps_dd"])}
+    out = {}
+    if "eps_dd" in pcfg:
+        out["eps_dd"] = float(pcfg["eps_dd"])
+    if "eps_ps" in pcfg:
+        out["eps_ps"] = float(pcfg["eps_ps"])
+    if "weights" in pcfg:
+        import numpy as _np
+        out["weights"] = _np.asarray(pcfg["weights"], dtype=_np.float64)
+    return out
 
 
 def _parse_bool(v):
@@ -357,7 +367,7 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
         dtype = _parse_dtype(pcfg.get("dtype", "float32"))
         known = {"class", "dtype", "block_size", "pressure", "weighting",
-                 "eps_dd", "relax"}
+                 "eps_dd", "eps_ps", "weights", "relax", "active_rows"}
         for k in pcfg:
             if k not in known:
                 warnings.warn("unknown parameter precond.%s" % k)
@@ -368,6 +378,10 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         wkw = _drs_kwargs(pcfg, weighting)
         relax = relaxation_from_params(pcfg["relax"]) \
             if "relax" in pcfg else None
+        # forwarded so DistCPRSolver raises its explicit NotImplementedError
+        # instead of silently ignoring the key
+        if "active_rows" in pcfg:
+            wkw["active_rows"] = int(pcfg["active_rows"])
         return DistCPRSolver(
             A, mesh,
             block_size=int(pcfg["block_size"]) if "block_size" in pcfg

@@ -80,16 +80,24 @@ class DistCPRSolver(DistAMGSolver):
                  dtype=jnp.float32, weighting: str = "quasi_impes",
                  **wkw):
         """``weighting``: 'quasi_impes' (cpr.hpp) or 'drs' (cpr_drs.hpp
-        dynamic row sums, with e.g. ``eps_dd``) — the same weight policies
-        as the serial CPR/CPRDRS."""
-        bad = set(wkw) - {"eps_dd"}
+        dynamic row sums, with ``eps_dd`` / ``eps_ps`` / user ``weights``)
+        — the same weight policies as the serial CPR/CPRDRS.
+        ``active_rows`` is serial-only: the distributed pressure partition
+        must align with the block partition, which a truncated pressure
+        system breaks — use the serial CPR for appended-well systems."""
+        if wkw.pop("active_rows", 0):
+            raise NotImplementedError(
+                "active_rows is not supported by the distributed CPR; "
+                "use the serial CPR/CPRDRS")
+        bad = set(wkw) - {"eps_dd", "eps_ps", "weights"}
         if bad:
             raise TypeError("unexpected keyword arguments: %s"
                             % ", ".join(sorted(bad)))
         if wkw and weighting != "drs":
             import warnings
-            warnings.warn("eps_dd only applies to weighting='drs'; ignored "
-                          "under weighting=%r" % weighting)
+            warnings.warn("DRS knobs (%s) only apply to weighting='drs'; "
+                          "ignored under weighting=%r"
+                          % (", ".join(sorted(wkw)), weighting))
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         if not A.is_block:

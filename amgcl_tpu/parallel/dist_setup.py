@@ -34,6 +34,7 @@ coarsening.
 from __future__ import annotations
 
 import copy
+import functools
 from typing import Any, Optional
 
 import numpy as np
@@ -68,14 +69,6 @@ class LocalComm:
     def max_scalar(self, per_shard) -> float:
         """Global max of one scalar per owned shard (MPI_Allreduce MAX)."""
         return float(max(v for v in per_shard if v is not None))
-
-    def exscan_sum(self, counts):
-        """Exclusive prefix sum of one int per shard + the total
-        (MPI_Exscan + Allreduce SUM). ``counts`` is globally known (it is
-        derived from allgathered data) so this is local arithmetic."""
-        c = np.asarray(counts, dtype=np.int64)
-        offs = np.concatenate([[0], np.cumsum(c)[:-1]])
-        return list(offs), int(c.sum())
 
     def alltoall(self, buckets):
         """buckets[src][dst] = (rows, cols, vals) destined for shard dst,
@@ -196,58 +189,81 @@ class MultihostComm(LocalComm):
 
     # -- bulk exchange: ONE device all_to_all over the mesh -----------------
 
-    def alltoall(self, buckets):
-        import jax
+    # elements per (src,dst) slot per exchange round: bounds the padded
+    # payload at nd * _CHUNK_CAP * 24B per shard per round; larger
+    # messages stream over several rounds of the SAME compiled program
+    # (a single global max chunk would inflate every nd^2 slot to the
+    # size of the one largest message)
+    _CHUNK_CAP = 1 << 16
 
+    def alltoall(self, buckets):
         nd = self.nd
-        # global max chunk + value dtype agreement
+        # global max message + value dtype agreement
         loc_max = max((len(buckets[s][d][0]) for s in self.my_shards
                        for d in range(nd)), default=0)
-        C = max(int(self._allgather_np(np.int64(loc_max), np.max)), 1)
-        # round up to the next power of two: the payload is zero-padded
-        # anyway, and a quantized C bounds _compiled_alltoall's distinct
-        # jit compilations to ~log2(range) instead of one per exchange
-        C = 1 << (C - 1).bit_length()
+        M = max(int(self._allgather_np(np.int64(loc_max), np.max)), 1)
         has_cplx = any(np.asarray(buckets[s][d][2]).dtype.kind == "c"
                        for s in self.my_shards for d in range(nd))
         has_cplx = bool(self._allgather_np(np.int64(has_cplx), np.max))
         vdt = np.complex128 if has_cplx else np.float64
+        # power-of-two chunk, capped: quantized so _compiled_alltoall's
+        # distinct jit compilations stay ~log2(range)
+        C = min(1 << (M - 1).bit_length(), self._CHUNK_CAP)
+        rounds = -(-M // C)
 
-        idx_parts = [None] * nd
-        val_parts = [None] * nd
         cnt = np.zeros((nd, nd), np.int64)
         for s in self.my_shards:
-            ip = np.zeros((nd, C, 2), np.int64)
-            vp = np.zeros((nd, C), vdt)
             for d in range(nd):
-                r, c, v = buckets[s][d]
-                k = len(np.asarray(r))
-                cnt[s, d] = k
-                if k:
-                    ip[d, :k, 0] = np.asarray(r)
-                    ip[d, :k, 1] = np.asarray(c)
-                    vp[d, :k] = np.asarray(v)
-            idx_parts[s] = ip
-            val_parts[s] = vp
+                cnt[s, d] = len(np.asarray(buckets[s][d][0]))
         cnt = self._allgather_np(cnt, np.sum)     # zeros elsewhere
-        idx_sh = put_sharded_parts(idx_parts, self.mesh, jnp.int64)
-        val_sh = put_sharded_parts(
-            val_parts, self.mesh,
-            jnp.complex128 if has_cplx else jnp.float64)
+
         fn = _compiled_alltoall(self.mesh, C, "c" if has_cplx else "f")
-        idx_r, val_r = fn(idx_sh, val_sh)
-        # read back the addressable shards only
-        got_i = {sh.index[0].start or 0: np.asarray(sh.data)[0]
-                 for sh in idx_r.addressable_shards}
-        got_v = {sh.index[0].start or 0: np.asarray(sh.data)[0]
-                 for sh in val_r.addressable_shards}
+        pieces = {d: [([], [], []) for _ in range(nd)]
+                  for d in self.my_shards}
+        for t in range(rounds):
+            lo = t * C
+            idx_parts = [None] * nd
+            val_parts = [None] * nd
+            for s in self.my_shards:
+                ip = np.zeros((nd, C, 2), np.int64)
+                vp = np.zeros((nd, C), vdt)
+                for d in range(nd):
+                    r, c, v = buckets[s][d]
+                    k = max(0, min(len(np.asarray(r)) - lo, C))
+                    if k:
+                        ip[d, :k, 0] = np.asarray(r)[lo:lo + k]
+                        ip[d, :k, 1] = np.asarray(c)[lo:lo + k]
+                        vp[d, :k] = np.asarray(v)[lo:lo + k]
+                idx_parts[s] = ip
+                val_parts[s] = vp
+            idx_sh = put_sharded_parts(idx_parts, self.mesh, jnp.int64)
+            val_sh = put_sharded_parts(
+                val_parts, self.mesh,
+                jnp.complex128 if has_cplx else jnp.float64)
+            idx_r, val_r = fn(idx_sh, val_sh)
+            got_i = {sh.index[0].start or 0: np.asarray(sh.data)[0]
+                     for sh in idx_r.addressable_shards}
+            got_v = {sh.index[0].start or 0: np.asarray(sh.data)[0]
+                     for sh in val_r.addressable_shards}
+            for d in self.my_shards:
+                for s in range(nd):
+                    k = max(0, min(int(cnt[s, d]) - lo, C))
+                    if k:
+                        rs, cs, vs = pieces[d][s]
+                        rs.append(got_i[d][s, :k, 0])
+                        cs.append(got_i[d][s, :k, 1])
+                        vs.append(got_v[d][s, :k])
+
         out = [None] * nd
+        z = np.zeros(0, np.int64)
         for d in self.my_shards:
             seg = []
             for s in range(nd):
-                k = int(cnt[s, d])
-                seg.append((got_i[d][s, :k, 0], got_i[d][s, :k, 1],
-                            got_v[d][s, :k]))
+                rs, cs, vs = pieces[d][s]
+                seg.append((
+                    np.concatenate(rs) if rs else z,
+                    np.concatenate(cs) if cs else z,
+                    np.concatenate(vs) if vs else np.zeros(0, vdt)))
             out[d] = seg
         return out
 
@@ -357,9 +373,6 @@ class MultihostComm(LocalComm):
             M.sort_indices()
             out[s] = M
         return out
-
-
-import functools
 
 
 @functools.lru_cache(maxsize=64)

@@ -108,3 +108,138 @@ def test_schur_runtime_params():
     x, info = solve(rhs)
     r = rhs - A.spmv(np.asarray(x))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def drs_hard_reservoir(n, ps=5.0, sp_own=-1.0, sp_nbr=6.0, sdiag=10.0):
+    """Block system engineered to break quasi-IMPES weighting: each cell's
+    saturation equation has a NEGATIVE own-cell pressure coupling and
+    large oscillating-sign neighbor pressure couplings, so the
+    diagonal-block-inverse weights mix the saturation equation into the
+    pressure system and pollute its M-matrix structure. The reference's
+    DRS test a_dia[i] < eps_dd * a_off[i] (cpr_drs.hpp:305-320, signed)
+    zeroes that equation's delta and recovers the clean Laplacian."""
+    Ap, _ = poisson3d(n)
+    m = Ap.to_scipy().tocoo()
+    nc = m.shape[0]
+    rows, cols, vals = [], [], []
+    for r, c, v in zip(m.row, m.col, m.data):
+        blk = np.zeros((2, 2))
+        if r == c:
+            blk[0, 0] = v
+            blk[0, 1] = ps
+            blk[1, 0] = sp_own
+            blk[1, 1] = sdiag
+        else:
+            blk[0, 0] = v
+            blk[1, 0] = sp_nbr * (1 if (r + c) % 2 else -1)
+        rows.append(r)
+        cols.append(c)
+        vals.append(blk)
+    order = np.lexsort((cols, rows))
+    vals = np.asarray(vals)[order]
+    rows = np.asarray(rows)[order]
+    cols = np.asarray(cols)[order]
+    ptr = np.concatenate([[0], np.cumsum(np.bincount(rows, minlength=nc))])
+    A = CSR(ptr.astype(np.int64), cols.astype(np.int32), vals, nc)
+    return A, np.ones(nc * 2)
+
+
+def test_drs_beats_quasi_impes():
+    """The point of DRS (VERDICT r3 item 5): on a non-diagonally-dominant
+    fixture the dynamic row-sum weights must win in iterations."""
+    A, rhs = drs_hard_reservoir(10)
+    iters = {}
+    for cls in (CPR, CPRDRS):
+        pre = cls(A, pressure_prm=AMGParams(dtype=jnp.float64,
+                                            coarse_enough=100),
+                  dtype=jnp.float64)
+        solve = make_solver(A, pre, BiCGStab(maxiter=400, tol=1e-8))
+        x, info = solve(rhs)
+        r = rhs - A.spmv(np.asarray(x))
+        assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+        iters[cls.weighting] = info.iters
+    assert iters["drs"] < iters["quasi_impes"], iters
+
+
+def test_drs_weight_semantics():
+    """Unit checks of the reference delta rules (cpr_drs.hpp:305-320):
+    signed eps_dd test, eps_ps pressure-sum test, user weights scaling."""
+    A, _ = drs_hard_reservoir(4)
+    n = A.nrows
+    W = CPRDRS._weights(A)
+    # saturation equations: a_dia[1] = -1 < eps_dd * a_off[1] -> delta 0
+    assert np.all(W[:, 1] == 0.0)
+    assert np.all(W[:, 0] == 1.0)
+    # eps_ps: a_top[1] = |ps| per cell; huge eps_ps kills equation 1 even
+    # when diagonally dominant; here it is already 0 — check it triggers
+    # on a dominance-passing fixture instead
+    A2, _ = drs_hard_reservoir(4, sp_own=50.0, sp_nbr=0.1)
+    W2 = CPRDRS._weights(A2)          # dominance test passes
+    assert np.all(W2[:, 1] == 1.0)
+    W2b = CPRDRS._weights(A2, eps_ps=2.0)   # a_top[1]=5 < 2*6 -> dropped
+    assert np.all(W2b[:, 1] == 0.0)
+    # user weights scale every delta, including the pressure equation's
+    w = np.full(n * 2, 0.5)
+    W3 = CPRDRS._weights(A2, weights=w)
+    assert np.allclose(W3, 0.5)
+    with pytest.raises(ValueError, match="weights"):
+        CPRDRS._weights(A2, weights=np.ones(3))
+
+
+def wells_reservoir(n, b=3, n_wells=2):
+    """Reservoir block system with appended well cells: trailing cells
+    whose equations are NOT reservoir equations (strong diagonal, sparse
+    coupling into cell 0's pressure) — the active_rows use case
+    (cpr.hpp:85-106)."""
+    A, rhs = reservoir_like(n, b)
+    m = A.unblock().to_scipy().tolil()
+    nc = A.nrows
+    N = nc * b
+    Nw = N + n_wells * b
+    M = sp.lil_matrix((Nw, Nw))
+    M[:N, :N] = m
+    for w in range(n_wells):
+        for i in range(b):
+            j = N + w * b + i
+            M[j, j] = 100.0
+            M[j, w * b] = 1.0          # couple to an early cell's pressure
+            M[w * b, j] = 1.0
+    A_full = CSR.from_scipy(sp.csr_matrix(M)).to_block(b)
+    return A_full, np.ones(Nw), N
+
+
+@pytest.mark.parametrize("cls", [CPR, CPRDRS])
+def test_cpr_active_rows(cls):
+    A, rhs, N = wells_reservoir(6, 3)
+    pre = cls(A, pressure_prm=AMGParams(dtype=jnp.float64,
+                                        coarse_enough=50),
+              dtype=jnp.float64, active_rows=N)
+    # the pressure hierarchy covers only the leading reservoir cells
+    assert pre.p_amg.host_levels[0][0].nrows == N // 3
+    solve = make_solver(A, pre, BiCGStab(maxiter=300, tol=1e-8))
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_cpr_active_rows_validation():
+    A, rhs, N = wells_reservoir(6, 3)
+    with pytest.raises(ValueError, match="multiple"):
+        CPR(A, active_rows=N + 1)
+
+
+def test_cpr_runtime_drs_keys():
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    A, rhs = drs_hard_reservoir(6)
+    solve = make_solver_from_config(A, {
+        "precond.class": "cpr",
+        "precond.weighting": "drs",
+        "precond.eps_dd": "0.2",
+        "precond.eps_ps": "0.02",
+        "precond.dtype": "float64",
+        "precond.pressure.coarse_enough": "100",
+        "solver.type": "bicgstab", "solver.maxiter": "400",
+        "solver.tol": "1e-8"})
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6

@@ -167,3 +167,31 @@ def test_unsupported_smoother_raises(mesh8):
         StripAMGSolver(A, mesh8, AMGParams(dtype=jnp.float32,
                                            relax=ILU0()),
                        CG(), replicate_below=600)
+
+
+def test_multihost_comm_chunked_alltoall(mesh8, monkeypatch):
+    """MultihostComm's exchange primitives work in-process too; force a
+    tiny chunk cap so large messages stream over multiple all_to_all
+    rounds and reassemble exactly."""
+    from amgcl_tpu.parallel.dist_setup import MultihostComm
+    comm = MultihostComm(mesh8)
+    monkeypatch.setattr(MultihostComm, "_CHUNK_CAP", 8)
+    rng = np.random.default_rng(1)
+    nd = 8
+    buckets = []
+    for s in range(nd):
+        bk = []
+        for d in range(nd):
+            k = int(rng.integers(0, 40))      # many messages exceed cap=8
+            bk.append((rng.integers(0, 1000, k),
+                       rng.integers(0, 1000, k),
+                       rng.standard_normal(k)))
+        buckets.append(bk)
+    recv = comm.alltoall(buckets)
+    for d in range(nd):
+        for s in range(nd):
+            r0, c0, v0 = buckets[s][d]
+            r1, c1, v1 = recv[d][s]
+            np.testing.assert_array_equal(np.asarray(r1), np.asarray(r0))
+            np.testing.assert_array_equal(np.asarray(c1), np.asarray(c0))
+            np.testing.assert_allclose(np.asarray(v1), np.asarray(v0))
