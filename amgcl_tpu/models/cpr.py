@@ -132,10 +132,14 @@ class CPR:
     @staticmethod
     def _weights(A: CSR, np_cells=None, **kw) -> np.ndarray:
         """Quasi-IMPES: first row of each diagonal block's inverse
-        (decouples the pressure equation from the other unknowns)."""
-        Dinv = A.diagonal(invert=True)
-        W = Dinv[:, 0, :]
-        return W if np_cells is None else W[:np_cells]
+        (decouples the pressure equation from the other unknowns).
+        Restricted to the active cells BEFORE inverting — trailing
+        (inactive) well/constraint blocks may be singular, and the
+        reference never forms weights for them (cpr.hpp:194)."""
+        dia = A.diagonal()
+        if np_cells is not None:
+            dia = dia[:np_cells]
+        return np.linalg.inv(dia)[:, 0, :]
 
     def __repr__(self):
         return "cpr(%s)\n[ P ]\n%r" % (self.weighting, self.p_amg)

@@ -113,6 +113,9 @@ def lib():
                 fn.restype = None
                 fn.argtypes = [ctypes.c_int64, ctypes.c_int64] + \
                     [ctypes.c_void_p] * 7
+            handle.rs_cfsplit.restype = None
+            handle.rs_cfsplit.argtypes = [ctypes.c_int64] + \
+                [ctypes.c_void_p] * 6
             _LIB = handle
         return _LIB or None
 
@@ -399,3 +402,23 @@ def native_dia_fnma_batch(abase, a_idx, bbase, b_idx, shifts, obase,
     fn(n, len(a_idx), _ptr(abase), _ptr(a_idx), _ptr(bbase), _ptr(b_idx),
        _ptr(shifts), _ptr(obase), _ptr(out_idx))
     return True
+
+
+def native_rs_cfsplit(ptr, col, strong, stp, stc, cf):
+    """Classic RS C/F split (sequential dynamic measures) in native code;
+    returns the updated cf array or None when unavailable. ``cf`` arrives
+    with no-strong-connection rows pre-marked 2 and is modified in a
+    copy."""
+    L = lib()
+    if L is None:
+        return None
+    n = len(ptr) - 1
+    ptr = np.ascontiguousarray(ptr, dtype=np.int64)
+    col = np.ascontiguousarray(col, dtype=np.int32)
+    strong = np.ascontiguousarray(strong, dtype=np.uint8)
+    stp = np.ascontiguousarray(stp, dtype=np.int64)
+    stc = np.ascontiguousarray(stc, dtype=np.int32)
+    out = np.ascontiguousarray(cf, dtype=np.int8).copy()
+    L.rs_cfsplit(n, _ptr(ptr), _ptr(col), _ptr(strong), _ptr(stp),
+                 _ptr(stc), _ptr(out))
+    return out

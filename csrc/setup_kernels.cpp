@@ -724,3 +724,68 @@ void dia_fnma_batch_f32(int64_t n, int64_t npairs, const float* abase,
 }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Classic Ruge-Stuben C/F splitting (sequential dynamic measures).
+//
+// Reference role: amgcl/coarsening/ruge_stuben.hpp cfsplit. Independent
+// implementation: a lazy max-heap (stale entries skipped by comparing the
+// stored lambda against the current one) instead of the reference's bucket
+// arrays; tie-break on the smaller index so the result matches the Python
+// fallback in coarsening/ruge_stuben.py exactly.
+//
+// cf: in/out, one byte per point — 0 undecided, 1 coarse, 2 fine (rows
+// without strong connections arrive pre-marked 2).
+// ---------------------------------------------------------------------------
+
+#include <queue>
+#include <utility>
+
+extern "C" {
+
+void rs_cfsplit(int64_t n, const int64_t* ptr, const int32_t* col,
+                const uint8_t* strong, const int64_t* stp,
+                const int32_t* stc, int8_t* cf) {
+  std::vector<int64_t> lam(n);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t t = 0;
+    for (int64_t j = stp[i]; j < stp[i + 1]; ++j)
+      t += (cf[stc[j]] == 0) ? 1 : 2;
+    lam[i] = t;
+  }
+  // (lambda, -index): max lambda first, smaller index on ties
+  std::priority_queue<std::pair<int64_t, int64_t>> pq;
+  for (int64_t i = 0; i < n; ++i)
+    if (cf[i] == 0) pq.push({lam[i], -i});
+  while (!pq.empty()) {
+    const int64_t l = pq.top().first;
+    const int64_t i = -pq.top().second;
+    pq.pop();
+    if (cf[i] != 0 || l != lam[i]) continue;  // decided or stale
+    if (l == 0) {
+      for (int64_t k = 0; k < n; ++k)
+        if (cf[k] == 0) cf[k] = 1;
+      break;
+    }
+    cf[i] = 1;
+    for (int64_t j = stp[i]; j < stp[i + 1]; ++j) {
+      const int64_t c = stc[j];
+      if (cf[c] != 0) continue;
+      cf[c] = 2;
+      // the new F point raises its strong neighbours' lambdas
+      for (int64_t aj = ptr[c]; aj < ptr[c + 1]; ++aj) {
+        if (!strong[aj]) continue;
+        const int64_t ac = col[aj];
+        if (cf[ac] == 0 && lam[ac] + 1 < n) pq.push({++lam[ac], -ac});
+      }
+    }
+    // the new C point lowers its strong neighbours' lambdas
+    for (int64_t j = ptr[i]; j < ptr[i + 1]; ++j) {
+      if (!strong[j]) continue;
+      const int64_t c = col[j];
+      if (cf[c] == 0 && lam[c] > 0) pq.push({--lam[c], -c});
+    }
+  }
+}
+
+}  // extern "C"

@@ -243,3 +243,24 @@ def test_cpr_runtime_drs_keys():
     x, info = solve(rhs)
     r = rhs - A.spmv(np.asarray(x))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
+def test_cpr_active_rows_singular_well_block():
+    """active_rows must never invert the INACTIVE trailing blocks — the
+    reference only forms weights over the active rows (cpr.hpp:194), and
+    well/constraint blocks are routinely singular."""
+    A, rhs, N = wells_reservoir(6, 3)
+    # make every trailing well block singular (duplicate an in-block row)
+    b = 3
+    dia_mask = A.expanded_rows() == A.col
+    vals = A.val.copy()
+    rows = A.expanded_rows()
+    sel = dia_mask & (rows >= N // b)
+    blocks = vals[sel]
+    blocks[:, 2, :] = blocks[:, 1, :]      # rank-deficient
+    vals[sel] = blocks
+    A2 = CSR(A.ptr.copy(), A.col.copy(), vals, A.ncols)
+    pre = CPR(A2, pressure_prm=AMGParams(dtype=jnp.float64,
+                                         coarse_enough=50),
+              dtype=jnp.float64, active_rows=N)
+    assert pre.p_amg.host_levels[0][0].nrows == N // b
