@@ -390,14 +390,25 @@ def device_build(A: CSR, prm):
             blocks=blocks, coarse=coarse, relax_kind=relax_kind)
         counts_h, axis_h = jax.device_get((counts, axis_strong))
         # speculation check (ops/stencil.strength_axes semantics): every
-        # extent>1 axis must actually be strongly coupled, else this is a
-        # semicoarsening problem — the host path handles it
+        # extent>1 axis must actually be strongly coupled. A mismatch is a
+        # SEMICOARSENING problem: rerun the level with the measured axes
+        # (one extra compile per (dims, blocks) shape — cached across
+        # rebuilds); no strong axis at all means aggregation would stall,
+        # so that still falls back to the host MIS route.
         want = tuple(
             min(2, dims[i]) if dims[i] > 1 and axis_h[i] >= 0.5 * n else 1
             for i in range(3))
         if want != blocks:
-            return None if not dev_levels \
-                else result(leftover_csr(), None)
+            if all(b == 1 for b in want):
+                return None if not dev_levels \
+                    else result(leftover_csr(), None)
+            blocks = want
+            coarse = tuple(-(-d // b) for d, b in zip(dims, blocks))
+            m, mt, ac_all, scale, counts, axis_strong = _level_setup(
+                adata, jnp.float32(eps), jnp.float32(c.relax),
+                jnp.float32(sm_omega), offs=tuple(offs), dims=dims,
+                blocks=blocks, coarse=coarse, relax_kind=relax_kind)
+            counts_h = jax.device_get(counts)
 
         main_in = (0, 0, 0) in offs
         af_offs = list(offs) + ([] if main_in else [(0, 0, 0)])

@@ -432,8 +432,11 @@ def dist_stencil_build(A: CSR, mesh, prm, rep_coarse_enough: int = 3000):
         d2 = dims[0]
         lz = d2 // nd
         n = int(np.prod(dims))
+        # z must split evenly over the mesh; z-COARSENING additionally
+        # needs an even local slab (zb below) — semicoarsening in x/y
+        # alone works with any lz
         if (n <= rep_coarse_enough or len(offs) > _MAX_DIAGS
-                or d2 % (2 * nd) != 0 or lz % 2 != 0):
+                or d2 % nd != 0):
             break
         # Halo-width guard: _halo_extend ships w elements across ONE ring
         # hop, so w must not exceed the local slab (w > nl would make
@@ -446,29 +449,43 @@ def dist_stencil_build(A: CSR, mesh, prm, rep_coarse_enough: int = 3000):
         hmax_l = max(max(abs(_flat(o, dims)) for o in offs), 1)
         if hmax_l > nl_guard:
             break
-        blocks = tuple(2 if d > 1 else 1 for d in dims)
+        zb = 2 if dims[0] > 1 and lz % 2 == 0 else 1
+        blocks = (zb, 2 if dims[1] > 1 else 1, 2 if dims[2] > 1 else 1)
+        if all(b == 1 for b in blocks):
+            break
         coarse = tuple(-(-d // b) for d, b in zip(dims, blocks))
 
-        fn = shard_map(
-            partial(_sharded_level_setup,
-                    offs=tuple(offs), gdims=dims, lz=lz, blocks=blocks,
-                    coarse=coarse, relax_kind=relax_kind),
-            mesh=mesh,
-            in_specs=(P(None, ROWS_AXIS), P(), P(), P()),
-            out_specs=(P(None, ROWS_AXIS), P(None, ROWS_AXIS),
-                       P(None, ROWS_AXIS), P(ROWS_AXIS), P(), P()),
-            check_vma=False)
-        m, mt, ac, scale, counts, axis_strong = jax.jit(fn)(
-            adata, jnp.float32(eps), jnp.float32(c.relax),
-            jnp.float32(sm_omega))
+        def run_setup(blocks, coarse):
+            fn = shard_map(
+                partial(_sharded_level_setup,
+                        offs=tuple(offs), gdims=dims, lz=lz, blocks=blocks,
+                        coarse=coarse, relax_kind=relax_kind),
+                mesh=mesh,
+                in_specs=(P(None, ROWS_AXIS), P(), P(), P()),
+                out_specs=(P(None, ROWS_AXIS), P(None, ROWS_AXIS),
+                           P(None, ROWS_AXIS), P(ROWS_AXIS), P(), P()),
+                check_vma=False)
+            return jax.jit(fn)(adata, jnp.float32(eps),
+                               jnp.float32(c.relax), jnp.float32(sm_omega))
+
+        m, mt, ac, scale, counts, axis_strong = run_setup(blocks, coarse)
         counts_h, axis_h = jax.device_get((counts, axis_strong))
         want = tuple(
             min(2, dims[i]) if dims[i] > 1 and axis_h[i] >= 0.5 * n else 1
             for i in range(3))
         if want != blocks:
-            if not levels:
-                return None
-            break
+            # semicoarsening: rerun with the measured strong axes (as the
+            # device path does, ops/stencil_device.py). z-coarsening a
+            # strong z-axis with an odd local slab is not expressible on
+            # this mesh — fall back to the replicated tail.
+            if all(b == 1 for b in want) or (want[0] == 2 and zb == 1):
+                if not levels:
+                    return None
+                break
+            blocks = want
+            coarse = tuple(-(-d // b) for d, b in zip(dims, blocks))
+            m, mt, ac, scale, counts, _ = run_setup(blocks, coarse)
+            counts_h = jax.device_get(counts)
 
         main_in = (0, 0, 0) in offs
         af_offs = list(offs) + ([] if main_in else [(0, 0, 0)])

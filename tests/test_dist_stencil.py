@@ -89,9 +89,29 @@ def test_indivisible_grid_rejected(mesh8):
         DistStencilSolver(A, mesh8, AMGParams(dtype=jnp.float32))
 
 
-def test_anisotropic_outside_fast_path(mesh8):
-    # semicoarsening wants unequal blocks -> speculation check fails at
-    # level 0 -> build declines (callers use DistAMGSolver instead)
-    A, rhs = poisson3d(32, anisotropy=1e-3)
+def test_unstructured_outside_fast_path(mesh8):
+    # a non-stencil matrix has no grid -> build declines (callers use
+    # DistAMGSolver / StripAMGSolver instead). Anisotropy no longer
+    # declines — the semicoarsening rerun handles it (test below).
+    from amgcl_tpu.ops.unstructured import fe_like_problem
+    A, _ = fe_like_problem(n=2048, nnz_target=30_000, seed=7)
     got = dist_stencil_build(A, mesh8, AMGParams(dtype=jnp.float32), 600)
     assert got is None
+
+
+def test_sharded_setup_anisotropic_semicoarsening(mesh8):
+    """Anisotropy stays on the MESH-BUILT path: the speculation check
+    reruns the level with the measured strong axes instead of breaking
+    out (mirrors ops/stencil_device.py's device-path behavior)."""
+    A, rhs = poisson3d(16, anisotropy=1e-3)
+    s = DistStencilSolver(A, mesh8,
+                          AMGParams(dtype=jnp.float32, coarse_enough=300),
+                          CG(maxiter=100, tol=1e-6),
+                          rep_coarse_enough=300)
+    assert len(s.hier.levels) >= 1       # mesh-built despite anisotropy
+    # semicoarsening: first coarse level halves only the strong axes
+    assert s.meta[1] > s.meta[0] // 8    # not full 2x2x2 coarsening
+    x, info = s(rhs)
+    r = rhs - A.spmv(np.asarray(x, dtype=np.float64))
+    rel = float(np.linalg.norm(r) / np.linalg.norm(rhs))
+    assert rel < 1e-3

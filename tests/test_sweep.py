@@ -63,3 +63,72 @@ def test_relax_x_coarsening(problem, relax_name, coarse_name):
     r = rhs - A.spmv(np.asarray(x))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4, \
         (relax_name, coarse_name, info.iters)
+
+
+# ---------------------------------------------------------------------------
+# value-type cross product (reference: test_solver.hpp instantiates the
+# sweep per value type — double / complex / static_matrix / nullspace)
+# ---------------------------------------------------------------------------
+
+def _value_problem(vtype):
+    from amgcl_tpu.utils.sample_problem import (poisson3d_block,
+                                                poisson3d_complex)
+    if vtype == "block2":
+        return poisson3d_block(8, 2)
+    if vtype == "complex":
+        return poisson3d_complex(8)
+    if vtype == "nullspace":
+        n = 8
+        A, rhs = poisson3d(n)
+        g = np.arange(n)
+        X, _, _ = np.meshgrid(g, g, g, indexing="ij")
+        B = np.stack([np.ones(n ** 3), X.ravel() / n], axis=1)
+        return (A, B), rhs
+    raise AssertionError(vtype)
+
+
+def _value_params(relax_name="spai0"):
+    return dict(relax=RELAXATION[relax_name](), dtype=jnp.float64,
+                coarse_enough=150)
+
+
+@pytest.mark.parametrize("solver_name", SOLVER_NAMES)
+@pytest.mark.parametrize("vtype", ["block2", "complex"])
+def test_solver_x_valuetype(solver_name, vtype):
+    """Every Krylov solver against block and complex value types — the
+    interaction coverage the per-feature tests could not give."""
+    A, rhs = _value_problem(vtype)
+    if vtype == "complex" and solver_name == "idrs":
+        pytest.skip("IDR(s) shadow space is real-valued by construction")
+    solver = SOLVERS[solver_name](maxiter=400, tol=1e-6)
+    solve = make_solver(A, AMGParams(**_value_params()), solver)
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4, \
+        (solver_name, vtype, info.iters)
+
+
+@pytest.mark.parametrize("relax_name", RELAX_NAMES)
+@pytest.mark.parametrize("vtype", ["block2", "complex", "nullspace"])
+def test_relax_x_valuetype(relax_name, vtype):
+    """Every smoother family against block / complex / near-nullspace
+    fixtures, CG outer loop. Combinations the framework rejects must
+    raise loudly (reference convention: thrown logic_error == skip)."""
+    from amgcl_tpu.coarsening.smoothed_aggregation import \
+        SmoothedAggregation
+    prob, rhs = _value_problem(vtype)
+    kw = _value_params(relax_name)
+    if vtype == "nullspace":
+        A, B = prob
+        kw["coarsening"] = SmoothedAggregation(nullspace=B)
+    else:
+        A = prob
+    solver = SOLVERS["cg"](maxiter=400, tol=1e-6)
+    try:
+        solve = make_solver(A, AMGParams(**kw), solver)
+    except (NotImplementedError, ValueError) as e:
+        pytest.skip("combination rejected loudly: %s" % e)
+    x, info = solve(rhs)
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4, \
+        (relax_name, vtype, info.iters)
