@@ -349,7 +349,14 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
     pclass = str(pcfg.get("class", "amg"))
     solver = solver_from_params(scfg)
     if pclass == "amg":
-        return DistAMGSolver(A, mesh, precond_params_from_dict(pcfg), solver)
+        dist_kw = {}
+        for key, cast in (("repartition", float), ("replicate_below", int),
+                          ("device_mis", _parse_bool),
+                          ("min_per_shard", int)):
+            if key in pcfg:
+                dist_kw[key] = cast(pcfg.pop(key))
+        return DistAMGSolver(A, mesh, precond_params_from_dict(pcfg),
+                             solver, **dist_kw)
     if pclass == "deflated_amg":
         return DistDeflatedSolver(A, mesh, precond_params_from_dict(pcfg),
                                   solver)

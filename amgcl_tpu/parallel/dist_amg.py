@@ -449,7 +449,8 @@ class DistAMGSolver:
 
     def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
                  solver: Any = None, replicate_below: int = 4096,
-                 device_mis: bool = False, min_per_shard: int = 0):
+                 device_mis: bool = False, min_per_shard: int = 0,
+                 repartition: float = 0.0):
         """``device_mis=True`` runs the aggregation MIS rounds sharded on
         the mesh (parallel/dist_mis.py) instead of the host greedy pass —
         the reference's distributed-PMIS role
@@ -457,7 +458,13 @@ class DistAMGSolver:
         propagation.
 
         ``min_per_shard`` concentrates mid-size sharded levels on fewer
-        shards (the repartition-merge analogue, see the level loop)."""
+        shards (the repartition-merge analogue, see the level loop).
+
+        ``repartition`` > 0 permutes any coarse sharded level whose halo
+        fraction (parallel/repartition.py) exceeds the value — the
+        reference's mpi::partition::parmetis/ptscotch role
+        (parmetis.hpp:105-199: A <- I^T A I, P <- P I) realized as an RCM
+        locality permutation of the level's index space."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.mesh = mesh
@@ -527,6 +534,14 @@ class DistAMGSolver:
 
         nlocs = [lvl_nloc(h[0].nrows * h[0].block_size[0])
                  for h in host.host_levels[:t]]
+        self.repartition_report = []
+        if repartition and t > 1:
+            from amgcl_tpu.parallel.repartition import \
+                repartition_host_levels
+            # after nlocs: the halo metric must describe the EXECUTED
+            # layout, incl. the min_per_shard concentration
+            self.repartition_report = repartition_host_levels(
+                host.host_levels, t, float(repartition), nd, nlocs)
         levels = []
         for k, (Ak, Pk, Rk) in enumerate(host.host_levels[:t]):
             Ak_s = Ak.unblock() if Ak.is_block else Ak

@@ -1,0 +1,103 @@
+"""Coarse-level repartitioning for the row-block mesh decomposition.
+
+Reference role: ``mpi::partition::parmetis`` / ``ptscotch``
+(amgcl/mpi/partition/parmetis.hpp:105-199): produce a permutation matrix I
+per level and re-distribute A <- Iᵀ A I, P <- P I, R <- Iᵀ R so coarse rows
+live near the rows they couple with. On a TPU mesh the shard assignment is
+fixed (equal row blocks), so re-distribution IS a symmetric permutation
+that groups connected rows into the same block; the partitioner here is
+reverse Cuthill-McKee — contiguous slices of the RCM order are
+connectivity-localized blocks (the same locality objective as recursive
+graph bisection, reference examples/mpi/domain_partition.hpp, with
+machinery the framework already uses for DIA/windowed-ELL packing).
+
+Math is permutation-invariant: iteration counts do not change (pinned by
+tests/test_repartition.py); what changes is the HALO VOLUME — the unique
+remote values each shard fetches per SpMV. ``halo_fraction`` measures it;
+``DistAMGSolver(repartition=thr)`` permutes any coarse level whose
+fraction exceeds ``thr``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from amgcl_tpu.ops.csr import CSR
+
+
+def halo_fraction(A: CSR, nd: int, nloc: int | None = None) -> float:
+    """Average unique remote columns per shard under the row-block
+    partition (``nloc`` rows per shard; defaults to the even nd-way
+    spread), as a fraction of the block size — the per-iteration halo
+    traffic of the distributed SpMV relative to the local vector."""
+    S = A.unblock() if A.is_block else A
+    n = S.nrows
+    nloc = -(-n // nd) if nloc is None else int(nloc)
+    nd = min(nd, -(-n // nloc))    # shards actually holding rows
+    rows = S.expanded_rows()
+    row_shard = np.minimum(rows // nloc, nd - 1)
+    col_shard = np.minimum(S.col // nloc, nd - 1)
+    rem = row_shard != col_shard
+    if not rem.any():
+        return 0.0
+    keys = row_shard[rem].astype(np.int64) * n + S.col[rem]
+    return len(np.unique(keys)) / float(nd * nloc)
+
+
+def locality_permutation(A: CSR) -> np.ndarray:
+    """RCM ordering of the level operator: contiguous index ranges become
+    connectivity-local row blocks."""
+    from amgcl_tpu.utils.adapters import cuthill_mckee
+    return cuthill_mckee(A.unblock() if A.is_block else A)
+
+
+def _perm_cols(M: CSR, perm: np.ndarray) -> CSR:
+    """Column j of the result is old column perm[j]."""
+    m = M.to_scipy()[:, perm].tocsr()
+    m.sort_indices()
+    return CSR.from_scipy(m)
+
+
+def _perm_rows(M: CSR, perm: np.ndarray) -> CSR:
+    m = M.to_scipy()[perm].tocsr()
+    m.sort_indices()
+    return CSR.from_scipy(m)
+
+
+def repartition_host_levels(host_levels, t: int, threshold: float,
+                            nd: int, nlocs=None):
+    """Permute coarse levels 1..t-1 (the sharded ones below the finest)
+    whose halo fraction exceeds ``threshold``. host_levels entries are
+    (A_k, P_k, R_k) with P_k: (n_k, n_{k+1}); ``nlocs`` gives each
+    level's ACTUAL rows-per-shard (the min_per_shard shrink may
+    concentrate a level on fewer shards — the metric must describe the
+    executed layout). Modifies the list in place and returns
+    [(level, before, after), ...] for reporting. Level 0 keeps the
+    user's ordering; block-valued levels are left alone (their pointwise
+    layout is already cell-grouped)."""
+    report = []
+    for k in range(1, t):
+        Ak = host_levels[k][0]
+        if Ak.is_block:
+            continue
+        nloc_k = None if nlocs is None else nlocs[k]
+        before = halo_fraction(Ak, nd, nloc_k)
+        if before <= threshold:
+            continue
+        perm = locality_permutation(Ak)
+        from amgcl_tpu.utils.adapters import permute
+        A_new = permute(Ak, perm)
+        after = halo_fraction(A_new, nd, nloc_k)
+        if after >= before:
+            continue            # RCM did not help; keep the original
+        Pk, Rk = host_levels[k][1], host_levels[k][2]
+        Pprev, Rprev = host_levels[k - 1][1], host_levels[k - 1][2]
+        host_levels[k - 1] = (host_levels[k - 1][0],
+                              _perm_cols(Pprev, perm),
+                              _perm_rows(Rprev, perm))
+        host_levels[k] = (
+            A_new,
+            None if Pk is None else _perm_rows(Pk, perm),
+            None if Rk is None else _perm_cols(Rk, perm))
+        report.append((k, before, after))
+    return report

@@ -1,0 +1,70 @@
+"""Coarse-level repartitioning (parallel/repartition.py) — the
+mpi::partition::parmetis/ptscotch analogue (parmetis.hpp:105-199):
+permutation-based re-distribution of coarse levels that cuts halo volume
+without changing the math."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.parallel.mesh import make_mesh
+from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+from amgcl_tpu.parallel.repartition import halo_fraction
+
+
+@pytest.fixture(scope="module")
+def mesh8():
+    return make_mesh(8)
+
+
+@pytest.fixture(scope="module")
+def scrambled_poisson():
+    """24^3 Poisson with SCRAMBLED row order: every shard couples with
+    every other, and the coarse levels inherit the scrambling — the case
+    the repartitioner exists for."""
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.utils.adapters import permute
+    A, rhs = poisson3d(24)
+    rng = np.random.RandomState(0)
+    perm = rng.permutation(A.nrows)
+    return permute(A, perm), np.asarray(rhs)[perm]
+
+
+def test_halo_fraction_measures_locality():
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.utils.adapters import permute
+    A, _ = poisson3d(24)
+    ordered = halo_fraction(A, 8)        # banded: slab-boundary planes
+    rng = np.random.RandomState(1)
+    scrambled = halo_fraction(permute(A, rng.permutation(A.nrows)), 8)
+    assert ordered < 1.0
+    assert scrambled > 2 * ordered       # random: near-total halo
+
+
+def test_repartition_cuts_halo_keeps_iterations(mesh8, scrambled_poisson):
+    A, rhs = scrambled_poisson
+    prm = lambda: AMGParams(dtype=jnp.float32, coarse_enough=300)
+    s0 = DistAMGSolver(A, mesh8, prm(), CG(maxiter=200, tol=1e-6),
+                       replicate_below=500)
+    s1 = DistAMGSolver(A, mesh8, prm(), CG(maxiter=200, tol=1e-6),
+                       replicate_below=500, repartition=0.2)
+    assert s1.repartition_report, "no level was repartitioned"
+    for (k, before, after) in s1.repartition_report:
+        assert after < before
+    x0, i0 = s0(rhs)
+    x1, i1 = s1(rhs)
+    # permutation-invariant math; f32 summation-order drift at the tol
+    # boundary may cost/save one iteration
+    assert abs(i1.iters - i0.iters) <= 1
+    r = np.linalg.norm(rhs - A.to_scipy() @ x1) / np.linalg.norm(rhs)
+    assert r < 1e-3
+
+
+def test_repartition_off_by_default(mesh8):
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, rhs = poisson3d(16)
+    s = DistAMGSolver(A, mesh8, AMGParams(dtype=jnp.float32),
+                      CG(maxiter=100, tol=1e-6))
+    assert s.repartition_report == []
