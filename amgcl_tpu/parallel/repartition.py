@@ -11,11 +11,15 @@ connectivity-localized blocks (the same locality objective as recursive
 graph bisection, reference examples/mpi/domain_partition.hpp, with
 machinery the framework already uses for DIA/windowed-ELL packing).
 
-Math is permutation-invariant: iteration counts do not change (pinned by
-tests/test_repartition.py); what changes is the HALO VOLUME — the unique
-remote values each shard fetches per SpMV. ``halo_fraction`` measures it;
-``DistAMGSolver(repartition=thr)`` permutes any coarse level whose
-fraction exceeds ``thr``.
+For order-independent smoothers (spai0/jacobi/chebyshev/spai1) the math
+is permutation-invariant — iteration counts do not change (pinned by
+tests/test_repartition.py). Order-DEPENDENT smoothers (Chow-Patel ILU
+sweeps, multicolor GS coloring) see a different but equally valid
+ordering, so counts may drift a little, exactly as the reference's
+repartitioners cause. What always changes is the HALO VOLUME — the
+unique remote values each shard fetches per SpMV. ``halo_fraction``
+measures it; ``DistAMGSolver(repartition=thr)`` permutes any coarse
+level whose fraction exceeds ``thr``.
 """
 
 from __future__ import annotations

@@ -665,14 +665,19 @@ def main_worker():
     _stage("solve chained timing")
     reps = 4 if on_tpu else 2
 
-    def one(c):
-        r = rhs_dev if c is None else rhs_dev + 0 * c
-        got = solver._solve_fn(solver.A_dev, solver.A_dev64,
-                               solver.precond.hierarchy, r, x0)
-        return got[0].astype(jnp.float32)
+    def chained_step(slv):
+        # the 0*c term makes each solve data-depend on the previous one,
+        # so chained repetitions cannot be reordered or elided
+        def one(c):
+            r = rhs_dev if c is None else rhs_dev + 0 * c
+            got = slv._solve_fn(slv.A_dev, slv.A_dev64,
+                                slv.precond.hierarchy, r, x0)
+            return got[0].astype(jnp.float32)
+        return one
 
     try:
-        t_solve = _timed_chain(one, reps, 3 if on_tpu else 2, overhead)
+        t_solve = _timed_chain(chained_step(solver), reps,
+                               3 if on_tpu else 2, overhead)
         t_solve = max(t_solve, 1e-9)
     except Exception:
         t_solve = wall_per_call
@@ -714,6 +719,31 @@ def main_worker():
             _PARTIAL["extra_configs"] = _bench_extra_configs(on_tpu)
         except Exception as e:
             _PARTIAL["extra_configs"] = {"error": repr(e)}
+    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_BF16") == "1":
+        # the ROADMAP's f32-vs-bf16 hierarchy decision, measured: same
+        # problem, bf16 level operators (half the HBM bytes per
+        # iteration) + f64-residual refinement; more iterations vs
+        # cheaper iterations is exactly the hardware question
+        _stage("bf16 hierarchy probe")
+        try:
+            t0 = time.perf_counter()
+            prm16 = AMGParams(dtype=jnp.bfloat16)
+            solver16 = make_solver(A, prm16, CG(maxiter=200, tol=1e-6),
+                                   refine=3)
+            t_setup16 = time.perf_counter() - t0
+            x16, info16 = solver16(rhs_dev)
+            jax.block_until_ready(x16)
+            t16 = max(_timed_chain(chained_step(solver16), reps,
+                                   3 if on_tpu else 2, overhead), 1e-9)
+            tr16 = float(np.linalg.norm(
+                rhs - A.spmv(np.asarray(x16, np.float64)))
+                / np.linalg.norm(rhs))
+            _PARTIAL["bf16"] = {
+                "solve_s": round(t16, 4), "setup_s": round(t_setup16, 3),
+                "iters": int(info16.iters), "true_resid": tr16,
+                "speedup_vs_f32": round(t_solve / t16, 3)}
+        except Exception as e:
+            _PARTIAL["bf16"] = {"error": repr(e)}
     out = {"metric": _METRIC, "unit": "s"}
     out.update(_PARTIAL)
     if levels is not None:
