@@ -33,6 +33,10 @@ def main(argv=None):
     ap.add_argument("--mesh", type=int, default=0, metavar="N",
                     help="distributed solve over an N-device mesh "
                          "(the mpi_solver equivalent; 0 = serial)")
+    ap.add_argument("--strip-setup", action="store_true",
+                    help="with --mesh: build the hierarchy strip-parallel "
+                         "(distributed transpose/SpGEMM, no global "
+                         "assembly — precond.class=strip_amg)")
     ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
     ap.add_argument("-x", "--x0", help="initial guess file")
     args = ap.parse_args(argv)
@@ -77,6 +81,10 @@ def main(argv=None):
     def factory(mat):
         if isinstance(mat, CSR) and mat.is_block and args.block_size > 1:
             mat = mat.unblock()
+        if args.strip_setup and not args.mesh:
+            import warnings
+            warnings.warn("--strip-setup only applies with --mesh; "
+                          "running the serial build")
         if args.mesh:
             from amgcl_tpu.models.runtime import make_dist_solver_from_config
             from amgcl_tpu.parallel.mesh import make_mesh
@@ -86,6 +94,8 @@ def main(argv=None):
                               "solving the scalar system")
             if isinstance(mat, CSR) and mat.is_block:
                 mat = mat.unblock()
+            if args.strip_setup:
+                overrides.setdefault("precond.class", "strip_amg")
             return make_dist_solver_from_config(
                 mat, make_mesh(args.mesh), args.params, **overrides)
         return make_solver_from_config(mat, args.params,

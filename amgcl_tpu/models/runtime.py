@@ -357,6 +357,15 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
                 dist_kw[key] = cast(pcfg.pop(key))
         return DistAMGSolver(A, mesh, precond_params_from_dict(pcfg),
                              solver, **dist_kw)
+    if pclass == "strip_amg":
+        # strip-parallel SETUP (parallel/dist_setup.py): the hierarchy
+        # itself is built distributed — the mpi::amg step_down analogue
+        from amgcl_tpu.parallel.dist_setup import StripAMGSolver
+        strip_kw = {}
+        if "replicate_below" in pcfg:
+            strip_kw["replicate_below"] = int(pcfg.pop("replicate_below"))
+        return StripAMGSolver(A, mesh, precond_params_from_dict(pcfg),
+                              solver, **strip_kw)
     if pclass == "deflated_amg":
         return DistDeflatedSolver(A, mesh, precond_params_from_dict(pcfg),
                                   solver)

@@ -494,10 +494,12 @@ def _strip_diag(strips, nloc, my_shards=None):
     return out
 
 
-def _strip_filtered(strips, nloc, eps, comm):
+def _strip_filtered(strips, nloc, eps, comm, need_filtered=True):
     """Strength filter + weak-entry lumping per strip (the serial
     ``smoothed_aggregation._filtered`` with halo diagonal fetch).
-    Returns (Af_strips, Dfinv_strips, strong_offdiag_masks, ucols, dj)."""
+    Returns (Af_strips, Dfinv_strips, strong_offdiag_masks, ucols);
+    ``need_filtered=False`` (plain aggregation) skips assembling the
+    lumped Af/Dfinv — only the strength masks are produced."""
     nd = comm.nd
     dloc = _strip_diag(strips, nloc, comm.my_shards)
     ucols = [None] * nd
@@ -518,6 +520,9 @@ def _strip_filtered(strips, nloc, eps, comm):
             if S.nnz else np.zeros(0)
         is_dia = S.indices == rows + r0
         strong = (np.abs(S.data) ** 2 > eps * eps * di[rows] * dj)
+        strong_masks[s] = (strong & ~is_dia, rows)
+        if not need_filtered:
+            continue
         keep = strong | is_dia
         # lump removed entries onto the diagonal
         removed = np.bincount(rows[~keep], weights=S.data[~keep].real,
@@ -537,7 +542,6 @@ def _strip_filtered(strips, nloc, eps, comm):
         dF[frows[fdia]] = F.data[fdia]
         Af[s] = F
         Dfinv[s] = np.where(dF != 0, 1.0 / np.where(dF != 0, dF, 1), 1.0)
-        strong_masks[s] = (strong & ~is_dia, rows)
     return Af, Dfinv, strong_masks, ucols
 
 
@@ -611,60 +615,76 @@ def _strip_mis_aggregates(strips, strong_masks, n, nloc, mesh, comm,
 
 
 def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
-                    mis_rounds=40):
-    """One SA level on strips: (P_strips, Ac_strips, nc, nloc_c). R is NOT
-    formed here — between two sharded levels the caller transposes P
-    (strip_transpose); at the replicated-tail boundary the local
-    S.T suffices (TransitionOps), so a distributed transpose there would
-    be wasted traffic.
+                    mis_rounds=40, smooth=True, ac_scale=1.0):
+    """One aggregation level on strips: (P_strips, Ac_strips, nc, nloc_c).
+    ``smooth=True`` is smoothed aggregation (P = (I - w D^-1 Af) P_tent,
+    Gershgorin omega); ``smooth=False`` is plain aggregation (P = P_tent,
+    ``ac_scale`` applies the reference's 1/over_interp Galerkin scaling,
+    aggregation.hpp:71-160). R is NOT formed here — between two sharded
+    levels the caller transposes P (strip_transpose); at the
+    replicated-tail boundary the local S.T suffices (TransitionOps), so a
+    distributed transpose there would be wasted traffic.
 
-    Mirrors the serial SmoothedAggregation.transfer_operators +
-    galerkin exactly (same strength filter, same Gershgorin omega, same
-    MIS — so iteration counts match the serial device_mis build up to a
-    permutation of coarse unknowns)."""
+    Mirrors the serial policies + galerkin exactly (same strength filter,
+    same omega, same MIS — iteration counts match the serial device_mis
+    build up to a permutation of coarse unknowns)."""
     nd = comm.nd
-    Af, Dfinv, strong_masks, ucols = _strip_filtered(strips, nloc, eps,
-                                                     comm)
+    Af, Dfinv, strong_masks, ucols = _strip_filtered(
+        strips, nloc, eps, comm, need_filtered=smooth)
     agg, nc = _strip_mis_aggregates(strips, strong_masks, n, nloc, mesh,
                                     comm, mis_rounds)
     if nc == 0:
         raise ValueError("empty coarse level (all rows isolated)")
     nloc_c = -(-nc // nd)
 
-    # omega = relax * 4/3 / rho(Df^-1 Af), Gershgorin (builtin.hpp:775-820)
-    rho_loc = [None] * nd
-    for s in comm.my_shards:
-        absrow = np.asarray(np.abs(Af[s]).sum(axis=1)).ravel()
-        rho_loc[s] = float(np.max(np.abs(Dfinv[s]) * absrow)) \
-            if len(absrow) else 0.0
-    rho = comm.max_scalar(rho_loc)
-    omega = relax * (4.0 / 3.0) / max(rho, 1e-30)
-
-    # P strip: row i of (I - omega Df^-1 Af) P_tent. P_tent[j] = e_{agg_j}
-    # for agg_j >= 0, so P entries come straight from Af entries:
-    # coef_ij = delta_ij - omega * Dfinv_i * Af_ij, col = agg_j.
-    agg_cols = [None] * nd
-    for s in comm.my_shards:
-        F = Af[s]
-        agg_cols[s] = np.unique(F.indices) if F.nnz \
-            else np.zeros(0, np.int64)
-    agg_j_per = comm.fetch_vals(agg, nloc, agg_cols)
     P_strips = [None] * nd
-    for s in comm.my_shards:
-        F = Af[s]
-        r0 = s * nloc
-        m_s = F.shape[0]
-        rows = np.repeat(np.arange(m_s), np.diff(F.indptr))
-        aj = agg_j_per[s][np.searchsorted(agg_cols[s], F.indices)] \
-            if F.nnz else np.zeros(0, np.int64)
-        coef = -omega * Dfinv[s][rows] * F.data
-        coef = coef + (F.indices == rows + r0)   # the identity term
-        live = aj >= 0
-        Pm = sp.coo_matrix(
-            (coef[live], (rows[live], aj[live])), shape=(m_s, nc)).tocsr()
-        Pm.sum_duplicates()
-        Pm.sort_indices()
-        P_strips[s] = Pm
+    if smooth:
+        # omega = relax * 4/3 / rho(Df^-1 Af), Gershgorin
+        # (builtin.hpp:775-820)
+        rho_loc = [None] * nd
+        for s in comm.my_shards:
+            absrow = np.asarray(np.abs(Af[s]).sum(axis=1)).ravel()
+            rho_loc[s] = float(np.max(np.abs(Dfinv[s]) * absrow)) \
+                if len(absrow) else 0.0
+        rho = comm.max_scalar(rho_loc)
+        omega = relax * (4.0 / 3.0) / max(rho, 1e-30)
+
+        # P strip: row i of (I - omega Df^-1 Af) P_tent. P_tent[j] =
+        # e_{agg_j} for agg_j >= 0, so P entries come straight from Af:
+        # coef_ij = delta_ij - omega * Dfinv_i * Af_ij, col = agg_j.
+        agg_cols = [None] * nd
+        for s in comm.my_shards:
+            F = Af[s]
+            agg_cols[s] = np.unique(F.indices) if F.nnz \
+                else np.zeros(0, np.int64)
+        agg_j_per = comm.fetch_vals(agg, nloc, agg_cols)
+        for s in comm.my_shards:
+            F = Af[s]
+            r0 = s * nloc
+            m_s = F.shape[0]
+            rows = np.repeat(np.arange(m_s), np.diff(F.indptr))
+            aj = agg_j_per[s][np.searchsorted(agg_cols[s], F.indices)] \
+                if F.nnz else np.zeros(0, np.int64)
+            coef = -omega * Dfinv[s][rows] * F.data
+            coef = coef + (F.indices == rows + r0)  # the identity term
+            live = aj >= 0
+            Pm = sp.coo_matrix(
+                (coef[live], (rows[live], aj[live])),
+                shape=(m_s, nc)).tocsr()
+            Pm.sum_duplicates()
+            Pm.sort_indices()
+            P_strips[s] = Pm
+    else:
+        # plain aggregation: P_tent rows are unit vectors at the row's
+        # aggregate — strictly strip-local
+        for s in comm.my_shards:
+            a = agg[s]
+            live = np.flatnonzero(a >= 0)
+            Pm = sp.coo_matrix(
+                (np.ones(len(live)), (live, a[live])),
+                shape=(len(a), nc)).tocsr()
+            Pm.sort_indices()
+            P_strips[s] = Pm
 
     # Ac = P^T (A P): local product per strip, triples routed to the coarse
     # owner (this is the distributed Galerkin SpGEMM,
@@ -686,6 +706,8 @@ def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
         rr = np.concatenate([np.asarray(t[0]) for t in recv[d]])
         cc = np.concatenate([np.asarray(t[1]) for t in recv[d]])
         vv = np.concatenate([np.asarray(t[2]) for t in recv[d]])
+        if ac_scale != 1.0:
+            vv = vv * ac_scale
         Ac = sp.coo_matrix((vv, (rr - r0, cc)),
                            shape=(r1 - r0, nc)).tocsr()
         Ac.sum_duplicates()
@@ -821,14 +843,26 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
         import jax
         comm = MultihostComm(mesh) if jax.process_count() > 1 \
             else LocalComm(nd)
+    from amgcl_tpu.coarsening.aggregation import Aggregation
     c = prm.coarsening
-    if not isinstance(c, SmoothedAggregation):
-        raise ValueError("strip setup implements smoothed_aggregation; "
-                         "got %s" % type(c).__name__)
-    if c.nullspace is not None or c.block_size != 1 or c.power_iters:
-        raise ValueError("strip setup supports scalar SA with Gershgorin "
-                         "omega (no nullspace, block_size=1, "
-                         "power_iters=0)")
+    if isinstance(c, SmoothedAggregation):
+        smooth, ac_scale = True, 1.0
+        if c.power_iters:
+            raise ValueError("strip setup uses the Gershgorin omega "
+                             "(power_iters=0)")
+    elif isinstance(c, Aggregation):
+        smooth, ac_scale = False, 1.0 / float(c.over_interp)
+    else:
+        raise ValueError("strip setup implements smoothed_aggregation "
+                         "and aggregation; got %s" % type(c).__name__)
+    if c.nullspace is not None or c.block_size != 1:
+        raise ValueError("strip setup supports scalar aggregation only "
+                         "(no nullspace, block_size=1)")
+    if c.aggregator is not None:
+        raise ValueError(
+            "strip setup always aggregates with its own mesh-sharded MIS;"
+            " a custom aggregator hook would be silently ignored — drop "
+            "it or use the serial-build DistAMGSolver")
     dtype = prm.dtype
     eps = float(c.eps_strong)
     nloc = -(-n // nd)
@@ -846,7 +880,9 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
            and len(levels) < max_sharded_levels):
         try:
             P_s, Ac_s, nc, nloc_c = _strip_sa_level(
-                strips, n, nloc, mesh, comm, eps, c.relax, mis_rounds)
+                strips, n, nloc, mesh, comm, eps,
+                getattr(c, "relax", 1.0), mis_rounds,
+                smooth=smooth, ac_scale=ac_scale)
         except ValueError:
             break       # coarsening stalled: serial build breaks too
         if nc >= n:

@@ -36,3 +36,16 @@ print("distributed AMG-CG: %d iterations, resid %.2e" % (info.iters,
 d = DistDeflatedSolver(A, mesh, AMGParams(dtype=jnp.float64), CG(tol=1e-8))
 x, info = d(rhs)
 print("with subdomain deflation: %d iterations" % info.iters)
+
+# strip-parallel SETUP (the mpi_solver.cpp per-rank pattern): the
+# hierarchy itself is built distributed — each shard owns a row strip,
+# transposes route triples, SpGEMM fetches remote rows, and no process
+# ever assembles the global matrix. Under jax.distributed each controller
+# passes only its own strips (None elsewhere) — see
+# tests/test_multihost.py::test_two_process_strip_ingestion.
+from amgcl_tpu.parallel.dist_setup import StripAMGSolver
+
+st = StripAMGSolver(A, mesh, AMGParams(dtype=jnp.float64), CG(tol=1e-8))
+x, info = st(rhs)
+print("strip-parallel setup: %d iterations, peak strip nnz %d of %d"
+      % (info.iters, st.stats["peak_strip_nnz"], A.nnz))
