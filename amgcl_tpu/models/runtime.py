@@ -362,8 +362,9 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         # itself is built distributed — the mpi::amg step_down analogue
         from amgcl_tpu.parallel.dist_setup import StripAMGSolver
         strip_kw = {}
-        if "replicate_below" in pcfg:
-            strip_kw["replicate_below"] = int(pcfg.pop("replicate_below"))
+        for key, cast in (("replicate_below", int), ("mis_rounds", int)):
+            if key in pcfg:
+                strip_kw[key] = cast(pcfg.pop(key))
         return StripAMGSolver(A, mesh, precond_params_from_dict(pcfg),
                               solver, **strip_kw)
     if pclass == "deflated_amg":

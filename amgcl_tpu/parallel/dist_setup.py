@@ -918,7 +918,6 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
     prm_tail = copy.copy(prm)
     prm_tail.coarsening = copy.deepcopy(c)
     prm_tail.coarsening.eps_strong = eps
-    prm_tail.coarsening.aggregator = None
     # the user's depth bound covers sharded + replicated levels together
     prm_tail.max_levels = max(prm.max_levels - len(levels), 1)
     A_tail = _gather_strips(strips, (n, n), nloc, comm)
