@@ -721,13 +721,16 @@ def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
 # ===========================================================================
 
 def _strip_smoother(relax, strips, n, nloc, mesh, comm, dtype):
-    """Strip-local DistSmoother state. Row-local families only — the
-    global-factorization families (ilu*, gauss_seidel, spai1) need the
-    assembled matrix and are served by the serial-build DistAMGSolver."""
+    """Strip-local DistSmoother state: the row-local families plus
+    SPAI-1 (whose Gram rows come from the same remote-row fetch the
+    SpGEMM uses). The truly global factorizations (ilu*, gauss_seidel)
+    need the assembled matrix and are served by the serial-build
+    DistAMGSolver."""
     from amgcl_tpu.parallel.dist_amg import DistSmoother
     from amgcl_tpu.relaxation.spai0 import Spai0
     from amgcl_tpu.relaxation.jacobi import DampedJacobi
     from amgcl_tpu.relaxation.chebyshev import Chebyshev
+    from amgcl_tpu.relaxation.spai1 import Spai1
 
     nd = comm.nd
 
@@ -783,9 +786,45 @@ def _strip_smoother(relax, strips, n, nloc, mesh, comm, dtype):
                 [None if d is None else invsafe(d) for d in dia])
         return DistSmoother("cheb", dinv_sh, theta=(a + b) / 2,
                             delta=(b - a) / 2, degree=relax.degree)
+    if isinstance(relax, Spai1):
+        # row-wise least squares over A's pattern (spai1.hpp:54): row i's
+        # normal equations need B = A A^T restricted to J_i x J_i — every
+        # needed A row is in this strip's column set, so ONE remote-row
+        # fetch serves the whole Gram block. Same padded batched solve as
+        # the serial build — per-row results are identical.
+        from amgcl_tpu.relaxation.spai1 import (gather_sparse_entries,
+                                                padded_pattern,
+                                                pattern_normal_solve)
+        ucols = [None] * nd
+        for s in comm.my_shards:
+            S = strips[s]
+            ucols[s] = np.unique(S.indices) if S.nnz \
+                else np.zeros(0, np.int64)
+        Rsub = comm.fetch_rows(strips, nloc, ucols)
+        M_strips = [None] * nd
+        for s in comm.my_shards:
+            S = strips[s]          # only the pattern is read; values come
+            m_s = S.shape[0]       # from the fetched rows R
+            if S.nnz == 0:
+                M_strips[s] = sp.csr_matrix(S.shape)
+                continue
+            R = Rsub[s].astype(np.float64)   # rows ucols[s] of A
+            posJ = np.searchsorted(ucols[s], S.indices)
+            Jp, valid, rows, pos, K = padded_pattern(S.indptr, posJ)
+            B = (R @ R.T).tocsr()            # strip-local Gram
+            # rhs c[i, k] = A[J_ik, i_global] = R[posJ_ik, r0 + i]
+            gcols = np.repeat(s * nloc + np.arange(m_s), K)
+            c = gather_sparse_entries(R, Jp.ravel(), gcols).reshape(m_s, K)
+            mvals = pattern_normal_solve(Jp, valid, B, c)
+            M_strips[s] = sp.csr_matrix(
+                (mvals[rows, pos], S.indices.copy(), S.indptr.copy()),
+                shape=S.shape)
+        Msp = _strips_to_dist_ell(M_strips, mesh, (n, n), dtype, nloc,
+                                  nloc, comm)
+        return DistSmoother("spai1", Msp=Msp)
     raise ValueError(
         "smoother %s has no strip-parallel build; use spai0/damped_jacobi/"
-        "chebyshev, or the serial-build DistAMGSolver for ilu/gs/spai1"
+        "chebyshev/spai1, or the serial-build DistAMGSolver for ilu/gs"
         % type(relax).__name__)
 
 

@@ -43,6 +43,39 @@ def gather_sparse_entries(m: sp.csr_matrix, rows: np.ndarray,
     return np.where(valid, m.data[pos_c], 0.0)
 
 
+def padded_pattern(indptr, indices):
+    """(Jp, valid, rows, pos, K): row patterns padded to the max row
+    width. Padded slots carry index 0 — they are masked to identity
+    rows before the solve, so the fill value never matters."""
+    n = len(indptr) - 1
+    nnz_row = np.diff(indptr)
+    K = int(nnz_row.max()) if n else 1
+    rows = np.repeat(np.arange(n), nnz_row)
+    pos = np.arange(int(indptr[-1])) - np.asarray(indptr)[rows]
+    Jp = np.zeros((n, K), dtype=np.int64)
+    valid = np.zeros((n, K), dtype=bool)
+    Jp[rows, pos] = indices
+    valid[rows, pos] = True
+    return Jp, valid, rows, pos, K
+
+
+def pattern_normal_solve(Jp, valid, B, c):
+    """Batched least-squares core shared by the serial and strip SPAI-1
+    builds: G[i] = B[Jp_i, Jp_i] (padded slots -> identity rows with zero
+    rhs, tiny ridge for degenerate rows), one batched solve for every
+    m_i. ``c`` is the (n, K) right-hand side aligned with Jp."""
+    n, K = Jp.shape
+    qi = np.repeat(Jp, K, axis=1).ravel()
+    qj = np.tile(Jp, (1, K)).ravel()
+    G = gather_sparse_entries(B, qi, qj).reshape(n, K, K)
+    pad = ~valid
+    eye = np.eye(K)[None, :, :]
+    G = np.where(pad[:, :, None] | pad[:, None, :], eye, G)
+    c = np.where(pad, 0.0, c)
+    G = G + 1e-12 * eye
+    return np.linalg.solve(G, c[..., None])[..., 0]
+
+
 @register_pytree_node_class
 class Spai1State:
     """M with A's pattern, stored as a device sparse matrix."""
@@ -79,36 +112,13 @@ class Spai1:
         m = S.to_scipy().astype(np.float64)
         m.sort_indices()
         n = m.shape[0]
-        nnz_row = np.diff(m.indptr)
-        K = int(nnz_row.max())
-        rows = np.repeat(np.arange(n), nnz_row)
-        pos = np.arange(m.nnz) - m.indptr[rows]
-
-        # padded pattern: J[i, k] = k-th column of row i (pad = i itself,
-        # masked out of the solve)
-        J = np.tile(np.arange(n)[:, None], (1, K))
-        valid = np.zeros((n, K), dtype=bool)
-        J[rows, pos] = m.indices
-        valid[rows, pos] = True
-
+        J, valid, rows, pos, K = padded_pattern(m.indptr, m.indices)
         B = (m @ m.T).tocsr()
-        # gather G[i] = B[J_i, J_i] into (n, K, K)
-        qi = np.repeat(J, K, axis=1).ravel()          # row index of queries
-        qj = np.tile(J, (1, K)).ravel()
-        G = gather_sparse_entries(B, qi, qj).reshape(n, K, K)
-        # rhs: c[i, k] = A[J_ik, i]  (= Aᵀ entries)
-        # rhs entries A[J_ik, i] = Aᵀ[i, J_ik]
+        # rhs: c[i, k] = A[J_ik, i] = Aᵀ[i, J_ik]
         At = m.T.tocsr()
         c = gather_sparse_entries(
             At, np.repeat(np.arange(n), K), J.ravel()).reshape(n, K)
-        # mask padded slots: identity row/col with zero rhs
-        pad = ~valid
-        eye = np.eye(K)[None, :, :]
-        G = np.where(pad[:, :, None] | pad[:, None, :], eye, G)
-        c = np.where(pad, 0.0, c)
-        # diagonal ridge for safety on degenerate rows
-        G = G + 1e-12 * eye
-        mvals = np.linalg.solve(G, c[..., None])[..., 0]   # (n, K)
+        mvals = pattern_normal_solve(J, valid, B, c)       # (n, K)
 
         Mcsr = CSR(m.indptr.copy(), m.indices.copy(),
                    mvals[rows, pos], n)

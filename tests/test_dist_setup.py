@@ -144,13 +144,15 @@ def test_strips_ingestion_no_global_matrix(mesh8):
     assert r < 1e-5
 
 
-@pytest.mark.parametrize("relax_name", ["spai0", "jacobi", "chebyshev"])
+@pytest.mark.parametrize("relax_name", ["spai0", "jacobi", "chebyshev",
+                                        "spai1"])
 def test_strip_smoothers(mesh8, relax_name):
     from amgcl_tpu.relaxation.spai0 import Spai0
     from amgcl_tpu.relaxation.jacobi import DampedJacobi
     from amgcl_tpu.relaxation.chebyshev import Chebyshev
+    from amgcl_tpu.relaxation.spai1 import Spai1
     relax = {"spai0": Spai0(), "jacobi": DampedJacobi(),
-             "chebyshev": Chebyshev(degree=3)}[relax_name]
+             "chebyshev": Chebyshev(degree=3), "spai1": Spai1()}[relax_name]
     A, rhs = poisson3d(16)
     s = StripAMGSolver(A, mesh8, AMGParams(dtype=jnp.float32, relax=relax),
                        BiCGStab(tol=1e-6, maxiter=100),
