@@ -216,3 +216,22 @@ def test_direct_transfer_operators_call_is_pure():
     P2, _ = sa.transfer_operators(A)
     assert sa.eps_strong == SmoothedAggregation().eps_strong
     np.testing.assert_array_equal(np.asarray(P1.val), np.asarray(P2.val))
+
+
+def test_device_coarse_inverse(monkeypatch):
+    """AMGCL_TPU_DEVICE_INV=1: the coarse inverse runs on device in f32
+    with Newton-Schulz polish — convergence must match the host f64
+    inverse (it is cast to f32 anyway)."""
+    monkeypatch.setenv("AMGCL_TPU_DEVICE_INV", "1")
+    A, rhs = poisson3d(20)
+    solve = make_solver(A, AMGParams(dtype=jnp.float32),
+                        CG(maxiter=100, tol=1e-6))
+    x, info = solve(jnp.asarray(rhs, jnp.float32))
+    monkeypatch.setenv("AMGCL_TPU_DEVICE_INV", "0")
+    solve0 = make_solver(A, AMGParams(dtype=jnp.float32),
+                         CG(maxiter=100, tol=1e-6))
+    x0, info0 = solve0(jnp.asarray(rhs, jnp.float32))
+    assert abs(info.iters - info0.iters) <= 1
+    r = np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64))) \
+        / np.linalg.norm(rhs)
+    assert r < 1e-4
