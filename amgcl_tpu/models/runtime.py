@@ -352,7 +352,8 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         dist_kw = {}
         for key, cast in (("repartition", float), ("replicate_below", int),
                           ("device_mis", _parse_bool),
-                          ("min_per_shard", int)):
+                          ("min_per_shard", int),
+                          ("precond_dtype", _parse_dtype)):
             if key in pcfg:
                 dist_kw[key] = cast(pcfg.pop(key))
         return DistAMGSolver(A, mesh, precond_params_from_dict(pcfg),
@@ -362,7 +363,8 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         # itself is built distributed — the mpi::amg step_down analogue
         from amgcl_tpu.parallel.dist_setup import StripAMGSolver
         strip_kw = {}
-        for key, cast in (("replicate_below", int), ("mis_rounds", int)):
+        for key, cast in (("replicate_below", int), ("mis_rounds", int),
+                          ("precond_dtype", _parse_dtype)):
             if key in pcfg:
                 strip_kw[key] = cast(pcfg.pop(key))
         return StripAMGSolver(A, mesh, precond_params_from_dict(pcfg),

@@ -324,7 +324,12 @@ class DistHierarchy:
         return x
 
     def system_A(self):
-        return self.levels[0].A if self.levels else self.top_A
+        """The Krylov-loop operator. ``top_A`` takes precedence when set:
+        under a narrowed precond_dtype it holds the solver-precision copy
+        of the system matrix (mixing.hpp seam — the residual recursion
+        must track the full-precision operator, not the bf16 hierarchy's
+        finest level)."""
+        return self.top_A if self.top_A is not None else self.levels[0].A
 
 
 def _transition_ops(Pt: CSR, Rt: CSR, nd, nloc, mesh, dtype):
@@ -450,7 +455,7 @@ class DistAMGSolver:
     def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
                  solver: Any = None, replicate_below: int = 4096,
                  device_mis: bool = False, min_per_shard: int = 0,
-                 repartition: float = 0.0):
+                 repartition: float = 0.0, precond_dtype: Any = None):
         """``device_mis=True`` runs the aggregation MIS rounds sharded on
         the mesh (parallel/dist_mis.py) instead of the host greedy pass —
         the reference's distributed-PMIS role
@@ -464,7 +469,12 @@ class DistAMGSolver:
         fraction (parallel/repartition.py) exceeds the value — the
         reference's mpi::partition::parmetis/ptscotch role
         (parmetis.hpp:105-199: A <- I^T A I, P <- P I) realized as an RCM
-        locality permutation of the level's index space."""
+        locality permutation of the level's index space.
+
+        ``precond_dtype`` stores the sharded level/transfer/smoother
+        arrays in a narrower dtype (e.g. bfloat16 — halves HBM bytes per
+        V-cycle) while the Krylov vectors stay in ``prm.dtype`` — the
+        distributed rendition of the mixing.hpp precision seam."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.mesh = mesh
@@ -499,7 +509,8 @@ class DistAMGSolver:
             prm2.coarsening.stencil_setup = False
             self.prm = prm2
         self.solver = solver or CG()
-        dtype = self.prm.dtype
+        dtype = self.prm.dtype                    # Krylov vector dtype
+        mat_dtype = precond_dtype or dtype        # sharded operator dtype
         nd = mesh.shape[ROWS_AXIS]
 
         # serial host-side construction; the device filter skips serial
@@ -545,20 +556,20 @@ class DistAMGSolver:
         levels = []
         for k, (Ak, Pk, Rk) in enumerate(host.host_levels[:t]):
             Ak_s = Ak.unblock() if Ak.is_block else Ak
-            dA = build_dist_ell(Ak_s, mesh, dtype, nloc=nlocs[k],
+            dA = build_dist_ell(Ak_s, mesh, mat_dtype, nloc=nlocs[k],
                                 ncloc=nlocs[k])
             dP = dR = None
             # the last sharded level's transfers become the transition ops,
             # so don't build (then discard) distributed versions of them
             if Pk is not None and k != t - 1:
                 dP = build_dist_ell(
-                    Pk.unblock() if Pk.is_block else Pk, mesh, dtype,
+                    Pk.unblock() if Pk.is_block else Pk, mesh, mat_dtype,
                     nloc=nlocs[k], ncloc=nlocs[k + 1])
                 dR = build_dist_ell(
-                    Rk.unblock() if Rk.is_block else Rk, mesh, dtype,
+                    Rk.unblock() if Rk.is_block else Rk, mesh, mat_dtype,
                     nloc=nlocs[k + 1], ncloc=nlocs[k])
             sm = _build_dist_smoother(self.prm.relax, Ak, Ak_s, dA, mesh,
-                                      nd, dtype)
+                                      nd, mat_dtype)
             levels.append(DistLevel(dA, dP, dR, sm))
 
         # replicated tail = the serial device hierarchy's own levels
@@ -570,6 +581,9 @@ class DistAMGSolver:
         top_A = None
         trans = None
         if t == 0:
+            # no sharded levels: top_A IS the Krylov operator and nothing
+            # else — always solver precision (the preconditioner runs
+            # through the replicated hierarchy)
             A0 = host.host_levels[0][0]
             top_A = build_dist_ell(A0.unblock() if A0.is_block else A0,
                                    mesh, dtype)
@@ -579,7 +593,14 @@ class DistAMGSolver:
             trans = _transition_ops(
                 Pt.unblock() if Pt.is_block else Pt,
                 Rt.unblock() if Rt.is_block else Rt,
-                nd, levels[-1].A.nloc, mesh, dtype)
+                nd, levels[-1].A.nloc, mesh, mat_dtype)
+        if levels and jnp.dtype(mat_dtype) != jnp.dtype(dtype):
+            # mixing.hpp seam: the Krylov loop needs a solver-precision
+            # system matrix; the narrowed copy serves only the cycle
+            A0 = host.host_levels[0][0]
+            top_A = build_dist_ell(A0.unblock() if A0.is_block else A0,
+                                   mesh, dtype, nloc=nlocs[0],
+                                   ncloc=nlocs[0])
         self.hier = DistHierarchy(levels, rep, trans, top_A,
                                   self.prm.npre, self.prm.npost,
                                   self.prm.ncycle, self.prm.pre_cycles)

@@ -865,7 +865,7 @@ def _gather_strips(strips, shape, nloc, comm):
 
 def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
                        replicate_below: int = 4096, mis_rounds: int = 40,
-                       max_sharded_levels: int = 30):
+                       max_sharded_levels: int = 30, precond_dtype=None):
     """Build the distributed hierarchy from row strips. Returns
     (DistHierarchy, level_sizes, stats). No global matrix is ever
     assembled while levels stay sharded; the replicated tail (below
@@ -902,7 +902,8 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
             "strip setup always aggregates with its own mesh-sharded MIS;"
             " a custom aggregator hook would be silently ignored — drop "
             "it or use the serial-build DistAMGSolver")
-    dtype = prm.dtype
+    dtype = precond_dtype or prm.dtype   # sharded operator dtype
+    strips0, nloc0, n0 = strips, -(-n // nd), n   # finest level, for top_A
     eps = float(c.eps_strong)
     nloc = -(-n // nd)
     sizes = [n]
@@ -999,9 +1000,16 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
                               put(rc_parts, jnp.int32),
                               put(rv_parts, dtype))
     else:
-        top_A = _strips_to_dist_ell(strips, mesh, (n, n), dtype, nloc,
+        # no sharded levels: top_A is only the Krylov operator — always
+        # solver precision (the preconditioner runs through `rep`)
+        top_A = _strips_to_dist_ell(strips, mesh, (n, n), prm.dtype, nloc,
                                     nloc, comm)
 
+    if dist_levels and jnp.dtype(dtype) != jnp.dtype(prm.dtype):
+        # mixing.hpp seam: the Krylov loop tracks a solver-precision
+        # system matrix; the narrowed operators serve only the cycle
+        top_A = _strips_to_dist_ell(strips0, mesh, (n0, n0), prm.dtype,
+                                    nloc0, nloc0, comm)
     hier = DistHierarchy(dist_levels, rep, trans, top_A, prm.npre,
                          prm.npost, prm.ncycle, prm.pre_cycles)
     return hier, sizes, stats
@@ -1017,7 +1025,7 @@ class StripAMGSolver:
     def __init__(self, A_or_strips, mesh, prm: Optional[Any] = None,
                  solver: Any = None, n: Optional[int] = None,
                  replicate_below: int = 4096, comm=None,
-                 mis_rounds: int = 40):
+                 mis_rounds: int = 40, precond_dtype=None):
         import jax
         from amgcl_tpu.models.amg import AMGParams
         self.mesh = mesh
@@ -1061,7 +1069,8 @@ class StripAMGSolver:
                           for s in range(nd)]
         self.hier, self.sizes, self.stats = strip_sa_hierarchy(
             strips, n, mesh, self.prm, comm=comm,
-            replicate_below=replicate_below, mis_rounds=mis_rounds)
+            replicate_below=replicate_below, mis_rounds=mis_rounds,
+            precond_dtype=precond_dtype)
         self.n = int(n)
         first_A = self.hier.levels[0].A if self.hier.levels \
             else self.hier.top_A

@@ -512,3 +512,25 @@ def test_dist_cpr_runtime_config(mesh8):
                    "solver.maxiter": 200})
     x, info = s(rhs)
     assert info.resid < 1e-8
+
+
+def test_precond_dtype_mixed_precision(mesh8):
+    """Distributed mixing.hpp seam: bfloat16 hierarchy internals, f32
+    Krylov loop against a solver-precision system matrix — accuracy must
+    reach the f32 level, not the bf16 matrix floor."""
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    from amgcl_tpu.parallel.dist_setup import StripAMGSolver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(16)
+    for cls in (DistAMGSolver, StripAMGSolver):
+        s = cls(A, mesh8, AMGParams(dtype=jnp.float32),
+                CG(maxiter=200, tol=1e-6), precond_dtype=jnp.bfloat16)
+        x, info = s(rhs)
+        r = np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64))) \
+            / np.linalg.norm(rhs)
+        assert r < 1e-4, (cls.__name__, r)
+        # the narrowed copy must not replace the Krylov operator
+        import jax.numpy as _jnp
+        assert _jnp.dtype(s.hier.system_A().loc_vals.dtype) == \
+            _jnp.dtype(_jnp.float32)
