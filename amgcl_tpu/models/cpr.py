@@ -118,16 +118,61 @@ class CPR:
         else:
             np_cells = A.nrows
         self.np_cells = np_cells
+        self._wkw = dict(wkw)
+        self._relax = relax or Spai0()
         W = self._weights(A, np_cells=np_cells, **wkw)
         App = _pressure_matrix(A, W, np_cells)
         pprm = pressure_prm or AMGParams(dtype=dtype)
         self.p_amg = AMG(App, pprm)
-        smoother = (relax or Spai0()).build(A, dtype)
+        smoother = self._relax.build(A, dtype)
         self.hierarchy = CPRHierarchy(
             dev.to_device(A, "ell", dtype),
             jnp.asarray(W, dtype=dtype),
             self.p_amg.hierarchy, smoother, b,
             None if np_cells == A.nrows else np_cells)
+
+    def partial_update(self, A, update_transfer_ops: bool = True):
+        """Time-dependent resimulation fast path (reference:
+        cpr.hpp:159-186 ``partial_update``): the matrix VALUES changed but
+        the structure did not. The global-stage smoother is always
+        rebuilt; ``update_transfer_ops`` additionally refreshes the
+        decoupling weights and the pressure hierarchy (via AMG.rebuild's
+        reuse of the transfer structure)."""
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        if not A.is_block:
+            b0 = self.A_host.block_size[0]
+            if A.nrows % b0 or A.ncols % b0:
+                raise ValueError(
+                    "partial_update requires the same structure "
+                    "(dimensions, block size and sparsity pattern)")
+            A = A.to_block(b0)
+        if (A.shape != self.A_host.shape
+                or A.block_size != self.A_host.block_size
+                or not np.array_equal(A.ptr, self.A_host.ptr)
+                or not np.array_equal(A.col, self.A_host.col)):
+            raise ValueError(
+                "partial_update requires the same structure "
+                "(dimensions, block size and sparsity pattern)")
+        b = A.block_size[0]
+        h = self.hierarchy
+        A_dev = dev.to_device(A, "ell", self.dtype)
+        smoother = self._relax.build(A, self.dtype)
+        p_hier = h.p_hier
+        W_dev = h.W
+        if update_transfer_ops:
+            W = self._weights(A, np_cells=self.np_cells, **self._wkw)
+            W_dev = jnp.asarray(W, dtype=self.dtype)
+            # last fallible step: the in-place p_amg mutation
+            self.p_amg.rebuild(_pressure_matrix(A, W, self.np_cells))
+            p_hier = self.p_amg.hierarchy
+        self.A_host = A
+        self.hierarchy = CPRHierarchy(
+            A_dev, W_dev, p_hier, smoother, b, h.np_cells)
+
+    # make_solver.rebuild seam: CPR's structure-reusing refresh IS its
+    # rebuild (reference: make_solver owning amg::rebuild)
+    rebuild = partial_update
 
     @staticmethod
     def _weights(A: CSR, np_cells=None, **kw) -> np.ndarray:
