@@ -58,7 +58,7 @@ class CPRHierarchy:
         dp = self.p_hier.apply(rp)
         x = jnp.zeros_like(rb).at[:npc, 0].set(dp).reshape(r.shape)
         # global smoothing of the remaining residual
-        s = self.smoother.apply(self.A_full, r - dev.spmv(self.A_full, x))
+        s = self.smoother.apply(self.A_full, dev.residual(r, self.A_full, x))
         return x + s
 
     @property

@@ -62,14 +62,18 @@ class DiaMatrix:
         offsets, shape = aux
         return cls(offsets, children[0], shape)
 
+    def _pallas_ok(self, *vecs):
+        from amgcl_tpu.ops.pallas_spmv import pallas_enabled
+        # f64 (refinement's wide operator) stays on the XLA path —
+        # Mosaic's f64 vector support is partial
+        return (pallas_enabled() and jax.default_backend() == "tpu"
+                and jnp.dtype(self.dtype).itemsize <= 4
+                and all(jnp.dtype(v.dtype).itemsize <= 4 for v in vecs))
+
     def mv(self, x):
         n, m = self.shape
-        from amgcl_tpu.ops.pallas_spmv import pallas_enabled, dia_spmv
-        if (pallas_enabled() and jax.default_backend() == "tpu"
-                and jnp.dtype(self.dtype).itemsize <= 4
-                and jnp.dtype(x.dtype).itemsize <= 4):
-            # f64 (refinement's wide operator) stays on the XLA path —
-            # Mosaic's f64 vector support is partial
+        from amgcl_tpu.ops.pallas_spmv import dia_spmv
+        if self._pallas_ok(x):
             return dia_spmv(self.offsets, self.data, x)
         lo = min(self.offsets + (0,))
         # each diagonal d reads xp[base+d : base+d+n); pad the tail so the
@@ -343,7 +347,14 @@ def spmv(A, x):
 
 
 def residual(f, A, x):
-    """r = f - A x (interface.hpp `residual`)."""
+    """r = f - A x (interface.hpp `residual`).
+
+    DIA operators take a fused single-pass Pallas kernel on TPU — the
+    composed spmv + subtract costs an extra HBM round-trip of A x because
+    XLA cannot fuse across the pallas_call boundary."""
+    if isinstance(A, DiaMatrix) and A._pallas_ok(x, f):
+        from amgcl_tpu.ops.pallas_spmv import dia_residual
+        return dia_residual(A.offsets, A.data, f, x)
     return f - A.mv(x)
 
 

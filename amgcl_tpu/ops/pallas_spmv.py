@@ -34,13 +34,13 @@ def pallas_enabled() -> bool:
     return os.environ.get("AMGCL_TPU_PALLAS", "1") != "0"
 
 
-@functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
-def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
-    """y = A x for DIA storage. offsets: static tuple; data: (ndiag, n);
-    x: (m,). Rows padded up to a tile multiple; result sliced back."""
-    from jax.experimental import pallas as pl
-    from jax.experimental.pallas import tpu as pltpu
+def _dia_window(offsets, data, x, tile, interpret):
+    """Shared tile/window geometry + padded operands for the DIA kernels.
 
+    Returns (base, win, n_pad, xp, dpad). BOTH dia_spmv and _dia_fused
+    must read x through exactly this geometry — any sizing fix here
+    services every kernel (round-1 finding: wide operators need
+    ``max(n_pad - tile + win, m + base)``)."""
     # Mosaic requires 1-D DMA slice starts/shapes aligned to the
     # 1024-element tiling, so the row tile must be a multiple of it on
     # real hardware (interpret mode has no such constraint)
@@ -55,7 +55,6 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
     # compare (wide matrices read far to the right of the tile's rows)
     hi = max(max(offsets + (0,)), 0)
     n_pad = -(-n // tile) * tile
-    ndiag = len(offsets)
     # Mosaic requires 1-D DMA slice shapes (and starts) aligned to the
     # 1024-element tiling; tile is a multiple of 1024, so round the halo
     # window up and size the padded x so the last tile's window is in range
@@ -66,6 +65,20 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
     xp = jnp.zeros(max(n_pad - tile + win, m + base), x.dtype)
     xp = jax.lax.dynamic_update_slice(xp, x, (base,))
     dpad = jnp.pad(data, ((0, 0), (0, n_pad - n)))
+    return base, win, n_pad, xp, dpad
+
+
+@functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
+def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
+    """y = A x for DIA storage. offsets: static tuple; data: (ndiag, n);
+    x: (m,). Rows padded up to a tile multiple; result sliced back."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = data.shape[1]
+    ndiag = len(offsets)
+    base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
+                                             interpret)
 
     def kernel(x_hbm, d_ref, o_ref, scratch, sem):
         i = pl.program_id(0)
@@ -101,3 +114,82 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
         interpret=interpret,
     )(xp, dpad)
     return out[:n]
+
+
+# -- fused residual / smoother-step kernels ---------------------------------
+#
+# The V-cycle's hot chain at every DIA level is residual-shaped:
+#   residual            r  = f − A x            (cycle + every Krylov loop)
+#   scaled correction   x' = x + w ∘ (f − A x)  (Jacobi/SPAI-0 sweeps)
+# Composed from dia_spmv + XLA elementwise, each costs an extra HBM
+# round-trip of the SpMV output (write y, read y back) plus one kernel
+# boundary, because XLA cannot fuse across a pallas_call. These kernels fold
+# the elementwise tail into the same single-pass-over-x structure as
+# dia_spmv: identical DMA window, identical static slices, only the
+# accumulator init (f tile) and the output expression differ — no new
+# Mosaic ops, so anywhere dia_spmv legalizes these do too.
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("offsets", "mode", "tile", "interpret"))
+def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = data.shape[1]
+    ndiag = len(offsets)
+    base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
+                                             interpret)
+    fp = jnp.pad(f, (0, n_pad - n))
+    out_dtype = jnp.result_type(data.dtype, x.dtype, f.dtype)
+    vecs = [fp]
+    if mode == "correction":
+        out_dtype = jnp.result_type(out_dtype, w.dtype)
+        vecs.append(jnp.pad(w, (0, n_pad - n)))
+
+    def kernel(x_hbm, d_ref, f_ref, *rest):
+        (*w_refs, o_ref, scratch, sem) = rest
+        i = pl.program_id(0)
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile, win)], scratch, sem)
+        cp.start()
+        cp.wait()
+        acc = f_ref[:].astype(out_dtype)
+        for k, d in enumerate(offsets):
+            acc = acc - d_ref[k, :] * scratch[pl.ds(base + d, tile)]
+        if mode == "residual":
+            o_ref[:] = acc
+        else:                       # x tile lives in the window already
+            xt = scratch[pl.ds(base, tile)].astype(out_dtype)
+            o_ref[:] = xt + w_refs[0][:] * acc
+
+    grid = (n_pad // tile,)
+    vec_spec = pl.BlockSpec((tile,), lambda i: (i,))
+    out = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),               # x stays in HBM
+            pl.BlockSpec((ndiag, tile), lambda i: (np.int32(0), i)),
+        ] + [vec_spec] * len(vecs),
+        out_specs=vec_spec,
+        out_shape=jax.ShapeDtypeStruct((n_pad,), out_dtype),
+        scratch_shapes=[
+            pltpu.VMEM((win,), x.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(xp, dpad, *vecs)
+    return out[:n]
+
+
+def dia_residual(offsets, data, f, x, tile: int = 2048,
+                 interpret: bool = False):
+    """r = f − A x in one pass (A in DIA storage, square or rectangular)."""
+    return _dia_fused(offsets, data, f, x, None, "residual", tile, interpret)
+
+
+def dia_scaled_correction(offsets, data, w, f, x, tile: int = 2048,
+                          interpret: bool = False):
+    """x + w ∘ (f − A x) in one pass — a damped-Jacobi/SPAI-0 sweep."""
+    return _dia_fused(offsets, data, f, x, w, "correction", tile, interpret)

@@ -47,7 +47,8 @@ class ChebyshevState:
         d = fs / self.theta
         z = d
         for _ in range(self.degree - 1):
-            r = fs - self._op(A, z)
+            r = dev.residual(fs, A, z) if not self.scale \
+                else fs - self._op(A, z)
             rho_new = 1.0 / (2.0 * sigma - rho)
             d = rho_new * rho * d + (2.0 * rho_new / self.delta) * r
             z = z + d
@@ -55,8 +56,7 @@ class ChebyshevState:
         return z
 
     def apply_pre(self, A, f, x):
-        r = f - dev.spmv(A, x)
-        return x + self.apply(A, r)
+        return x + self.apply(A, dev.residual(f, A, x))
 
     apply_post = apply_pre
 

@@ -53,7 +53,7 @@ class ILU0State:
             self.uinv, self.jacobi_iters, f)
 
     def apply_pre(self, A, f, x):
-        return x + self.apply(A, f - dev.spmv(A, x))
+        return x + self.apply(A, dev.residual(f, A, x))
 
     apply_post = apply_pre
 

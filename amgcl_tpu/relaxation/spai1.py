@@ -94,7 +94,7 @@ class Spai1State:
         return dev.spmv(self.M, f)
 
     def apply_pre(self, A, f, x):
-        return x + dev.spmv(self.M, f - dev.spmv(A, x))
+        return x + dev.spmv(self.M, dev.residual(f, A, x))
 
     apply_post = apply_pre
 

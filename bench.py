@@ -437,6 +437,19 @@ def _bench_levels(solver):
             else:
                 row["winner"] = "pallas" \
                     if row["pallas_us"] < row["xla_us"] else "xla"
+            # fused residual (one-pass f - A x) vs composed (spmv kernel +
+            # XLA subtract, with the HBM round-trip of A x in between) —
+            # decides whether the fused kernels stay default-on
+            from amgcl_tpu.ops.pallas_spmv import dia_residual
+            f = jnp.asarray(np.random.RandomState(li + 1).rand(M.shape[0]),
+                            dtype=jnp.float32)
+            row["fused_resid_us"] = round(max(timeit(
+                lambda v: dia_residual(offs, M.data, f, v,
+                                       interpret=interp), x)
+                - overhead, 0.0) / reps * 1e6, 1)
+            row["composed_resid_us"] = round(max(timeit(
+                lambda v: f - dia_spmv(offs, M.data, v, interpret=interp),
+                x) - overhead, 0.0) / reps * 1e6, 1)
         out.append(row)
     return out
 
