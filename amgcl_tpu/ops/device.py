@@ -62,19 +62,30 @@ class DiaMatrix:
         offsets, shape = aux
         return cls(offsets, children[0], shape)
 
-    def _pallas_ok(self, *vecs):
-        from amgcl_tpu.ops.pallas_spmv import pallas_enabled
+    def _pallas_mode(self, *vecs):
+        """None = use the XLA path; else the ``interpret`` flag for the
+        Pallas kernels (False on real TPU, True under the CI test hook)."""
+        from amgcl_tpu.ops.pallas_spmv import (pallas_enabled,
+                                               pallas_interpret_forced)
         # f64 (refinement's wide operator) stays on the XLA path —
         # Mosaic's f64 vector support is partial
-        return (pallas_enabled() and jax.default_backend() == "tpu"
+        if not (pallas_enabled()
                 and jnp.dtype(self.dtype).itemsize <= 4
-                and all(jnp.dtype(v.dtype).itemsize <= 4 for v in vecs))
+                and all(jnp.dtype(v.dtype).itemsize <= 4 for v in vecs)):
+            return None
+        if jax.default_backend() == "tpu":
+            return False
+        return True if pallas_interpret_forced() else None
+
+    def _pallas_ok(self, *vecs):
+        return self._pallas_mode(*vecs) is not None
 
     def mv(self, x):
         n, m = self.shape
         from amgcl_tpu.ops.pallas_spmv import dia_spmv
-        if self._pallas_ok(x):
-            return dia_spmv(self.offsets, self.data, x)
+        ip = self._pallas_mode(x)
+        if ip is not None:
+            return dia_spmv(self.offsets, self.data, x, interpret=ip)
         lo = min(self.offsets + (0,))
         # each diagonal d reads xp[base+d : base+d+n); pad the tail so the
         # slice stays in range even for tall (nrows > ncols) matrices —
@@ -352,9 +363,11 @@ def residual(f, A, x):
     DIA operators take a fused single-pass Pallas kernel on TPU — the
     composed spmv + subtract costs an extra HBM round-trip of A x because
     XLA cannot fuse across the pallas_call boundary."""
-    if isinstance(A, DiaMatrix) and A._pallas_ok(x, f):
-        from amgcl_tpu.ops.pallas_spmv import dia_residual
-        return dia_residual(A.offsets, A.data, f, x)
+    if isinstance(A, DiaMatrix):
+        ip = A._pallas_mode(x, f)
+        if ip is not None:
+            from amgcl_tpu.ops.pallas_spmv import dia_residual
+            return dia_residual(A.offsets, A.data, f, x, interpret=ip)
     return f - A.mv(x)
 
 

@@ -34,6 +34,14 @@ def pallas_enabled() -> bool:
     return os.environ.get("AMGCL_TPU_PALLAS", "1") != "0"
 
 
+def pallas_interpret_forced() -> bool:
+    """AMGCL_TPU_PALLAS_INTERPRET=1 routes the DIA dispatch seams through
+    the Pallas kernels in interpret mode on NON-TPU backends — a test hook
+    so CI exercises the production wiring (hierarchy/smoother/Krylov seams
+    through pallas_call), not just the kernels in isolation."""
+    return os.environ.get("AMGCL_TPU_PALLAS_INTERPRET") == "1"
+
+
 def _dia_window(offsets, data, x, tile, interpret):
     """Shared tile/window geometry + padded operands for the DIA kernels.
 

@@ -34,12 +34,14 @@ class ScaledResidualSmoother:
         return jnp.einsum("nij,nj->ni", self.scale, rb).reshape(r.shape)
 
     def apply_pre(self, A, f, x):
-        if self.scale.ndim == 1 and isinstance(A, dev.DiaMatrix) \
-                and A._pallas_ok(x, f, self.scale):
-            # one-pass fused sweep: spmv + subtract + scale + add would
-            # otherwise cross two pallas/XLA boundaries per application
-            from amgcl_tpu.ops.pallas_spmv import dia_scaled_correction
-            return dia_scaled_correction(A.offsets, A.data, self.scale, f, x)
+        if self.scale.ndim == 1 and isinstance(A, dev.DiaMatrix):
+            ip = A._pallas_mode(x, f, self.scale)
+            if ip is not None:
+                # one-pass fused sweep: spmv + subtract + scale + add would
+                # otherwise cross two pallas/XLA boundaries per application
+                from amgcl_tpu.ops.pallas_spmv import dia_scaled_correction
+                return dia_scaled_correction(A.offsets, A.data, self.scale,
+                                             f, x, interpret=ip)
         return x + self._mul(dev.residual(f, A, x))
 
     apply_post = apply_pre
