@@ -65,17 +65,8 @@ class DiaMatrix:
     def _pallas_mode(self, *vecs):
         """None = use the XLA path; else the ``interpret`` flag for the
         Pallas kernels (False on real TPU, True under the CI test hook)."""
-        from amgcl_tpu.ops.pallas_spmv import (pallas_enabled,
-                                               pallas_interpret_forced)
-        # f64 (refinement's wide operator) stays on the XLA path —
-        # Mosaic's f64 vector support is partial
-        if not (pallas_enabled()
-                and jnp.dtype(self.dtype).itemsize <= 4
-                and all(jnp.dtype(v.dtype).itemsize <= 4 for v in vecs)):
-            return None
-        if jax.default_backend() == "tpu":
-            return False
-        return True if pallas_interpret_forced() else None
+        from amgcl_tpu.ops.pallas_spmv import pallas_mode
+        return pallas_mode(self.dtype, *(v.dtype for v in vecs))
 
     def _pallas_ok(self, *vecs):
         return self._pallas_mode(*vecs) is not None

@@ -42,6 +42,21 @@ def pallas_interpret_forced() -> bool:
     return os.environ.get("AMGCL_TPU_PALLAS_INTERPRET") == "1"
 
 
+def pallas_mode(*dtypes):
+    """None = use the XLA path; else the ``interpret`` flag to pass the
+    kernels (False on real TPU, True under the CI interpret hook). All
+    participating dtypes must be <= 32-bit (Mosaic's f64 vector support
+    is partial)."""
+    import jax
+    if not pallas_enabled():
+        return None
+    if any(jnp.dtype(d).itemsize > 4 for d in dtypes):
+        return None
+    if jax.default_backend() == "tpu":
+        return False
+    return True if pallas_interpret_forced() else None
+
+
 def _dia_window(offsets, data, x, tile, interpret):
     """Shared tile/window geometry + padded operands for the DIA kernels.
 

@@ -144,11 +144,19 @@ def dia_halo_mv(data_l, flat_offs, x_l):
     next_head = lax.ppermute(x_l[:w], ROWS_AXIS, bwd)
 
     # ... while the interior streams: zero-filled local shifts, valid for
-    # rows [w, nl-w)
-    xp = jnp.pad(x_l, (w, w))
-    y0 = jnp.zeros(nl, dtype=acc_dt)
-    for k, s in enumerate(flat_offs):
-        y0 = y0 + data_l[k] * lax.dynamic_slice(xp, (w + s,), (nl,))
+    # rows [w, nl-w).  On TPU the interior takes the Pallas DIA kernel —
+    # its semantics ARE the zero-filled shift product, and the pallas_call
+    # consumes only x_l, so it still shares no operands with the ppermutes
+    # and overlaps the exchange exactly like the XLA loop.
+    from amgcl_tpu.ops.pallas_spmv import pallas_mode, dia_spmv
+    ip = pallas_mode(data_l.dtype, x_l.dtype)
+    if ip is not None:
+        y0 = dia_spmv(flat_offs, data_l, x_l, interpret=ip)
+    else:
+        xp = jnp.pad(x_l, (w, w))
+        y0 = jnp.zeros(nl, dtype=acc_dt)
+        for k, s in enumerate(flat_offs):
+            y0 = y0 + data_l[k] * lax.dynamic_slice(xp, (w + s,), (nl,))
 
     # exact edge rows from the received halo (2w rows, O(w·ndiag) work)
     xe = jnp.concatenate([prev_tail, x_l, next_head])
