@@ -44,14 +44,17 @@ class AMGParams:
 class Level:
     """Device-resident state of one hierarchy level."""
 
-    def __init__(self, A, relax, P=None, R=None):
+    def __init__(self, A, relax, P=None, R=None, down=None, up=None):
         self.A = A          # device matrix (level operator)
         self.relax = relax  # smoother state (None on the coarsest level)
         self.P = P          # prolongation to this level from the next coarser
         self.R = R          # restriction to the next coarser level
+        self.down = down    # optional fused residual+restrict kernel handle
+        self.up = up        # optional fused prolong+correct+smooth handle
 
     def tree_flatten(self):
-        return (self.A, self.relax, self.P, self.R), None
+        return (self.A, self.relax, self.P, self.R, self.down,
+                self.up), None
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -96,14 +99,24 @@ class Hierarchy:
                 u = lv.relax.apply_pre(lv.A, f, u)
         else:
             u = dev.clear(f)
-        r = dev.residual(f, lv.A, u)
-        fc = dev.spmv(lv.R, r)
+        if lv.down is not None:
+            # one-pass residual + filtered tentative restriction
+            fc = lv.down(f, u)
+        else:
+            r = dev.residual(f, lv.A, u)
+            fc = dev.spmv(lv.R, r)
         uc = self.cycle(i + 1, fc)
         for _ in range(self.ncycle - 1):      # W-cycle: extra coarse visits
             rc = dev.residual(fc, self.levels[i + 1].A, uc)
             uc = uc + self.cycle(i + 1, rc)
-        u = u + dev.spmv(lv.P, uc)
-        for _ in range(self.npost):
+        if lv.up is not None and self.npost >= 1:
+            # one-pass prolong + correct + first post-smoothing sweep
+            u = lv.up(f, u, uc)
+            extra = self.npost - 1
+        else:
+            u = u + dev.spmv(lv.P, uc)
+            extra = self.npost
+        for _ in range(extra):
             u = lv.relax.apply_post(lv.A, f, u)
         return u
 
@@ -270,10 +283,14 @@ class AMG:
             else:
                 P_dev = dev.to_device(P, "ell", dtype)
                 R_dev = dev.to_device(R, "ell", dtype)
+            A_dev = dev.to_device(Ai, prm.matrix_format, dtype)
+            from amgcl_tpu.ops.pallas_vcycle import (build_fused_down,
+                                                     build_fused_up)
+            relax_state = prm.relax.build(Ai, dtype)
             dev_levels.append(Level(
-                dev.to_device(Ai, prm.matrix_format, dtype),
-                prm.relax.build(Ai, dtype),
-                P_dev, R_dev))
+                A_dev, relax_state, P_dev, R_dev,
+                build_fused_down(A_dev, R_dev),
+                build_fused_up(A_dev, P_dev, relax_state)))
         Alast = host[-1][0]
         n_last = Alast.nrows * Alast.block_size[0]
         if prm.direct_coarse and n_last > max(4 * prm.coarse_enough, 20000):

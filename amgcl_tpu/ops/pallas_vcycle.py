@@ -1,0 +1,502 @@
+"""Fused V-cycle down-sweep kernel: rc = Tᵀ (I − Mᵀ) (f − A u) in ONE pass.
+
+The down-sweep tail at a grid-aligned stencil level chains three
+fine-grid traversals (residual, smoothed-restriction filter, tentative
+reduction), each separated by an HBM round-trip of an n-sized vector
+because XLA cannot fuse across pallas_call boundaries:
+
+    r  = f − A u            Pallas kernel       write n, read n
+    t  = r − Mᵀ r           Pallas kernel       write n, read n
+    rc = Tᵀ t               XLA reshape/reduce  write n/8
+
+This kernel folds the whole chain into one pass: per coarse z-plane it
+DMAs a fine 2-plane window (plus stencil halo) of f, u and both
+diagonal sets, computes r and t entirely in VMEM, and reduces the
+2×2×2 aggregates with a z-pair add followed by two small 0/1 matmuls
+(S_y · t₂ · S_x — the pairwise sums ride the MXU, avoiding stride-2
+lane slices that Mosaic may not legalize). Only the (c2, c1, c0)
+coarse result ever returns to HBM.
+
+Every op class here is already exercised by `ops/pallas_spmv.py` on
+real hardware (1-D aligned DMA windows, static VMEM slices, FMA) plus
+`jnp.dot` — but the composition is new and the chip is currently
+unreachable, so the builder PROBE-COMPILES on first use (the
+`ops/unstructured.py` pattern) and silently falls back to the composed
+path when Mosaic declines.
+
+Eligibility (v1, deliberately conservative): scalar DIA level operator
+and Mt, grid-aligned tentative with blocks (2,2,2), f0 % 128 == 0
+(keeps the (2s,) → (f1, f0) VMEM reshape layout-preserving),
+f1 % 8 == 0, ≤32-bit dtype, and a VMEM window estimate under the cap.
+At the 128³ Poisson headline this covers level 0 — ~85% of cycle
+bytes; coarser levels keep the composed fused-residual path.
+
+Reference context: the reference's cycle does the same three ops as
+separate backend calls (amgcl/amg.hpp:514-553 + the spmv/residual
+primitives of backend/interface.hpp) — batching them is impossible on
+its vendor backends; on TPU it is the natural continuation of kernel
+fusion.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.tree_util import register_pytree_node_class
+
+
+_VMEM_CAP_BYTES = 12 << 20
+_PROBE_OK = {}
+
+
+def _round_up(v, m):
+    return -(-int(v) // int(m)) * int(m)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "offs_a", "offs_m", "dims", "coarse", "H", "interpret"))
+def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
+                     offs_a, offs_m, dims, coarse, H,
+                     interpret: bool = False):
+    """(c2, c1, c0) coarse rhs from fine f, u — see module docstring.
+
+    a_flat / mt_flat: the level's DIA data rows, each zero-padded into a
+    length-L aligned frame and flattened (built once at setup by
+    ``build_fused_down``). sy (c1, f1) / sx (f0, c0): 0/1 pairwise-sum
+    operators. H: halo frame (multiple of 512)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    f2, f1, f0 = dims
+    c2, c1, c0 = coarse
+    s = f1 * f0
+    n = f2 * s
+    n2 = 2 * c2 * s                   # fine rows rounded up to even planes
+    L = n2 + 2 * H
+    hA = max(max(offs_a), -min(offs_a), 0)
+    Hr = H - hA                       # halo left for the Mᵀ stage
+    W = 2 * s + 2 * H                 # DMA window per step
+    Wr = 2 * s + 2 * Hr               # extent on which r is valid
+    nA = len(offs_a)
+    nM = len(offs_m)
+    dt = f.dtype
+    if sy.shape != (c1, f1) or sx.shape != (f0, c0):
+        raise ValueError("pair-sum operator shapes %s/%s do not match "
+                         "(c1,f1)/(f0,c0)" % (sy.shape, sx.shape))
+
+    # place the cycle vectors into the kernel's aligned frame
+    fp = jnp.zeros(L, dt).at[H:H + n].set(f)
+    up = jnp.zeros(L, dt).at[H:H + n].set(u)
+
+    def kernel(af_hbm, mf_hbm, fp_hbm, up_hbm, sy_ref, sx_ref, o_ref,
+               sa, sm, sf, su, sems):
+        c = pl.program_id(0)
+        start = c * (2 * s)
+        cps = []
+        for k in range(nA):
+            cps.append(pltpu.make_async_copy(
+                af_hbm.at[pl.ds(k * L + start, W)], sa.at[k], sems.at[k]))
+        for k in range(nM):
+            cps.append(pltpu.make_async_copy(
+                mf_hbm.at[pl.ds(k * L + start, W)], sm.at[k],
+                sems.at[nA + k]))
+        cps.append(pltpu.make_async_copy(
+            fp_hbm.at[pl.ds(start, W)], sf, sems.at[nA + nM]))
+        cps.append(pltpu.make_async_copy(
+            up_hbm.at[pl.ds(start, W)], su, sems.at[nA + nM + 1]))
+        for cp in cps:
+            cp.start()
+        for cp in cps:
+            cp.wait()
+
+        # r = f − A u on the Wr frame (row j of the frame is global fine
+        # row c·2s − Hr + j; u reads stay inside the W window by hA)
+        acc = jnp.zeros((Wr,), dt)
+        for k, d in enumerate(offs_a):
+            acc = acc + sa[k, pl.ds(hA, Wr)] * su[pl.ds(hA + d, Wr)]
+        rext = sf[pl.ds(hA, Wr)] - acc
+
+        # t = r − Mᵀ r on the 2-plane tile (tile row i ↔ frame Hr + i)
+        accm = jnp.zeros((2 * s,), dt)
+        for k, d in enumerate(offs_m):
+            accm = accm + sm[k, pl.ds(H, 2 * s)] \
+                * jax.lax.dynamic_slice(rext, (Hr + d,), (2 * s,))
+        t = jax.lax.dynamic_slice(rext, (Hr,), (2 * s,)) - accm
+
+        # Tᵀ for 2×2×2 blocks: z-pair add, then MXU pairwise sums
+        t2 = (jax.lax.dynamic_slice(t, (0,), (s,))
+              + jax.lax.dynamic_slice(t, (s,), (s,))).reshape(f1, f0)
+        red = jnp.dot(sy_ref[:], t2, preferred_element_type=jnp.float32)
+        out = jnp.dot(red, sx_ref[:], preferred_element_type=jnp.float32)
+        o_ref[0] = out.astype(dt)
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(c2,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # a_flat
+            pl.BlockSpec(memory_space=pl.ANY),          # mt_flat
+            pl.BlockSpec(memory_space=pl.ANY),          # fp
+            pl.BlockSpec(memory_space=pl.ANY),          # up
+            pl.BlockSpec((c1, f1), lambda c: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((f0, c0), lambda c: (np.int32(0), np.int32(0))),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, c1, c0), lambda c: (c, np.int32(0), np.int32(0))),
+        out_shape=jax.ShapeDtypeStruct((c2, c1, c0), dt),
+        scratch_shapes=[
+            pltpu.VMEM((nA, W), dt),
+            pltpu.VMEM((nM, W), dt),
+            pltpu.VMEM((W,), dt),
+            pltpu.VMEM((W,), dt),
+            pltpu.SemaphoreType.DMA((nA + nM + 2,)),
+        ],
+        interpret=interpret,
+    )(a_flat, mt_flat, fp, up, sy, sx)
+    return out
+
+
+@register_pytree_node_class
+class FusedDownSweep:
+    """Device handle attached to a hierarchy Level; ``__call__(f, u)``
+    returns the restricted filtered residual as a flat coarse vector."""
+
+    def __init__(self, a_flat, mt_flat, sy, sx, offs_a, offs_m,
+                 dims, coarse, H, interpret):
+        self.a_flat = a_flat
+        self.mt_flat = mt_flat
+        self.sy = sy
+        self.sx = sx
+        self.offs_a = tuple(int(o) for o in offs_a)
+        self.offs_m = tuple(int(o) for o in offs_m)
+        self.dims = tuple(int(d) for d in dims)
+        self.coarse = tuple(int(c) for c in coarse)
+        self.H = int(H)
+        self.interpret = bool(interpret)
+
+    def tree_flatten(self):
+        return ((self.a_flat, self.mt_flat, self.sy, self.sx),
+                (self.offs_a, self.offs_m, self.dims, self.coarse,
+                 self.H, self.interpret))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __call__(self, f, u):
+        rc = fused_down_sweep(
+            self.a_flat, self.mt_flat, self.sy, self.sx, f, u,
+            self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
+            self.interpret)
+        return rc.reshape(-1)
+
+    def bytes(self):
+        return sum(a.size * a.dtype.itemsize
+                   for a in (self.a_flat, self.mt_flat, self.sy, self.sx))
+
+
+def _pair_sum(rows, cols, dtype):
+    """(rows, cols) 0/1 matrix summing index pairs: out[i] = in[2i]+in[2i+1]."""
+    m = np.zeros((rows, cols), np.float32)
+    m[np.arange(cols) // 2, np.arange(cols)] = 1.0
+    return jnp.asarray(m, dtype=dtype)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "offs_a", "offs_m", "dims", "coarse", "interpret"))
+def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
+                   offs_a, offs_m, dims, coarse, interpret: bool = False):
+    """u'' = u' + w ∘ (f − A u') with u' = u + (I − M) T uc, in ONE pass.
+
+    The up-sweep mirror of :func:`fused_down_sweep`: per coarse z-plane
+    the kernel expands three coarse planes (c−1, c, c+1 — the halo the
+    M product needs) through the transposed pair-sum matmuls, forms
+    u' = u + T uc − M (T uc) on a 6-plane frame in VMEM, and applies the
+    first post-smoothing sweep — prolongation, correction and smoother
+    in one fine-grid traversal, with only u'' returning to HBM.
+
+    a_data: the level's (nA, n) DIA data, read per-tile via BlockSpec.
+    m_flat: M's diagonals in a ±2s zero frame, flattened. rc3p: the
+    coarse vector as (c2+2, c1, c0) with one zero plane each side.
+    Eligibility (enforced by ``build_fused_up``): hA ≤ s, hM ≤ s and f2
+    even, so one coarse plane of halo suffices and no ghost fine plane
+    exists."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    f2, f1, f0 = dims
+    c2, c1, c0 = coarse
+    s = f1 * f0
+    n = f2 * s
+    Lm = n + 4 * s
+    nA = len(offs_a)
+    nM = len(offs_m)
+    dt = f.dtype
+    if syt.shape != (f1, c1) or sxt.shape != (c0, f0):
+        raise ValueError("pair-sum operator shapes %s/%s do not match "
+                         "(f1,c1)/(c0,f0)" % (syt.shape, sxt.shape))
+
+    def kernel(mf_hbm, up_hbm, a_ref, f_ref, w_ref, rm1, r0, rp1,
+               syt_ref, sxt_ref, o_ref, sm, su, tuc, sems):
+        c = pl.program_id(0)
+        start = c * (2 * s)
+        cps = [pltpu.make_async_copy(
+            up_hbm.at[pl.ds(start, 6 * s)], su, sems.at[0])]
+        for k in range(nM):
+            cps.append(pltpu.make_async_copy(
+                mf_hbm.at[pl.ds(k * Lm + start, 6 * s)], sm.at[k],
+                sems.at[1 + k]))
+        for cp in cps:
+            cp.start()
+        # T uc on the frame while the DMAs fly: MXU pair expansion of the
+        # three coarse planes, each written to two fine planes
+        for p, ref in enumerate((rm1, r0, rp1)):
+            plane = ref[0].astype(jnp.float32)
+            f2d = jnp.dot(syt_ref[:].astype(jnp.float32),
+                          jnp.dot(plane, sxt_ref[:].astype(jnp.float32),
+                                  preferred_element_type=jnp.float32),
+                          preferred_element_type=jnp.float32)
+            flat = f2d.reshape(s).astype(dt)
+            tuc[pl.ds(2 * p * s, s)] = flat
+            tuc[pl.ds((2 * p + 1) * s, s)] = flat
+        for cp in cps:
+            cp.wait()
+
+        # u' = u + T uc − M (T uc) on frame [s, 5s) (global rows
+        # [2cs − s, 2cs + 3s); zero-frame edges match global zero-fill)
+        accm = jnp.zeros((4 * s,), dt)
+        for k, d in enumerate(offs_m):
+            accm = accm + sm[k, pl.ds(s, 4 * s)] * tuc[pl.ds(s + d, 4 * s)]
+        upr = su[pl.ds(s, 4 * s)] + tuc[pl.ds(s, 4 * s)] - accm
+
+        # first post-smooth sweep on the tile
+        acc = jnp.zeros((2 * s,), dt)
+        for k, d in enumerate(offs_a):
+            acc = acc + a_ref[k, :] \
+                * jax.lax.dynamic_slice(upr, (s + d,), (2 * s,))
+        o_ref[:] = jax.lax.dynamic_slice(upr, (s,), (2 * s,)) \
+            + w_ref[:] * (f_ref[:] - acc)
+
+    if m_flat.ndim != 1:
+        raise ValueError("m_flat must be the pre-padded flat frame "
+                         "built by build_fused_up")
+    up = jnp.zeros(n + 4 * s, dt).at[2 * s:2 * s + n].set(u)
+    vec = pl.BlockSpec((2 * s,), lambda c: (c,))
+    plane = lambda off: pl.BlockSpec(
+        (1, c1, c0), lambda c, _o=off: (c + _o, np.int32(0), np.int32(0)))
+    out = pl.pallas_call(
+        kernel,
+        grid=(c2,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),              # m flat frame
+            pl.BlockSpec(memory_space=pl.ANY),              # u padded
+            pl.BlockSpec((nA, 2 * s), lambda c: (np.int32(0), c)),
+            vec, vec,                                       # f, w
+            plane(0), plane(1), plane(2),                   # rc planes
+            pl.BlockSpec((f1, c1), lambda c: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((c0, f0), lambda c: (np.int32(0), np.int32(0))),
+        ],
+        out_specs=vec,
+        out_shape=jax.ShapeDtypeStruct((n,), dt),
+        scratch_shapes=[
+            pltpu.VMEM((nM, 6 * s), dt),
+            pltpu.VMEM((6 * s,), dt),
+            pltpu.VMEM((6 * s,), dt),
+            pltpu.SemaphoreType.DMA((nM + 1,)),
+        ],
+        interpret=interpret,
+    )(m_flat, up, a_data, f, w, rc3p, rc3p, rc3p, syt, sxt)
+    return out
+
+
+@register_pytree_node_class
+class FusedUpSweep:
+    """Device handle for the fused prolong+correct+post-smooth pass."""
+
+    def __init__(self, a_data, m_flat, syt, sxt, w,
+                 offs_a, offs_m, dims, coarse, interpret):
+        self.a_data = a_data
+        self.m_flat = m_flat      # pre-padded frame, flattened
+        self.syt = syt
+        self.sxt = sxt
+        self.w = w
+        self.offs_a = tuple(int(o) for o in offs_a)
+        self.offs_m = tuple(int(o) for o in offs_m)
+        self.dims = tuple(int(d) for d in dims)
+        self.coarse = tuple(int(c) for c in coarse)
+        self.interpret = bool(interpret)
+
+    def tree_flatten(self):
+        return ((self.a_data, self.m_flat, self.syt, self.sxt, self.w),
+                (self.offs_a, self.offs_m, self.dims, self.coarse,
+                 self.interpret))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def __call__(self, f, u, uc):
+        c2, c1, c0 = self.coarse
+        rc3p = jnp.pad(uc.reshape(c2, c1, c0), ((1, 1), (0, 0), (0, 0)))
+        return fused_up_sweep(
+            self.a_data, self.m_flat, self.syt, self.sxt, rc3p,
+            f, self.w, u, self.offs_a, self.offs_m, self.dims,
+            self.coarse, self.interpret)
+
+    def bytes(self):
+        return sum(a.size * a.dtype.itemsize
+                   for a in (self.m_flat, self.syt, self.sxt, self.w))
+
+
+def build_fused_up(A_dev, P_dev, relax):
+    """FusedUpSweep for an eligible (A, P, smoother) triple, else None."""
+    from amgcl_tpu.ops.device import DiaMatrix
+    from amgcl_tpu.ops.structured import ImplicitSmoothedP, GridTentative
+    from amgcl_tpu.ops.pallas_spmv import pallas_mode
+    from amgcl_tpu.relaxation.base import ScaledResidualSmoother
+
+    if not isinstance(A_dev, DiaMatrix) \
+            or not isinstance(P_dev, ImplicitSmoothedP) \
+            or not isinstance(P_dev.T, GridTentative) \
+            or not isinstance(P_dev.M, DiaMatrix) \
+            or not isinstance(relax, ScaledResidualSmoother) \
+            or relax.scale.ndim != 1:
+        return None
+    T = P_dev.T
+    if T.block != (2, 2, 2):
+        return None
+    f2, f1, f0 = T.fine
+    if f0 % 128 or f1 % 8 or f2 % 2 or f2 < 2:
+        return None
+    dt = jnp.dtype(A_dev.dtype)
+    if dt != jnp.dtype(P_dev.M.dtype) or dt.itemsize > 4 \
+            or jnp.issubdtype(dt, jnp.complexfloating) \
+            or jnp.dtype(relax.scale.dtype) != dt:
+        return None
+    interpret = pallas_mode(dt)
+    if interpret is None:
+        return None
+    offs_a, offs_m = A_dev.offsets, P_dev.M.offsets
+    if not offs_a or not offs_m:
+        return None
+    s = f1 * f0
+    hA = max(max(offs_a), -min(offs_a), 0)
+    hM = max(max(offs_m), -min(offs_m), 0)
+    if hA > s or hM > s:
+        return None
+    n = A_dev.shape[0]
+    nA, nM = len(offs_a), len(offs_m)
+    if ((nM + 2) * 6 * s + (nA + 4) * 2 * s) * dt.itemsize \
+            > _VMEM_CAP_BYTES:
+        return None
+    c2, c1, c0 = T.coarse
+    Lm = n + 4 * s
+    m_flat = jnp.zeros((nM, Lm), dt).at[:, 2 * s:2 * s + n].set(
+        P_dev.M.data).reshape(-1)
+    syt = _pair_sum(c1, f1, dt).T
+    sxt = _pair_sum(c0, f0, dt)
+
+    if not interpret:
+        key = ("up", tuple(offs_a), tuple(offs_m), T.fine, T.coarse,
+               dt.name)
+        if key not in _PROBE_OK:
+            try:
+                av = jax.ShapeDtypeStruct((nA, n), dt)
+                mv = jax.ShapeDtypeStruct((nM * Lm,), dt)
+                sytv = jax.ShapeDtypeStruct((f1, c1), dt)
+                sxtv = jax.ShapeDtypeStruct((c0, f0), dt)
+                rv = jax.ShapeDtypeStruct((c2 + 2, c1, c0), dt)
+                fv = jax.ShapeDtypeStruct((n,), dt)
+                jax.jit(functools.partial(
+                    fused_up_sweep, offs_a=tuple(offs_a),
+                    offs_m=tuple(offs_m), dims=T.fine,
+                    coarse=T.coarse)).lower(
+                        av, mv, sytv, sxtv, rv, fv, fv, fv).compile()
+                _PROBE_OK[key] = True
+            except Exception:
+                _PROBE_OK[key] = False
+        if not _PROBE_OK[key]:
+            return None
+
+    return FusedUpSweep(A_dev.data, m_flat, syt, sxt, relax.scale,
+                        offs_a, offs_m, T.fine, T.coarse, interpret)
+
+
+def build_fused_down(A_dev, R_dev):
+    """FusedDownSweep for an eligible (A, R) pair, else None.
+
+    Eligibility and the probe-compile are both decided here, eagerly —
+    inside the outer solve jit a Mosaic legalization failure would only
+    surface at the OUTER compile, too late to fall back."""
+    from amgcl_tpu.ops.device import DiaMatrix
+    from amgcl_tpu.ops.structured import ImplicitSmoothedR, GridTentative
+    from amgcl_tpu.ops.pallas_spmv import pallas_mode
+
+    if not isinstance(A_dev, DiaMatrix) \
+            or not isinstance(R_dev, ImplicitSmoothedR) \
+            or not isinstance(R_dev.T, GridTentative) \
+            or not isinstance(R_dev.Mt, DiaMatrix):
+        return None
+    T = R_dev.T
+    if T.block != (2, 2, 2):
+        return None
+    f2, f1, f0 = T.fine
+    # odd f2 IS supported (the last coarse plane reduces over a zero
+    # ghost plane, matching GridTentative.rmv's pad)
+    if f0 % 128 or f1 % 8 or f2 < 2:
+        return None
+    dt = jnp.dtype(A_dev.dtype)
+    if dt != jnp.dtype(R_dev.Mt.dtype) or dt.itemsize > 4 \
+            or jnp.issubdtype(dt, jnp.complexfloating):
+        return None
+    interpret = pallas_mode(dt)
+    if interpret is None:
+        return None
+    offs_a, offs_m = A_dev.offsets, R_dev.Mt.offsets
+    if not offs_a or not offs_m:
+        return None
+    s = f1 * f0
+    hA = max(max(offs_a), -min(offs_a), 0)
+    hM = max(max(offs_m), -min(offs_m), 0)
+    # f0 % 128 == 0 and f1 % 8 == 0 make s (hence 2s and the DMA starts)
+    # a multiple of 1024, and H >= hA + hM by construction
+    H = _round_up(hA + hM, 512)
+    W = 2 * s + 2 * H
+    n_bufs = len(offs_a) + len(offs_m) + 2
+    if (n_bufs * W + 3 * s) * dt.itemsize > _VMEM_CAP_BYTES:
+        return None
+    c2, c1, c0 = T.coarse
+    n = A_dev.shape[0]
+    L = 2 * c2 * s + 2 * H
+
+    if not interpret:
+        key = (tuple(offs_a), tuple(offs_m), T.fine, T.coarse, H, dt.name)
+        if key not in _PROBE_OK:
+            try:
+                av = jax.ShapeDtypeStruct((len(offs_a) * L,), dt)
+                mv = jax.ShapeDtypeStruct((len(offs_m) * L,), dt)
+                syv = jax.ShapeDtypeStruct((c1, f1), dt)
+                sxv = jax.ShapeDtypeStruct((f0, c0), dt)
+                fv = jax.ShapeDtypeStruct((n,), dt)
+                jax.jit(functools.partial(
+                    fused_down_sweep, offs_a=tuple(offs_a),
+                    offs_m=tuple(offs_m), dims=T.fine, coarse=T.coarse,
+                    H=H)).lower(av, mv, syv, sxv, fv, fv).compile()
+                _PROBE_OK[key] = True
+            except Exception:
+                _PROBE_OK[key] = False
+        if not _PROBE_OK[key]:
+            return None
+
+    def _flat(M):
+        nd = len(M.offsets)
+        padded = jnp.zeros((nd, L), dt).at[:, H:H + n].set(M.data)
+        return padded.reshape(-1)
+
+    return FusedDownSweep(
+        _flat(A_dev), _flat(R_dev.Mt),
+        _pair_sum(c1, f1, dt), _pair_sum(c0, f0, dt).T,
+        offs_a, offs_m, T.fine, T.coarse, H, interpret)

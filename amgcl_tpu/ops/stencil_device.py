@@ -425,10 +425,16 @@ def device_build(A: CSR, prm):
         T = GridTentative(dims, blocks, coarse)
         M_dev = _to_dia_matrix(m, af_offs, dims, dtype)
         Mt_dev = _to_dia_matrix(mt, mt_offs, dims, dtype)
+        from amgcl_tpu.ops.pallas_vcycle import (build_fused_down,
+                                                 build_fused_up)
+        A_lvl = _to_dia_matrix(adata, offs, dims, dtype)
+        R_lvl = ImplicitSmoothedR(T, Mt_dev)
+        P_lvl = ImplicitSmoothedP(T, M_dev)
+        relax_lvl = ScaledResidualSmoother(scale.astype(jnp.dtype(dtype)))
         dev_levels.append(Level(
-            _to_dia_matrix(adata, offs, dims, dtype),
-            ScaledResidualSmoother(scale.astype(jnp.dtype(dtype))),
-            ImplicitSmoothedP(T, M_dev), ImplicitSmoothedR(T, Mt_dev)))
+            A_lvl, relax_lvl, P_lvl, R_lvl,
+            build_fused_down(A_lvl, R_lvl),
+            build_fused_up(A_lvl, P_lvl, relax_lvl)))
 
         adata, offs, dims = ac, new_offs, coarse
         n = int(np.prod(dims))

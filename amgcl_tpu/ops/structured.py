@@ -379,8 +379,10 @@ class ImplicitSmoothedP:
         return cls(*children)
 
     def mv(self, x):
+        from amgcl_tpu.ops import device as dev
         u = self.T.mv(x)
-        return u - self.M.mv(u)
+        # u - M u is residual-shaped: one fused pass on the Pallas path
+        return dev.residual(u, self.M, u)
 
     def bytes(self):
         return self.T.bytes() + self.M.bytes()
@@ -407,7 +409,8 @@ class ImplicitSmoothedR:
         return cls(*children)
 
     def mv(self, y):
-        return self.T.rmv(y - self.Mt.mv(y))
+        from amgcl_tpu.ops import device as dev
+        return self.T.rmv(dev.residual(y, self.Mt, y))
 
     def bytes(self):
         return self.T.bytes() + self.Mt.bytes()

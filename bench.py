@@ -450,6 +450,35 @@ def _bench_levels(solver):
             row["composed_resid_us"] = round(max(timeit(
                 lambda v: f - dia_spmv(offs, M.data, v, interpret=interp),
                 x) - overhead, 0.0) / reps * 1e6, 1)
+        if getattr(lv, "down", None) is not None:
+            # one-pass down-sweep tail vs the composed 3-op chain (the
+            # timeit scan needs shape-preserving fns, so wrap both to
+            # return a fine-grid vector via the prolongation broadcast)
+            f = jnp.asarray(np.random.RandomState(li + 2).rand(M.shape[0]),
+                            dtype=jnp.float32)
+            from amgcl_tpu.ops import device as _dv
+            T = lv.R.T
+            row["fused_down_us"] = round(max(timeit(
+                lambda v: T.mv(lv.down(f, v)), x) - overhead, 0.0)
+                / reps * 1e6, 1)
+            # honest baseline: the ACTUAL fallback path (which already
+            # rides the fused dia_residual kernel), not spmv + subtract
+            row["composed_down_us"] = round(max(timeit(
+                lambda v: T.mv(lv.R.mv(_dv.residual(f, lv.A, v))), x)
+                - overhead, 0.0) / reps * 1e6, 1)
+        if getattr(lv, "up", None) is not None:
+            from amgcl_tpu.ops import device as _d
+            f = jnp.asarray(np.random.RandomState(li + 3).rand(M.shape[0]),
+                            dtype=jnp.float32)
+            uc = jnp.asarray(np.random.RandomState(li + 4).rand(
+                lv.R.shape[0]), dtype=jnp.float32)
+            row["fused_up_us"] = round(max(timeit(
+                lambda v: lv.up(f, v, uc), x) - overhead, 0.0)
+                / reps * 1e6, 1)
+            row["composed_up_us"] = round(max(timeit(
+                lambda v: lv.relax.apply_post(
+                    lv.A, f, v + _d.spmv(lv.P, uc)), x) - overhead, 0.0)
+                / reps * 1e6, 1)
         out.append(row)
     return out
 
