@@ -93,16 +93,23 @@ class Hierarchy:
                 return self.coarse.solve(f)
             u = lv.relax.apply(lv.A, f)
             return u
-        if self.npre > 0:
-            u = lv.relax.apply(lv.A, f)       # first pre-sweep from zero
-            for _ in range(self.npre - 1):
-                u = lv.relax.apply_pre(lv.A, f, u)
+        fc = None
+        if self.npre == 1 and lv.down is not None \
+                and lv.down.w is not None:
+            # whole down-sweep in one pass: pre-smooth from zero,
+            # residual, filtered tentative restriction
+            u, fc = lv.down.zero(f)
         else:
-            u = dev.clear(f)
-        if lv.down is not None:
-            # one-pass residual + filtered tentative restriction
-            fc = lv.down(f, u)
-        else:
+            if self.npre > 0:
+                u = lv.relax.apply(lv.A, f)   # first pre-sweep from zero
+                for _ in range(self.npre - 1):
+                    u = lv.relax.apply_pre(lv.A, f, u)
+            else:
+                u = dev.clear(f)
+            if lv.down is not None:
+                # one-pass residual + filtered tentative restriction
+                fc = lv.down(f, u)
+        if fc is None:
             r = dev.residual(f, lv.A, u)
             fc = dev.spmv(lv.R, r)
         uc = self.cycle(i + 1, fc)
@@ -289,7 +296,7 @@ class AMG:
             relax_state = prm.relax.build(Ai, dtype)
             dev_levels.append(Level(
                 A_dev, relax_state, P_dev, R_dev,
-                build_fused_down(A_dev, R_dev),
+                build_fused_down(A_dev, R_dev, relax_state),
                 build_fused_up(A_dev, P_dev, relax_state)))
         Alast = host[-1][0]
         n_last = Alast.nrows * Alast.block_size[0]

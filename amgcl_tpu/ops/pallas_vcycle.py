@@ -57,16 +57,21 @@ def _round_up(v, m):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offs_a", "offs_m", "dims", "coarse", "H", "interpret"))
+    "offs_a", "offs_m", "dims", "coarse", "H", "zero_guess", "interpret"))
 def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
                      offs_a, offs_m, dims, coarse, H,
-                     interpret: bool = False):
+                     zero_guess: bool = False, interpret: bool = False):
     """(c2, c1, c0) coarse rhs from fine f, u — see module docstring.
 
     a_flat / mt_flat: the level's DIA data rows, each zero-padded into a
     length-L aligned frame and flattened (built once at setup by
     ``build_fused_down``). sy (c1, f1) / sx (f0, c0): 0/1 pairwise-sum
-    operators. H: halo frame (multiple of 512)."""
+    operators. H: halo frame (multiple of 512).
+
+    ``zero_guess``: the npre=1 cycle entry — ``u`` is then the
+    smoother's SCALE vector w, the pre-smoothed iterate u = w ∘ f is
+    formed in VMEM, and the kernel returns ``(rc3, u)`` so the whole
+    down-sweep is one pass with no separate smoothing launch."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -91,8 +96,12 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
     fp = jnp.zeros(L, dt).at[H:H + n].set(f)
     up = jnp.zeros(L, dt).at[H:H + n].set(u)
 
-    def kernel(af_hbm, mf_hbm, fp_hbm, up_hbm, sy_ref, sx_ref, o_ref,
-               sa, sm, sf, su, sems):
+    def kernel(af_hbm, mf_hbm, fp_hbm, up_hbm, sy_ref, sx_ref, *rest):
+        if zero_guess:
+            o_ref, o_u, sa, sm, sf, su, sems = rest
+        else:
+            o_ref, sa, sm, sf, su, sems = rest
+            o_u = None
         c = pl.program_id(0)
         start = c * (2 * s)
         cps = []
@@ -112,11 +121,19 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
         for cp in cps:
             cp.wait()
 
+        if zero_guess:
+            # su holds the scale frame: pre-smooth u = w ∘ f in VMEM
+            uext = su[:] * sf[:]
+            o_u[:] = jax.lax.dynamic_slice(uext, (H,), (2 * s,))
+            uslice = lambda a, b: jax.lax.dynamic_slice(uext, (a,), (b,))
+        else:
+            uslice = lambda a, b: su[pl.ds(a, b)]
+
         # r = f − A u on the Wr frame (row j of the frame is global fine
         # row c·2s − Hr + j; u reads stay inside the W window by hA)
         acc = jnp.zeros((Wr,), dt)
         for k, d in enumerate(offs_a):
-            acc = acc + sa[k, pl.ds(hA, Wr)] * su[pl.ds(hA + d, Wr)]
+            acc = acc + sa[k, pl.ds(hA, Wr)] * uslice(hA + d, Wr)
         rext = sf[pl.ds(hA, Wr)] - acc
 
         # t = r − Mᵀ r on the 2-plane tile (tile row i ↔ frame Hr + i)
@@ -133,6 +150,15 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
         out = jnp.dot(red, sx_ref[:], preferred_element_type=jnp.float32)
         o_ref[0] = out.astype(dt)
 
+    rc_spec = pl.BlockSpec(
+        (1, c1, c0), lambda c: (c, np.int32(0), np.int32(0)))
+    rc_shape = jax.ShapeDtypeStruct((c2, c1, c0), dt)
+    if zero_guess:
+        out_specs = (rc_spec, pl.BlockSpec((2 * s,), lambda c: (c,)))
+        out_shape = (rc_shape, jax.ShapeDtypeStruct((n2,), dt))
+    else:
+        out_specs = rc_spec
+        out_shape = rc_shape
     out = pl.pallas_call(
         kernel,
         grid=(c2,),
@@ -140,13 +166,12 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
             pl.BlockSpec(memory_space=pl.ANY),          # a_flat
             pl.BlockSpec(memory_space=pl.ANY),          # mt_flat
             pl.BlockSpec(memory_space=pl.ANY),          # fp
-            pl.BlockSpec(memory_space=pl.ANY),          # up
+            pl.BlockSpec(memory_space=pl.ANY),          # up (u or scale)
             pl.BlockSpec((c1, f1), lambda c: (np.int32(0), np.int32(0))),
             pl.BlockSpec((f0, c0), lambda c: (np.int32(0), np.int32(0))),
         ],
-        out_specs=pl.BlockSpec(
-            (1, c1, c0), lambda c: (c, np.int32(0), np.int32(0))),
-        out_shape=jax.ShapeDtypeStruct((c2, c1, c0), dt),
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((nA, W), dt),
             pltpu.VMEM((nM, W), dt),
@@ -162,14 +187,18 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
 @register_pytree_node_class
 class FusedDownSweep:
     """Device handle attached to a hierarchy Level; ``__call__(f, u)``
-    returns the restricted filtered residual as a flat coarse vector."""
+    returns the restricted filtered residual as a flat coarse vector.
+    ``zero(f)`` (available when the level smoother is a scalar scaled-
+    residual smoother — ``w`` is set) additionally forms the npre=1
+    pre-smoothed iterate in the same pass and returns ``(u, fc)``."""
 
-    def __init__(self, a_flat, mt_flat, sy, sx, offs_a, offs_m,
+    def __init__(self, a_flat, mt_flat, sy, sx, w, offs_a, offs_m,
                  dims, coarse, H, interpret):
         self.a_flat = a_flat
         self.mt_flat = mt_flat
         self.sy = sy
         self.sx = sx
+        self.w = w                    # smoother scale, or None
         self.offs_a = tuple(int(o) for o in offs_a)
         self.offs_m = tuple(int(o) for o in offs_m)
         self.dims = tuple(int(d) for d in dims)
@@ -178,7 +207,7 @@ class FusedDownSweep:
         self.interpret = bool(interpret)
 
     def tree_flatten(self):
-        return ((self.a_flat, self.mt_flat, self.sy, self.sx),
+        return ((self.a_flat, self.mt_flat, self.sy, self.sx, self.w),
                 (self.offs_a, self.offs_m, self.dims, self.coarse,
                  self.H, self.interpret))
 
@@ -190,8 +219,17 @@ class FusedDownSweep:
         rc = fused_down_sweep(
             self.a_flat, self.mt_flat, self.sy, self.sx, f, u,
             self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
-            self.interpret)
+            False, self.interpret)
         return rc.reshape(-1)
+
+    def zero(self, f):
+        """(u, fc) from rhs alone — the whole npre=1 down-sweep."""
+        n = int(np.prod(self.dims))
+        rc, u = fused_down_sweep(
+            self.a_flat, self.mt_flat, self.sy, self.sx, f, self.w,
+            self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
+            True, self.interpret)
+        return u[:n], rc.reshape(-1)
 
     def bytes(self):
         return sum(a.size * a.dtype.itemsize
@@ -425,15 +463,19 @@ def build_fused_up(A_dev, P_dev, relax):
                         offs_a, offs_m, T.fine, T.coarse, interpret)
 
 
-def build_fused_down(A_dev, R_dev):
+def build_fused_down(A_dev, R_dev, relax=None):
     """FusedDownSweep for an eligible (A, R) pair, else None.
 
-    Eligibility and the probe-compile are both decided here, eagerly —
-    inside the outer solve jit a Mosaic legalization failure would only
-    surface at the OUTER compile, too late to fall back."""
+    ``relax``: the level's smoother state; a scalar ScaledResidualSmoother
+    additionally enables the zero-guess mode (pre-smooth + residual +
+    restrict in one kernel). Eligibility and the probe-compile are both
+    decided here, eagerly — inside the outer solve jit a Mosaic
+    legalization failure would only surface at the OUTER compile, too
+    late to fall back."""
     from amgcl_tpu.ops.device import DiaMatrix
     from amgcl_tpu.ops.structured import ImplicitSmoothedR, GridTentative
     from amgcl_tpu.ops.pallas_spmv import pallas_mode
+    from amgcl_tpu.relaxation.base import ScaledResidualSmoother
 
     if not isinstance(A_dev, DiaMatrix) \
             or not isinstance(R_dev, ImplicitSmoothedR) \
@@ -472,24 +514,35 @@ def build_fused_down(A_dev, R_dev):
     n = A_dev.shape[0]
     L = 2 * c2 * s + 2 * H
 
+    w = None
+    if isinstance(relax, ScaledResidualSmoother) and relax.scale.ndim == 1 \
+            and jnp.dtype(relax.scale.dtype) == dt:
+        w = relax.scale
+
     if not interpret:
-        key = (tuple(offs_a), tuple(offs_m), T.fine, T.coarse, H, dt.name)
-        if key not in _PROBE_OK:
-            try:
-                av = jax.ShapeDtypeStruct((len(offs_a) * L,), dt)
-                mv = jax.ShapeDtypeStruct((len(offs_m) * L,), dt)
-                syv = jax.ShapeDtypeStruct((c1, f1), dt)
-                sxv = jax.ShapeDtypeStruct((f0, c0), dt)
-                fv = jax.ShapeDtypeStruct((n,), dt)
-                jax.jit(functools.partial(
-                    fused_down_sweep, offs_a=tuple(offs_a),
-                    offs_m=tuple(offs_m), dims=T.fine, coarse=T.coarse,
-                    H=H)).lower(av, mv, syv, sxv, fv, fv).compile()
-                _PROBE_OK[key] = True
-            except Exception:
-                _PROBE_OK[key] = False
-        if not _PROBE_OK[key]:
-            return None
+        for zg in ((False, True) if w is not None else (False,)):
+            key = (tuple(offs_a), tuple(offs_m), T.fine, T.coarse, H,
+                   dt.name, zg)
+            if key not in _PROBE_OK:
+                try:
+                    av = jax.ShapeDtypeStruct((len(offs_a) * L,), dt)
+                    mv = jax.ShapeDtypeStruct((len(offs_m) * L,), dt)
+                    syv = jax.ShapeDtypeStruct((c1, f1), dt)
+                    sxv = jax.ShapeDtypeStruct((f0, c0), dt)
+                    fv = jax.ShapeDtypeStruct((n,), dt)
+                    jax.jit(functools.partial(
+                        fused_down_sweep, offs_a=tuple(offs_a),
+                        offs_m=tuple(offs_m), dims=T.fine,
+                        coarse=T.coarse, H=H, zero_guess=zg)).lower(
+                            av, mv, syv, sxv, fv, fv).compile()
+                    _PROBE_OK[key] = True
+                except Exception:
+                    _PROBE_OK[key] = False
+            if not _PROBE_OK[key]:
+                if zg:
+                    w = None      # base kernel fine, zero-guess declined
+                else:
+                    return None
 
     def _flat(M):
         nd = len(M.offsets)
@@ -498,5 +551,5 @@ def build_fused_down(A_dev, R_dev):
 
     return FusedDownSweep(
         _flat(A_dev), _flat(R_dev.Mt),
-        _pair_sum(c1, f1, dt), _pair_sum(c0, f0, dt).T,
+        _pair_sum(c1, f1, dt), _pair_sum(c0, f0, dt).T, w,
         offs_a, offs_m, T.fine, T.coarse, H, interpret)

@@ -433,7 +433,7 @@ def device_build(A: CSR, prm):
         relax_lvl = ScaledResidualSmoother(scale.astype(jnp.dtype(dtype)))
         dev_levels.append(Level(
             A_lvl, relax_lvl, P_lvl, R_lvl,
-            build_fused_down(A_lvl, R_lvl),
+            build_fused_down(A_lvl, R_lvl, relax_lvl),
             build_fused_up(A_lvl, P_lvl, relax_lvl)))
 
         adata, offs, dims = ac, new_offs, coarse

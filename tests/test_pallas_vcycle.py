@@ -133,3 +133,22 @@ def test_fused_cycle_solve_parity(interpret_hook):
     assert i1.iters == i2.iters
     r = rhs - A.spmv(np.asarray(x1, dtype=np.float64))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
+
+
+def test_fused_down_zero_guess_exact(interpret_hook):
+    """zero(f) must match pre-smooth-from-zero + composed down chain."""
+    A, rhs = grid_laplacian(4, 8, 128)
+    amg = AMG(A, AMGParams(dtype=jnp.float32, coarse_enough=200))
+    lv = amg.hierarchy.levels[0]
+    assert lv.down is not None and lv.down.w is not None
+
+    rng = np.random.RandomState(4)
+    f = jnp.asarray(rng.rand(A.nrows), dtype=jnp.float32)
+    u_z, fc_z = lv.down.zero(f)
+    from amgcl_tpu.ops import device as dev
+    u_ref = lv.relax.apply(lv.A, f)
+    fc_ref = dev.spmv(lv.R, dev.residual(f, lv.A, u_ref))
+    np.testing.assert_allclose(np.asarray(u_z), np.asarray(u_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(fc_z), np.asarray(fc_ref),
+                               rtol=2e-5, atol=2e-5)
