@@ -152,3 +152,30 @@ def test_fused_down_zero_guess_exact(interpret_hook):
                                rtol=2e-5, atol=2e-5)
     np.testing.assert_allclose(np.asarray(fc_z), np.asarray(fc_ref),
                                rtol=2e-5, atol=2e-5)
+
+
+def test_fused_kernels_bf16(interpret_hook):
+    """bf16 hierarchy (precond_dtype seam) through both fused kernels:
+    eligibility holds (itemsize 2) and parity vs the composed bf16 path."""
+    A, rhs = grid_laplacian(4, 8, 128)
+    amg = AMG(A, AMGParams(dtype=jnp.bfloat16, coarse_enough=200))
+    lv = amg.hierarchy.levels[0]
+    if lv.down is None:
+        pytest.skip("bf16 level fell off the stencil path")
+    rng = np.random.RandomState(5)
+    f = jnp.asarray(rng.rand(A.nrows), dtype=jnp.bfloat16)
+    u = jnp.asarray(rng.rand(A.nrows), dtype=jnp.bfloat16)
+    from amgcl_tpu.ops import device as dev
+    fused = np.asarray(lv.down(f, u), dtype=np.float32)
+    composed = np.asarray(dev.spmv(lv.R, dev.residual(f, lv.A, u)),
+                          dtype=np.float32)
+    # bf16 accumulation orders differ; tolerance matches the format
+    scale = max(1.0, np.abs(composed).max())
+    assert np.max(np.abs(fused - composed)) / scale < 0.05
+    if lv.up is not None:
+        uc = jnp.asarray(rng.rand(lv.R.shape[0]), dtype=jnp.bfloat16)
+        fu = np.asarray(lv.up(f, u, uc), dtype=np.float32)
+        cu = np.asarray(lv.relax.apply_post(
+            lv.A, f, u + dev.spmv(lv.P, uc)), dtype=np.float32)
+        scale = max(1.0, np.abs(cu).max())
+        assert np.max(np.abs(fu - cu)) / scale < 0.05
