@@ -343,6 +343,14 @@ class AMG:
         ]
         for i, (Ai, _, _) in enumerate(self.host_levels):
             lines.append("%5d %12d %14d" % (i, Ai.nrows, Ai.nnz))
+        fused = [
+            "%d%s%s" % (i, "d" if lv.down is not None else "",
+                        "u" if lv.up is not None else "")
+            for i, lv in enumerate(self.hierarchy.levels)
+            if lv.down is not None or lv.up is not None]
+        if fused:
+            lines.append("fused V-cycle kernels (level+direction): "
+                         + " ".join(fused))
         return "\n".join(lines)
 
     def bytes(self):

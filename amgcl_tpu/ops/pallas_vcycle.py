@@ -56,6 +56,31 @@ def _round_up(v, m):
     return -(-int(v) // int(m)) * int(m)
 
 
+def _pack_shape(f1, f0, c1, c0):
+    """Lane-packing factor and the packed view of a plane.
+
+    For f0 < 128 (coarser levels), k = 128 // f0 consecutive fine y-rows
+    share one 128-lane row; a fine plane (f1, f0) is viewed flat-
+    preserving as (f1//k, 128) and the coarse plane (c1, c0) as
+    (f1//k, (k//2)·c0) — each packed row then holds complete y-pairs,
+    so the whole 2-D pair reduction (or expansion) is ONE matmul with a
+    0/1 operator instead of the two k=1 matmuls. Returns
+    (k, fine_view, coarse_view)."""
+    k = 128 // f0
+    if k <= 1:
+        return 1, (f1, f0), (c1, c0)
+    return k, (f1 // k, 128), (f1 // k, (k // 2) * c0)
+
+
+def _packed_reduce(f0, k, c0, dtype):
+    """(128, (k//2)·c0) 0/1 operator: packed fine row -> packed coarse
+    row, summing the 2x2 (y, x) pairs that live inside one packed row."""
+    m = np.zeros((128, (k // 2) * c0), np.float32)
+    j = np.arange(128)
+    m[j, (j // f0 // 2) * c0 + (j % f0) // 2] = 1.0
+    return jnp.asarray(m, dtype=dtype)
+
+
 @functools.partial(jax.jit, static_argnames=(
     "offs_a", "offs_m", "dims", "coarse", "H", "zero_guess", "interpret"))
 def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
@@ -88,9 +113,12 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
     nA = len(offs_a)
     nM = len(offs_m)
     dt = f.dtype
-    if sy.shape != (c1, f1) or sx.shape != (f0, c0):
-        raise ValueError("pair-sum operator shapes %s/%s do not match "
-                         "(c1,f1)/(f0,c0)" % (sy.shape, sx.shape))
+    k, fv, cv = _pack_shape(f1, f0, c1, c0)
+    pc1, pc0 = cv
+    if sy.shape != (pc1, fv[0]) or sx.shape != (fv[1], pc0):
+        raise ValueError("reduction operator shapes %s/%s do not match "
+                         "the packed plane views %s/%s"
+                         % (sy.shape, sx.shape, (pc1, fv[0]), (fv[1], pc0)))
 
     # place the cycle vectors into the kernel's aligned frame
     fp = jnp.zeros(L, dt).at[H:H + n].set(f)
@@ -143,16 +171,19 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
                 * jax.lax.dynamic_slice(rext, (Hr + d,), (2 * s,))
         t = jax.lax.dynamic_slice(rext, (Hr,), (2 * s,)) - accm
 
-        # Tᵀ for 2×2×2 blocks: z-pair add, then MXU pairwise sums
+        # Tᵀ for 2×2×2 blocks: z-pair add, then MXU pairwise sums on the
+        # lane-packed plane view (one matmul pair; for f0 < 128 the left
+        # operator is I over packed rows and the right one folds both
+        # the y- and x-pairs — see _pack_shape)
         t2 = (jax.lax.dynamic_slice(t, (0,), (s,))
-              + jax.lax.dynamic_slice(t, (s,), (s,))).reshape(f1, f0)
+              + jax.lax.dynamic_slice(t, (s,), (s,))).reshape(fv)
         red = jnp.dot(sy_ref[:], t2, preferred_element_type=jnp.float32)
         out = jnp.dot(red, sx_ref[:], preferred_element_type=jnp.float32)
         o_ref[0] = out.astype(dt)
 
     rc_spec = pl.BlockSpec(
-        (1, c1, c0), lambda c: (c, np.int32(0), np.int32(0)))
-    rc_shape = jax.ShapeDtypeStruct((c2, c1, c0), dt)
+        (1, pc1, pc0), lambda c: (c, np.int32(0), np.int32(0)))
+    rc_shape = jax.ShapeDtypeStruct((c2, pc1, pc0), dt)
     if zero_guess:
         out_specs = (rc_spec, pl.BlockSpec((2 * s,), lambda c: (c,)))
         out_shape = (rc_shape, jax.ShapeDtypeStruct((n2,), dt))
@@ -167,8 +198,10 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
             pl.BlockSpec(memory_space=pl.ANY),          # mt_flat
             pl.BlockSpec(memory_space=pl.ANY),          # fp
             pl.BlockSpec(memory_space=pl.ANY),          # up (u or scale)
-            pl.BlockSpec((c1, f1), lambda c: (np.int32(0), np.int32(0))),
-            pl.BlockSpec((f0, c0), lambda c: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((pc1, fv[0]),
+                         lambda c: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((fv[1], pc0),
+                         lambda c: (np.int32(0), np.int32(0))),
         ],
         out_specs=out_specs,
         out_shape=out_shape,
@@ -273,9 +306,13 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
     nA = len(offs_a)
     nM = len(offs_m)
     dt = f.dtype
-    if syt.shape != (f1, c1) or sxt.shape != (c0, f0):
-        raise ValueError("pair-sum operator shapes %s/%s do not match "
-                         "(f1,c1)/(c0,f0)" % (syt.shape, sxt.shape))
+    k2, fv, cv = _pack_shape(f1, f0, c1, c0)
+    pc1, pc0 = cv
+    if syt.shape != (fv[0], pc1) or sxt.shape != (pc0, fv[1]):
+        raise ValueError("expansion operator shapes %s/%s do not match "
+                         "the packed plane views %s/%s"
+                         % (syt.shape, sxt.shape, (fv[0], pc1),
+                            (pc0, fv[1])))
 
     def kernel(mf_hbm, up_hbm, a_ref, f_ref, w_ref, rm1, r0, rp1,
                syt_ref, sxt_ref, o_ref, sm, su, tuc, sems):
@@ -324,7 +361,8 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
     up = jnp.zeros(n + 4 * s, dt).at[2 * s:2 * s + n].set(u)
     vec = pl.BlockSpec((2 * s,), lambda c: (c,))
     plane = lambda off: pl.BlockSpec(
-        (1, c1, c0), lambda c, _o=off: (c + _o, np.int32(0), np.int32(0)))
+        (1, pc1, pc0),
+        lambda c, _o=off: (c + _o, np.int32(0), np.int32(0)))
     out = pl.pallas_call(
         kernel,
         grid=(c2,),
@@ -334,8 +372,10 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
             pl.BlockSpec((nA, 2 * s), lambda c: (np.int32(0), c)),
             vec, vec,                                       # f, w
             plane(0), plane(1), plane(2),                   # rc planes
-            pl.BlockSpec((f1, c1), lambda c: (np.int32(0), np.int32(0))),
-            pl.BlockSpec((c0, f0), lambda c: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((fv[0], pc1),
+                         lambda c: (np.int32(0), np.int32(0))),
+            pl.BlockSpec((pc0, fv[1]),
+                         lambda c: (np.int32(0), np.int32(0))),
         ],
         out_specs=vec,
         out_shape=jax.ShapeDtypeStruct((n,), dt),
@@ -377,8 +417,11 @@ class FusedUpSweep:
         return cls(*children, *aux)
 
     def __call__(self, f, u, uc):
-        c2, c1, c0 = self.coarse
-        rc3p = jnp.pad(uc.reshape(c2, c1, c0), ((1, 1), (0, 0), (0, 0)))
+        c2 = self.coarse[0]
+        _, _, cv = _pack_shape(self.dims[1], self.dims[2],
+                               self.coarse[1], self.coarse[2])
+        rc3p = jnp.pad(uc.reshape(c2, cv[0], cv[1]),
+                       ((1, 1), (0, 0), (0, 0)))
         return fused_up_sweep(
             self.a_data, self.m_flat, self.syt, self.sxt, rc3p,
             f, self.w, u, self.offs_a, self.offs_m, self.dims,
@@ -407,7 +450,9 @@ def build_fused_up(A_dev, P_dev, relax):
     if T.block != (2, 2, 2):
         return None
     f2, f1, f0 = T.fine
-    if f0 % 128 or f1 % 8 or f2 % 2 or f2 < 2:
+    k = 128 // f0 if f0 and 128 % f0 == 0 else 0
+    if (not k) or f0 % 2 or f1 % 2 or (k > 1 and f1 % k) \
+            or (f1 * f0) % 512 or f2 % 2 or f2 < 2:
         return None
     dt = jnp.dtype(A_dev.dtype)
     if dt != jnp.dtype(P_dev.M.dtype) or dt.itemsize > 4 \
@@ -434,8 +479,13 @@ def build_fused_up(A_dev, P_dev, relax):
     Lm = n + 4 * s
     m_flat = jnp.zeros((nM, Lm), dt).at[:, 2 * s:2 * s + n].set(
         P_dev.M.data).reshape(-1)
-    syt = _pair_sum(c1, f1, dt).T
-    sxt = _pair_sum(c0, f0, dt)
+    _, fvw, cvw = _pack_shape(f1, f0, c1, c0)
+    if k == 1:
+        syt = _pair_sum(c1, f1, dt).T
+        sxt = _pair_sum(c0, f0, dt)
+    else:
+        syt = jnp.eye(fvw[0], dtype=dt)
+        sxt = _packed_reduce(f0, k, c0, dt).T
 
     if not interpret:
         key = ("up", tuple(offs_a), tuple(offs_m), T.fine, T.coarse,
@@ -444,9 +494,9 @@ def build_fused_up(A_dev, P_dev, relax):
             try:
                 av = jax.ShapeDtypeStruct((nA, n), dt)
                 mv = jax.ShapeDtypeStruct((nM * Lm,), dt)
-                sytv = jax.ShapeDtypeStruct((f1, c1), dt)
-                sxtv = jax.ShapeDtypeStruct((c0, f0), dt)
-                rv = jax.ShapeDtypeStruct((c2 + 2, c1, c0), dt)
+                sytv = jax.ShapeDtypeStruct((fvw[0], cvw[0]), dt)
+                sxtv = jax.ShapeDtypeStruct((cvw[1], fvw[1]), dt)
+                rv = jax.ShapeDtypeStruct((c2 + 2, cvw[0], cvw[1]), dt)
                 fv = jax.ShapeDtypeStruct((n,), dt)
                 jax.jit(functools.partial(
                     fused_up_sweep, offs_a=tuple(offs_a),
@@ -487,8 +537,11 @@ def build_fused_down(A_dev, R_dev, relax=None):
         return None
     f2, f1, f0 = T.fine
     # odd f2 IS supported (the last coarse plane reduces over a zero
-    # ghost plane, matching GridTentative.rmv's pad)
-    if f0 % 128 or f1 % 8 or f2 < 2:
+    # ghost plane, matching GridTentative.rmv's pad); f0 < 128 levels
+    # pack k = 128//f0 y-rows per lane row (_pack_shape)
+    k = 128 // f0 if f0 and 128 % f0 == 0 else 0
+    if (not k) or f0 % 2 or f1 % 2 or (k > 1 and f1 % k) \
+            or (f1 * f0) % 512 or f2 < 2:
         return None
     dt = jnp.dtype(A_dev.dtype)
     if dt != jnp.dtype(R_dev.Mt.dtype) or dt.itemsize > 4 \
@@ -525,16 +578,17 @@ def build_fused_down(A_dev, R_dev, relax=None):
                    dt.name, zg)
             if key not in _PROBE_OK:
                 try:
+                    _, fvw, cvw = _pack_shape(f1, f0, c1, c0)
                     av = jax.ShapeDtypeStruct((len(offs_a) * L,), dt)
                     mv = jax.ShapeDtypeStruct((len(offs_m) * L,), dt)
-                    syv = jax.ShapeDtypeStruct((c1, f1), dt)
-                    sxv = jax.ShapeDtypeStruct((f0, c0), dt)
-                    fv = jax.ShapeDtypeStruct((n,), dt)
+                    syv = jax.ShapeDtypeStruct((cvw[0], fvw[0]), dt)
+                    sxv = jax.ShapeDtypeStruct((fvw[1], cvw[1]), dt)
+                    fvec = jax.ShapeDtypeStruct((n,), dt)
                     jax.jit(functools.partial(
                         fused_down_sweep, offs_a=tuple(offs_a),
                         offs_m=tuple(offs_m), dims=T.fine,
                         coarse=T.coarse, H=H, zero_guess=zg)).lower(
-                            av, mv, syv, sxv, fv, fv).compile()
+                            av, mv, syv, sxv, fvec, fvec).compile()
                     _PROBE_OK[key] = True
                 except Exception:
                     _PROBE_OK[key] = False
@@ -549,7 +603,12 @@ def build_fused_down(A_dev, R_dev, relax=None):
         padded = jnp.zeros((nd, L), dt).at[:, H:H + n].set(M.data)
         return padded.reshape(-1)
 
+    if k == 1:
+        red_a = _pair_sum(c1, f1, dt)
+        red_b = _pair_sum(c0, f0, dt).T
+    else:
+        red_a = jnp.eye(f1 // k, dtype=dt)
+        red_b = _packed_reduce(f0, k, c0, dt)
     return FusedDownSweep(
-        _flat(A_dev), _flat(R_dev.Mt),
-        _pair_sum(c1, f1, dt), _pair_sum(c0, f0, dt).T, w,
+        _flat(A_dev), _flat(R_dev.Mt), red_a, red_b, w,
         offs_a, offs_m, T.fine, T.coarse, H, interpret)

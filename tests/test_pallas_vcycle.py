@@ -179,3 +179,34 @@ def test_fused_kernels_bf16(interpret_hook):
             lv.A, f, u + dev.spmv(lv.P, uc)), dtype=np.float32)
         scale = max(1.0, np.abs(cu).max())
         assert np.max(np.abs(fu - cu)) / scale < 0.05
+
+
+@pytest.mark.parametrize("dims", [(4, 8, 64), (4, 32, 32)])
+def test_fused_packed_lanes(interpret_hook, dims):
+    """f0 < 128 levels pack k = 128//f0 y-rows per lane row; both fused
+    directions must stay exact under the packed reductions."""
+    A, rhs = grid_laplacian(*dims)
+    amg = AMG(A, AMGParams(dtype=jnp.float32, coarse_enough=100))
+    lv = amg.hierarchy.levels[0]
+    assert lv.down is not None, "packed grid %s not eligible" % (dims,)
+    rng = np.random.RandomState(6)
+    f = jnp.asarray(rng.rand(A.nrows), dtype=jnp.float32)
+    u = jnp.asarray(rng.rand(A.nrows), dtype=jnp.float32)
+    from amgcl_tpu.ops import device as dev
+    fused = np.asarray(lv.down(f, u))
+    composed = np.asarray(dev.spmv(lv.R, dev.residual(f, lv.A, u)))
+    np.testing.assert_allclose(fused, composed, rtol=2e-5, atol=2e-5)
+    u_z, fc_z = lv.down.zero(f)
+    u_ref = lv.relax.apply(lv.A, f)
+    np.testing.assert_allclose(np.asarray(u_z), np.asarray(u_ref),
+                               rtol=2e-5, atol=2e-5)
+    np.testing.assert_allclose(
+        np.asarray(fc_z),
+        np.asarray(dev.spmv(lv.R, dev.residual(f, lv.A, u_ref))),
+        rtol=2e-5, atol=2e-5)
+    if lv.up is not None:
+        uc = jnp.asarray(rng.rand(lv.R.shape[0]), dtype=jnp.float32)
+        fu = np.asarray(lv.up(f, u, uc))
+        cu = np.asarray(lv.relax.apply_post(
+            lv.A, f, u + dev.spmv(lv.P, uc)))
+        np.testing.assert_allclose(fu, cu, rtol=2e-5, atol=2e-5)
