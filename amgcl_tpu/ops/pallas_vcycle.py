@@ -276,6 +276,21 @@ def _pair_sum(rows, cols, dtype):
     return jnp.asarray(m, dtype=dtype)
 
 
+def _values_agree(got, want, dt):
+    """One-shot build-time numeric check of a fused kernel against the
+    composed path ON THE DEVICE. The probe-compile above catches Mosaic
+    legalization failures; this catches the silent-miscompute class that
+    interpret-mode CI cannot (interpret is not Mosaic). Tolerances are
+    format-scaled."""
+    got = np.asarray(got, np.float64)
+    want = np.asarray(want, np.float64)
+    if not (np.isfinite(got).all() and np.isfinite(want).all()):
+        return False
+    tol = 0.05 if jnp.dtype(dt) == jnp.bfloat16 else 2e-3
+    denom = np.linalg.norm(want) + 1e-30
+    return np.linalg.norm(got - want) / denom < tol
+
+
 @functools.partial(jax.jit, static_argnames=(
     "offs_a", "offs_m", "dims", "coarse", "interpret"))
 def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
@@ -509,8 +524,18 @@ def build_fused_up(A_dev, P_dev, relax):
         if not _PROBE_OK[key]:
             return None
 
-    return FusedUpSweep(A_dev.data, m_flat, syt, sxt, relax.scale,
-                        offs_a, offs_m, T.fine, T.coarse, interpret)
+    handle = FusedUpSweep(A_dev.data, m_flat, syt, sxt, relax.scale,
+                          offs_a, offs_m, T.fine, T.coarse, interpret)
+    if not interpret:
+        from amgcl_tpu.ops import device as _dev
+        rng = np.random.RandomState(19)
+        fv = jnp.asarray(rng.rand(n), dt)
+        uv = jnp.asarray(rng.rand(n), dt)
+        ucv = jnp.asarray(rng.rand(T.shape[1]), dt)
+        want = relax.apply_post(A_dev, fv, uv + P_dev.mv(ucv))
+        if not _values_agree(handle(fv, uv, ucv), want, dt):
+            return None
+    return handle
 
 
 def build_fused_down(A_dev, R_dev, relax=None):
@@ -609,6 +634,22 @@ def build_fused_down(A_dev, R_dev, relax=None):
     else:
         red_a = jnp.eye(f1 // k, dtype=dt)
         red_b = _packed_reduce(f0, k, c0, dt)
-    return FusedDownSweep(
+    handle = FusedDownSweep(
         _flat(A_dev), _flat(R_dev.Mt), red_a, red_b, w,
         offs_a, offs_m, T.fine, T.coarse, H, interpret)
+    if not interpret:
+        # real-hardware value check vs the (round-2-proven) composed path
+        from amgcl_tpu.ops import device as _dev
+        rng = np.random.RandomState(17)
+        fv = jnp.asarray(rng.rand(n), dt)
+        uv = jnp.asarray(rng.rand(n), dt)
+        want = R_dev.mv(_dev.residual(fv, A_dev, uv))
+        if not _values_agree(handle(fv, uv), want, dt):
+            return None
+        if w is not None:
+            uz, fz = handle.zero(fv)
+            uw = w * fv
+            if not (_values_agree(uz, uw, dt) and _values_agree(
+                    fz, R_dev.mv(_dev.residual(fv, A_dev, uw)), dt)):
+                handle.w = None     # base kernel fine, zero mode declined
+    return handle
