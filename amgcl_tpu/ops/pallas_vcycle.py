@@ -292,32 +292,36 @@ def _values_agree(got, want, dt):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offs_a", "offs_m", "dims", "coarse", "interpret"))
+    "offs_a", "offs_m", "dims", "coarse", "halo_planes", "interpret"))
 def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
-                   offs_a, offs_m, dims, coarse, interpret: bool = False):
+                   offs_a, offs_m, dims, coarse, halo_planes: int = 1,
+                   interpret: bool = False):
     """u'' = u' + w ∘ (f − A u') with u' = u + (I − M) T uc, in ONE pass.
 
     The up-sweep mirror of :func:`fused_down_sweep`: per coarse z-plane
-    the kernel expands three coarse planes (c−1, c, c+1 — the halo the
-    M product needs) through the transposed pair-sum matmuls, forms
-    u' = u + T uc − M (T uc) on a 6-plane frame in VMEM, and applies the
-    first post-smoothing sweep — prolongation, correction and smoother
-    in one fine-grid traversal, with only u'' returning to HBM.
+    the kernel expands the coarse plane plus ``halo_planes`` (= hp)
+    neighbors each side — the halo the A/M products need — through the
+    transposed pair-sum matmuls, forms u' = u + T uc − M (T uc) on a
+    (2hp+1)·2-plane frame in VMEM, and applies the first post-smoothing
+    sweep — prolongation, correction and smoother in one fine-grid
+    traversal, with only u'' returning to HBM.
 
     a_data: the level's (nA, n) DIA data, read per-tile via BlockSpec.
-    m_flat: M's diagonals in a ±2s zero frame, flattened. rc3p: the
-    coarse vector as (c2+2, c1, c0) with one zero plane each side.
-    Eligibility (enforced by ``build_fused_up``): hA ≤ s, hM ≤ s and f2
-    even, so one coarse plane of halo suffices and no ghost fine plane
-    exists."""
+    m_flat: M's diagonals in a ±hp·2s zero frame, flattened. rc3p: the
+    coarse vector in its packed plane view with hp zero planes each
+    side. Eligibility (enforced by ``build_fused_up``):
+    hA + hM ≤ hp·2s and f2 even (no ghost fine plane)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     f2, f1, f0 = dims
     c2, c1, c0 = coarse
+    hp = int(halo_planes)
     s = f1 * f0
     n = f2 * s
-    Lm = n + 4 * s
+    F = (2 * hp + 1) * 2 * s          # VMEM frame length
+    Lm = n + 2 * hp * 2 * s
+    hA = max(max(offs_a), -min(offs_a), 0)
     nA = len(offs_a)
     nM = len(offs_m)
     dt = f.dtype
@@ -328,22 +332,27 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
                          "the packed plane views %s/%s"
                          % (syt.shape, sxt.shape, (fv[0], pc1),
                             (pc0, fv[1])))
+    tile0 = hp * 2 * s                # tile offset inside the frame
+    seg0 = tile0 - hA                 # u' segment start (width 2s + 2hA)
+    E = 2 * s + 2 * hA
 
-    def kernel(mf_hbm, up_hbm, a_ref, f_ref, w_ref, rm1, r0, rp1,
-               syt_ref, sxt_ref, o_ref, sm, su, tuc, sems):
+    def kernel(*args):
+        (mf_hbm, up_hbm, a_ref, f_ref, w_ref) = args[:5]
+        planes = args[5:5 + 2 * hp + 1]
+        (syt_ref, sxt_ref, o_ref, sm, su, tuc, sems) = args[5 + 2 * hp + 1:]
         c = pl.program_id(0)
         start = c * (2 * s)
         cps = [pltpu.make_async_copy(
-            up_hbm.at[pl.ds(start, 6 * s)], su, sems.at[0])]
+            up_hbm.at[pl.ds(start, F)], su, sems.at[0])]
         for k in range(nM):
             cps.append(pltpu.make_async_copy(
-                mf_hbm.at[pl.ds(k * Lm + start, 6 * s)], sm.at[k],
+                mf_hbm.at[pl.ds(k * Lm + start, F)], sm.at[k],
                 sems.at[1 + k]))
         for cp in cps:
             cp.start()
-        # T uc on the frame while the DMAs fly: MXU pair expansion of the
-        # three coarse planes, each written to two fine planes
-        for p, ref in enumerate((rm1, r0, rp1)):
+        # T uc on the frame while the DMAs fly: MXU pair expansion of
+        # each coarse plane, written to its two fine planes
+        for p, ref in enumerate(planes):
             plane = ref[0].astype(jnp.float32)
             f2d = jnp.dot(syt_ref[:].astype(jnp.float32),
                           jnp.dot(plane, sxt_ref[:].astype(jnp.float32),
@@ -355,25 +364,27 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
         for cp in cps:
             cp.wait()
 
-        # u' = u + T uc − M (T uc) on frame [s, 5s) (global rows
-        # [2cs − s, 2cs + 3s); zero-frame edges match global zero-fill)
-        accm = jnp.zeros((4 * s,), dt)
+        # u' = u + T uc − M (T uc) on frame [seg0, seg0 + E) (global
+        # rows [2cs − hA, 2cs + 2s + hA); zero-frame edges match global
+        # zero-fill)
+        accm = jnp.zeros((E,), dt)
         for k, d in enumerate(offs_m):
-            accm = accm + sm[k, pl.ds(s, 4 * s)] * tuc[pl.ds(s + d, 4 * s)]
-        upr = su[pl.ds(s, 4 * s)] + tuc[pl.ds(s, 4 * s)] - accm
+            accm = accm + sm[k, pl.ds(seg0, E)] * tuc[pl.ds(seg0 + d, E)]
+        upr = su[pl.ds(seg0, E)] + tuc[pl.ds(seg0, E)] - accm
 
-        # first post-smooth sweep on the tile
+        # first post-smooth sweep on the tile (tile i ↔ seg hA + i)
         acc = jnp.zeros((2 * s,), dt)
         for k, d in enumerate(offs_a):
             acc = acc + a_ref[k, :] \
-                * jax.lax.dynamic_slice(upr, (s + d,), (2 * s,))
-        o_ref[:] = jax.lax.dynamic_slice(upr, (s,), (2 * s,)) \
+                * jax.lax.dynamic_slice(upr, (hA + d,), (2 * s,))
+        o_ref[:] = jax.lax.dynamic_slice(upr, (hA,), (2 * s,)) \
             + w_ref[:] * (f_ref[:] - acc)
 
     if m_flat.ndim != 1:
         raise ValueError("m_flat must be the pre-padded flat frame "
                          "built by build_fused_up")
-    up = jnp.zeros(n + 4 * s, dt).at[2 * s:2 * s + n].set(u)
+    up = jnp.zeros(n + 2 * hp * 2 * s, dt).at[
+        tile0:tile0 + n].set(u)
     vec = pl.BlockSpec((2 * s,), lambda c: (c,))
     plane = lambda off: pl.BlockSpec(
         (1, pc1, pc0),
@@ -386,7 +397,7 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
             pl.BlockSpec(memory_space=pl.ANY),              # u padded
             pl.BlockSpec((nA, 2 * s), lambda c: (np.int32(0), c)),
             vec, vec,                                       # f, w
-            plane(0), plane(1), plane(2),                   # rc planes
+        ] + [plane(o) for o in range(2 * hp + 1)] + [      # rc planes
             pl.BlockSpec((fv[0], pc1),
                          lambda c: (np.int32(0), np.int32(0))),
             pl.BlockSpec((pc0, fv[1]),
@@ -395,13 +406,13 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
         out_specs=vec,
         out_shape=jax.ShapeDtypeStruct((n,), dt),
         scratch_shapes=[
-            pltpu.VMEM((nM, 6 * s), dt),
-            pltpu.VMEM((6 * s,), dt),
-            pltpu.VMEM((6 * s,), dt),
+            pltpu.VMEM((nM, F), dt),
+            pltpu.VMEM((F,), dt),
+            pltpu.VMEM((F,), dt),
             pltpu.SemaphoreType.DMA((nM + 1,)),
         ],
         interpret=interpret,
-    )(m_flat, up, a_data, f, w, rc3p, rc3p, rc3p, syt, sxt)
+    )(m_flat, up, a_data, f, w, *([rc3p] * (2 * hp + 1)), syt, sxt)
     return out
 
 
@@ -410,12 +421,13 @@ class FusedUpSweep:
     """Device handle for the fused prolong+correct+post-smooth pass."""
 
     def __init__(self, a_data, m_flat, syt, sxt, w,
-                 offs_a, offs_m, dims, coarse, interpret):
+                 offs_a, offs_m, dims, coarse, halo_planes, interpret):
         self.a_data = a_data
         self.m_flat = m_flat      # pre-padded frame, flattened
         self.syt = syt
         self.sxt = sxt
         self.w = w
+        self.halo_planes = int(halo_planes)
         self.offs_a = tuple(int(o) for o in offs_a)
         self.offs_m = tuple(int(o) for o in offs_m)
         self.dims = tuple(int(d) for d in dims)
@@ -425,7 +437,7 @@ class FusedUpSweep:
     def tree_flatten(self):
         return ((self.a_data, self.m_flat, self.syt, self.sxt, self.w),
                 (self.offs_a, self.offs_m, self.dims, self.coarse,
-                 self.interpret))
+                 self.halo_planes, self.interpret))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -433,14 +445,15 @@ class FusedUpSweep:
 
     def __call__(self, f, u, uc):
         c2 = self.coarse[0]
+        hp = self.halo_planes
         _, _, cv = _pack_shape(self.dims[1], self.dims[2],
                                self.coarse[1], self.coarse[2])
         rc3p = jnp.pad(uc.reshape(c2, cv[0], cv[1]),
-                       ((1, 1), (0, 0), (0, 0)))
+                       ((hp, hp), (0, 0), (0, 0)))
         return fused_up_sweep(
             self.a_data, self.m_flat, self.syt, self.sxt, rc3p,
             f, self.w, u, self.offs_a, self.offs_m, self.dims,
-            self.coarse, self.interpret)
+            self.coarse, hp, self.interpret)
 
     def bytes(self):
         return sum(a.size * a.dtype.itemsize
@@ -483,17 +496,21 @@ def build_fused_up(A_dev, P_dev, relax):
     s = f1 * f0
     hA = max(max(offs_a), -min(offs_a), 0)
     hM = max(max(offs_m), -min(offs_m), 0)
-    if hA > s or hM > s:
+    # the COMBINED A+M halo sets how many coarse neighbor planes the
+    # frame expands (hA <= hp*2s follows from the ceil)
+    hp = max(1, -(-(hA + hM) // (2 * s)))
+    if hp > 2:
         return None
     n = A_dev.shape[0]
     nA, nM = len(offs_a), len(offs_m)
-    if ((nM + 2) * 6 * s + (nA + 4) * 2 * s) * dt.itemsize \
+    F = (2 * hp + 1) * 2 * s
+    if ((nM + 2) * F + (nA + 4) * 2 * s) * dt.itemsize \
             > _VMEM_CAP_BYTES:
         return None
     c2, c1, c0 = T.coarse
-    Lm = n + 4 * s
-    m_flat = jnp.zeros((nM, Lm), dt).at[:, 2 * s:2 * s + n].set(
-        P_dev.M.data).reshape(-1)
+    Lm = n + 2 * hp * 2 * s
+    m_flat = jnp.zeros((nM, Lm), dt).at[
+        :, hp * 2 * s:hp * 2 * s + n].set(P_dev.M.data).reshape(-1)
     _, fvw, cvw = _pack_shape(f1, f0, c1, c0)
     if k == 1:
         syt = _pair_sum(c1, f1, dt).T
@@ -504,19 +521,20 @@ def build_fused_up(A_dev, P_dev, relax):
 
     if not interpret:
         key = ("up", tuple(offs_a), tuple(offs_m), T.fine, T.coarse,
-               dt.name)
+               hp, dt.name)
         if key not in _PROBE_OK:
             try:
                 av = jax.ShapeDtypeStruct((nA, n), dt)
                 mv = jax.ShapeDtypeStruct((nM * Lm,), dt)
                 sytv = jax.ShapeDtypeStruct((fvw[0], cvw[0]), dt)
                 sxtv = jax.ShapeDtypeStruct((cvw[1], fvw[1]), dt)
-                rv = jax.ShapeDtypeStruct((c2 + 2, cvw[0], cvw[1]), dt)
+                rv = jax.ShapeDtypeStruct((c2 + 2 * hp, cvw[0], cvw[1]),
+                                          dt)
                 fv = jax.ShapeDtypeStruct((n,), dt)
                 jax.jit(functools.partial(
                     fused_up_sweep, offs_a=tuple(offs_a),
-                    offs_m=tuple(offs_m), dims=T.fine,
-                    coarse=T.coarse)).lower(
+                    offs_m=tuple(offs_m), dims=T.fine, coarse=T.coarse,
+                    halo_planes=hp)).lower(
                         av, mv, sytv, sxtv, rv, fv, fv, fv).compile()
                 _PROBE_OK[key] = True
             except Exception:
@@ -525,7 +543,7 @@ def build_fused_up(A_dev, P_dev, relax):
             return None
 
     handle = FusedUpSweep(A_dev.data, m_flat, syt, sxt, relax.scale,
-                          offs_a, offs_m, T.fine, T.coarse, interpret)
+                          offs_a, offs_m, T.fine, T.coarse, hp, interpret)
     if not interpret:
         from amgcl_tpu.ops import device as _dev
         rng = np.random.RandomState(19)

@@ -210,3 +210,24 @@ def test_fused_packed_lanes(interpret_hook, dims):
         cu = np.asarray(lv.relax.apply_post(
             lv.A, f, u + dev.spmv(lv.P, uc)))
         np.testing.assert_allclose(fu, cu, rtol=2e-5, atol=2e-5)
+
+
+def test_fused_up_two_plane_halo(interpret_hook):
+    """27-point coarse operators whose halo exceeds one plane take the
+    hp=2 frame; parity on a level-1 handle."""
+    A, rhs = grid_laplacian(8, 32, 64)
+    amg = AMG(A, AMGParams(dtype=jnp.float32, coarse_enough=100))
+    lv = amg.hierarchy.levels[1]
+    if lv.up is None:
+        pytest.skip("level-1 up handle not built for this fixture")
+    assert lv.up.halo_planes == 2
+    n1 = lv.A.shape[0]
+    rng = np.random.RandomState(7)
+    f = jnp.asarray(rng.rand(n1), dtype=jnp.float32)
+    u = jnp.asarray(rng.rand(n1), dtype=jnp.float32)
+    uc = jnp.asarray(rng.rand(lv.R.shape[0]), dtype=jnp.float32)
+    from amgcl_tpu.ops import device as dev
+    fused = np.asarray(lv.up(f, u, uc))
+    composed = np.asarray(lv.relax.apply_post(
+        lv.A, f, u + dev.spmv(lv.P, uc)))
+    np.testing.assert_allclose(fused, composed, rtol=2e-5, atol=2e-5)
