@@ -56,6 +56,32 @@ def _round_up(v, m):
     return -(-int(v) // int(m)) * int(m)
 
 
+def down_geometry(offs_a, offs_m, dims):
+    """(H, W, vmem_bytes_per_f32) for the down kernel's frame — the ONE
+    source of the halo/window arithmetic, shared by every builder
+    (single-chip and distributed slab)."""
+    _, f1, f0 = dims
+    s = f1 * f0
+    hA = max(max(offs_a), -min(offs_a), 0)
+    hM = max(max(offs_m), -min(offs_m), 0)
+    H = _round_up(hA + hM, 512)
+    W = 2 * s + 2 * H
+    vmem = (len(offs_a) + len(offs_m) + 2) * W + 3 * s
+    return H, W, vmem
+
+
+def up_geometry(offs_a, offs_m, dims):
+    """(hp, F, vmem_bytes_per_f32) for the up kernel's frame."""
+    _, f1, f0 = dims
+    s = f1 * f0
+    hA = max(max(offs_a), -min(offs_a), 0)
+    hM = max(max(offs_m), -min(offs_m), 0)
+    hp = max(1, -(-(hA + hM) // (2 * s)))
+    F = (2 * hp + 1) * 2 * s
+    vmem = (len(offs_m) + 2) * F + (len(offs_a) + 4) * 2 * s
+    return hp, F, vmem
+
+
 def _pack_shape(f1, f0, c1, c0):
     """Lane-packing factor and the packed view of a plane.
 
@@ -82,10 +108,12 @@ def _packed_reduce(f0, k, c0, dtype):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offs_a", "offs_m", "dims", "coarse", "H", "zero_guess", "interpret"))
+    "offs_a", "offs_m", "dims", "coarse", "H", "zero_guess", "framed",
+    "interpret"))
 def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
                      offs_a, offs_m, dims, coarse, H,
-                     zero_guess: bool = False, interpret: bool = False):
+                     zero_guess: bool = False, framed: bool = False,
+                     interpret: bool = False):
     """(c2, c1, c0) coarse rhs from fine f, u — see module docstring.
 
     a_flat / mt_flat: the level's DIA data rows, each zero-padded into a
@@ -96,7 +124,12 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
     ``zero_guess``: the npre=1 cycle entry — ``u`` is then the
     smoother's SCALE vector w, the pre-smoothed iterate u = w ∘ f is
     formed in VMEM, and the kernel returns ``(rc3, u)`` so the whole
-    down-sweep is one pass with no separate smoothing launch."""
+    down-sweep is one pass with no separate smoothing launch.
+
+    ``framed``: distributed-slab mode — f and u arrive ALREADY in the
+    length-L aligned frame (halo-extended by the caller with real
+    neighbor-slab values instead of the single-chip zero pad; requires
+    an even plane count so L = n + 2H)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -121,8 +154,14 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
                          % (sy.shape, sx.shape, (pc1, fv[0]), (fv[1], pc0)))
 
     # place the cycle vectors into the kernel's aligned frame
-    fp = jnp.zeros(L, dt).at[H:H + n].set(f)
-    up = jnp.zeros(L, dt).at[H:H + n].set(u)
+    if framed:
+        if n2 != n or f.shape[0] != L or u.shape[0] != L:
+            raise ValueError("framed mode needs an even plane count and "
+                             "pre-framed length-L vectors")
+        fp, up = f, u
+    else:
+        fp = jnp.zeros(L, dt).at[H:H + n].set(f)
+        up = jnp.zeros(L, dt).at[H:H + n].set(u)
 
     def kernel(af_hbm, mf_hbm, fp_hbm, up_hbm, sy_ref, sx_ref, *rest):
         if zero_guess:
@@ -252,7 +291,7 @@ class FusedDownSweep:
         rc = fused_down_sweep(
             self.a_flat, self.mt_flat, self.sy, self.sx, f, u,
             self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
-            False, self.interpret)
+            zero_guess=False, interpret=self.interpret)
         return rc.reshape(-1)
 
     def zero(self, f):
@@ -261,7 +300,7 @@ class FusedDownSweep:
         rc, u = fused_down_sweep(
             self.a_flat, self.mt_flat, self.sy, self.sx, f, self.w,
             self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
-            True, self.interpret)
+            zero_guess=True, interpret=self.interpret)
         return u[:n], rc.reshape(-1)
 
     def bytes(self):
@@ -292,10 +331,11 @@ def _values_agree(got, want, dt):
 
 
 @functools.partial(jax.jit, static_argnames=(
-    "offs_a", "offs_m", "dims", "coarse", "halo_planes", "interpret"))
+    "offs_a", "offs_m", "dims", "coarse", "halo_planes", "framed",
+    "interpret"))
 def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
                    offs_a, offs_m, dims, coarse, halo_planes: int = 1,
-                   interpret: bool = False):
+                   framed: bool = False, interpret: bool = False):
     """u'' = u' + w ∘ (f − A u') with u' = u + (I − M) T uc, in ONE pass.
 
     The up-sweep mirror of :func:`fused_down_sweep`: per coarse z-plane
@@ -383,8 +423,16 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
     if m_flat.ndim != 1:
         raise ValueError("m_flat must be the pre-padded flat frame "
                          "built by build_fused_up")
-    up = jnp.zeros(n + 2 * hp * 2 * s, dt).at[
-        tile0:tile0 + n].set(u)
+    if framed:
+        # distributed-slab mode: u arrives halo-extended by the caller
+        # (real neighbor values); rc3p likewise carries hp neighbor
+        # coarse planes each side
+        if u.shape[0] != n + 2 * tile0:
+            raise ValueError("framed mode needs a pre-framed u")
+        up = u
+    else:
+        up = jnp.zeros(n + 2 * hp * 2 * s, dt).at[
+            tile0:tile0 + n].set(u)
     vec = pl.BlockSpec((2 * s,), lambda c: (c,))
     plane = lambda off: pl.BlockSpec(
         (1, pc1, pc0),
@@ -453,7 +501,7 @@ class FusedUpSweep:
         return fused_up_sweep(
             self.a_data, self.m_flat, self.syt, self.sxt, rc3p,
             f, self.w, u, self.offs_a, self.offs_m, self.dims,
-            self.coarse, hp, self.interpret)
+            self.coarse, halo_planes=hp, interpret=self.interpret)
 
     def bytes(self):
         return sum(a.size * a.dtype.itemsize
@@ -494,19 +542,13 @@ def build_fused_up(A_dev, P_dev, relax):
     if not offs_a or not offs_m:
         return None
     s = f1 * f0
-    hA = max(max(offs_a), -min(offs_a), 0)
-    hM = max(max(offs_m), -min(offs_m), 0)
     # the COMBINED A+M halo sets how many coarse neighbor planes the
     # frame expands (hA <= hp*2s follows from the ceil)
-    hp = max(1, -(-(hA + hM) // (2 * s)))
-    if hp > 2:
+    hp, _, vmem = up_geometry(offs_a, offs_m, T.fine)
+    if hp > 2 or vmem * dt.itemsize > _VMEM_CAP_BYTES:
         return None
     n = A_dev.shape[0]
     nA, nM = len(offs_a), len(offs_m)
-    F = (2 * hp + 1) * 2 * s
-    if ((nM + 2) * F + (nA + 4) * 2 * s) * dt.itemsize \
-            > _VMEM_CAP_BYTES:
-        return None
     c2, c1, c0 = T.coarse
     Lm = n + 2 * hp * 2 * s
     m_flat = jnp.zeros((nM, Lm), dt).at[
@@ -597,14 +639,8 @@ def build_fused_down(A_dev, R_dev, relax=None):
     if not offs_a or not offs_m:
         return None
     s = f1 * f0
-    hA = max(max(offs_a), -min(offs_a), 0)
-    hM = max(max(offs_m), -min(offs_m), 0)
-    # f0 % 128 == 0 and f1 % 8 == 0 make s (hence 2s and the DMA starts)
-    # a multiple of 1024, and H >= hA + hM by construction
-    H = _round_up(hA + hM, 512)
-    W = 2 * s + 2 * H
-    n_bufs = len(offs_a) + len(offs_m) + 2
-    if (n_bufs * W + 3 * s) * dt.itemsize > _VMEM_CAP_BYTES:
+    H, _, vmem = down_geometry(offs_a, offs_m, T.fine)
+    if vmem * dt.itemsize > _VMEM_CAP_BYTES:
         return None
     c2, c1, c0 = T.coarse
     n = A_dev.shape[0]

@@ -63,6 +63,214 @@ def _halo_extend(arr, w):
 
 
 
+def _build_fused_slab(mesh, adata, mdata, mtdata, scale, a_flats, m_flats,
+                      mt_flats, ldims, lcoarse, blocks, npre=1):
+    """FusedSlab for an eligible sharded stencil level, else None.
+
+    Same eligibility logic as the single-chip builders (the shared
+    geometry helpers in ops/pallas_vcycle.py) evaluated on the LOCAL
+    slab, plus the ring constraint: every frame must be fillable by ONE
+    neighbor hop (frame halo ≤ slab size). Matrix/scale frames are
+    built once here via a shard_map'd halo extend; vectors are framed
+    per cycle. The down frames are only built when ``npre == 1`` (the
+    only cycle entry the zero-guess slab kernel serves)."""
+    import functools
+    from amgcl_tpu.ops.pallas_spmv import pallas_mode
+    from amgcl_tpu.ops import pallas_vcycle as pv
+
+    lz, d1, d0 = (int(x) for x in ldims)
+    cz, c1, c0 = (int(x) for x in lcoarse)
+    if tuple(blocks) != (2, 2, 2) or not a_flats or not mt_flats \
+            or not m_flats:
+        return None
+    k = 128 // d0 if d0 and 128 % d0 == 0 else 0
+    s = d1 * d0
+    if (not k) or d0 % 2 or d1 % 2 or (k > 1 and d1 % k) or s % 512 \
+            or lz % 2 or lz < 2:
+        return None
+    dt = jnp.dtype(jnp.float32)
+    interpret = pallas_mode(dt)
+    if interpret is None:
+        return None
+    nl = lz * s
+    nA, nMt, nM = len(a_flats), len(mt_flats), len(m_flats)
+    H, _, vmem_dn = pv.down_geometry(a_flats, mt_flats, ldims)
+    down_ok = (npre == 1 and H <= nl
+               and vmem_dn * dt.itemsize <= pv._VMEM_CAP_BYTES)
+    hp, _, vmem_up = pv.up_geometry(a_flats, m_flats, ldims)
+    up_ok = (hp <= 2 and hp <= cz and hp * 2 * s <= nl
+             and vmem_up * dt.itemsize <= pv._VMEM_CAP_BYTES)
+    if not (down_ok or up_ok):
+        return None
+
+    L = nl + 2 * H
+    Lm = nl + 2 * hp * 2 * s
+    _, fv, cv = pv._pack_shape(d1, d0, c1, c0)
+    if not interpret and down_ok:
+        key = ("slab_dn", tuple(a_flats), tuple(mt_flats),
+               tuple(ldims), tuple(lcoarse), H)
+        if key not in _SLAB_PROBE:
+            try:
+                av = jax.ShapeDtypeStruct((nA * L,), dt)
+                mv = jax.ShapeDtypeStruct((nMt * L,), dt)
+                ra = jax.ShapeDtypeStruct((cv[0], fv[0]), dt)
+                rb = jax.ShapeDtypeStruct((fv[1], cv[1]), dt)
+                fvec = jax.ShapeDtypeStruct((L,), dt)
+                jax.jit(functools.partial(
+                    pv.fused_down_sweep, offs_a=tuple(a_flats),
+                    offs_m=tuple(mt_flats), dims=tuple(ldims),
+                    coarse=tuple(lcoarse), H=H, zero_guess=True,
+                    framed=True)).lower(
+                        av, mv, ra, rb, fvec, fvec).compile()
+                _SLAB_PROBE[key] = True
+            except Exception:
+                _SLAB_PROBE[key] = False
+        down_ok = _SLAB_PROBE[key]
+    if not interpret and up_ok:
+        key = ("slab_up", tuple(a_flats), tuple(m_flats),
+               tuple(ldims), tuple(lcoarse), hp)
+        if key not in _SLAB_PROBE:
+            try:
+                av = jax.ShapeDtypeStruct((nA, nl), dt)
+                mv = jax.ShapeDtypeStruct((nM * Lm,), dt)
+                ea = jax.ShapeDtypeStruct((fv[0], cv[0]), dt)
+                eb = jax.ShapeDtypeStruct((cv[1], fv[1]), dt)
+                rv = jax.ShapeDtypeStruct(
+                    (cz + 2 * hp, cv[0], cv[1]), dt)
+                fvec = jax.ShapeDtypeStruct((nl,), dt)
+                uv = jax.ShapeDtypeStruct((nl + 2 * hp * 2 * s,), dt)
+                jax.jit(functools.partial(
+                    pv.fused_up_sweep, offs_a=tuple(a_flats),
+                    offs_m=tuple(m_flats), dims=tuple(ldims),
+                    coarse=tuple(lcoarse), halo_planes=hp,
+                    framed=True)).lower(
+                        av, mv, ea, eb, rv, fvec, fvec, uv).compile()
+                _SLAB_PROBE[key] = True
+            except Exception:
+                _SLAB_PROBE[key] = False
+        up_ok = _SLAB_PROBE[key]
+    if not (down_ok or up_ok):
+        return None
+
+    if k == 1:
+        red_a = pv._pair_sum(c1, d1, dt)
+        red_b = pv._pair_sum(c0, d0, dt).T
+        exp_a, exp_b = red_a.T, red_b.T
+    else:
+        red_a = jnp.eye(fv[0], dtype=dt)
+        red_b = pv._packed_reduce(d0, k, c0, dt)
+        exp_a, exp_b = red_a, red_b.T
+
+    def body(ad, mtd, md, sc):
+        outs = ()
+        if down_ok:
+            outs = (_halo_extend(ad, H)[None], _halo_extend(mtd, H)[None],
+                    _halo_extend(sc[None], H)[0][None])
+        if up_ok:
+            outs = outs + (_halo_extend(md, hp * 2 * s)[None],)
+        return outs
+
+    out_specs = ()
+    if down_ok:
+        out_specs = (P(ROWS_AXIS, None, None), P(ROWS_AXIS, None, None),
+                     P(ROWS_AXIS, None))
+    if up_ok:
+        out_specs = out_specs + (P(ROWS_AXIS, None, None),)
+    fn = shard_map(body, mesh=mesh,
+                   in_specs=(P(None, ROWS_AXIS), P(None, ROWS_AXIS),
+                             P(None, ROWS_AXIS), P(ROWS_AXIS)),
+                   out_specs=out_specs, check_vma=False)
+    got = list(jax.jit(fn)(adata, mtdata, mdata, scale))
+    a_fr, mt_fr, w_fr = (got[:3] if down_ok else (None, None, None))
+    m_fr = got[-1] if up_ok else None
+
+    if not interpret:
+        # real-hardware value check vs the composed slab chain — the
+        # slab shapes (thin lz, H == nl windows) are never exercised by
+        # the single-chip checks, and a silent Mosaic miscompute here
+        # would corrupt the distributed preconditioner with no fallback
+        afl, mfl, mtfl = tuple(a_flats), tuple(m_flats), tuple(mt_flats)
+        frames = []
+        frame_specs = []
+        if down_ok:
+            frames += [a_fr, mt_fr, w_fr]
+            frame_specs += [P(ROWS_AXIS, None, None)] * 2 \
+                + [P(ROWS_AXIS, None)]
+        if up_ok:
+            frames.append(m_fr)
+            frame_specs.append(P(ROWS_AXIS, None, None))
+
+        def chk(ad, mtd, md, sc, f_l, *fr):
+            u_ref = sc * f_l
+            outs = ()
+            if down_ok:
+                afr, mtfr, wfr = fr[:3]
+                r = f_l - _dia_halo_mv(ad, afl, u_ref)
+                t = r - _dia_halo_mv(mtd, mtfl, r)
+                fc_ref = t.reshape(cz, 2, c1, 2, c0, 2).sum(
+                    axis=(1, 3, 5)).reshape(-1)
+                f_fr = _halo_extend(f_l[None], H)[0]
+                rc3, u_z = pv.fused_down_sweep(
+                    afr[0].reshape(-1), mtfr[0].reshape(-1),
+                    red_a, red_b, f_fr, wfr[0],
+                    offs_a=afl, offs_m=mtfl, dims=ldims, coarse=lcoarse,
+                    H=H, zero_guess=True, framed=True)
+                outs = (fc_ref, rc3.reshape(-1), u_ref, u_z)
+            if up_ok:
+                mfr = fr[-1]
+                uc = f_l.reshape(cz, 2, c1, 2, c0, 2).sum(
+                    axis=(1, 3, 5)).reshape(-1)
+                tt = jnp.broadcast_to(
+                    uc.reshape(cz, 1, c1, 1, c0, 1),
+                    (cz, 2, c1, 2, c0, 2)).reshape(-1)
+                u1 = u_ref + tt - _dia_halo_mv(md, mfl, tt)
+                u2_ref = u1 + sc * (f_l - _dia_halo_mv(ad, afl, u1))
+                uc_fr = _halo_extend(uc[None], hp * c1 * c0)[0]
+                rc3p = uc_fr.reshape(cz + 2 * hp, cv[0], cv[1])
+                u_fr = _halo_extend(u_ref[None], hp * 2 * s)[0]
+                u2 = pv.fused_up_sweep(
+                    ad, mfr[0].reshape(-1), exp_a, exp_b, rc3p, f_l,
+                    sc, u_fr, offs_a=afl, offs_m=mfl, dims=ldims,
+                    coarse=lcoarse, halo_planes=hp, framed=True)
+                outs = outs + (u2_ref, u2)
+            return outs
+
+        n_out = (4 if down_ok else 0) + (2 if up_ok else 0)
+        cfn = shard_map(
+            chk, mesh=mesh,
+            in_specs=(P(None, ROWS_AXIS), P(None, ROWS_AXIS),
+                      P(None, ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS))
+            + tuple(frame_specs),
+            out_specs=(P(ROWS_AXIS),) * n_out, check_vma=False)
+        rng = np.random.RandomState(23)
+        fprobe = put_with_sharding(
+            rng.rand(adata.shape[1]).astype(np.float32),
+            NamedSharding(mesh, P(ROWS_AXIS)))
+        vals = jax.jit(cfn)(adata, mtdata, mdata, scale, fprobe, *frames)
+        i = 0
+        if down_ok:
+            ok = pv._values_agree(vals[1], vals[0], dt) \
+                and pv._values_agree(vals[3], vals[2], dt)
+            if not ok:
+                down_ok = False
+                a_fr = mt_fr = w_fr = None
+            i = 4
+        if up_ok and not pv._values_agree(vals[i + 1], vals[i], dt):
+            up_ok = False
+            m_fr = None
+        if not (down_ok or up_ok):
+            return None
+
+    return FusedSlab(
+        a_fr, mt_fr, w_fr, m_fr,
+        red_a, red_b, exp_a if up_ok else None,
+        exp_b if up_ok else None, H, hp, ldims, lcoarse,
+        a_flats, mt_flats, m_flats, interpret)
+
+
+_SLAB_PROBE = {}
+
+
 # -- sharded per-level setup program -----------------------------------------
 
 def _sharded_level_setup(adata_l, eps_strong, relax_scale, smoother_omega,
@@ -184,12 +392,69 @@ def _sharded_level_setup(adata_l, eps_strong, relax_scale, smoother_omega,
 # -- sharded hierarchy + solve -----------------------------------------------
 
 @register_pytree_node_class
+class FusedSlab:
+    """Per-shard framed operands for the fused V-cycle kernels
+    (ops/pallas_vcycle.py) on a distributed stencil level.
+
+    The single-chip kernels' zero frames become halo frames filled with
+    REAL neighbor-slab values at build time (matrix data, smoother
+    scale — static per solve) or per cycle (f, u, uc — one
+    ``_halo_extend`` ppermute each, replacing the per-op exchanges of
+    the composed slab chain). The flat offsets are identical on the
+    slab because shards split whole z-planes."""
+
+    def __init__(self, a_fr, mt_fr, w_fr, m_fr, red_a, red_b, exp_a,
+                 exp_b, H, hp, ldims, lcoarse, offs_a, offs_mt, offs_m,
+                 interpret):
+        self.a_fr = a_fr        # (nd, nA, L) sharded: framed A diagonals
+        self.mt_fr = mt_fr      # (nd, nMt, L): framed Mᵀ diagonals
+        self.w_fr = w_fr        # (nd, L): framed smoother scale
+        self.m_fr = m_fr        # (nd, nM, Lm) or None: framed M (up)
+        self.red_a = red_a
+        self.red_b = red_b
+        self.exp_a = exp_a      # None when the up direction is gated
+        self.exp_b = exp_b
+        self.H = int(H)
+        self.hp = int(hp)
+        self.ldims = tuple(int(d) for d in ldims)
+        self.lcoarse = tuple(int(c) for c in lcoarse)
+        self.offs_a = tuple(int(o) for o in offs_a)
+        self.offs_mt = tuple(int(o) for o in offs_mt)
+        self.offs_m = tuple(int(o) for o in offs_m)
+        self.interpret = bool(interpret)
+
+    @property
+    def up_ok(self):
+        return self.m_fr is not None
+
+    def tree_flatten(self):
+        return ((self.a_fr, self.mt_fr, self.w_fr, self.m_fr,
+                 self.red_a, self.red_b, self.exp_a, self.exp_b),
+                (self.H, self.hp, self.ldims, self.lcoarse, self.offs_a,
+                 self.offs_mt, self.offs_m, self.interpret))
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
+
+    def spec(self):
+        sh3 = P(ROWS_AXIS, None, None)
+        opt = lambda v, sp: None if v is None else sp
+        return FusedSlab(
+            opt(self.a_fr, sh3), opt(self.mt_fr, sh3),
+            opt(self.w_fr, P(ROWS_AXIS, None)), opt(self.m_fr, sh3),
+            P(), P(), opt(self.exp_a, P()), opt(self.exp_b, P()),
+            self.H, self.hp, self.ldims, self.lcoarse, self.offs_a,
+            self.offs_mt, self.offs_m, self.interpret)
+
+
+@register_pytree_node_class
 class DistStencilLevel:
     """One sharded level: local slabs of the operator/smoother/transfer
     diagonals plus the static grid plan."""
 
     def __init__(self, adata, scale, mdata, mtdata, a_flats, m_flats,
-                 mt_flats, ldims, lcoarse, blocks):
+                 mt_flats, ldims, lcoarse, blocks, fused=None):
         self.adata = adata          # (ndiag, nl) sharded
         self.scale = scale          # (nl,) sharded
         self.mdata = mdata
@@ -200,15 +465,18 @@ class DistStencilLevel:
         self.ldims = tuple(ldims)         # local slab dims (lz, d1, d0)
         self.lcoarse = tuple(lcoarse)     # local coarse dims
         self.blocks = tuple(blocks)
+        self.fused = fused                # FusedSlab or None
 
     def tree_flatten(self):
-        return ((self.adata, self.scale, self.mdata, self.mtdata),
+        return ((self.adata, self.scale, self.mdata, self.mtdata,
+                 self.fused),
                 (self.a_flats, self.m_flats, self.mt_flats, self.ldims,
                  self.lcoarse, self.blocks))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        return cls(*children, *aux)
+        return cls(children[0], children[1], children[2], children[3],
+                   *aux, fused=children[4])
 
     # tentative transfer over the local slab (GridTentative logic)
     def t_mv(self, uc):
@@ -255,7 +523,8 @@ class DistStencilHierarchy:
             specs_levels.append(DistStencilLevel(
                 P(None, ROWS_AXIS), P(ROWS_AXIS), P(None, ROWS_AXIS),
                 P(None, ROWS_AXIS), lv.a_flats, lv.m_flats, lv.mt_flats,
-                lv.ldims, lv.lcoarse, lv.blocks))
+                lv.ldims, lv.lcoarse, lv.blocks,
+                None if lv.fused is None else lv.fused.spec()))
         rep = jax.tree.map(lambda _: P(), self.rep_hier)
         return DistStencilHierarchy(specs_levels, rep, self.n_rep,
                                     self.npre, self.npost)
@@ -272,18 +541,50 @@ class DistStencilHierarchy:
             return lax.dynamic_slice(u, (idx * nl,), (nl,))
         lv = self.levels[i]
         amv = partial(_dia_halo_mv, lv.adata, lv.a_flats)
-        u = lv.scale * f
-        for _ in range(self.npre - 1):
-            u = u + lv.scale * (f - amv(u))
-        r = f - amv(u)
-        # restrict: fc = T^T (r - M^T r)
-        t = r - _dia_halo_mv(lv.mtdata, lv.mt_flats, r)
-        fc = lv.t_rmv(t)
+        fz = lv.fused
+        if fz is not None and fz.a_fr is not None and self.npre == 1:
+            # whole down-sweep as one per-shard kernel on halo frames
+            from amgcl_tpu.ops.pallas_vcycle import fused_down_sweep
+            f_fr = _halo_extend(f[None], fz.H)[0]
+            rc3, u = fused_down_sweep(
+                fz.a_fr[0].reshape(-1), fz.mt_fr[0].reshape(-1),
+                fz.red_a, fz.red_b, f_fr, fz.w_fr[0],
+                offs_a=fz.offs_a, offs_m=fz.offs_mt, dims=fz.ldims,
+                coarse=fz.lcoarse, H=fz.H, zero_guess=True, framed=True,
+                interpret=fz.interpret)
+            fc = rc3.reshape(-1)
+        else:
+            u = lv.scale * f
+            for _ in range(self.npre - 1):
+                u = u + lv.scale * (f - amv(u))
+            r = f - amv(u)
+            # restrict: fc = T^T (r - M^T r)
+            t = r - _dia_halo_mv(lv.mtdata, lv.mt_flats, r)
+            fc = lv.t_rmv(t)
         uc = self.shard_cycle(i + 1, fc)
-        # prolong: u += (I - M) T uc
-        t = lv.t_mv(uc)
-        u = u + t - _dia_halo_mv(lv.mdata, lv.m_flats, t)
-        for _ in range(self.npost):
+        if fz is not None and fz.up_ok and self.npost >= 1:
+            # prolong + correct + first post-sweep as one kernel
+            from amgcl_tpu.ops.pallas_vcycle import fused_up_sweep
+            cz, pc1xpc0 = fz.lcoarse[0], fz.lcoarse[1] * fz.lcoarse[2]
+            from amgcl_tpu.ops.pallas_vcycle import _pack_shape
+            _, _, cv = _pack_shape(fz.ldims[1], fz.ldims[2],
+                                   fz.lcoarse[1], fz.lcoarse[2])
+            uc_fr = _halo_extend(uc[None], fz.hp * pc1xpc0)[0]
+            rc3p = uc_fr.reshape(cz + 2 * fz.hp, cv[0], cv[1])
+            s2 = 2 * fz.ldims[1] * fz.ldims[2]
+            u_fr = _halo_extend(u[None], fz.hp * s2)[0]
+            u = fused_up_sweep(
+                lv.adata, fz.m_fr[0].reshape(-1), fz.exp_a, fz.exp_b,
+                rc3p, f, lv.scale, u_fr,
+                offs_a=fz.offs_a, offs_m=fz.offs_m, dims=fz.ldims,
+                coarse=fz.lcoarse, halo_planes=fz.hp, framed=True,
+                interpret=fz.interpret)
+            extra = self.npost - 1
+        else:
+            t = lv.t_mv(uc)
+            u = u + t - _dia_halo_mv(lv.mdata, lv.m_flats, t)
+            extra = self.npost
+        for _ in range(extra):
             u = u + lv.scale * (f - amv(u))
         return u
 
@@ -499,14 +800,16 @@ def dist_stencil_build(A: CSR, mesh, prm, rep_coarse_enough: int = 3000):
         new_offs = [c_offs[k] for k in keep]
         ac = ac[jnp.asarray(keep)]
 
+        a_fl = [_flat(o, dims) for o in offs]
+        m_fl = [_flat(o, dims) for o in af_offs]
+        mt_fl = [_flat(o, dims) for o in mt_offs]
+        ld = (lz, dims[1], dims[2])
+        lc = (lz // 2 if blocks[0] > 1 else lz, coarse[1], coarse[2])
         levels.append(DistStencilLevel(
-            adata, scale, m, mt,
-            [_flat(o, dims) for o in offs],
-            [_flat(o, dims) for o in af_offs],
-            [_flat(o, dims) for o in mt_offs],
-            (lz, dims[1], dims[2]),
-            (lz // 2 if blocks[0] > 1 else lz, coarse[1], coarse[2]),
-            blocks))
+            adata, scale, m, mt, a_fl, m_fl, mt_fl, ld, lc, blocks,
+            fused=_build_fused_slab(mesh, adata, m, mt, scale, a_fl,
+                                    m_fl, mt_fl, ld, lc, blocks,
+                                    npre=prm.npre)))
         adata, offs, dims = ac, new_offs, coarse
         meta.append(int(np.prod(dims)))
         eps *= 0.5
