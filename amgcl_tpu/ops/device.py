@@ -384,6 +384,22 @@ def inner_product(x, y):
     return jnp.vdot(x, y)
 
 
+def spmv_dot(A, p, ip=inner_product):
+    """(q, <q, p>) with q = A p — the CG hot pair, fused into one Pallas
+    pass on the DIA path when ``ip`` is the plain single-device dot
+    (a swapped seam means a collective must run OUTSIDE the kernel, and
+    complex dtypes need the conjugating vdot; both fall back — the
+    itemsize gate in _pallas_mode already excludes complex)."""
+    if isinstance(A, DiaMatrix) and ip is inner_product \
+            and A.shape[0] == A.shape[1]:
+        m = A._pallas_mode(p)
+        if m is not None:
+            from amgcl_tpu.ops.pallas_spmv import dia_spmv_dot
+            return dia_spmv_dot(A.offsets, A.data, p, interpret=m)
+    q = A.mv(p)
+    return q, ip(q, p)
+
+
 def norm(x):
     return jnp.sqrt(jnp.abs(jnp.vdot(x, x)))
 

@@ -206,6 +206,74 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False):
     return out[:n]
 
 
+@functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
+def dia_spmv_dot(offsets, data, x, tile: int = 2048,
+                 interpret: bool = False):
+    """(y, <y, x>) in one pass — the CG hot pair q = A p, <q, p>.
+
+    Composed, the dot re-reads both q and p from HBM after the spmv
+    kernel; fused, the per-tile partial is reduced in-register and
+    accumulated into an SMEM scalar across the (sequential) grid steps.
+    Square real operators only (the caller gates)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n = data.shape[1]
+    if x.shape[0] != n:
+        raise ValueError("dia_spmv_dot needs a square operator")
+    ndiag = len(offsets)
+    base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
+                                             interpret)
+    out_dtype = jnp.result_type(data.dtype, x.dtype)
+    acc_dtype = jnp.float32 if jnp.dtype(out_dtype).itemsize <= 4 \
+        else jnp.float64
+
+    def kernel(x_hbm, d_ref, o_ref, dot_ref, scratch, sem):
+        i = pl.program_id(0)
+        cp = pltpu.make_async_copy(
+            x_hbm.at[pl.ds(i * tile, win)], scratch, sem)
+        cp.start()
+        cp.wait()
+        acc = jnp.zeros((tile,), dtype=out_dtype)
+        for k, d in enumerate(offsets):
+            acc = acc + d_ref[k, :] * scratch[pl.ds(base + d, tile)]
+        o_ref[:] = acc
+        # padding rows contribute zero (dpad is zero there), so the
+        # partial over the full tile equals the true local dot
+        part = jnp.sum(acc.astype(acc_dtype)
+                       * scratch[pl.ds(base, tile)].astype(acc_dtype))
+
+        @pl.when(i == 0)
+        def _init():
+            dot_ref[0, 0] = jnp.zeros((), acc_dtype)
+
+        dot_ref[0, 0] += part
+
+    grid = (n_pad // tile,)
+    y, dot = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),
+            pl.BlockSpec((ndiag, tile), lambda i: (np.int32(0), i)),
+        ],
+        out_specs=(
+            pl.BlockSpec((tile,), lambda i: (i,)),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((n_pad,), out_dtype),
+            jax.ShapeDtypeStruct((1, 1), acc_dtype),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((win,), x.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+        interpret=interpret,
+    )(xp, dpad)
+    return y[:n], dot[0, 0].astype(out_dtype)
+
+
 def dia_residual(offsets, data, f, x, tile: int = 2048,
                  interpret: bool = False):
     """r = f − A x in one pass (A in DIA storage, square or rectangular)."""

@@ -51,8 +51,8 @@ class CG:
             rho = dot(r, s)
             beta = jnp.where(rho_prev == 0, 0.0, rho / rho_prev)
             p = dev.axpby(1.0, s, beta, p)
-            q = dev.spmv(A, p)
-            alpha = rho / dot(q, p)
+            q, qp = dev.spmv_dot(A, p, dot)
+            alpha = rho / qp
             x = dev.axpby(alpha, p, 1.0, x)
             r = dev.axpby(-alpha, q, 1.0, r)
             res = jnp.sqrt(jnp.abs(dot(r, r)))

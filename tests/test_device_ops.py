@@ -234,3 +234,17 @@ def test_pallas_wiring_end_to_end(monkeypatch):
     assert i_pal.iters == i_ref.iters
     r = rhs - A.spmv(np.asarray(x_pal, dtype=np.float64))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
+
+
+def test_pallas_dia_spmv_dot_interpret():
+    """Fused (A p, <Ap, p>) vs composed."""
+    from amgcl_tpu.ops.pallas_spmv import dia_spmv_dot
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, _ = poisson3d(10)
+    M = dev.csr_to_dia(A, jnp.float32)
+    p = jnp.asarray(np.random.RandomState(8).rand(A.nrows),
+                    dtype=jnp.float32)
+    q, qp = dia_spmv_dot(M.offsets, M.data, p, tile=256, interpret=True)
+    q_ref = M.mv(p)
+    assert np.allclose(np.asarray(q), np.asarray(q_ref), atol=1e-5)
+    assert np.allclose(float(qp), float(jnp.vdot(q_ref, p)), rtol=1e-5)
