@@ -384,20 +384,27 @@ def inner_product(x, y):
     return jnp.vdot(x, y)
 
 
-def spmv_dot(A, p, ip=inner_product):
-    """(q, <q, p>) with q = A p — the CG hot pair, fused into one Pallas
-    pass on the DIA path when ``ip`` is the plain single-device dot
-    (a swapped seam means a collective must run OUTSIDE the kernel, and
-    complex dtypes need the conjugating vdot; both fall back — the
-    itemsize gate in _pallas_mode already excludes complex)."""
+def spmv_dots(A, x, w=None, ip=inner_product):
+    """(y, <y,y>, <y,x>, <y,w>) with y = A x — the Krylov hot pairs,
+    fused into one Pallas pass on the DIA path when ``ip`` is the plain
+    single-device dot (a swapped seam means a collective must run
+    OUTSIDE the kernel, and complex dtypes need the conjugating vdot;
+    both fall back — the itemsize gate in _pallas_mode already excludes
+    complex)."""
     if isinstance(A, DiaMatrix) and ip is inner_product \
             and A.shape[0] == A.shape[1]:
-        m = A._pallas_mode(p)
+        m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)
         if m is not None:
-            from amgcl_tpu.ops.pallas_spmv import dia_spmv_dot
-            return dia_spmv_dot(A.offsets, A.data, p, interpret=m)
-    q = A.mv(p)
-    return q, ip(q, p)
+            from amgcl_tpu.ops.pallas_spmv import dia_spmv_dots
+            return dia_spmv_dots(A.offsets, A.data, x, w, interpret=m)
+    y = A.mv(x)
+    return y, ip(y, y), ip(y, x), (None if w is None else ip(y, w))
+
+
+def spmv_dot(A, p, ip=inner_product):
+    """(q, <q, p>) with q = A p — the CG hot pair (see spmv_dots)."""
+    q, _, qp, _ = spmv_dots(A, p, None, ip)
+    return q, qp
 
 
 def norm(x):

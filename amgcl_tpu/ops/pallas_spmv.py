@@ -207,28 +207,34 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False):
 
 
 @functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
-def dia_spmv_dot(offsets, data, x, tile: int = 2048,
-                 interpret: bool = False):
-    """(y, <y, x>) in one pass — the CG hot pair q = A p, <q, p>.
+def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
+                  interpret: bool = False):
+    """(y, <y, y>, <y, x>, <y, w>) in one pass, y = A x (w optional).
 
-    Composed, the dot re-reads both q and p from HBM after the spmv
-    kernel; fused, the per-tile partial is reduced in-register and
-    accumulated into an SMEM scalar across the (sequential) grid steps.
+    The Krylov hot pairs: CG needs <Ap, p>; BiCGStab needs <rhat, v>
+    with v = A z and, on the second stage, <t, t> and <t, s> with
+    t = A shat. Composed, each dot re-reads its vectors from HBM after
+    the spmv kernel; fused, per-tile partials reduce in-register and
+    accumulate into SMEM scalars across the (sequential) grid steps.
     Square real operators only (the caller gates)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     n = data.shape[1]
     if x.shape[0] != n:
-        raise ValueError("dia_spmv_dot needs a square operator")
+        raise ValueError("dia_spmv_dots needs a square operator")
     ndiag = len(offsets)
     base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
                                              interpret)
     out_dtype = jnp.result_type(data.dtype, x.dtype)
     acc_dtype = jnp.float32 if jnp.dtype(out_dtype).itemsize <= 4 \
         else jnp.float64
+    has_w = w is not None
+    wvecs = [jnp.pad(w, (0, n_pad - n))] if has_w else []
+    vec_spec = pl.BlockSpec((tile,), lambda i: (i,))
 
-    def kernel(x_hbm, d_ref, o_ref, dot_ref, scratch, sem):
+    def kernel(x_hbm, d_ref, *rest):
+        (*w_refs, o_ref, dots_ref, scratch, sem) = rest
         i = pl.program_id(0)
         cp = pltpu.make_async_copy(
             x_hbm.at[pl.ds(i * tile, win)], scratch, sem)
@@ -239,39 +245,54 @@ def dia_spmv_dot(offsets, data, x, tile: int = 2048,
             acc = acc + d_ref[k, :] * scratch[pl.ds(base + d, tile)]
         o_ref[:] = acc
         # padding rows contribute zero (dpad is zero there), so the
-        # partial over the full tile equals the true local dot
-        part = jnp.sum(acc.astype(acc_dtype)
-                       * scratch[pl.ds(base, tile)].astype(acc_dtype))
+        # partials over the full tile equal the true dots
+        ya = acc.astype(acc_dtype)
+        p_yy = jnp.sum(ya * ya)
+        p_yx = jnp.sum(ya * scratch[pl.ds(base, tile)].astype(acc_dtype))
 
         @pl.when(i == 0)
         def _init():
-            dot_ref[0, 0] = jnp.zeros((), acc_dtype)
+            for j in range(2 + has_w):
+                dots_ref[0, j] = jnp.zeros((), acc_dtype)
 
-        dot_ref[0, 0] += part
+        dots_ref[0, 0] += p_yy
+        dots_ref[0, 1] += p_yx
+        if has_w:
+            dots_ref[0, 2] += jnp.sum(ya * w_refs[0][:].astype(acc_dtype))
 
     grid = (n_pad // tile,)
-    y, dot = pl.pallas_call(
+    y, dots = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),
             pl.BlockSpec((ndiag, tile), lambda i: (np.int32(0), i)),
-        ],
+        ] + [vec_spec] * len(wvecs),
         out_specs=(
-            pl.BlockSpec((tile,), lambda i: (i,)),
+            vec_spec,
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((n_pad,), out_dtype),
-            jax.ShapeDtypeStruct((1, 1), acc_dtype),
+            jax.ShapeDtypeStruct((1, 2 + has_w), acc_dtype),
         ),
         scratch_shapes=[
             pltpu.VMEM((win,), x.dtype),
             pltpu.SemaphoreType.DMA,
         ],
         interpret=interpret,
-    )(xp, dpad)
-    return y[:n], dot[0, 0].astype(out_dtype)
+    )(xp, dpad, *wvecs)
+    yy = dots[0, 0].astype(out_dtype)
+    yx = dots[0, 1].astype(out_dtype)
+    yw = dots[0, 2].astype(out_dtype) if has_w else None
+    return y[:n], yy, yx, yw
+
+
+def dia_spmv_dot(offsets, data, x, tile: int = 2048,
+                 interpret: bool = False):
+    """(y, <y, x>) — the CG pair; see dia_spmv_dots."""
+    y, _, yx, _ = dia_spmv_dots(offsets, data, x, None, tile, interpret)
+    return y, yx
 
 
 def dia_residual(offsets, data, f, x, tile: int = 2048,

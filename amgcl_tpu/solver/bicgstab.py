@@ -61,13 +61,23 @@ class BiCGStab:
             beta = (rho_new / jnp.where(rho == 0, 1, rho)) \
                 * (alpha / jnp.where(omega == 0, 1, omega))
             p = r + beta * (p - omega * v)
-            v, phat = apply_op(p)
-            denom = dot(rhat, v)
+            if left:
+                v, phat = apply_op(p)
+                denom = dot(rhat, v)
+            else:
+                # fused spmv + <rhat, v> on the DIA path (one HBM pass)
+                phat = precond(p)
+                v, _, _, denom = dev.spmv_dots(A, phat, rhat, dot)
             alpha = rho_new / jnp.where(denom == 0, 1, denom)
             s = r - alpha * v
-            t, shat = apply_op(s)
-            tt = dot(t, t)
-            omega = dot(t, s) / jnp.where(tt == 0, 1, tt)
+            if left:
+                t, shat = apply_op(s)
+                tt = dot(t, t)
+                ts = dot(t, s)
+            else:
+                shat = precond(s)
+                t, tt, _, ts = dev.spmv_dots(A, shat, s, dot)
+            omega = ts / jnp.where(tt == 0, 1, tt)
             x = x + alpha * phat + omega * shat
             r = s - omega * t
             res = jnp.sqrt(jnp.abs(dot(r, r)))

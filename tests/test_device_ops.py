@@ -248,3 +248,23 @@ def test_pallas_dia_spmv_dot_interpret():
     q_ref = M.mv(p)
     assert np.allclose(np.asarray(q), np.asarray(q_ref), atol=1e-5)
     assert np.allclose(float(qp), float(jnp.vdot(q_ref, p)), rtol=1e-5)
+
+
+def test_pallas_wiring_bicgstab(monkeypatch):
+    """BiCGStab's fused spmv+dots path (interpret hook): iteration
+    parity with the composed path."""
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+
+    A, rhs = poisson3d(10)
+    prm = AMGParams(dtype=jnp.float32, coarse_enough=200)
+    x_ref, i_ref = make_solver(A, prm, BiCGStab(tol=1e-6, maxiter=40))(rhs)
+
+    monkeypatch.setenv("AMGCL_TPU_PALLAS_INTERPRET", "1")
+    x_pal, i_pal = make_solver(A, prm, BiCGStab(tol=1e-6, maxiter=40))(rhs)
+
+    assert i_pal.iters == i_ref.iters
+    r = rhs - A.spmv(np.asarray(x_pal, dtype=np.float64))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
