@@ -279,3 +279,23 @@ def test_fused_down_asymmetric_offsets(offs_a, offs_m):
     rc = t.reshape(c2, 2, c1, 2, c0, 2).sum(axis=(1, 3, 5))
     np.testing.assert_allclose(out.ravel(), rc.ravel(),
                                rtol=1e-4, atol=1e-4)
+
+
+def test_fused_handles_survive_rebuild(interpret_hook):
+    """AMG.rebuild (time-dependent path) must reconstruct the fused
+    handles against the NEW values, not keep stale padded copies."""
+    A, rhs = grid_laplacian(4, 8, 128)
+    amg = AMG(A, AMGParams(dtype=jnp.float32, coarse_enough=200))
+    assert amg.hierarchy.levels[0].down is not None
+    from amgcl_tpu.ops.csr import CSR as _CSR
+    A2 = _CSR(A.ptr.copy(), A.col.copy(), A.val * 2.0, A.ncols)
+    amg.rebuild(A2)
+    lv = amg.hierarchy.levels[0]
+    assert lv.down is not None, "rebuild dropped the fused handle"
+    rng = np.random.RandomState(9)
+    f = jnp.asarray(rng.rand(A.nrows), dtype=jnp.float32)
+    u = jnp.asarray(rng.rand(A.nrows), dtype=jnp.float32)
+    from amgcl_tpu.ops import device as dev
+    fused = np.asarray(lv.down(f, u))
+    composed = np.asarray(dev.spmv(lv.R, dev.residual(f, lv.A, u)))
+    np.testing.assert_allclose(fused, composed, rtol=2e-5, atol=2e-5)
