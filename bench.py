@@ -246,7 +246,9 @@ def main_opportunistic():
         plat = probe_platform(90)
         rec = {"ts": time.time(), "platform": plat}
         if plat == "tpu":
-            result, stages, err = run_worker(900)
+            # 1800s: the first chip session additionally pays the fused-
+            # kernel probe compiles (cached persistently afterwards)
+            result, stages, err = run_worker(1800)
             if result is not None and result.get("value") is not None \
                     and result.get("device_platform") == "tpu":
                 _save_last_good(result)
