@@ -268,3 +268,25 @@ def test_pallas_wiring_bicgstab(monkeypatch):
     assert i_pal.iters == i_ref.iters
     r = rhs - A.spmv(np.asarray(x_pal, dtype=np.float64))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
+
+
+@pytest.mark.parametrize("name", ["gmres", "lgmres", "idrs", "bicgstabl",
+                                  "richardson"])
+def test_pallas_wiring_solver_sweep(monkeypatch, name):
+    """Remaining Krylov bodies through the interpret hook: iteration
+    parity with the composed path (wiring-level check)."""
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.runtime import SOLVERS
+
+    A, rhs = poisson3d(8)
+    prm = AMGParams(dtype=jnp.float32, coarse_enough=200)
+    mk = lambda: SOLVERS[name](maxiter=60, tol=1e-6)
+    x_ref, i_ref = make_solver(A, prm, mk())(rhs)
+
+    monkeypatch.setenv("AMGCL_TPU_PALLAS_INTERPRET", "1")
+    x_pal, i_pal = make_solver(A, prm, mk())(rhs)
+    assert i_pal.iters == i_ref.iters
+    r = rhs - A.spmv(np.asarray(x_pal, dtype=np.float64))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4
