@@ -65,9 +65,12 @@ class BiCGStab:
                 v, phat = apply_op(p)
                 denom = dot(rhat, v)
             else:
-                # fused spmv + <rhat, v> on the DIA path (one HBM pass)
+                # fused spmv + <rhat, v> on the DIA path (one HBM pass);
+                # spmv_dots returns <v, rhat> — conjugate for the
+                # complex fallback (identity for real)
                 phat = precond(p)
-                v, _, _, denom = dev.spmv_dots(A, phat, rhat, dot)
+                v, _, _, vr = dev.spmv_dots(A, phat, rhat, dot)
+                denom = jnp.conj(vr)
             alpha = rho_new / jnp.where(denom == 0, 1, denom)
             s = r - alpha * v
             if left:
