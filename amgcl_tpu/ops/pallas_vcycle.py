@@ -146,7 +146,7 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
     nA = len(offs_a)
     nM = len(offs_m)
     dt = f.dtype
-    k, fv, cv = _pack_shape(f1, f0, c1, c0)
+    _, fv, cv = _pack_shape(f1, f0, c1, c0)
     pc1, pc0 = cv
     if sy.shape != (pc1, fv[0]) or sx.shape != (fv[1], pc0):
         raise ValueError("reduction operator shapes %s/%s do not match "
@@ -365,7 +365,7 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
     nA = len(offs_a)
     nM = len(offs_m)
     dt = f.dtype
-    k2, fv, cv = _pack_shape(f1, f0, c1, c0)
+    _, fv, cv = _pack_shape(f1, f0, c1, c0)
     pc1, pc0 = cv
     if syt.shape != (fv[0], pc1) or sxt.shape != (pc0, fv[1]):
         raise ValueError("expansion operator shapes %s/%s do not match "

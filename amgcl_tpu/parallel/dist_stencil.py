@@ -190,6 +190,10 @@ def _build_fused_slab(mesh, adata, mdata, mtdata, scale, a_flats, m_flats,
         # the single-chip checks, and a silent Mosaic miscompute here
         # would corrupt the distributed preconditioner with no fallback
         afl, mfl, mtfl = tuple(a_flats), tuple(m_flats), tuple(mt_flats)
+        # grid-plan-only level instance: reuses t_mv/t_rmv instead of
+        # re-inlining the tentative reshape chains
+        plan = DistStencilLevel(None, None, None, None, afl, mfl, mtfl,
+                                ldims, lcoarse, blocks)
         frames = []
         frame_specs = []
         if down_ok:
@@ -207,8 +211,7 @@ def _build_fused_slab(mesh, adata, mdata, mtdata, scale, a_flats, m_flats,
                 afr, mtfr, wfr = fr[:3]
                 r = f_l - _dia_halo_mv(ad, afl, u_ref)
                 t = r - _dia_halo_mv(mtd, mtfl, r)
-                fc_ref = t.reshape(cz, 2, c1, 2, c0, 2).sum(
-                    axis=(1, 3, 5)).reshape(-1)
+                fc_ref = plan.t_rmv(t)
                 f_fr = _halo_extend(f_l[None], H)[0]
                 rc3, u_z = pv.fused_down_sweep(
                     afr[0].reshape(-1), mtfr[0].reshape(-1),
@@ -218,11 +221,8 @@ def _build_fused_slab(mesh, adata, mdata, mtdata, scale, a_flats, m_flats,
                 outs = (fc_ref, rc3.reshape(-1), u_ref, u_z)
             if up_ok:
                 mfr = fr[-1]
-                uc = f_l.reshape(cz, 2, c1, 2, c0, 2).sum(
-                    axis=(1, 3, 5)).reshape(-1)
-                tt = jnp.broadcast_to(
-                    uc.reshape(cz, 1, c1, 1, c0, 1),
-                    (cz, 2, c1, 2, c0, 2)).reshape(-1)
+                uc = plan.t_rmv(f_l)
+                tt = plan.t_mv(uc)
                 u1 = u_ref + tt - _dia_halo_mv(md, mfl, tt)
                 u2_ref = u1 + sc * (f_l - _dia_halo_mv(ad, afl, u1))
                 uc_fr = _halo_extend(uc[None], hp * c1 * c0)[0]
@@ -564,9 +564,9 @@ class DistStencilHierarchy:
         uc = self.shard_cycle(i + 1, fc)
         if fz is not None and fz.up_ok and self.npost >= 1:
             # prolong + correct + first post-sweep as one kernel
-            from amgcl_tpu.ops.pallas_vcycle import fused_up_sweep
+            from amgcl_tpu.ops.pallas_vcycle import (fused_up_sweep,
+                                                     _pack_shape)
             cz, pc1xpc0 = fz.lcoarse[0], fz.lcoarse[1] * fz.lcoarse[2]
-            from amgcl_tpu.ops.pallas_vcycle import _pack_shape
             _, _, cv = _pack_shape(fz.ldims[1], fz.ldims[2],
                                    fz.lcoarse[1], fz.lcoarse[2])
             uc_fr = _halo_extend(uc[None], fz.hp * pc1xpc0)[0]
