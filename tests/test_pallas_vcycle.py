@@ -299,3 +299,59 @@ def test_fused_handles_survive_rebuild(interpret_hook):
     fused = np.asarray(lv.down(f, u))
     composed = np.asarray(dev.spmv(lv.R, dev.residual(f, lv.A, u)))
     np.testing.assert_allclose(fused, composed, rtol=2e-5, atol=2e-5)
+
+
+def _shift_mv(data, offs, x):
+    y = np.zeros(len(x))
+    for k, d in enumerate(offs):
+        lo, hi = max(0, -d), min(len(x), len(x) - d)
+        y[lo:hi] += data[k, lo:hi] * x[lo + d:hi + d]
+    return y
+
+
+def test_fused_down_fuzz_fixed_seed():
+    """Randomized (fixed-seed) shape x offset-set sweep of the down
+    kernel vs a numpy reference — regression net for the frame
+    arithmetic beyond the hand-picked cases."""
+    from amgcl_tpu.ops.pallas_vcycle import (fused_down_sweep, _pair_sum,
+                                             _packed_reduce, _pack_shape,
+                                             down_geometry)
+    rng = np.random.RandomState(42)
+    for dims in [(2, 8, 64), (3, 8, 128), (4, 16, 32)]:
+        f2, f1, f0 = dims
+        k = 128 // f0
+        s = f1 * f0
+        n = f2 * s
+        c2, c1, c0 = (f2 + 1) // 2, f1 // 2, f0 // 2
+        na, nm = rng.randint(3, 8), rng.randint(3, 8)
+        pool = [-s, -f0, -1, 0, 1, f0, s, -2 * f0, 2 * f0, -s - f0, s + 1]
+        offs_a = tuple(sorted(rng.choice(pool, na, replace=False).tolist()))
+        offs_m = tuple(sorted(rng.choice(pool, nm, replace=False).tolist()))
+        H, _, _ = down_geometry(offs_a, offs_m, dims)
+        L = 2 * c2 * s + 2 * H
+        Ad = rng.rand(na, n).astype(np.float32)
+        Md = rng.rand(nm, n).astype(np.float32)
+        af = jnp.asarray(np.concatenate(
+            [np.pad(Ad[i], (H, L - H - n)) for i in range(na)]))
+        mf = jnp.asarray(np.concatenate(
+            [np.pad(Md[i], (H, L - H - n)) for i in range(nm)]))
+        _, fv, _ = _pack_shape(f1, f0, c1, c0)
+        if k == 1:
+            sy = _pair_sum(c1, f1, jnp.float32)
+            sx = _pair_sum(c0, f0, jnp.float32).T
+        else:
+            sy = jnp.eye(fv[0], dtype=jnp.float32)
+            sx = _packed_reduce(f0, k, c0, jnp.float32)
+        f = jnp.asarray(rng.rand(n).astype(np.float32))
+        u = jnp.asarray(rng.rand(n).astype(np.float32))
+        out = np.asarray(fused_down_sweep(
+            af, mf, sy, sx, f, u, offs_a, offs_m, dims,
+            (c2, c1, c0), H, interpret=True))
+        r = np.asarray(f, np.float64) - _shift_mv(Ad, offs_a,
+                                                  np.asarray(u, np.float64))
+        t = r - _shift_mv(Md, offs_m, r)
+        rc = np.pad(t, (0, 2 * c2 * s - n)).reshape(
+            c2, 2, c1, 2, c0, 2).sum(axis=(1, 3, 5))
+        np.testing.assert_allclose(out.ravel(), rc.ravel(),
+                                   rtol=2e-4, atol=2e-4,
+                                   err_msg=str((dims, offs_a, offs_m)))
