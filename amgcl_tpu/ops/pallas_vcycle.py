@@ -41,6 +41,7 @@ fusion.
 from __future__ import annotations
 
 import functools
+import os
 
 import numpy as np
 import jax
@@ -50,6 +51,14 @@ from jax.tree_util import register_pytree_node_class
 
 _VMEM_CAP_BYTES = 12 << 20
 _PROBE_OK = {}
+
+
+def vcycle_fusion_enabled() -> bool:
+    """AMGCL_TPU_FUSED_VCYCLE=0 disables ONLY this tier (the whole-leg
+    sweep kernels), leaving the tier-1 spmv/residual kernels active — the
+    A/B knob for isolating the fusion's effect on the chip
+    (AMGCL_TPU_PALLAS=0 kills all Pallas paths at once)."""
+    return os.environ.get("AMGCL_TPU_FUSED_VCYCLE", "1") != "0"
 
 
 def _round_up(v, m):
@@ -515,6 +524,8 @@ def build_fused_up(A_dev, P_dev, relax):
     from amgcl_tpu.ops.pallas_spmv import pallas_mode
     from amgcl_tpu.relaxation.base import ScaledResidualSmoother
 
+    if not vcycle_fusion_enabled():
+        return None
     if not isinstance(A_dev, DiaMatrix) \
             or not isinstance(P_dev, ImplicitSmoothedP) \
             or not isinstance(P_dev.T, GridTentative) \
@@ -612,6 +623,8 @@ def build_fused_down(A_dev, R_dev, relax=None):
     from amgcl_tpu.ops.pallas_spmv import pallas_mode
     from amgcl_tpu.relaxation.base import ScaledResidualSmoother
 
+    if not vcycle_fusion_enabled():
+        return None
     if not isinstance(A_dev, DiaMatrix) \
             or not isinstance(R_dev, ImplicitSmoothedR) \
             or not isinstance(R_dev.T, GridTentative) \

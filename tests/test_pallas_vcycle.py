@@ -355,3 +355,12 @@ def test_fused_down_fuzz_fixed_seed():
         np.testing.assert_allclose(out.ravel(), rc.ravel(),
                                    rtol=2e-4, atol=2e-4,
                                    err_msg=str((dims, offs_a, offs_m)))
+
+
+def test_vcycle_fusion_kill_switch(interpret_hook, monkeypatch):
+    """AMGCL_TPU_FUSED_VCYCLE=0 disables the sweep-kernel tier only."""
+    monkeypatch.setenv("AMGCL_TPU_FUSED_VCYCLE", "0")
+    A, rhs = grid_laplacian(4, 8, 128)
+    amg = AMG(A, AMGParams(dtype=jnp.float32, coarse_enough=200))
+    assert all(lv.down is None and lv.up is None
+               for lv in amg.hierarchy.levels)
