@@ -676,6 +676,13 @@ def main_worker():
         "gen_s": round(t_gen, 3),
         "device": str(dev0), "device_platform": dev0.platform,
         "device_kind": getattr(dev0, "device_kind", None)})
+    # which levels carry the fused sweep kernels (empty on CPU fallback
+    # where pallas_mode gates them off — documents engagement per run)
+    _PARTIAL["fused_levels"] = " ".join(
+        "%d%s%s" % (i, "d" if lv.down is not None else "",
+                    "u" if lv.up is not None else "")
+        for i, lv in enumerate(solver.precond.hierarchy.levels)
+        if lv.down is not None or lv.up is not None)
 
     rhs_dev = jnp.asarray(rhs, dtype=jnp.float32)
     x0 = jnp.zeros_like(rhs_dev)
