@@ -103,10 +103,10 @@ class IDRs:
                 x = x + beta * U[k]
                 f = f - beta * M[:, k]
             # dimension-reduction step into the next Sonneveld space
+            # (fused spmv + <t,t>/<t,r> on the DIA path — one HBM pass)
             v = precond(r)
-            t = dev.spmv(A, v)
-            tt = dot(t, t)
-            om = dot(t, r) / jnp.where(tt == 0, 1.0, tt)
+            t, tt, _, tr = dev.spmv_dots(A, v, r, dot)
+            om = tr / jnp.where(tt == 0, 1.0, tt)
             x = x + om * v
             r = r - om * t
             res = jnp.sqrt(jnp.abs(dot(r, r)))
