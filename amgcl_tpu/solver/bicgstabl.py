@@ -43,12 +43,22 @@ class BiCGStabL:
             def op(v):
                 return dev.spmv(A, precond(v))
 
+            def op_dot_rhat(v, rhat):
+                # fused spmv + <rhat, op(v)> on the DIA path; spmv_dots
+                # yields <y, rhat> — conjugate (identity for real)
+                y, _, _, yr = dev.spmv_dots(A, precond(v), rhat, dot)
+                return y, jnp.conj(yr)
+
             b_p = rhs
             r0 = dev.residual(rhs, A, x_init)
             x = jnp.zeros_like(rhs)
         else:
             def op(v):
                 return precond(dev.spmv(A, v))
+
+            def op_dot_rhat(v, rhat):
+                y = op(v)
+                return y, dot(rhat, y)
 
             b_p = precond(rhs)
             r0 = b_p - op(x_init)
@@ -74,8 +84,8 @@ class BiCGStabL:
                 rho = rho1
                 for i in range(j + 1):
                     U = U.at[i].set(R[i] - beta * U[i])
-                U = U.at[j + 1].set(op(U[j]))
-                gamma = dot(rhat, U[j + 1])
+                ujp1, gamma = op_dot_rhat(U[j], rhat)
+                U = U.at[j + 1].set(ujp1)
                 alpha = rho / jnp.where(gamma == 0, 1.0, gamma)
                 for i in range(j + 1):
                     R = R.at[i].set(R[i] - alpha * U[i + 1])
