@@ -70,10 +70,10 @@ class MulticolorGS:
     def _sweep(self, A, f, x, order):
         for c in order:
             mask = self.masks[c]
-            t = dev.spmv(A, x)
             # row i: x_i <- dinv_i (f_i - sum_{j != i} a_ij x_j)
-            #       = x_i + dinv_i * (f - A x)_i  (diagonal folded back in)
-            x = x + mask * (self.dinv * (f - t))
+            #       = x_i + dinv_i * (f - A x)_i  (diagonal folded back in);
+            # the residual takes the fused one-pass kernel on the DIA path
+            x = x + mask * (self.dinv * dev.residual(f, A, x))
         return x
 
     def apply_pre(self, A, f, x):
