@@ -270,8 +270,8 @@ def test_pallas_wiring_bicgstab(monkeypatch):
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
 
 
-@pytest.mark.parametrize("name", ["gmres", "lgmres", "idrs", "bicgstabl",
-                                  "richardson"])
+@pytest.mark.parametrize("name", ["gmres", "fgmres", "lgmres", "idrs",
+                                  "bicgstabl", "richardson"])
 def test_pallas_wiring_solver_sweep(monkeypatch, name):
     """Remaining Krylov bodies through the interpret hook: iteration
     parity with the composed path (wiring-level check)."""
