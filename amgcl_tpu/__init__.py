@@ -20,6 +20,12 @@ Package layout:
 
 __version__ = "0.1.0"
 
+# An explicit JAX_PLATFORMS=cpu must win even against plugins that override
+# the config at registration time (see utils/axon_guard.py). No-op otherwise.
+from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested as \
+    _apply_if_cpu_requested
+_apply_if_cpu_requested()
+
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.models.make_solver import make_solver

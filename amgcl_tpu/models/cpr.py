@@ -144,8 +144,9 @@ class CPR:
             b0 = self.A_host.block_size[0]
             if A.nrows % b0 or A.ncols % b0:
                 raise ValueError(
-                    "partial_update requires the same structure "
-                    "(dimensions, block size and sparsity pattern)")
+                    "partial_update: scalar matrix shape %s is not a "
+                    "multiple of the original block size %d, so it cannot "
+                    "be re-blocked to match" % (A.shape, b0))
             A = A.to_block(b0)
         if (A.shape != self.A_host.shape
                 or A.block_size != self.A_host.block_size

@@ -54,6 +54,13 @@ __all__ = [
 # communication seam
 # ===========================================================================
 
+class CoarseningStall(ValueError):
+    """Raised when a strip level cannot coarsen further (all rows
+    isolated). The hierarchy builder catches exactly this — not arbitrary
+    ValueErrors — and closes the hierarchy with the replicated tail, the
+    same way the serial build stops (models/amg.py stall guard)."""
+
+
 class LocalComm:
     """Single-controller realization of the strip-exchange primitives.
 
@@ -67,8 +74,17 @@ class LocalComm:
         self.my_shards = list(range(self.nd))
 
     def max_scalar(self, per_shard) -> float:
-        """Global max of one scalar per owned shard (MPI_Allreduce MAX)."""
-        return float(max(v for v in per_shard if v is not None))
+        """Global max of one scalar per owned shard (MPI_Allreduce MAX).
+        -inf when nothing is owned anywhere (the allreduce identity)."""
+        return float(max((v for v in per_shard if v is not None),
+                         default=-np.inf))
+
+    def _vals_meta(self, vals_per_shard):
+        """(is_complex, is_int) of the value payload, from owned non-None
+        entries only — safe for a process that owns no shards."""
+        kinds = {np.asarray(vals_per_shard[s]).dtype.kind
+                 for s in self.my_shards if vals_per_shard[s] is not None}
+        return bool(kinds & {"c"}), bool(kinds & {"i", "u"})
 
     def alltoall(self, buckets):
         """buckets[src][dst] = (rows, cols, vals) destined for shard dst,
@@ -161,6 +177,13 @@ class MultihostComm(LocalComm):
         vals = [v for v in per_shard if v is not None]
         loc = max(vals) if vals else -np.inf
         return float(self._allgather_np(np.float64(loc), np.max))
+
+    def _vals_meta(self, vals_per_shard):
+        # flags must agree across processes even when this one owns no
+        # shards on the rows axis — reduce them over process_allgather
+        cplx, isint = LocalComm._vals_meta(self, vals_per_shard)
+        flags = self._allgather_np(np.int64([cplx, isint]), np.max)
+        return bool(flags[0]), bool(flags[1])
 
     def _allgather_var(self, arr):
         """Allgatherv of one variable-length 1-D array per process.
@@ -302,6 +325,7 @@ class MultihostComm(LocalComm):
                 bk.append((want, np.zeros(len(want), np.int64), served))
             resp[o] = bk
         recv = self.alltoall(resp)
+        has_cplx, has_int = self._vals_meta(vals_per_shard)
         out = [None] * nd
         for s in self.my_shards:
             gids = np.asarray(gids_per_shard[s]) \
@@ -316,13 +340,11 @@ class MultihostComm(LocalComm):
             order = np.argsort(got_g)
             pos = order[np.searchsorted(got_g[order], gids)]
             vals = got_v[pos]
-            if not np.iscomplexobj(np.asarray(vals_per_shard[
-                    self.my_shards[0]])):
+            if not has_cplx:
                 vals = vals.real
             # integer payloads (aggregate ids) ride the float channel;
             # values are exact integers well below 2^53
-            if np.asarray(vals_per_shard[self.my_shards[0]]).dtype.kind \
-                    in "iu":
+            if has_int:
                 vals = np.rint(vals.real).astype(np.int64)
             out[s] = vals
         return out
@@ -634,7 +656,7 @@ def _strip_sa_level(strips, n, nloc, mesh, comm, eps, relax,
     agg, nc = _strip_mis_aggregates(strips, strong_masks, n, nloc, mesh,
                                     comm, mis_rounds)
     if nc == 0:
-        raise ValueError("empty coarse level (all rows isolated)")
+        raise CoarseningStall("empty coarse level (all rows isolated)")
     nloc_c = -(-nc // nd)
 
     P_strips = [None] * nd
@@ -923,8 +945,10 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
                 strips, n, nloc, mesh, comm, eps,
                 getattr(c, "relax", 1.0), mis_rounds,
                 smooth=smooth, ac_scale=ac_scale)
-        except ValueError:
+        except CoarseningStall:
             break       # coarsening stalled: serial build breaks too
+            # (any OTHER error propagates — a silent truncation here would
+            # masquerade as a performance regression)
         if nc >= n:
             break
         dA = _strips_to_dist_ell(strips, mesh, (n, n), dtype, nloc, nloc,

@@ -17,6 +17,7 @@ host float64 path)."""
 from __future__ import annotations
 
 import os
+import warnings
 
 import numpy as np
 import scipy.linalg
@@ -66,17 +67,32 @@ class DenseDirectSolver:
             # operators (cond >> 1/eps_f32) give a FINITE but useless f32
             # inverse that Newton-Schulz makes worse — those fall through
             # to the host f64 LU / pinv regularization
-            if bool(jnp.isfinite(rnorm)) and float(rnorm) < 1e-2:
+            if bool(jnp.isfinite(rnorm)) and float(rnorm) < 1e-3:
                 return cls(X.astype(jnp.dtype(dtype)), block)
+            if bool(jnp.isfinite(rnorm)) and float(rnorm) < 1e-2:
+                # borderline: a host f64 LU would do better — take it, but
+                # leave an attributable trace for convergence forensics
+                warnings.warn(
+                    "device f32 coarse inverse rejected near the gate "
+                    "(||AX-I||_F/sqrt(n) = %.2e); using host f64 path"
+                    % float(rnorm), RuntimeWarning, stacklevel=2)
 
         # regularize the (often singular-up-to-constant) coarse operator the
-        # pragmatic way: pseudo-inverse fallback when LU is too ill-posed
+        # pragmatic way: pseudo-inverse fallback when LU is too ill-posed.
+        # The pinv branch switches semantics to a least-squares solve —
+        # the right thing for operators singular up to constants (pure
+        # Neumann coarse levels), and what the reference's skyline LU
+        # degenerates to with its tiny-pivot clamp. Announced, not silent.
         try:
             inv = scipy.linalg.inv(dense)
             if not np.all(np.isfinite(inv)):
                 raise np.linalg.LinAlgError
         except (np.linalg.LinAlgError, scipy.linalg.LinAlgError):
             inv = np.linalg.pinv(dense)
+            warnings.warn(
+                "singular coarse operator: coarse solve uses the "
+                "pseudo-inverse (least-squares solve)", RuntimeWarning,
+                stacklevel=2)
         return cls(jnp.asarray(inv, dtype=dtype), block)
 
 

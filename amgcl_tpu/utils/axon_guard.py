@@ -11,6 +11,10 @@ two copies cannot drift.
 
 from __future__ import annotations
 
+import os
+import subprocess
+import sys
+
 
 def force_cpu_backend() -> None:
     import jax
@@ -22,3 +26,37 @@ def force_cpu_backend() -> None:
     # outside the try: the config override must happen even if the private
     # factory registry moved in a newer JAX
     jax.config.update("jax_platforms", "cpu")
+
+
+def apply_if_cpu_requested() -> None:
+    """Honor an explicit ``JAX_PLATFORMS=cpu`` request even when the axon
+    plugin's registration-time override would beat the env var. Called from
+    the package ``__init__`` so `JAX_PLATFORMS=cpu python anything.py` can
+    never hang on the wedged tunnel."""
+    plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plats in ("cpu", "cpu,"):
+        force_cpu_backend()
+
+
+def ensure_live_backend(probe_timeout: float = 60.0) -> str:
+    """Probe jax backend init in a throwaway subprocess; if init wedges
+    (the axon-tunnel hang) or crashes, force the cpu backend in THIS
+    process before its first backend init. Returns the platform that will
+    be used ('tpu', 'cpu', ...).
+
+    Examples call this first so they run out of the box whether or not the
+    TPU tunnel is alive — same probe discipline as bench.py's supervisor.
+    """
+    code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
+    try:
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True,
+                           timeout=probe_timeout)
+    except (subprocess.TimeoutExpired, OSError):
+        r = None
+    if r is not None and r.returncode == 0:
+        for line in r.stdout.splitlines():
+            if line.startswith("PLATFORM="):
+                return line.split("=", 1)[1].strip()
+    force_cpu_backend()
+    return "cpu"

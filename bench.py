@@ -660,6 +660,17 @@ def main_worker():
     solve_base = 0.55 * (n / 150.0) ** 3    # K80 CUDA, volume-scaled
     setup_base = 1.33 * (n / 150.0) ** 3
 
+    # environment telemetry: host contention invalidated the r03→r04
+    # cross-round comparison (same code, 4× slower generation); record the
+    # load so future readers can tell a regression from a noisy host
+    ncpu = os.cpu_count() or 1
+    load0 = os.getloadavg()
+    _PARTIAL["telemetry"] = {
+        "ncpu": ncpu,
+        "loadavg_start": [round(v, 2) for v in load0],
+        "contended": load0[0] / ncpu > 0.5,
+        "timing": "median-of-k chained (see _timed_chain)"}
+
     _stage("problem gen")
     t0 = time.perf_counter()
     A, rhs = poisson3d(n)
@@ -715,6 +726,9 @@ def main_worker():
     # device-time measurement — this is the headline number
     _stage("solve chained timing")
     reps = 4 if on_tpu else 2
+    repeats = 3 if on_tpu else 2
+    _PARTIAL["telemetry"]["chain_reps"] = reps
+    _PARTIAL["telemetry"]["timing_repeats"] = repeats
 
     def chained_step(slv):
         # the 0*c term makes each solve data-depend on the previous one,
@@ -728,7 +742,7 @@ def main_worker():
 
     try:
         t_solve = _timed_chain(chained_step(solver), reps,
-                               3 if on_tpu else 2, overhead)
+                               repeats, overhead)
         t_solve = max(t_solve, 1e-9)
     except Exception:
         t_solve = wall_per_call
@@ -785,7 +799,7 @@ def main_worker():
             x16, info16 = solver16(rhs_dev)
             jax.block_until_ready(x16)
             t16 = max(_timed_chain(chained_step(solver16), reps,
-                                   3 if on_tpu else 2, overhead), 1e-9)
+                                   repeats, overhead), 1e-9)
             tr16 = float(np.linalg.norm(
                 rhs - A.spmv(np.asarray(x16, np.float64)))
                 / np.linalg.norm(rhs))
@@ -795,6 +809,10 @@ def main_worker():
                 "speedup_vs_f32": round(t_solve / t16, 3)}
         except Exception as e:
             _PARTIAL["bf16"] = {"error": repr(e)}
+    loadN = os.getloadavg()
+    _PARTIAL["telemetry"]["loadavg_end"] = [round(v, 2) for v in loadN]
+    _PARTIAL["telemetry"]["contended"] = (
+        _PARTIAL["telemetry"]["contended"] or loadN[0] / ncpu > 0.5)
     out = {"metric": _METRIC, "unit": "s"}
     out.update(_PARTIAL)
     if levels is not None:

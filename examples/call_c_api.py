@@ -45,6 +45,13 @@ def main():
         subprocess.run(cmd, check=True)
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+        # probe backend init; if the TPU tunnel is wedged (probe → 'cpu'),
+        # the embedded interpreter honors JAX_PLATFORMS=cpu via the
+        # package-import guard
+        sys.path.insert(0, REPO)
+        from amgcl_tpu.utils.axon_guard import ensure_live_backend
+        if ensure_live_backend() == "cpu":
+            env["JAX_PLATFORMS"] = "cpu"
         got = subprocess.run([exe], env=env, text=True,
                              capture_output=True, timeout=600)
         print(got.stdout, end="")

@@ -6,6 +6,11 @@ import sys
 sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 os.pardir))
 
+# Run whether or not the TPU tunnel is alive: probe backend init in a
+# subprocess and fall back to cpu if it wedges (utils/axon_guard.py).
+from amgcl_tpu.utils.axon_guard import ensure_live_backend
+ensure_live_backend()
+
 import numpy as np
 import scipy.sparse as sp
 import jax
