@@ -68,9 +68,6 @@ class DiaMatrix:
         from amgcl_tpu.ops.pallas_spmv import pallas_mode
         return pallas_mode(self.dtype, *(v.dtype for v in vecs))
 
-    def _pallas_ok(self, *vecs):
-        return self._pallas_mode(*vecs) is not None
-
     def mv(self, x):
         n, m = self.shape
         from amgcl_tpu.ops.pallas_spmv import dia_spmv
@@ -351,14 +348,24 @@ def spmv(A, x):
 def residual(f, A, x):
     """r = f - A x (interface.hpp `residual`).
 
-    DIA operators take a fused single-pass Pallas kernel on TPU — the
-    composed spmv + subtract costs an extra HBM round-trip of A x because
-    XLA cannot fuse across the pallas_call boundary."""
+    DIA and windowed-ELL operators take a fused single-pass Pallas kernel
+    on TPU — the composed spmv + subtract costs an extra HBM round-trip of
+    A x because XLA cannot fuse across the pallas_call boundary. Plain
+    ELL/Dense stay composed: their mv is pure XLA, and XLA fuses the
+    subtraction into the gather/matmul consumer already."""
     if isinstance(A, DiaMatrix):
         ip = A._pallas_mode(x, f)
         if ip is not None:
             from amgcl_tpu.ops.pallas_spmv import dia_residual
             return dia_residual(A.offsets, A.data, f, x, interpret=ip)
+    from amgcl_tpu.ops.unstructured import WindowedEllMatrix
+    if isinstance(A, WindowedEllMatrix):
+        ip = A._pallas_mode(x, f)
+        if ip is not None:
+            from amgcl_tpu.ops.unstructured import windowed_ell_residual
+            return windowed_ell_residual(
+                A.window_starts, A.cols_local, A.vals, f, x, A.win,
+                A.shape[0], interpret=ip)
     return f - A.mv(x)
 
 
@@ -397,6 +404,15 @@ def spmv_dots(A, x, w=None, ip=inner_product):
         if m is not None:
             from amgcl_tpu.ops.pallas_spmv import dia_spmv_dots
             return dia_spmv_dots(A.offsets, A.data, x, w, interpret=m)
+    from amgcl_tpu.ops.unstructured import WindowedEllMatrix
+    if isinstance(A, WindowedEllMatrix) and ip is inner_product \
+            and A.shape[0] == A.shape[1]:
+        m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)
+        if m is not None:
+            from amgcl_tpu.ops.unstructured import windowed_ell_spmv_dots
+            return windowed_ell_spmv_dots(
+                A.window_starts, A.cols_local, A.vals, x, w,
+                win=A.win, n_out=A.shape[0], interpret=m)
     y = A.mv(x)
     return y, ip(y, y), ip(y, x), (None if w is None else ip(y, w))
 

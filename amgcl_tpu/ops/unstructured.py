@@ -79,16 +79,23 @@ class WindowedEllMatrix:
         shape, win = aux
         return cls(children[0], children[1], children[2], shape, win)
 
+    def _pallas_mode(self, *vecs):
+        """None = XLA path; else the ``interpret`` flag for the windowed
+        kernels (False on real TPU after a support probe, True under the
+        CI interpret hook) — the same dispatch seam as DiaMatrix."""
+        from amgcl_tpu.ops.pallas_spmv import pallas_mode
+        m = pallas_mode(self.dtype, *(v.dtype for v in vecs))
+        if m is False and not kernel_supported(
+                self.win, self.cols_local.shape[2], self.dtype):
+            return None
+        return m
+
     def mv(self, x):
-        from amgcl_tpu.ops.pallas_spmv import pallas_enabled
-        if (pallas_enabled() and jax.default_backend() == "tpu"
-                and jnp.dtype(self.dtype).itemsize <= 4
-                and jnp.dtype(x.dtype).itemsize <= 4
-                and kernel_supported(self.win, self.cols_local.shape[2],
-                                     self.dtype)):
+        ip = self._pallas_mode(x)
+        if ip is not None:
             return windowed_ell_spmv(
                 self.window_starts, self.cols_local, self.vals, x,
-                self.win, self.shape[0])
+                self.win, self.shape[0], interpret=ip)
         return self._mv_xla(x)
 
     def _mv_xla(self, x):
@@ -138,6 +145,46 @@ def kernel_supported(win: int = 2 << 20, K: int = 4,
     return _KERNEL_OK[key]
 
 
+def _well_geometry(x, win, n_tiles, tile, K, n_vecs, out_specs):
+    """Shared window-DMA geometry for ALL windowed-ELL kernels: the padded
+    x (window DMA reads x[start : start+win]; padding keeps the last
+    window in range — starts are host-computed, start+win <= len(xp) by
+    construction), the scalar-prefetch grid spec with the HBM-x +
+    cols/vals block prefix plus ``n_vecs`` tile-blocked vector streams,
+    and the VMEM window + DMA semaphore scratch. Every kernel must read x
+    through exactly this geometry — any sizing/alignment fix here
+    services all of them (the DIA path's _dia_window lesson)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    xp = jnp.pad(x, (0, win))
+    vec_spec = pl.BlockSpec((1, tile), lambda t, starts: (t, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # x stays in HBM
+            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
+            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
+        ] + [vec_spec] * n_vecs,
+        out_specs=out_specs if out_specs is not None else vec_spec,
+        scratch_shapes=[
+            pltpu.VMEM((win,), x.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return xp, vec_spec, grid_spec
+
+
+def _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win):
+    """Issue + wait the per-tile x-window DMA (the one access of x)."""
+    t = pl.program_id(0)
+    start = starts_smem[t]
+    cp = pltpu.make_async_copy(x_hbm.at[pl.ds(start, win)], xw, sem)
+    cp.start()
+    cp.wait()
+
+
 @functools.partial(jax.jit,
                    static_argnames=("win", "n_out", "interpret"))
 def windowed_ell_spmv(window_starts, cols_local, vals, x, win, n_out,
@@ -148,34 +195,14 @@ def windowed_ell_spmv(window_starts, cols_local, vals, x, win, n_out,
 
     n_tiles, tile, K = cols_local.shape
     out_dtype = jnp.result_type(vals.dtype, x.dtype)
-    # window DMA reads x[start : start+win]; pad x so the last window is in
-    # range (starts are host-computed; start+win <= len(xp) by construction)
-    xp = jnp.pad(x, (0, win))
+    xp, _, grid_spec = _well_geometry(x, win, n_tiles, tile, K, 0, None)
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, o_ref, xw, sem):
-        t = pl.program_id(0)
-        start = starts_smem[t]
-        cp = pltpu.make_async_copy(x_hbm.at[pl.ds(start, win)], xw, sem)
-        cp.start()
-        cp.wait()
+        _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win)
         xg = jnp.take(xw[:], c_ref[0], axis=0)     # (tile, K) VMEM gather
         o_ref[0] = jnp.sum(v_ref[0] * xg.astype(v_ref.dtype),
                            axis=1).astype(o_ref.dtype)
 
-    grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1,
-        grid=(n_tiles,),
-        in_specs=[
-            pl.BlockSpec(memory_space=pl.ANY),          # x stays in HBM
-            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
-            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
-        ],
-        out_specs=pl.BlockSpec((1, tile), lambda t, starts: (t, 0)),
-        scratch_shapes=[
-            pltpu.VMEM((win,), x.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
-    )
     out = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -183,6 +210,144 @@ def windowed_ell_spmv(window_starts, cols_local, vals, x, win, n_out,
         interpret=interpret,
     )(window_starts, xp, cols_local, vals)
     return out.reshape(n_tiles * tile)[:n_out]
+
+
+# -- fused residual / smoother-step / Krylov-dot kernels --------------------
+#
+# Mirror of the DIA fusion tiers (ops/pallas_spmv.py:142-307) for the
+# unstructured path: every kernel keeps windowed_ell_spmv's access pattern
+# (scalar-prefetched window start, one DMA of the x-window into VMEM, VMEM
+# gather, dense reduction) and only changes the accumulator init / output
+# expression — no new Mosaic ops, so wherever the plain SpMV legalizes
+# these do too. Composed from windowed_ell_spmv + XLA elementwise, each of
+# these costs an extra HBM round-trip of the SpMV output because XLA
+# cannot fuse across a pallas_call boundary. Reference precedent for
+# backend-specialized kernel generation: the reference's per-backend
+# static-matrix kernels (amgcl/backend/vexcl_static_matrix.hpp:228-1031).
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mode", "win", "n_out", "interpret"))
+def windowed_ell_fused(window_starts, cols_local, vals, f, x, w, mode,
+                       win, n_out, interpret: bool = False):
+    """mode='residual':  r  = f − A x;
+    mode='correction':   x' = x + w ∘ (f − A x)   (Jacobi/SPAI-0 sweep)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles, tile, K = cols_local.shape
+    n_pad = n_tiles * tile
+    out_dtype = jnp.result_type(vals.dtype, x.dtype, f.dtype)
+    vecs = [jnp.pad(f, (0, n_pad - f.shape[0]))]
+    if mode == "correction":
+        out_dtype = jnp.result_type(out_dtype, w.dtype)
+        # the x tile is streamed as its own block: tile rows need not lie
+        # inside the tile's column window for a general (rect/asym) pattern
+        vecs.append(jnp.pad(x, (0, n_pad - x.shape[0])))
+        vecs.append(jnp.pad(w, (0, n_pad - w.shape[0])))
+    xp, _, grid_spec = _well_geometry(x, win, n_tiles, tile, K,
+                                      len(vecs), None)
+
+    def kernel(starts_smem, x_hbm, c_ref, v_ref, f_ref, *rest):
+        (*w_refs, o_ref, xw, sem) = rest
+        _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win)
+        xg = jnp.take(xw[:], c_ref[0], axis=0)          # (tile, K)
+        ax = jnp.sum(v_ref[0] * xg.astype(v_ref.dtype), axis=1)
+        acc = f_ref[0].astype(out_dtype) - ax.astype(out_dtype)
+        if mode == "residual":
+            o_ref[0] = acc
+        else:
+            xt = w_refs[0][0].astype(out_dtype)
+            o_ref[0] = xt + w_refs[1][0].astype(out_dtype) * acc
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles, tile), out_dtype),
+        interpret=interpret,
+    )(window_starts, xp, cols_local,
+      vals, *(v.reshape(n_tiles, tile) for v in vecs))
+    return out.reshape(n_pad)[:n_out]
+
+
+def windowed_ell_residual(window_starts, cols_local, vals, f, x, win,
+                          n_out, interpret: bool = False):
+    """r = f − A x in one pass (A in windowed-ELL storage)."""
+    return windowed_ell_fused(window_starts, cols_local, vals, f, x, None,
+                              "residual", win, n_out, interpret)
+
+
+def windowed_ell_scaled_correction(window_starts, cols_local, vals, w, f,
+                                   x, win, n_out, interpret: bool = False):
+    """x + w ∘ (f − A x) in one pass — a damped-Jacobi/SPAI-0 sweep."""
+    return windowed_ell_fused(window_starts, cols_local, vals, f, x, w,
+                              "correction", win, n_out, interpret)
+
+
+@functools.partial(jax.jit, static_argnames=("win", "n_out", "interpret"))
+def windowed_ell_spmv_dots(window_starts, cols_local, vals, x, w=None,
+                           win: int = 0, n_out: int = 0,
+                           interpret: bool = False):
+    """(y, <y, y>, <y, x>, <y, w>) in one pass, y = A x (w optional) —
+    the Krylov hot pairs (see dia_spmv_dots). Square real operators only
+    (the caller gates); per-tile partials accumulate into SMEM scalars
+    across the sequential grid steps."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles, tile, K = cols_local.shape
+    n_pad = n_tiles * tile
+    out_dtype = jnp.result_type(vals.dtype, x.dtype)
+    acc_dtype = jnp.float32 if jnp.dtype(out_dtype).itemsize <= 4 \
+        else jnp.float64
+    has_w = w is not None
+    # x rides again as a tile-blocked stream for <y, x> (padding is zero,
+    # and padded rows have vals == 0, so partials equal the true dots)
+    vecs = [jnp.pad(x, (0, n_pad - x.shape[0]))]
+    if has_w:
+        vecs.append(jnp.pad(w, (0, n_pad - w.shape[0])))
+
+    def kernel(starts_smem, x_hbm, c_ref, v_ref, xt_ref, *rest):
+        (*w_refs, o_ref, dots_ref, xw, sem) = rest
+        _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win)
+        t = pl.program_id(0)
+        xg = jnp.take(xw[:], c_ref[0], axis=0)          # (tile, K)
+        y = jnp.sum(v_ref[0] * xg.astype(v_ref.dtype),
+                    axis=1).astype(out_dtype)
+        o_ref[0] = y
+        ya = y.astype(acc_dtype)
+        p_yy = jnp.sum(ya * ya)
+        p_yx = jnp.sum(ya * xt_ref[0].astype(acc_dtype))
+
+        @pl.when(t == 0)
+        def _init():
+            for j in range(2 + has_w):
+                dots_ref[0, j] = jnp.zeros((), acc_dtype)
+
+        dots_ref[0, 0] += p_yy
+        dots_ref[0, 1] += p_yx
+        if has_w:
+            dots_ref[0, 2] += jnp.sum(ya * w_refs[0][0].astype(acc_dtype))
+
+    from jax.experimental.pallas import tpu as _pltpu
+    xp, _, grid_spec = _well_geometry(
+        x, win, n_tiles, tile, K, len(vecs),
+        (pl.BlockSpec((1, tile), lambda t, starts: (t, 0)),
+         pl.BlockSpec(memory_space=_pltpu.SMEM)))
+    y, dots = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((n_tiles, tile), out_dtype),
+            jax.ShapeDtypeStruct((1, 2 + has_w), acc_dtype),
+        ),
+        interpret=interpret,
+    )(window_starts, xp, cols_local, vals,
+      *(v.reshape(n_tiles, tile) for v in vecs))
+    yy = dots[0, 0].astype(out_dtype)
+    yx = dots[0, 1].astype(out_dtype)
+    yw = dots[0, 2].astype(out_dtype) if has_w else None
+    return y.reshape(n_pad)[:n_out], yy, yx, yw
 
 
 def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,

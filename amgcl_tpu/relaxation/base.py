@@ -42,6 +42,15 @@ class ScaledResidualSmoother:
                 from amgcl_tpu.ops.pallas_spmv import dia_scaled_correction
                 return dia_scaled_correction(A.offsets, A.data, self.scale,
                                              f, x, interpret=ip)
+        from amgcl_tpu.ops.unstructured import WindowedEllMatrix
+        if self.scale.ndim == 1 and isinstance(A, WindowedEllMatrix):
+            ip = A._pallas_mode(x, f, self.scale)
+            if ip is not None:
+                from amgcl_tpu.ops.unstructured import \
+                    windowed_ell_scaled_correction
+                return windowed_ell_scaled_correction(
+                    A.window_starts, A.cols_local, A.vals, self.scale,
+                    f, x, A.win, A.shape[0], interpret=ip)
         return x + self._mul(dev.residual(f, A, x))
 
     apply_post = apply_pre

@@ -47,6 +47,15 @@ def ensure_live_backend(probe_timeout: float = 60.0) -> str:
     Examples call this first so they run out of the box whether or not the
     TPU tunnel is alive — same probe discipline as bench.py's supervisor.
     """
+    plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
+    if plats:
+        # an explicit platform choice skips the probe: cpu is covered by
+        # apply_if_cpu_requested (package import), and any other explicit
+        # request means the user accepts that backend's init behavior
+        if plats in ("cpu", "cpu,"):
+            force_cpu_backend()
+            return "cpu"
+        return plats.split(",")[0]
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     try:
         r = subprocess.run([sys.executable, "-c", code],

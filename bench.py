@@ -542,13 +542,37 @@ def _bench_unstructured(on_tpu):
         out["well_xla_us"] = round(timeit(W._mv_xla), 1)
         if on_tpu and kernel_supported(W.win, W.cols_local.shape[2],
                                        W.vals.dtype):
-            from amgcl_tpu.ops.unstructured import windowed_ell_spmv
+            from amgcl_tpu.ops.unstructured import (
+                windowed_ell_spmv, windowed_ell_residual,
+                windowed_ell_scaled_correction)
             out["well_pallas_us"] = round(timeit(
                 lambda v: windowed_ell_spmv(
                     W.window_starts, W.cols_local, W.vals, v,
                     W.win, W.shape[0])), 1)
             out["speedup_vs_take"] = round(
                 out["ell_take_us"] / out["well_pallas_us"], 2)
+            # fused tiers on the unstructured path (VERDICT r4 item 2):
+            # fused single-pass vs composed kernel + XLA elementwise
+            f = jnp.asarray(np.random.RandomState(1).rand(A.nrows),
+                            jnp.float32)
+            wgt = jnp.asarray(np.random.RandomState(2).rand(A.nrows),
+                              jnp.float32)
+            out["fused_resid_us"] = round(timeit(
+                lambda v: windowed_ell_residual(
+                    W.window_starts, W.cols_local, W.vals, f, v,
+                    W.win, W.shape[0])), 1)
+            out["composed_resid_us"] = round(timeit(
+                lambda v: f - windowed_ell_spmv(
+                    W.window_starts, W.cols_local, W.vals, v,
+                    W.win, W.shape[0])), 1)
+            out["fused_sweep_us"] = round(timeit(
+                lambda v: windowed_ell_scaled_correction(
+                    W.window_starts, W.cols_local, W.vals, wgt, f, v,
+                    W.win, W.shape[0])), 1)
+            out["composed_sweep_us"] = round(timeit(
+                lambda v: v + wgt * (f - windowed_ell_spmv(
+                    W.window_starts, W.cols_local, W.vals, v,
+                    W.win, W.shape[0]))), 1)
         elif on_tpu:
             out["well_pallas_us"] = None
             out["note"] = "in-kernel gather not legalized on this backend"

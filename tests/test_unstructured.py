@@ -11,7 +11,8 @@ from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.ops import device as dev
 from amgcl_tpu.ops.unstructured import (
     WindowedEllMatrix, csr_to_windowed_ell, windowed_ell_spmv,
-    fe_like_problem, _TILE, _WIN_ALIGN)
+    windowed_ell_residual, windowed_ell_scaled_correction,
+    windowed_ell_spmv_dots, fe_like_problem, _TILE, _WIN_ALIGN)
 from amgcl_tpu.utils.adapters import cuthill_mckee, permute
 
 
@@ -68,6 +69,75 @@ def test_to_device_auto_picks_windowed_for_banded_irregular():
     np.testing.assert_allclose(
         np.asarray(M.mv(jnp.asarray(x, dtype=jnp.float32))),
         Ap.spmv(x), rtol=2e-4)
+
+
+def _windowed_fixture(n=2500, seed=7):
+    A, _ = _small_fe(n=n, seed=seed)
+    Ap = permute(A, cuthill_mckee(A))
+    W = csr_to_windowed_ell(Ap, jnp.float32)
+    rng = np.random.RandomState(seed)
+    x = rng.rand(Ap.nrows).astype(np.float32)
+    f = rng.rand(Ap.nrows).astype(np.float32)
+    w = rng.rand(Ap.nrows).astype(np.float32)
+    return Ap, W, x, f, w
+
+
+def test_windowed_fused_residual_interpret_matches():
+    Ap, W, x, f, _ = _windowed_fixture()
+    r_ref = f - Ap.spmv(x.astype(np.float64))
+    r = np.asarray(windowed_ell_residual(
+        W.window_starts, W.cols_local, W.vals, jnp.asarray(f),
+        jnp.asarray(x), W.win, W.shape[0], interpret=True))
+    np.testing.assert_allclose(r, r_ref, rtol=5e-4, atol=5e-4)
+
+
+def test_windowed_fused_correction_interpret_matches():
+    Ap, W, x, f, w = _windowed_fixture(seed=8)
+    ref = x + w * (f - Ap.spmv(x.astype(np.float64)))
+    got = np.asarray(windowed_ell_scaled_correction(
+        W.window_starts, W.cols_local, W.vals, jnp.asarray(w),
+        jnp.asarray(f), jnp.asarray(x), W.win, W.shape[0],
+        interpret=True))
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
+
+
+def test_windowed_fused_spmv_dots_interpret_matches():
+    Ap, W, x, _, w = _windowed_fixture(seed=9)
+    y_ref = Ap.spmv(x.astype(np.float64))
+    y, yy, yx, yw = windowed_ell_spmv_dots(
+        W.window_starts, W.cols_local, W.vals, jnp.asarray(x),
+        jnp.asarray(w), win=W.win, n_out=W.shape[0], interpret=True)
+    np.testing.assert_allclose(np.asarray(y), y_ref, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(float(yy), y_ref @ y_ref, rtol=1e-3)
+    np.testing.assert_allclose(float(yx), y_ref @ x, rtol=1e-3)
+    np.testing.assert_allclose(float(yw), y_ref @ w, rtol=1e-3)
+    # w=None leg returns yw=None and the same pairs
+    y2, yy2, yx2, yw2 = windowed_ell_spmv_dots(
+        W.window_starts, W.cols_local, W.vals, jnp.asarray(x),
+        None, win=W.win, n_out=W.shape[0], interpret=True)
+    assert yw2 is None
+    np.testing.assert_allclose(float(yx2), float(yx), rtol=1e-6)
+
+
+def test_windowed_fused_wiring_through_seams(monkeypatch):
+    """The production seams (dev.residual / dev.spmv_dots / smoother
+    apply_pre) must route WindowedEllMatrix through the fused kernels
+    under the CI interpret hook — same wiring discipline as the DIA
+    tiers (tests/test_sweep.py)."""
+    monkeypatch.setenv("AMGCL_TPU_PALLAS_INTERPRET", "1")
+    Ap, W, x, f, w = _windowed_fixture(seed=10)
+    assert W._pallas_mode(jnp.asarray(x)) is True
+    r = np.asarray(dev.residual(jnp.asarray(f), W, jnp.asarray(x)))
+    np.testing.assert_allclose(
+        r, f - Ap.spmv(x.astype(np.float64)), rtol=5e-4, atol=5e-4)
+    y, yy, yx, yw = dev.spmv_dots(W, jnp.asarray(x), jnp.asarray(w))
+    y_ref = Ap.spmv(x.astype(np.float64))
+    np.testing.assert_allclose(float(yx), y_ref @ x, rtol=1e-3)
+    from amgcl_tpu.relaxation.base import ScaledResidualSmoother
+    sm = ScaledResidualSmoother(jnp.asarray(w))
+    got = np.asarray(sm.apply_pre(W, jnp.asarray(f), jnp.asarray(x)))
+    ref = x + w * (f - Ap.spmv(x.astype(np.float64)))
+    np.testing.assert_allclose(got, ref, rtol=5e-4, atol=5e-4)
 
 
 def test_amg_solve_fe_like():
