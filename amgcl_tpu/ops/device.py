@@ -303,7 +303,7 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
         return DenseMatrix(jnp.asarray(A.to_dense(), dtype=dtype))
     if fmt == "dia":
         return csr_to_dia(A, dtype)
-    if fmt == "well" and not A.is_block:
+    if fmt == "well":
         from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
         W = csr_to_windowed_ell(A, dtype)
         if W is None:
@@ -311,26 +311,29 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 "windowed-ELL format needs banded column locality; apply "
                 "a Cuthill-McKee reorder first (utils/adapters.Reordered)")
         return W
-    if fmt == "auto" and not A.is_block:
-        on_tpu = jax.default_backend() == "tpu"
-        # measured on v5e: gathers run ~130M elem/s while DIA streams at
-        # HBM bandwidth — DIA wins over ELL even at large fill, so accept
-        # many more diagonals on TPU (bounded by a 2 GB data guard); an
-        # explicit caller-supplied cap is honored as-is
-        if max_diags is None:
-            max_diags = 512 if on_tpu else 40
-        if max_fill is None:
-            max_fill = 16.0 if on_tpu else 1.5
-        nd, fill = dia_efficiency(A)
-        if (nd <= max_diags and fill <= max_fill
-                and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
-            return csr_to_dia(A, dtype)
+    if fmt == "auto":
+        if not A.is_block:
+            on_tpu = jax.default_backend() == "tpu"
+            # measured on v5e: gathers run ~130M elem/s while DIA streams
+            # at HBM bandwidth — DIA wins over ELL even at large fill, so
+            # accept many more diagonals on TPU (bounded by a 2 GB data
+            # guard); an explicit caller-supplied cap is honored as-is
+            if max_diags is None:
+                max_diags = 512 if on_tpu else 40
+            if max_fill is None:
+                max_fill = 16.0 if on_tpu else 1.5
+            nd, fill = dia_efficiency(A)
+            if (nd <= max_diags and fill <= max_fill
+                    and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
+                return csr_to_dia(A, dtype)
         if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
             # unstructured but banded (e.g. after Cuthill-McKee): windowed
             # ELL replaces the HBM-serialized gather with per-tile VMEM
-            # windows (ops/unstructured.py). Auto-selection keeps a tighter
-            # VMEM budget than the explicit 'well' format so the window +
-            # pipeline tiles cannot blow VMEM at solver-jit time
+            # windows, for scalar AND block values (the budget scales by
+            # the block column width inside csr_to_windowed_ell).
+            # Auto-selection keeps a tighter VMEM budget than the explicit
+            # 'well' format so the window + pipeline tiles cannot blow
+            # VMEM at solver-jit time
             from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
             W = csr_to_windowed_ell(A, dtype, max_win_bytes=4 << 20)
             if W is not None:
@@ -362,8 +365,15 @@ def residual(f, A, x):
     if isinstance(A, WindowedEllMatrix):
         ip = A._pallas_mode(x, f)
         if ip is not None:
-            from amgcl_tpu.ops.unstructured import windowed_ell_residual
-            return windowed_ell_residual(
+            if A.block == (1, 1):
+                from amgcl_tpu.ops.unstructured import \
+                    windowed_ell_residual
+                return windowed_ell_residual(
+                    A.window_starts, A.cols_local, A.vals, f, x, A.win,
+                    A.shape[0], interpret=ip)
+            from amgcl_tpu.ops.unstructured import \
+                windowed_ell_block_residual
+            return windowed_ell_block_residual(
                 A.window_starts, A.cols_local, A.vals, f, x, A.win,
                 A.shape[0], interpret=ip)
     return f - A.mv(x)
@@ -406,7 +416,7 @@ def spmv_dots(A, x, w=None, ip=inner_product):
             return dia_spmv_dots(A.offsets, A.data, x, w, interpret=m)
     from amgcl_tpu.ops.unstructured import WindowedEllMatrix
     if isinstance(A, WindowedEllMatrix) and ip is inner_product \
-            and A.shape[0] == A.shape[1]:
+            and A.shape[0] == A.shape[1] and A.block == (1, 1):
         m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)
         if m is not None:
             from amgcl_tpu.ops.unstructured import windowed_ell_spmv_dots

@@ -53,14 +53,24 @@ class WindowedEllMatrix:
     window_starts[t]; padding entries point at slot 0 with val 0. The
     window width ``win`` is the static max over tiles (rounded up), so the
     per-tile DMA has a static shape.
+
+    Block values (BCSR convention, ops/csr.py): vals gains trailing
+    (br, bc) dims, cols/windows index BLOCK columns, shape is in block
+    units and x is logically (ncols, bc) flattened — the same windowed
+    access pattern with a bc-wide window DMA and a per-node matvec in the
+    reduction (the reference's BCSR micro-kernels,
+    amgcl/value_type/static_matrix.hpp:43-342, recast as MXU-friendly
+    batched einsums).
     """
 
-    def __init__(self, window_starts, cols_local, vals, shape, win):
+    def __init__(self, window_starts, cols_local, vals, shape, win,
+                 block=(1, 1)):
         self.window_starts = window_starts    # (n_tiles,) int32
         self.cols_local = cols_local          # (n_tiles, tile, K) int32
-        self.vals = vals                      # (n_tiles, tile, K)
+        self.vals = vals                      # (n_tiles, tile, K[, br, bc])
         self.shape = (int(shape[0]), int(shape[1]))
         self.win = int(win)
+        self.block = (int(block[0]), int(block[1]))
 
     @property
     def dtype(self):
@@ -72,12 +82,12 @@ class WindowedEllMatrix:
 
     def tree_flatten(self):
         return ((self.window_starts, self.cols_local, self.vals),
-                (self.shape, self.win))
+                (self.shape, self.win, self.block))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
-        shape, win = aux
-        return cls(children[0], children[1], children[2], shape, win)
+        shape, win, block = aux
+        return cls(children[0], children[1], children[2], shape, win, block)
 
     def _pallas_mode(self, *vecs):
         """None = XLA path; else the ``interpret`` flag for the windowed
@@ -86,14 +96,19 @@ class WindowedEllMatrix:
         from amgcl_tpu.ops.pallas_spmv import pallas_mode
         m = pallas_mode(self.dtype, *(v.dtype for v in vecs))
         if m is False and not kernel_supported(
-                self.win, self.cols_local.shape[2], self.dtype):
+                self.win, self.cols_local.shape[2], self.dtype,
+                self.block):
             return None
         return m
 
     def mv(self, x):
         ip = self._pallas_mode(x)
         if ip is not None:
-            return windowed_ell_spmv(
+            if self.block == (1, 1):
+                return windowed_ell_spmv(
+                    self.window_starts, self.cols_local, self.vals, x,
+                    self.win, self.shape[0], interpret=ip)
+            return windowed_ell_block_spmv(
                 self.window_starts, self.cols_local, self.vals, x,
                 self.win, self.shape[0], interpret=ip)
         return self._mv_xla(x)
@@ -102,13 +117,22 @@ class WindowedEllMatrix:
         # global gather: reconstruct absolute columns; one take over x
         n_tiles, tile, K = self.cols_local.shape
         cols = self.cols_local + self.window_starts[:, None, None]
+        out_dtype = jnp.result_type(self.dtype, x.dtype)
+        br, bc = self.block
+        if (br, bc) != (1, 1):
+            xb = x.reshape(self.shape[1], bc)
+            xg = jnp.take(xb, cols.reshape(-1), axis=0) \
+                .reshape(n_tiles, tile, K, bc)
+            y = jnp.einsum("trkij,trkj->tri", self.vals,
+                           xg.astype(self.vals.dtype),
+                           preferred_element_type=out_dtype)
+            return y.reshape(n_tiles * tile * br)[
+                : self.shape[0] * br].astype(out_dtype)
         xg = jnp.take(x, cols.reshape(-1), axis=0).reshape(n_tiles, tile, K)
         y = jnp.einsum("trk,trk->tr", self.vals,
                        xg.astype(self.vals.dtype),
-                       preferred_element_type=jnp.result_type(
-                           self.dtype, x.dtype))
-        return y.reshape(n_tiles * tile)[: self.shape[0]].astype(
-            jnp.result_type(self.dtype, x.dtype))
+                       preferred_element_type=out_dtype)
+        return y.reshape(n_tiles * tile)[: self.shape[0]].astype(out_dtype)
 
     def bytes(self):
         return (self.cols_local.size * self.cols_local.dtype.itemsize
@@ -120,25 +144,33 @@ _KERNEL_OK = {}
 
 
 def kernel_supported(win: int = 2 << 20, K: int = 4,
-                     dtype=jnp.float32) -> bool:
+                     dtype=jnp.float32, block=(1, 1)) -> bool:
     """Probe-compile the windowed kernel on the current backend for THIS
-    matrix's VMEM footprint (window size, tile width K, value dtype): the
-    in-kernel gather needs Mosaic support that may vary by TPU
-    generation, and VMEM-pressure failures depend on the window scratch
-    plus the (tile, K) cols/vals blocks. mv() cannot use try/except —
-    inside an outer jit a legalization failure only surfaces at the
-    OUTER compile — so the path choice is made here, eagerly. Results
-    are cached per (win, K, dtype)."""
-    key = (int(win), int(K), jnp.dtype(dtype).name)
+    matrix's VMEM footprint (window size, tile width K, value dtype,
+    block dims): the in-kernel gather needs Mosaic support that may vary
+    by TPU generation, and VMEM-pressure failures depend on the window
+    scratch plus the (tile, K) cols/vals blocks. mv() cannot use
+    try/except — inside an outer jit a legalization failure only surfaces
+    at the OUTER compile — so the path choice is made here, eagerly.
+    Results are cached per (win, K, dtype, block)."""
+    br, bc = int(block[0]), int(block[1])
+    key = (int(win), int(K), jnp.dtype(dtype).name, br, bc)
     if key not in _KERNEL_OK:
         try:
             starts = jnp.zeros(1, jnp.int32)
             cols = jnp.zeros((1, _TILE, int(K)), jnp.int32)
-            vals = jnp.zeros((1, _TILE, int(K)), dtype)
-            x = jnp.zeros(int(win), jnp.float32)
-            jax.jit(functools.partial(
-                windowed_ell_spmv, win=int(win), n_out=_TILE)
-            ).lower(starts, cols, vals, x).compile()
+            if (br, bc) == (1, 1):
+                vals = jnp.zeros((1, _TILE, int(K)), dtype)
+                x = jnp.zeros(int(win), jnp.float32)
+                jax.jit(functools.partial(
+                    windowed_ell_spmv, win=int(win), n_out=_TILE)
+                ).lower(starts, cols, vals, x).compile()
+            else:
+                vals = jnp.zeros((1, _TILE, int(K), br, bc), dtype)
+                x = jnp.zeros(int(win) * bc, jnp.float32)
+                jax.jit(functools.partial(
+                    windowed_ell_block_spmv, win=int(win), n_out=_TILE)
+                ).lower(starts, cols, vals, x).compile()
             _KERNEL_OK[key] = True
         except Exception:
             _KERNEL_OK[key] = False
@@ -350,14 +382,172 @@ def windowed_ell_spmv_dots(window_starts, cols_local, vals, x, w=None,
     return y.reshape(n_pad)[:n_out], yy, yx, yw
 
 
+# -- block-value kernels ----------------------------------------------------
+#
+# Same windowed access pattern with block (br, bc) values: the window DMA
+# moves bc-wide block rows of x (flat layout, so the slice is contiguous),
+# the VMEM gather fetches bc consecutive elements per referenced block
+# column, and the reduction is a batched per-node matvec einsum. Block
+# sizes are tiny (2-8), so the einsum stays VPU work — the win is the same
+# as the scalar path: on-chip gather bandwidth instead of the
+# HBM-serialized global take.
+
+
+def _well_block_geometry(x, win, bc, n_tiles, tile, K, br, n_vecs,
+                         out_specs, extra_specs=()):
+    """Block-value counterpart of _well_geometry: the x pad and VMEM
+    scratch scale by bc (flat block rows), vector streams by br;
+    ``extra_specs`` appends non-vector inputs (e.g. a block-scale
+    stream) after the vector streams."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    xp = jnp.pad(x, (0, win * bc))
+    vec_spec = pl.BlockSpec((1, tile * br), lambda t, starts: (t, 0))
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(n_tiles,),
+        in_specs=[
+            pl.BlockSpec(memory_space=pl.ANY),          # x stays in HBM
+            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
+            pl.BlockSpec((1, tile, K, br, bc),
+                         lambda t, starts: (t, 0, 0, 0, 0)),
+        ] + [vec_spec] * n_vecs + list(extra_specs),
+        out_specs=out_specs if out_specs is not None else vec_spec,
+        scratch_shapes=[
+            pltpu.VMEM((win * bc,), x.dtype),
+            pltpu.SemaphoreType.DMA,
+        ],
+    )
+    return xp, vec_spec, grid_spec
+
+
+def _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc):
+    """Per-tile window DMA of bc-wide block rows (flat, contiguous)."""
+    t = pl.program_id(0)
+    start = starts_smem[t] * np.int32(bc)
+    cp = pltpu.make_async_copy(x_hbm.at[pl.ds(start, win * bc)], xw, sem)
+    cp.start()
+    cp.wait()
+
+
+def _block_gather(c_ref, xw, tile, K, bc):
+    """(tile, K, bc) block-row gather from the flat VMEM window."""
+    import jax.lax as lax
+    idx = (c_ref[0].astype(jnp.int32) * np.int32(bc))[:, :, None] \
+        + lax.broadcasted_iota(jnp.int32, (tile, K, bc), 2)
+    return jnp.take(xw[:], idx.reshape(tile, K * bc),
+                    axis=0).reshape(tile, K, bc)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("win", "n_out", "interpret"))
+def windowed_ell_block_spmv(window_starts, cols_local, vals, x, win, n_out,
+                            interpret: bool = False):
+    """y = A x for block windowed-ELL (vals (n_tiles, tile, K, br, bc);
+    x flat of length ncols*bc; returns flat length n_out*br)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles, tile, K, br, bc = vals.shape
+    out_dtype = jnp.result_type(vals.dtype, x.dtype)
+    xp, _, grid_spec = _well_block_geometry(x, win, bc, n_tiles, tile, K,
+                                            br, 0, None)
+
+    def kernel(starts_smem, x_hbm, c_ref, v_ref, o_ref, xw, sem):
+        _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc)
+        xg = _block_gather(c_ref, xw, tile, K, bc)
+        y = jnp.einsum("tkij,tkj->ti", v_ref[0], xg.astype(v_ref.dtype),
+                       preferred_element_type=out_dtype)
+        o_ref[0] = y.reshape(tile * br).astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles, tile * br), out_dtype),
+        interpret=interpret,
+    )(window_starts, xp, cols_local, vals)
+    return out.reshape(n_tiles * tile * br)[:n_out * br]
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("mode", "win", "n_out", "interpret"))
+def windowed_ell_block_fused(window_starts, cols_local, vals, f, x, S,
+                             mode, win, n_out, interpret: bool = False):
+    """mode='residual':  r  = f − A x;
+    mode='correction':   x' = x + S ∘ (f − A x), S a per-node (br, br)
+    block scale (block damped-Jacobi / block SPAI-0 sweep)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles, tile, K, br, bc = vals.shape
+    n_pad = n_tiles * tile * br
+    out_dtype = jnp.result_type(vals.dtype, x.dtype, f.dtype)
+    vecs = [jnp.pad(f, (0, n_pad - f.shape[0]))]
+    extra_specs, extra_args = (), []
+    if mode == "correction":
+        out_dtype = jnp.result_type(out_dtype, S.dtype)
+        vecs.append(jnp.pad(x, (0, n_pad - x.shape[0])))
+        Sp = jnp.pad(S.reshape(-1, br, br),
+                     ((0, n_tiles * tile - S.shape[0]), (0, 0), (0, 0)))
+        extra_specs = (pl.BlockSpec((1, tile, br, br),
+                                    lambda t, starts: (t, 0, 0, 0)),)
+        extra_args = [Sp.reshape(n_tiles, tile, br, br)]
+    xp, _, grid_spec = _well_block_geometry(
+        x, win, bc, n_tiles, tile, K, br, len(vecs), None, extra_specs)
+    args = [window_starts, xp, cols_local, vals,
+            *(v.reshape(n_tiles, tile * br) for v in vecs), *extra_args]
+
+    def kernel(starts_smem, x_hbm, c_ref, v_ref, f_ref, *rest):
+        (*w_refs, o_ref, xw, sem) = rest
+        _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc)
+        xg = _block_gather(c_ref, xw, tile, K, bc)
+        ax = jnp.einsum("tkij,tkj->ti", v_ref[0], xg.astype(v_ref.dtype),
+                        preferred_element_type=out_dtype)
+        acc = f_ref[0].reshape(tile, br).astype(out_dtype) - ax
+        if mode == "residual":
+            o_ref[0] = acc.reshape(tile * br)
+        else:
+            xt = w_refs[0][0].reshape(tile, br).astype(out_dtype)
+            corr = jnp.einsum("tij,tj->ti",
+                              w_refs[1][0].astype(out_dtype), acc,
+                              preferred_element_type=out_dtype)
+            o_ref[0] = (xt + corr).reshape(tile * br)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles, tile * br), out_dtype),
+        interpret=interpret,
+    )(*args)
+    return out.reshape(n_pad)[:n_out * br]
+
+
+def windowed_ell_block_residual(window_starts, cols_local, vals, f, x,
+                                win, n_out, interpret: bool = False):
+    """r = f − A x in one pass (block windowed-ELL)."""
+    return windowed_ell_block_fused(window_starts, cols_local, vals, f, x,
+                                    None, "residual", win, n_out, interpret)
+
+
+def windowed_ell_block_scaled_correction(window_starts, cols_local, vals,
+                                         S, f, x, win, n_out,
+                                         interpret: bool = False):
+    """x + S ∘ (f − A x) in one pass — a block Jacobi/SPAI-0 sweep."""
+    return windowed_ell_block_fused(window_starts, cols_local, vals, f, x,
+                                    S, "correction", win, n_out, interpret)
+
+
 def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,
                         max_win_bytes: int = 8 << 20):
-    """Pack a host scalar CSR into windowed ELL. Assumes the caller already
-    applied a bandwidth-reducing permutation (RCM) if profitable; windows
-    are computed from the matrix as given. Returns None when any row tile's
-    column span exceeds the VMEM budget (no banded locality)."""
-    assert not A.is_block
-    n, m = A.shape
+    """Pack a host CSR (scalar or block-valued BCSR) into windowed ELL.
+    Assumes the caller already applied a bandwidth-reducing permutation
+    (RCM) if profitable; windows are computed from the matrix as given.
+    Returns None when any row tile's column span exceeds the VMEM budget
+    (no banded locality). Block matrices index BLOCK columns; the window
+    DMA budget scales by the block column width."""
+    br, bc = A.block_size
+    n, m = A.shape                  # block units for BCSR
     n_tiles = -(-n // tile)
     nnz_row = A.row_nnz()
     K = max(4, int(nnz_row.max()) if n else 1)
@@ -378,16 +568,25 @@ def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,
     win = int(span.max()) if n_tiles else 1
     win = -(-win // _WIN_ALIGN) * _WIN_ALIGN
     # VMEM budget: window + one cols/vals/out tile must fit comfortably
-    if win * np.dtype(np.float32).itemsize > max_win_bytes:
+    if win * bc * np.dtype(np.float32).itemsize > max_win_bytes:
         return None
     starts32 = starts.astype(np.int32)
 
     flat = rows * K + (np.arange(A.nnz) - A.ptr[rows])
     cols = np.zeros(n_tiles * tile * K, dtype=np.int32)
-    vals = np.zeros(n_tiles * tile * K, dtype=np.dtype(dtype)
-                    if np.dtype(dtype).kind != "c" else A.val.dtype)
+    vdt = np.dtype(dtype) if np.dtype(dtype).kind != "c" else A.val.dtype
     # local columns relative to the window start of the entry's tile
     cols[flat] = A.col - starts[tiles]
+    if A.is_block:
+        vals = np.zeros((n_tiles * tile * K, br, bc), dtype=vdt)
+        vals[flat] = A.val
+        return WindowedEllMatrix(
+            jnp.asarray(starts32),
+            jnp.asarray(cols.reshape(n_tiles, tile, K)),
+            jnp.asarray(vals.reshape(n_tiles, tile, K, br, bc),
+                        dtype=dtype),
+            A.shape, win, (br, bc))
+    vals = np.zeros(n_tiles * tile * K, dtype=vdt)
     vals[flat] = A.val
     return WindowedEllMatrix(
         jnp.asarray(starts32),

@@ -43,14 +43,24 @@ class ScaledResidualSmoother:
                 return dia_scaled_correction(A.offsets, A.data, self.scale,
                                              f, x, interpret=ip)
         from amgcl_tpu.ops.unstructured import WindowedEllMatrix
-        if self.scale.ndim == 1 and isinstance(A, WindowedEllMatrix):
-            ip = A._pallas_mode(x, f, self.scale)
-            if ip is not None:
-                from amgcl_tpu.ops.unstructured import \
-                    windowed_ell_scaled_correction
-                return windowed_ell_scaled_correction(
-                    A.window_starts, A.cols_local, A.vals, self.scale,
-                    f, x, A.win, A.shape[0], interpret=ip)
+        if isinstance(A, WindowedEllMatrix):
+            if self.scale.ndim == 1 and A.block == (1, 1):
+                ip = A._pallas_mode(x, f, self.scale)
+                if ip is not None:
+                    from amgcl_tpu.ops.unstructured import \
+                        windowed_ell_scaled_correction
+                    return windowed_ell_scaled_correction(
+                        A.window_starts, A.cols_local, A.vals, self.scale,
+                        f, x, A.win, A.shape[0], interpret=ip)
+            if (self.scale.ndim == 3 and A.block != (1, 1)
+                    and A.block[0] == A.block[1] == self.scale.shape[-1]):
+                ip = A._pallas_mode(x, f, self.scale)
+                if ip is not None:
+                    from amgcl_tpu.ops.unstructured import \
+                        windowed_ell_block_scaled_correction
+                    return windowed_ell_block_scaled_correction(
+                        A.window_starts, A.cols_local, A.vals, self.scale,
+                        f, x, A.win, A.shape[0], interpret=ip)
         return x + self._mul(dev.residual(f, A, x))
 
     apply_post = apply_pre

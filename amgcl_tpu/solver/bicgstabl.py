@@ -23,10 +23,18 @@ from amgcl_tpu.ops import device as dev
 
 @dataclass
 class BiCGStabL:
+    """``delta`` enables the reliable-update scheme of bicgstabl.hpp:
+    386-409 — when the recursive residual has dropped far enough below
+    its running peaks, the TRUE residual of the inner operator is
+    recomputed (curing recursion drift), and on the stronger condition
+    the accumulated correction is flushed into the solution and the
+    effective rhs re-centered. delta=0 (the reference default) disables
+    the machinery entirely."""
     L: int = 2
     maxiter: int = 100
     tol: float = 1e-8
     pside: str = "right"  # the reference default (bicgstabl.hpp:137)
+    delta: float = 0.0    # reliable-update threshold (bicgstabl.hpp:110)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -69,28 +77,63 @@ class BiCGStabL:
         rhat = r0
         n = rhs.shape[0]
         dtype = rhs.dtype
+        use_delta = self.delta > 0
+        zeta0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
+        if use_delta and not right:
+            # reliable updates need the correction form on BOTH sides:
+            # run from Xc = 0 against B = r0, flush into xbase
+            x = jnp.zeros_like(rhs)
 
         def cond(st):
-            x, R, U, rho, alpha, omega, it, res = st
+            res, it = st[7], st[6]
             return (it < self.maxiter) & (res > eps)
 
         def body(st):
-            x, R, U, rho, alpha, omega, it, res = st
+            if use_delta:
+                (x, R, U, rho, alpha, omega, it, res,
+                 xbase, B, rnc, rnt) = st
+            else:
+                x, R, U, rho, alpha, omega, it, res = st
+            # the reference exits the whole solve the moment ||R[0]|| drops
+            # below eps INSIDE the BiCG stage (bicgstabl.hpp:296-299,
+            # `goto done`) — without that, a near-exact preconditioner
+            # makes the post-convergence step divide ~0/~0 and poison the
+            # state with NaN. Traced control flow cannot goto, so each
+            # unrolled step commits its candidate state only while `live`.
+            live = res > eps
+            took = jnp.zeros((), jnp.int32)
+
+            def commit(new, old):
+                return jax.tree_util.tree_map(
+                    lambda a, b: jnp.where(live, a, b), new, old)
+
             rho = -omega * rho
             # -- BiCG part --
             for j in range(Lp):
                 rho1 = dot(rhat, R[j])
                 beta = alpha * rho1 / jnp.where(rho == 0, 1.0, rho)
-                rho = rho1
+                Uc = U
                 for i in range(j + 1):
-                    U = U.at[i].set(R[i] - beta * U[i])
-                ujp1, gamma = op_dot_rhat(U[j], rhat)
-                U = U.at[j + 1].set(ujp1)
-                alpha = rho / jnp.where(gamma == 0, 1.0, gamma)
+                    Uc = Uc.at[i].set(R[i] - beta * Uc[i])
+                ujp1, gamma = op_dot_rhat(Uc[j], rhat)
+                Uc = Uc.at[j + 1].set(ujp1)
+                alpha_c = rho1 / jnp.where(gamma == 0, 1.0, gamma)
+                Rc = R
                 for i in range(j + 1):
-                    R = R.at[i].set(R[i] - alpha * U[i + 1])
-                R = R.at[j + 1].set(op(R[j]))
-                x = x + alpha * U[0]
+                    Rc = Rc.at[i].set(Rc[i] - alpha_c * Uc[i + 1])
+                Rc = Rc.at[j + 1].set(op(Rc[j]))
+                xc = x + alpha_c * Uc[0]
+                zeta = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
+                took = took + live.astype(jnp.int32)
+                x, R, U, rho, alpha, res = commit(
+                    (xc, Rc, Uc, rho1, alpha_c, zeta),
+                    (x, R, U, rho, alpha, res))
+                if use_delta:
+                    # peaks track EVERY inner step (bicgstabl.hpp:292-294)
+                    # so intra-cycle spikes arm the recompute triggers
+                    rnc = jnp.where(live, jnp.maximum(rnc, zeta), rnc)
+                    rnt = jnp.where(live, jnp.maximum(rnt, zeta), rnt)
+                live = live & (zeta > eps)
             # -- MR part: minimize ||R[0] - sum_j g_j R[j]|| over j=1..L --
             # Gram products go through the inner-product seam (vmapped) so
             # they stay globally reduced inside shard_map; a raw conj(Z)@Z.T
@@ -100,19 +143,61 @@ class BiCGStabL:
             rhs_g = jax.vmap(lambda zi: dot(zi, R[0]))(Z)
             gam = jnp.linalg.solve(
                 G + 1e-300 * jnp.eye(Lp, dtype=dtype), rhs_g)
-            x = x + jnp.tensordot(gam, R[:Lp], axes=1)
-            R = R.at[0].set(R[0] - jnp.tensordot(gam, R[1:], axes=1))
-            U = U.at[0].set(U[0] - jnp.tensordot(gam, U[1:], axes=1))
-            omega = gam[Lp - 1]
-            res = jnp.sqrt(jnp.abs(dot(R[0], R[0])))
-            return (x, R, U, rho, alpha, omega, it + Lp, res)
+            xc = x + jnp.tensordot(gam, R[:Lp], axes=1)
+            Rc = R.at[0].set(R[0] - jnp.tensordot(gam, R[1:], axes=1))
+            Uc = U.at[0].set(U[0] - jnp.tensordot(gam, U[1:], axes=1))
+            res_c = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
+            x, R, U, omega, res = commit(
+                (xc, Rc, Uc, gam[Lp - 1], res_c), (x, R, U, omega, res))
+            if not use_delta:
+                return (x, R, U, rho, alpha, omega, it + took, res)
+
+            # -- reliable updates (bicgstabl.hpp:386-409): recompute the
+            # true inner-operator residual when the recursive one has
+            # dropped below delta times its running peaks; on the stronger
+            # condition also flush the correction into the solution and
+            # re-center the effective rhs
+            rnc = jnp.maximum(res, rnc)
+            rnt = jnp.maximum(res, rnt)
+            update_x = (res < self.delta * zeta0) & (zeta0 <= rnc) & live
+            recomp = (((res < self.delta * rnt) & (res <= rnt))
+                      | update_x) & live
+
+            def do_flush(args):
+                xc, Rr, xb, Bc, rc, rt = args
+                # compute M xc once and reuse it for both the true
+                # residual and the flush (the reference's *T intermediate,
+                # bicgstabl.hpp:394-404) — a second precond application
+                # here would be a whole extra V-cycle
+                Mx = precond(xc) if right else xc
+                r_true = Bc - (dev.spmv(A, Mx) if right else op(xc))
+                Rr = Rr.at[0].set(r_true)
+
+                def do_up(a):
+                    xc2, xb2, Bc2, rc2 = a
+                    return jnp.zeros_like(xc2), xb2 + Mx, r_true, res
+
+                xc, xb, Bc, rc = lax.cond(update_x, do_up, lambda a: a,
+                                          (xc, xb, Bc, rc))
+                return xc, Rr, xb, Bc, rc, res
+
+            x, R, xbase, B, rnc, rnt = lax.cond(
+                recomp, do_flush, lambda a: a,
+                (x, R, xbase, B, rnc, rnt))
+            return (x, R, U, rho, alpha, omega, it + took, res,
+                    xbase, B, rnc, rnt)
 
         R0 = jnp.zeros((Lp + 1, n), dtype).at[0].set(r0)
         U0 = jnp.zeros((Lp + 1, n), dtype)
         one = jnp.ones((), dtype)
-        st = (x, R0, U0, one, jnp.zeros((), dtype), one, 0,
-              jnp.sqrt(jnp.abs(dot(r0, r0))))
-        x, R, U, rho, alpha, omega, it, res = lax.while_loop(cond, body, st)
-        if right:
+        st = (x, R0, U0, one, jnp.zeros((), dtype), one, 0, zeta0)
+        if use_delta:
+            st = st + (x_init, r0, zeta0, zeta0)
+        out = lax.while_loop(cond, body, st)
+        x, it, res = out[0], out[6], out[7]
+        if use_delta:
+            xbase = out[8]
+            x = xbase + (precond(x) if right else x)
+        elif right:
             x = x_init + precond(x)
         return x, it, res / scale

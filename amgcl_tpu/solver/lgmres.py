@@ -23,10 +23,17 @@ from amgcl_tpu.solver.gmres import _arnoldi_cycle
 
 @dataclass
 class LGMRES:
+    """``pside`` selects the preconditioning side (reference:
+    amgcl/solver/lgmres.hpp params, default side::right there; here the
+    historical default stays left). With ``pside='right'`` the Arnoldi
+    directions live in the unpreconditioned W-space and the
+    preconditioner is applied ONCE to the assembled correction per cycle
+    (lgmres.hpp:384-389), with true residuals tracked."""
     M: int = 30
     K: int = 3
     maxiter: int = 100
     tol: float = 1e-8
+    pside: str = "left"
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -35,12 +42,24 @@ class LGMRES:
         n = rhs.shape[0]
         dtype = rhs.dtype
         x = jnp.zeros_like(rhs) if x0 is None else x0
+        if self.pside not in ("left", "right"):
+            raise ValueError("pside must be 'left' or 'right'")
+        left = self.pside == "left"
 
-        def apply_op(v):
-            return precond(dev.spmv(A, v)), v
+        if left:
+            def apply_op(v):
+                return precond(dev.spmv(A, v)), v
 
-        def presid(x):
-            return precond(dev.residual(rhs, A, x))
+            def presid(x):
+                return precond(dev.residual(rhs, A, x))
+        else:
+            # preconditioner::spmv(side::right): w = A (M z); the stored
+            # directions are the z themselves, M lands on the assembled dx
+            def apply_op(v):
+                return dev.spmv(A, precond(v)), v
+
+            def presid(x):
+                return dev.residual(rhs, A, x)
 
         bref = presid(jnp.zeros_like(rhs))
         norm_rhs = jnp.sqrt(jnp.abs(dot(bref, bref)))
@@ -62,10 +81,14 @@ class LGMRES:
             dx, steps, res = _arnoldi_cycle(
                 apply_op, r, m, eps, dot, direction=direction,
                 n_steps=mk + jnp.minimum(n_aug, K))
+            # augmentation stores the W-space correction for BOTH sides
+            # (lgmres.hpp:363-371 normalizes dx before the P application)
             nrm = jnp.sqrt(jnp.abs(dot(dx, dx)))
             aug = jnp.roll(aug, 1, axis=0).at[0].set(
                 dx / jnp.where(nrm == 0, 1.0, nrm))
-            return (x + dx, aug, jnp.minimum(n_aug + 1, K), it + steps, res)
+            step = dx if left else precond(dx)
+            return (x + step, aug, jnp.minimum(n_aug + 1, K),
+                    it + steps, res)
 
         r0 = presid(x)
         st = (x, jnp.zeros((K, n), dtype), 0, 0,

@@ -617,6 +617,47 @@ def _bench_extra_configs(on_tpu):
         out["block3_n%d" % n] = {
             "rows": A.nrows * 3, "solve_s": round(t, 4),
             "iters": int(info.iters), "resid": float(info.resid)}
+        # block SpMV format decision (VERDICT r4 item 3): windowed
+        # block-ELL Pallas kernel vs the einsum block-ELL XLA path on the
+        # fine-level operator
+        from jax import lax
+        from amgcl_tpu.ops import device as devops
+        from amgcl_tpu.ops.unstructured import (
+            csr_to_windowed_ell, kernel_supported,
+            windowed_ell_block_spmv)
+        reps = 50
+        xv = jnp.asarray(np.random.RandomState(0).rand(A.nrows * 3),
+                         jnp.float32)
+
+        def timeit(fn):
+            def many(x0):
+                def body(c, _):
+                    return fn(c) * 0.5 + x0, None
+                o, _ = lax.scan(body, x0, None, length=reps)
+                return o.sum()
+            fj = jax.jit(many)
+            float(fj(xv))
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                float(fj(xv))
+                ts.append(time.perf_counter() - t0)
+            return round(float(np.median(ts)) / reps * 1e6, 1)
+
+        E = devops.csr_to_ell(A, jnp.float32)
+        out["block3_ell_einsum_us"] = timeit(E.mv)
+        Wb = csr_to_windowed_ell(A, jnp.float32)
+        if Wb is not None:
+            out["block3_well_xla_us"] = timeit(Wb._mv_xla)
+            if on_tpu and kernel_supported(
+                    Wb.win, Wb.cols_local.shape[2], Wb.dtype, Wb.block):
+                out["block3_well_pallas_us"] = timeit(
+                    lambda v: windowed_ell_block_spmv(
+                        Wb.window_starts, Wb.cols_local, Wb.vals, v,
+                        Wb.win, Wb.shape[0]))
+                out["block3_speedup_vs_einsum"] = round(
+                    out["block3_ell_einsum_us"]
+                    / out["block3_well_pallas_us"], 2)
     except Exception as e:
         out["block3"] = {"error": repr(e)}
 

@@ -131,6 +131,66 @@ def test_lgmres_bicgstabl_idrs():
             type(s).__name__
 
 
+def test_lgmres_right_side():
+    """pside='right' (the reference default, lgmres.hpp params): true
+    residuals tracked, preconditioner applied once per cycle to the
+    assembled correction — converges to the same quality as left."""
+    from amgcl_tpu.solver.lgmres import LGMRES
+    A, rhs = convection_diffusion_2d(24, eps=0.05)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        LGMRES(maxiter=300, tol=1e-8, pside="right"))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
+    # warm start in correction form
+    x2, info2 = solve(rhs, x0=np.asarray(x))
+    assert info2.iters <= 2
+    with pytest.raises(ValueError):
+        LGMRES(pside="middle").solve(None, None, jnp.zeros(4))
+
+
+@pytest.mark.parametrize("pside", ["left", "right"])
+def test_bicgstabl_delta_reliable_updates(pside):
+    """delta > 0 enables the reliable-update scheme
+    (bicgstabl.hpp:386-409): convergence quality must match delta=0, and
+    the knob must be reachable from the runtime config."""
+    from amgcl_tpu.solver.bicgstabl import BiCGStabL
+    A, rhs = convection_diffusion_2d(24, eps=0.05)
+    prm = AMGParams(dtype=jnp.float64, coarse_enough=200)
+    s = make_solver(A, prm, BiCGStabL(L=2, maxiter=200, tol=1e-8,
+                                      pside=pside, delta=1e-2))
+    x, info = s(rhs)
+    assert info.resid < 1e-8
+    r = rhs - A.spmv(np.asarray(x))
+    assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-5
+    # warm start still correct with the flush machinery
+    x2, info2 = s(rhs, x0=np.asarray(x))
+    assert info2.iters <= 2
+
+
+def test_runtime_config_reaches_new_knobs():
+    """lgmres.pside and bicgstabl.delta are expressible in the dotted
+    runtime config (VERDICT r4 item 6)."""
+    from amgcl_tpu.models.runtime import make_solver_from_config
+    A, rhs = poisson3d(8)
+    for cfg in (
+        {"solver": {"type": "lgmres", "pside": "right", "tol": 1e-8,
+                    "maxiter": 300},
+         "precond": {"dtype": "float64"}},
+        {"solver": {"type": "bicgstabl", "delta": "1e-2", "tol": 1e-8,
+                    "maxiter": 200},
+         "precond": {"dtype": "float64"}},
+    ):
+        import warnings as _w
+        with _w.catch_warnings():
+            _w.simplefilter("error")       # unknown keys would warn
+            solve = make_solver_from_config(A, cfg)
+        x, info = solve(rhs)
+        r = rhs - A.spmv(np.asarray(x))
+        assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-6
+
+
 def test_lgmres_small_restart_beats_gmres_stall():
     """Augmentation should not be slower than plain GMRES at equal M."""
     from amgcl_tpu.solver.lgmres import LGMRES
