@@ -228,7 +228,7 @@ class DistHierarchy:
     """Sharded multilevel state; ``shard_apply`` runs inside shard_map."""
 
     def __init__(self, levels, rep, trans, top_A=None, npre=1, npost=1,
-                 ncycle=1, pre_cycles=1):
+                 ncycle=1, pre_cycles=1, rep_rowshard=False):
         self.levels = list(levels)   # sharded levels (may be empty)
         self.rep = rep               # replicated serial sub-hierarchy
         self.trans = trans           # TransitionOps (None = whole-vector
@@ -238,10 +238,12 @@ class DistHierarchy:
         self.npost = int(npost)
         self.ncycle = int(ncycle)
         self.pre_cycles = int(pre_cycles)
+        self.rep_rowshard = bool(rep_rowshard)
 
     def tree_flatten(self):
         return ((self.levels, self.rep, self.trans, self.top_A),
-                (self.npre, self.npost, self.ncycle, self.pre_cycles))
+                (self.npre, self.npost, self.ncycle, self.pre_cycles,
+                 self.rep_rowshard))
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -258,19 +260,114 @@ class DistHierarchy:
             lvls, rep_spec,
             None if self.trans is None else self.trans.specs(),
             None if self.top_A is None else self.top_A.specs(),
-            self.npre, self.npost, self.ncycle, self.pre_cycles)
+            self.npre, self.npost, self.ncycle, self.pre_cycles,
+            self.rep_rowshard)
 
     # -- inside shard_map ---------------------------------------------------
+
+    @staticmethod
+    def _rowshard_mat_ok(M):
+        from amgcl_tpu.ops.device import EllMatrix, DenseMatrix
+        return ((isinstance(M, EllMatrix) and M.block == (1, 1))
+                or isinstance(M, DenseMatrix))
+
+    def _rowshard_ok(self):
+        """The finest replicated level qualifies for row-sharded visits:
+        scalar ELL or dense operator, diagonal-scaling smoother, no fused
+        sweep closures (their layout assumptions are per-level). P/R may
+        be anything (incl. implicit proxies) — they run replicated; the
+        sharded work is the smoother/residual passes, which dominate."""
+        from amgcl_tpu.relaxation.base import ScaledResidualSmoother
+        rep = self.rep
+        if len(rep.levels) < 2 or rep.npre < 1:
+            return False
+        lv = rep.levels[0]
+        return (self._rowshard_mat_ok(lv.A)
+                and isinstance(lv.relax, ScaledResidualSmoother)
+                and lv.relax.scale.ndim == 1
+                and lv.down is None and lv.up is None)
+
+    def _rep_rowshard_visit(self, f_full):
+        """cycle(0, ·) of the replicated tail with the FINEST tail level
+        row-sharded over the mesh: each shard smooths/residuals its own
+        row slice of the replicated operator against the replicated
+        vector (no halo — x is already whole), one all_gather per op.
+        Trades the tail's N-fold redundant FLOPs for a few small
+        collectives; ``rep_rowshard=True`` opts in, the 8-device dryrun
+        A/Bs it (ROADMAP 'coarse levels underutilize large meshes')."""
+        from amgcl_tpu.ops import device as sdev
+        rep = self.rep
+        lv = rep.levels[0]
+        A = lv.A
+        n = A.shape[0]
+        nd = lax.axis_size(ROWS_AXIS)
+        nloc = -(-n // nd)
+        n_pad = nloc * nd
+        s = lax.axis_index(ROWS_AXIS)
+
+        from amgcl_tpu.ops.device import EllMatrix
+
+        def row_slice_op(M):
+            """Local-rows matvec closure for an ELL or dense operator."""
+            if isinstance(M, EllMatrix):
+                K = M.cols.shape[1]
+                cp = jnp.pad(M.cols, ((0, n_pad - n), (0, 0)))
+                vp = jnp.pad(M.vals, ((0, n_pad - n), (0, 0)))
+                c = lax.dynamic_slice(cp, (s * nloc, np.int32(0)), (nloc, K))
+                v = lax.dynamic_slice(vp, (s * nloc, np.int32(0)), (nloc, K))
+                return lambda x_full: jnp.einsum(
+                    "nk,nk->n", v, jnp.take(x_full, c, axis=0),
+                    preferred_element_type=f_full.dtype)
+            ap = jnp.pad(M.a, ((0, n_pad - n), (0, 0)))
+            a = lax.dynamic_slice(ap, (s * nloc, np.int32(0)),
+                                  (nloc, M.a.shape[1]))
+            return lambda x_full: (a @ x_full).astype(f_full.dtype)
+
+        def vec_slice(v_full):
+            vp = jnp.pad(v_full, (0, n_pad - v_full.shape[0]))
+            return lax.dynamic_slice(vp, (s * nloc,), (nloc,))
+
+        def allg(y_loc):
+            return lax.all_gather(y_loc, ROWS_AXIS, tiled=True)[:n]
+
+        mv_loc = row_slice_op(A)
+        w_loc = vec_slice(lv.relax.scale)
+        f_loc = vec_slice(f_full)
+
+        # pre-smoothing: first sweep from zero, then scaled-residual sweeps
+        u_loc = w_loc * f_loc
+        for _ in range(rep.npre - 1):
+            u_loc = u_loc + w_loc * (f_loc - mv_loc(allg(u_loc)))
+        u_full = allg(u_loc)
+        # sharded residual -> replicated restrict + coarse tail-of-tail
+        r_full = allg(f_loc - mv_loc(u_full))
+        fc = sdev.spmv(lv.R, r_full)
+        uc = rep.cycle(1, fc)
+        for _ in range(rep.ncycle - 1):
+            rc = sdev.residual(fc, rep.levels[1].A, uc)
+            uc = uc + rep.cycle(1, rc)
+        # replicated prolong (P may be an implicit proxy), local correct,
+        # then sharded post-smoothing
+        u_loc = u_loc + vec_slice(sdev.spmv(lv.P, uc))
+        for _ in range(rep.npost):
+            u_loc = u_loc + w_loc * (f_loc - mv_loc(allg(u_loc)))
+        return allg(u_loc)
+
+    def _rep_visit(self, fc_full):
+        if self.rep_rowshard and self._rowshard_ok():
+            return self._rep_rowshard_visit(fc_full)
+        return self.rep.cycle(0, fc_full)
 
     def _rep_solve(self, fc_full):
         """Replicated sub-hierarchy visit(s): every shard runs the same
         serial cycle on the full coarse vector — redundant FLOPs on tiny
-        levels instead of per-level collectives."""
+        levels instead of per-level collectives (or row-sharded finest
+        tail level under ``rep_rowshard``)."""
         from amgcl_tpu.ops import device as sdev
-        uc = self.rep.cycle(0, fc_full)
+        uc = self._rep_visit(fc_full)
         for _ in range(self.ncycle - 1):
             rc = fc_full - sdev.spmv(self.rep.levels[0].A, uc)
-            uc = uc + self.rep.cycle(0, rc)
+            uc = uc + self._rep_visit(rc)
         return uc
 
     def shard_cycle(self, i, f):
@@ -455,7 +552,8 @@ class DistAMGSolver:
     def __init__(self, A, mesh, prm: Optional[AMGParams] = None,
                  solver: Any = None, replicate_below: int = 4096,
                  device_mis: bool = False, min_per_shard: int = 0,
-                 repartition: float = 0.0, precond_dtype: Any = None):
+                 repartition: float = 0.0, precond_dtype: Any = None,
+                 rep_rowshard: bool = False):
         """``device_mis=True`` runs the aggregation MIS rounds sharded on
         the mesh (parallel/dist_mis.py) instead of the host greedy pass —
         the reference's distributed-PMIS role
@@ -474,7 +572,14 @@ class DistAMGSolver:
         ``precond_dtype`` stores the sharded level/transfer/smoother
         arrays in a narrower dtype (e.g. bfloat16 — halves HBM bytes per
         V-cycle) while the Krylov vectors stay in ``prm.dtype`` — the
-        distributed rendition of the mixing.hpp precision seam."""
+        distributed rendition of the mixing.hpp precision seam.
+
+        ``rep_rowshard=True`` row-shards the FINEST replicated-tail
+        level's smoother/residual/prolong work across the mesh (one
+        all_gather per op) instead of every shard redundantly computing
+        the whole tail — trades tail FLOPs for small collectives; worth
+        it when the tail is fat relative to ICI latency (A/B'd in the
+        multichip dryrun)."""
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.mesh = mesh
@@ -603,7 +708,8 @@ class DistAMGSolver:
                                    ncloc=nlocs[0])
         self.hier = DistHierarchy(levels, rep, trans, top_A,
                                   self.prm.npre, self.prm.npost,
-                                  self.prm.ncycle, self.prm.pre_cycles)
+                                  self.prm.ncycle, self.prm.pre_cycles,
+                                  rep_rowshard=rep_rowshard)
         self.n = A.nrows * A.block_size[0]
         first_A = levels[0].A if levels else top_A
         self.n_pad = first_A.nloc * nd

@@ -5,11 +5,14 @@ Reference role: ``mpi::partition::parmetis`` / ``ptscotch``
 per level and re-distribute A <- Iᵀ A I, P <- P I, R <- Iᵀ R so coarse rows
 live near the rows they couple with. On a TPU mesh the shard assignment is
 fixed (equal row blocks), so re-distribution IS a symmetric permutation
-that groups connected rows into the same block; the partitioner here is
-reverse Cuthill-McKee — contiguous slices of the RCM order are
-connectivity-localized blocks (the same locality objective as recursive
-graph bisection, reference examples/mpi/domain_partition.hpp, with
-machinery the framework already uses for DIA/windowed-ELL packing).
+that groups connected rows into the same block. Two partitioners compete
+per level (``best_permutation``): reverse Cuthill-McKee (contiguous
+slices of the RCM order — wins on banded graphs; machinery shared with
+DIA/windowed-ELL packing) and the real multilevel k-way partitioner of
+``parallel/partition.py`` (heavy-edge coarsening + spectral bisection +
+FM refinement — the algorithm parmetis/ptscotch themselves run, winning
+on genuinely irregular graphs where bandwidth reduction cannot localize
+coupling). The winner is whichever achieves the lower halo fraction.
 
 For order-independent smoothers (spai0/jacobi/chebyshev/spai1) the math
 is permutation-invariant — iteration counts do not change (pinned by
@@ -55,6 +58,34 @@ def locality_permutation(A: CSR) -> np.ndarray:
     return cuthill_mckee(A.unblock() if A.is_block else A)
 
 
+def best_permutation(A: CSR, nd: int, nloc: int | None = None):
+    """(perm, permuted_A, halo_after): the better of the k-way
+    partitioner (parallel/partition.py — the parmetis/ptscotch role) and
+    the RCM locality ordering, judged by the halo fraction each achieves
+    under the mesh's row-block layout. RCM wins on banded problems (its
+    blocks are contiguous by construction); k-way wins on genuinely
+    irregular graphs where bandwidth reduction cannot localize coupling.
+    The winner's permuted matrix is returned so the caller does not
+    permute a second time."""
+    import warnings
+    from amgcl_tpu.parallel.partition import partition_permutation
+    from amgcl_tpu.utils.adapters import permute
+    cands = [locality_permutation(A)]
+    try:
+        cands.append(partition_permutation(A, nd, nloc))
+    except Exception as e:         # k-way is best-effort; RCM always works
+        warnings.warn("k-way partitioner failed (%r); repartitioning "
+                      "falls back to RCM locality only" % (e,),
+                      RuntimeWarning, stacklevel=2)
+    best = None
+    for perm in cands:
+        Ap = permute(A, perm)
+        h = halo_fraction(Ap, nd, nloc)
+        if best is None or h < best[2]:
+            best = (perm, Ap, h)
+    return best
+
+
 def _perm_cols(M: CSR, perm: np.ndarray) -> CSR:
     """Column j of the result is old column perm[j]."""
     m = M.to_scipy()[:, perm].tocsr()
@@ -88,12 +119,9 @@ def repartition_host_levels(host_levels, t: int, threshold: float,
         before = halo_fraction(Ak, nd, nloc_k)
         if before <= threshold:
             continue
-        perm = locality_permutation(Ak)
-        from amgcl_tpu.utils.adapters import permute
-        A_new = permute(Ak, perm)
-        after = halo_fraction(A_new, nd, nloc_k)
+        perm, A_new, after = best_permutation(Ak, nd, nloc_k)
         if after >= before:
-            continue            # RCM did not help; keep the original
+            continue            # neither partitioner helped; keep as is
         Pk, Rk = host_levels[k][1], host_levels[k][2]
         Pprev, Rprev = host_levels[k - 1][1], host_levels[k - 1][2]
         host_levels[k - 1] = (host_levels[k - 1][0],

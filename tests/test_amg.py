@@ -235,3 +235,28 @@ def test_device_coarse_inverse(monkeypatch):
     r = np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64))) \
         / np.linalg.norm(rhs)
     assert r < 1e-4
+
+
+def test_singular_coarse_pinv_fallback():
+    """A singular coarse operator (pure Neumann: nullspace = constants)
+    must announce the pseudo-inverse fallback and still produce a valid
+    least-squares coarse solve (solver/direct.py pinv branch)."""
+    import warnings
+    import scipy.sparse as sp
+    from amgcl_tpu.solver.direct import DenseDirectSolver
+    from amgcl_tpu.ops.csr import CSR
+    n = 24
+    e = np.ones(n)
+    # 1D Neumann Laplacian: rows sum to zero -> exactly singular
+    L = sp.diags([-e[:-1], 2 * e, -e[:-1]], [-1, 0, 1]).tolil()
+    L[0, 0] = 1.0
+    L[-1, -1] = 1.0
+    A = CSR.from_scipy(L.tocsr())
+    with warnings.catch_warnings(record=True) as got:
+        warnings.simplefilter("always")
+        ds = DenseDirectSolver.build(A, jnp.float64)
+    assert any("pseudo-inverse" in str(w.message) for w in got)
+    # least-squares solve: for rhs in range(A), A (A+ f) == f
+    f = np.asarray(A.to_dense() @ np.linspace(0, 1, n))
+    y = np.asarray(ds.solve(jnp.asarray(f)))
+    np.testing.assert_allclose(A.to_dense() @ y, f, atol=1e-8)
