@@ -353,6 +353,7 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         for key, cast in (("repartition", float), ("replicate_below", int),
                           ("device_mis", _parse_bool),
                           ("min_per_shard", int),
+                          ("rep_rowshard", _parse_bool),
                           ("precond_dtype", _parse_dtype)):
             if key in pcfg:
                 dist_kw[key] = cast(pcfg.pop(key))

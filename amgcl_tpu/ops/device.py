@@ -416,13 +416,15 @@ def spmv_dots(A, x, w=None, ip=inner_product):
             return dia_spmv_dots(A.offsets, A.data, x, w, interpret=m)
     from amgcl_tpu.ops.unstructured import WindowedEllMatrix
     if isinstance(A, WindowedEllMatrix) and ip is inner_product \
-            and A.shape[0] == A.shape[1] and A.block == (1, 1):
+            and A.shape[0] == A.shape[1] and A.block[0] == A.block[1]:
         m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)
         if m is not None:
-            from amgcl_tpu.ops.unstructured import windowed_ell_spmv_dots
-            return windowed_ell_spmv_dots(
-                A.window_starts, A.cols_local, A.vals, x, w,
-                win=A.win, n_out=A.shape[0], interpret=m)
+            from amgcl_tpu.ops.unstructured import (
+                windowed_ell_spmv_dots, windowed_ell_block_spmv_dots)
+            fn = windowed_ell_spmv_dots if A.block == (1, 1) \
+                else windowed_ell_block_spmv_dots
+            return fn(A.window_starts, A.cols_local, A.vals, x, w,
+                      win=A.win, n_out=A.shape[0], interpret=m)
     y = A.mv(x)
     return y, ip(y, y), ip(y, x), (None if w is None else ip(y, w))
 

@@ -159,18 +159,33 @@ def kernel_supported(win: int = 2 << 20, K: int = 4,
         try:
             starts = jnp.zeros(1, jnp.int32)
             cols = jnp.zeros((1, _TILE, int(K)), jnp.int32)
+            # probe BOTH the plain SpMV and the dots kernel: the dots
+            # variant adds vector streams in VMEM plus an SMEM
+            # accumulator output, so it can fail legalization where the
+            # plain kernel compiles — and its dispatch (dev.spmv_dots)
+            # has no outer-jit-safe fallback once this gate said yes
             if (br, bc) == (1, 1):
                 vals = jnp.zeros((1, _TILE, int(K)), dtype)
                 x = jnp.zeros(int(win), jnp.float32)
                 jax.jit(functools.partial(
                     windowed_ell_spmv, win=int(win), n_out=_TILE)
                 ).lower(starts, cols, vals, x).compile()
+                xs = jnp.zeros(_TILE, jnp.float32)   # square-operator x
+                jax.jit(functools.partial(
+                    windowed_ell_spmv_dots, win=int(win), n_out=_TILE)
+                ).lower(starts, cols, vals, xs, xs).compile()
             else:
                 vals = jnp.zeros((1, _TILE, int(K), br, bc), dtype)
                 x = jnp.zeros(int(win) * bc, jnp.float32)
                 jax.jit(functools.partial(
                     windowed_ell_block_spmv, win=int(win), n_out=_TILE)
                 ).lower(starts, cols, vals, x).compile()
+                if br == bc:
+                    xs = jnp.zeros(_TILE * bc, jnp.float32)
+                    jax.jit(functools.partial(
+                        windowed_ell_block_spmv_dots, win=int(win),
+                        n_out=_TILE)
+                    ).lower(starts, cols, vals, xs, xs).compile()
             _KERNEL_OK[key] = True
         except Exception:
             _KERNEL_OK[key] = False
@@ -521,6 +536,70 @@ def windowed_ell_block_fused(window_starts, cols_local, vals, f, x, S,
         interpret=interpret,
     )(*args)
     return out.reshape(n_pad)[:n_out * br]
+
+
+@functools.partial(jax.jit, static_argnames=("win", "n_out", "interpret"))
+def windowed_ell_block_spmv_dots(window_starts, cols_local, vals, x,
+                                 w=None, win: int = 0, n_out: int = 0,
+                                 interpret: bool = False):
+    """(y, <y, y>, <y, x>, <y, w>) in one pass, y = A x for block
+    windowed-ELL — the Krylov hot pairs on the block path (see
+    dia_spmv_dots). Square (br == bc) real operators only (the caller
+    gates); per-tile partials accumulate into SMEM scalars."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles, tile, K, br, bc = vals.shape
+    n_pad = n_tiles * tile * br
+    out_dtype = jnp.result_type(vals.dtype, x.dtype)
+    acc_dtype = jnp.float32 if jnp.dtype(out_dtype).itemsize <= 4 \
+        else jnp.float64
+    has_w = w is not None
+    vecs = [jnp.pad(x, (0, n_pad - x.shape[0]))]
+    if has_w:
+        vecs.append(jnp.pad(w, (0, n_pad - w.shape[0])))
+
+    def kernel(starts_smem, x_hbm, c_ref, v_ref, xt_ref, *rest):
+        (*w_refs, o_ref, dots_ref, xw, sem) = rest
+        _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc)
+        t = pl.program_id(0)
+        xg = _block_gather(c_ref, xw, tile, K, bc)
+        y = jnp.einsum("tkij,tkj->ti", v_ref[0], xg.astype(v_ref.dtype),
+                       preferred_element_type=out_dtype
+                       ).reshape(tile * br)
+        o_ref[0] = y.astype(o_ref.dtype)
+        ya = y.astype(acc_dtype)
+        p_yy = jnp.sum(ya * ya)
+        p_yx = jnp.sum(ya * xt_ref[0].astype(acc_dtype))
+
+        @pl.when(t == 0)
+        def _init():
+            for j in range(2 + has_w):
+                dots_ref[0, j] = jnp.zeros((), acc_dtype)
+
+        dots_ref[0, 0] += p_yy
+        dots_ref[0, 1] += p_yx
+        if has_w:
+            dots_ref[0, 2] += jnp.sum(ya * w_refs[0][0].astype(acc_dtype))
+
+    xp, vec_spec, grid_spec = _well_block_geometry(
+        x, win, bc, n_tiles, tile, K, br, len(vecs),
+        (pl.BlockSpec((1, tile * br), lambda t, starts: (t, 0)),
+         pl.BlockSpec(memory_space=pltpu.SMEM)))
+    y, dots = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=(
+            jax.ShapeDtypeStruct((n_tiles, tile * br), out_dtype),
+            jax.ShapeDtypeStruct((1, 2 + has_w), acc_dtype),
+        ),
+        interpret=interpret,
+    )(window_starts, xp, cols_local, vals,
+      *(v.reshape(n_tiles, tile * br) for v in vecs))
+    yy = dots[0, 0].astype(out_dtype)
+    yx = dots[0, 1].astype(out_dtype)
+    yw = dots[0, 2].astype(out_dtype) if has_w else None
+    return y.reshape(n_pad)[:n_out * br], yy, yx, yw
 
 
 def windowed_ell_block_residual(window_starts, cols_local, vals, f, x,

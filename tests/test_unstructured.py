@@ -187,6 +187,32 @@ def test_windowed_block_fused_interpret_matches():
     np.testing.assert_allclose(got, corr_ref, rtol=5e-4, atol=5e-4)
 
 
+def test_windowed_block_spmv_dots_interpret_matches(monkeypatch):
+    import amgcl_tpu.ops.unstructured as unstruct
+    Ab, W, x, _, _ = _block_fixture(seed=16)
+    rng = np.random.RandomState(16)
+    w = rng.rand(x.shape[0]).astype(np.float32)
+    y_ref = Ab.unblock().spmv(x.astype(np.float64))
+    y, yy, yx, yw = unstruct.windowed_ell_block_spmv_dots(
+        W.window_starts, W.cols_local, W.vals, jnp.asarray(x),
+        jnp.asarray(w), win=W.win, n_out=W.shape[0], interpret=True)
+    np.testing.assert_allclose(np.asarray(y), y_ref, rtol=5e-4, atol=5e-4)
+    np.testing.assert_allclose(float(yy), y_ref @ y_ref, rtol=1e-3)
+    np.testing.assert_allclose(float(yx), y_ref @ x, rtol=1e-3)
+    np.testing.assert_allclose(float(yw), y_ref @ w, rtol=1e-3)
+    # the seam must actually REACH the block kernel under the interpret
+    # hook (numeric equality alone also holds on the mv fallback)
+    monkeypatch.setenv("AMGCL_TPU_PALLAS_INTERPRET", "1")
+    calls = []
+    real = unstruct.windowed_ell_block_spmv_dots
+    monkeypatch.setattr(
+        unstruct, "windowed_ell_block_spmv_dots",
+        lambda *a, **k: calls.append(1) or real(*a, **k))
+    y2, yy2, yx2, yw2 = dev.spmv_dots(W, jnp.asarray(x), jnp.asarray(w))
+    assert calls, "seam did not dispatch the block dots kernel"
+    np.testing.assert_allclose(float(yx2), float(yx), rtol=1e-5)
+
+
 def test_windowed_block_wiring_through_seams(monkeypatch):
     monkeypatch.setenv("AMGCL_TPU_PALLAS_INTERPRET", "1")
     Ab, W, x, f, S = _block_fixture(seed=14)
