@@ -365,6 +365,7 @@ def make_dist_solver_from_config(A, mesh=None, prm=None, **flat_overrides):
         from amgcl_tpu.parallel.dist_setup import StripAMGSolver
         strip_kw = {}
         for key, cast in (("replicate_below", int), ("mis_rounds", int),
+                          ("rep_rowshard", _parse_bool),
                           ("precond_dtype", _parse_dtype)):
             if key in pcfg:
                 strip_kw[key] = cast(pcfg.pop(key))

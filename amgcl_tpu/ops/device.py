@@ -363,7 +363,7 @@ def residual(f, A, x):
             return dia_residual(A.offsets, A.data, f, x, interpret=ip)
     from amgcl_tpu.ops.unstructured import WindowedEllMatrix
     if isinstance(A, WindowedEllMatrix):
-        ip = A._pallas_mode(x, f)
+        ip = A._pallas_mode(x, f, kernel="fused")
         if ip is not None:
             if A.block == (1, 1):
                 from amgcl_tpu.ops.unstructured import \
@@ -417,7 +417,8 @@ def spmv_dots(A, x, w=None, ip=inner_product):
     from amgcl_tpu.ops.unstructured import WindowedEllMatrix
     if isinstance(A, WindowedEllMatrix) and ip is inner_product \
             and A.shape[0] == A.shape[1] and A.block[0] == A.block[1]:
-        m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)
+        m = A._pallas_mode(x, kernel="dots") if w is None \
+            else A._pallas_mode(x, w, kernel="dots")
         if m is not None:
             from amgcl_tpu.ops.unstructured import (
                 windowed_ell_spmv_dots, windowed_ell_block_spmv_dots)

@@ -89,15 +89,19 @@ class WindowedEllMatrix:
         shape, win, block = aux
         return cls(children[0], children[1], children[2], shape, win, block)
 
-    def _pallas_mode(self, *vecs):
+    def _pallas_mode(self, *vecs, kernel: str = "spmv"):
         """None = XLA path; else the ``interpret`` flag for the windowed
         kernels (False on real TPU after a support probe, True under the
-        CI interpret hook) — the same dispatch seam as DiaMatrix."""
+        CI interpret hook) — the same dispatch seam as DiaMatrix.
+        ``kernel`` names the variant being dispatched ('spmv' / 'fused' /
+        'dots'): each is probed separately, so a legalization failure in
+        one (e.g. the SMEM-accumulating dots) does not disable the
+        others."""
         from amgcl_tpu.ops.pallas_spmv import pallas_mode
         m = pallas_mode(self.dtype, *(v.dtype for v in vecs))
         if m is False and not kernel_supported(
                 self.win, self.cols_local.shape[2], self.dtype,
-                self.block):
+                self.block, kernel):
             return None
         return m
 
@@ -144,48 +148,74 @@ _KERNEL_OK = {}
 
 
 def kernel_supported(win: int = 2 << 20, K: int = 4,
-                     dtype=jnp.float32, block=(1, 1)) -> bool:
-    """Probe-compile the windowed kernel on the current backend for THIS
-    matrix's VMEM footprint (window size, tile width K, value dtype,
-    block dims): the in-kernel gather needs Mosaic support that may vary
-    by TPU generation, and VMEM-pressure failures depend on the window
-    scratch plus the (tile, K) cols/vals blocks. mv() cannot use
-    try/except — inside an outer jit a legalization failure only surfaces
-    at the OUTER compile — so the path choice is made here, eagerly.
-    Results are cached per (win, K, dtype, block)."""
+                     dtype=jnp.float32, block=(1, 1),
+                     kernel: str = "spmv") -> bool:
+    """Probe-compile ONE windowed kernel variant on the current backend
+    for THIS matrix's VMEM footprint (window size, tile width K, value
+    dtype, block dims): the in-kernel gather needs Mosaic support that
+    may vary by TPU generation, and VMEM-pressure failures depend on the
+    window scratch plus the (tile, K) cols/vals blocks. Dispatch cannot
+    use try/except — inside an outer jit a legalization failure only
+    surfaces at the OUTER compile — so the path choice is made here,
+    eagerly. ``kernel`` in {'spmv', 'fused', 'dots'}: each variant is
+    probed and cached separately (per (win, K, dtype, block, kernel)),
+    because the fused/dots variants add vector streams and an SMEM
+    accumulator that can fail where the plain SpMV compiles — and a dots
+    failure must not disable the others."""
     br, bc = int(block[0]), int(block[1])
-    key = (int(win), int(K), jnp.dtype(dtype).name, br, bc)
+    key = (int(win), int(K), jnp.dtype(dtype).name, br, bc, kernel)
     if key not in _KERNEL_OK:
         try:
             starts = jnp.zeros(1, jnp.int32)
             cols = jnp.zeros((1, _TILE, int(K)), jnp.int32)
-            # probe BOTH the plain SpMV and the dots kernel: the dots
-            # variant adds vector streams in VMEM plus an SMEM
-            # accumulator output, so it can fail legalization where the
-            # plain kernel compiles — and its dispatch (dev.spmv_dots)
-            # has no outer-jit-safe fallback once this gate said yes
-            if (br, bc) == (1, 1):
-                vals = jnp.zeros((1, _TILE, int(K)), dtype)
-                x = jnp.zeros(int(win), jnp.float32)
-                jax.jit(functools.partial(
-                    windowed_ell_spmv, win=int(win), n_out=_TILE)
-                ).lower(starts, cols, vals, x).compile()
-                xs = jnp.zeros(_TILE, jnp.float32)   # square-operator x
-                jax.jit(functools.partial(
-                    windowed_ell_spmv_dots, win=int(win), n_out=_TILE)
-                ).lower(starts, cols, vals, xs, xs).compile()
-            else:
-                vals = jnp.zeros((1, _TILE, int(K), br, bc), dtype)
-                x = jnp.zeros(int(win) * bc, jnp.float32)
-                jax.jit(functools.partial(
-                    windowed_ell_block_spmv, win=int(win), n_out=_TILE)
-                ).lower(starts, cols, vals, x).compile()
-                if br == bc:
-                    xs = jnp.zeros(_TILE * bc, jnp.float32)
+            scalar = (br, bc) == (1, 1)
+            vals = jnp.zeros((1, _TILE, int(K)), dtype) if scalar \
+                else jnp.zeros((1, _TILE, int(K), br, bc), dtype)
+            x = jnp.zeros(int(win) * bc, jnp.float32)
+            xs = jnp.zeros(_TILE * br, jnp.float32)   # row-shaped vector
+            if kernel == "spmv":
+                fn = windowed_ell_spmv if scalar else \
+                    windowed_ell_block_spmv
+                jax.jit(functools.partial(fn, win=int(win), n_out=_TILE)
+                        ).lower(starts, cols, vals, x).compile()
+            elif kernel == "fused":
+                # the correction mode is the superset (one more stream
+                # than residual): probing it covers both fused forms
+                if scalar:
+                    jax.jit(functools.partial(
+                        windowed_ell_fused, mode="correction",
+                        win=int(win), n_out=_TILE)
+                    ).lower(starts, cols, vals, xs, xs, xs).compile()
+                elif br == bc:
+                    S = jnp.zeros((_TILE, br, br), jnp.float32)
+                    jax.jit(functools.partial(
+                        windowed_ell_block_fused, mode="correction",
+                        win=int(win), n_out=_TILE)
+                    ).lower(starts, cols, vals, xs, x[:_TILE * bc],
+                            S).compile()
+                else:
+                    # rectangular blocks only ever dispatch the residual
+                    # form (the correction gate requires br == bc)
+                    jax.jit(functools.partial(
+                        windowed_ell_block_fused, mode="residual",
+                        win=int(win), n_out=_TILE)
+                    ).lower(starts, cols, vals, xs, x[:_TILE * bc],
+                            None).compile()
+            elif kernel == "dots":
+                if scalar:
+                    jax.jit(functools.partial(
+                        windowed_ell_spmv_dots, win=int(win),
+                        n_out=_TILE)
+                    ).lower(starts, cols, vals, xs, xs).compile()
+                elif br == bc:
                     jax.jit(functools.partial(
                         windowed_ell_block_spmv_dots, win=int(win),
                         n_out=_TILE)
                     ).lower(starts, cols, vals, xs, xs).compile()
+                else:
+                    raise ValueError("dots needs a square block")
+            else:
+                raise ValueError("unknown kernel %r" % kernel)
             _KERNEL_OK[key] = True
         except Exception:
             _KERNEL_OK[key] = False

@@ -887,7 +887,8 @@ def _gather_strips(strips, shape, nloc, comm):
 
 def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
                        replicate_below: int = 4096, mis_rounds: int = 40,
-                       max_sharded_levels: int = 30, precond_dtype=None):
+                       max_sharded_levels: int = 30, precond_dtype=None,
+                       rep_rowshard: bool = False):
     """Build the distributed hierarchy from row strips. Returns
     (DistHierarchy, level_sizes, stats). No global matrix is ever
     assembled while levels stay sharded; the replicated tail (below
@@ -1035,7 +1036,8 @@ def strip_sa_hierarchy(strips, n, mesh, prm, comm=None,
         top_A = _strips_to_dist_ell(strips0, mesh, (n0, n0), prm.dtype,
                                     nloc0, nloc0, comm)
     hier = DistHierarchy(dist_levels, rep, trans, top_A, prm.npre,
-                         prm.npost, prm.ncycle, prm.pre_cycles)
+                         prm.npost, prm.ncycle, prm.pre_cycles,
+                         rep_rowshard=rep_rowshard)
     return hier, sizes, stats
 
 
@@ -1049,7 +1051,8 @@ class StripAMGSolver:
     def __init__(self, A_or_strips, mesh, prm: Optional[Any] = None,
                  solver: Any = None, n: Optional[int] = None,
                  replicate_below: int = 4096, comm=None,
-                 mis_rounds: int = 40, precond_dtype=None):
+                 mis_rounds: int = 40, precond_dtype=None,
+                 rep_rowshard: bool = False):
         import jax
         from amgcl_tpu.models.amg import AMGParams
         self.mesh = mesh
@@ -1094,7 +1097,7 @@ class StripAMGSolver:
         self.hier, self.sizes, self.stats = strip_sa_hierarchy(
             strips, n, mesh, self.prm, comm=comm,
             replicate_below=replicate_below, mis_rounds=mis_rounds,
-            precond_dtype=precond_dtype)
+            precond_dtype=precond_dtype, rep_rowshard=rep_rowshard)
         self.n = int(n)
         first_A = self.hier.levels[0].A if self.hier.levels \
             else self.hier.top_A

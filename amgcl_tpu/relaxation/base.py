@@ -45,7 +45,7 @@ class ScaledResidualSmoother:
         from amgcl_tpu.ops.unstructured import WindowedEllMatrix
         if isinstance(A, WindowedEllMatrix):
             if self.scale.ndim == 1 and A.block == (1, 1):
-                ip = A._pallas_mode(x, f, self.scale)
+                ip = A._pallas_mode(x, f, self.scale, kernel="fused")
                 if ip is not None:
                     from amgcl_tpu.ops.unstructured import \
                         windowed_ell_scaled_correction
@@ -54,7 +54,7 @@ class ScaledResidualSmoother:
                         f, x, A.win, A.shape[0], interpret=ip)
             if (self.scale.ndim == 3 and A.block != (1, 1)
                     and A.block[0] == A.block[1] == self.scale.shape[-1]):
-                ip = A._pallas_mode(x, f, self.scale)
+                ip = A._pallas_mode(x, f, self.scale, kernel="fused")
                 if ip is not None:
                     from amgcl_tpu.ops.unstructured import \
                         windowed_ell_block_scaled_correction

@@ -234,3 +234,49 @@ def test_strip_amg_runtime_and_cli(mesh8, tmp_path, capsys):
     cli_main(["-n", "16", "--mesh", "8", "--strip-setup",
               "-p", "solver.tol=1e-6", "-o", out])
     assert "iterations" in capsys.readouterr().out.lower()
+
+
+def test_comm_empty_shards_safe():
+    """A process (or view) that owns no shards must participate in the
+    reductions instead of crashing (advisor r4: max_scalar over all-None,
+    fetch_vals dereferencing my_shards[0])."""
+    comm = LocalComm(4)
+    # all-None reduction: the allreduce identity, not a ValueError
+    assert comm.max_scalar([None] * 4) == float("-inf")
+    # zero-owned-shards view: _vals_meta must not index my_shards[0]
+    empty = LocalComm(4)
+    empty.my_shards = []
+    assert empty._vals_meta([None] * 4) == (False, False)
+    # mixed ownership: flags come from owned non-None entries only
+    comm2 = LocalComm(4)
+    comm2.my_shards = [1, 3]
+    vals = [None, np.arange(3, dtype=np.int64), None,
+            np.ones(2, dtype=np.float64)]
+    assert comm2._vals_meta(vals) == (False, True)
+    vals_c = [None, np.ones(2, dtype=np.complex128), None, None]
+    assert comm2._vals_meta(vals_c) == (True, False)
+
+
+def test_coarsening_stall_is_distinct_exception():
+    """strip_sa_hierarchy catches exactly CoarseningStall; an unrelated
+    ValueError from deep inside a level build must PROPAGATE instead of
+    silently truncating the hierarchy (advisor r4)."""
+    from amgcl_tpu.parallel.dist_setup import CoarseningStall
+    assert issubclass(CoarseningStall, ValueError)
+    import amgcl_tpu.parallel.dist_setup as ds
+    mesh = make_mesh(8)
+    A, _ = poisson3d(12)
+    orig = ds._strip_sa_level
+
+    def boom(*a, **k):
+        raise ValueError("unrelated numpy failure")
+
+    ds._strip_sa_level = boom
+    try:
+        with pytest.raises(ValueError, match="unrelated"):
+            ds.strip_sa_hierarchy(
+                split_strips(A, 8)[0], A.nrows, mesh,
+                AMGParams(dtype=jnp.float32, coarse_enough=100),
+                replicate_below=200)
+    finally:
+        ds._strip_sa_level = orig

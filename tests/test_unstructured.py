@@ -250,6 +250,25 @@ def test_block_solver_windowed_end_to_end(monkeypatch):
     assert np.linalg.norm(r) / np.linalg.norm(rhs_p) < 1e-6
 
 
+def test_windowed_bf16_values_interpret():
+    """bfloat16 operator values through the windowed kernels (the HBM-
+    halving hierarchy option): packing, SpMV, and fused residual stay
+    within bf16 accuracy of the f64 reference."""
+    Ap, _, x, f, _ = _windowed_fixture(seed=17)
+    Wb = csr_to_windowed_ell(Ap, jnp.bfloat16)
+    assert Wb is not None and Wb.dtype == jnp.bfloat16
+    y_ref = Ap.spmv(x.astype(np.float64))
+    y = np.asarray(windowed_ell_spmv(
+        Wb.window_starts, Wb.cols_local, Wb.vals, jnp.asarray(x),
+        Wb.win, Wb.shape[0], interpret=True), np.float64)
+    denom = np.abs(y_ref).max()
+    assert np.abs(y - y_ref).max() / denom < 3e-2      # bf16 epsilon
+    r = np.asarray(windowed_ell_residual(
+        Wb.window_starts, Wb.cols_local, Wb.vals, jnp.asarray(f),
+        jnp.asarray(x), Wb.win, Wb.shape[0], interpret=True), np.float64)
+    assert np.abs(r - (f - y_ref)).max() / denom < 3e-2
+
+
 def test_amg_solve_fe_like():
     from amgcl_tpu.models.make_solver import make_solver
     from amgcl_tpu.models.amg import AMGParams
