@@ -163,7 +163,11 @@ def kernel_supported(win: int = 2 << 20, K: int = 4,
     accumulator that can fail where the plain SpMV compiles — and a dots
     failure must not disable the others."""
     br, bc = int(block[0]), int(block[1])
-    key = (int(win), int(K), jnp.dtype(dtype).name, br, bc, kernel)
+    # the DB flag changes the kernel geometry (scratch slots), so the
+    # probe verdict must be keyed on it — an in-process flip would
+    # otherwise reuse the other geometry's verdict
+    key = (int(win), int(K), jnp.dtype(dtype).name, br, bc, kernel,
+           _double_buffered())
     if key not in _KERNEL_OK:
         try:
             starts = jnp.zeros(1, jnp.int32)
@@ -222,18 +226,33 @@ def kernel_supported(win: int = 2 << 20, K: int = 4,
     return _KERNEL_OK[key]
 
 
+# Double-buffered window DMA (prefetch tile t+1's window while tile t
+# computes — the canonical Pallas latency-hiding pattern) is the default;
+# AMGCL_TPU_WELL_DB=0 falls back to the serial start/wait. Snapshotted at
+# IMPORT: jit traces and probe verdicts bake the geometry in, so an
+# in-process flip would silently reuse the other mode's artifacts —
+# A/B the two modes with one process per arm (CHIP_SESSION.md).
+_WELL_DB = os.environ.get("AMGCL_TPU_WELL_DB", "1") != "0"
+
+
+def _double_buffered() -> bool:
+    return _WELL_DB
+
+
 def _well_geometry(x, win, n_tiles, tile, K, n_vecs, out_specs):
     """Shared window-DMA geometry for ALL windowed-ELL kernels: the padded
     x (window DMA reads x[start : start+win]; padding keeps the last
     window in range — starts are host-computed, start+win <= len(xp) by
     construction), the scalar-prefetch grid spec with the HBM-x +
     cols/vals block prefix plus ``n_vecs`` tile-blocked vector streams,
-    and the VMEM window + DMA semaphore scratch. Every kernel must read x
-    through exactly this geometry — any sizing/alignment fix here
-    services all of them (the DIA path's _dia_window lesson)."""
+    and the VMEM window + DMA semaphore scratch (two slots when double
+    buffering). Every kernel must read x through exactly this geometry —
+    any sizing/alignment fix here services all of them (the DIA path's
+    _dia_window lesson)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    nbuf = 2 if _double_buffered() else 1
     xp = jnp.pad(x, (0, win))
     vec_spec = pl.BlockSpec((1, tile), lambda t, starts: (t, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -246,20 +265,45 @@ def _well_geometry(x, win, n_tiles, tile, K, n_vecs, out_specs):
         ] + [vec_spec] * n_vecs,
         out_specs=out_specs if out_specs is not None else vec_spec,
         scratch_shapes=[
-            pltpu.VMEM((win,), x.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((nbuf, win), x.dtype),
+            pltpu.SemaphoreType.DMA((nbuf,)),
         ],
     )
     return xp, vec_spec, grid_spec
 
 
-def _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win):
-    """Issue + wait the per-tile x-window DMA (the one access of x)."""
+def _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, n_tiles,
+              bc: int = 1):
+    """Per-tile x-window DMA (the one access of x). Double-buffered by
+    default: tile t+1's window transfer is issued before waiting on tile
+    t's, so the next DMA rides under this tile's compute (grid steps are
+    sequential on TPU and scratch persists across them). Returns the
+    scratch slot holding THIS tile's window."""
     t = pl.program_id(0)
-    start = starts_smem[t]
-    cp = pltpu.make_async_copy(x_hbm.at[pl.ds(start, win)], xw, sem)
-    cp.start()
-    cp.wait()
+
+    def dma(tile_idx, slot):
+        start = starts_smem[tile_idx] * np.int32(bc)
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(start, win * bc)], xw.at[slot], sem.at[slot])
+
+    if xw.shape[0] == 1:                 # serial fallback
+        dma(t, 0).start()
+        dma(t, 0).wait()
+        return 0
+    ti = jnp.asarray(t, jnp.int32)       # program_id dtype varies w/ x64
+    slot = jax.lax.rem(ti, np.int32(2))
+    nxt = jax.lax.rem(ti + np.int32(1), np.int32(2))
+
+    @pl.when(t == 0)
+    def _warm():
+        dma(0, 0).start()
+
+    @pl.when(t + 1 < n_tiles)
+    def _prefetch():
+        dma(t + 1, nxt).start()
+
+    dma(t, slot).wait()
+    return slot
 
 
 @functools.partial(jax.jit,
@@ -275,8 +319,9 @@ def windowed_ell_spmv(window_starts, cols_local, vals, x, win, n_out,
     xp, _, grid_spec = _well_geometry(x, win, n_tiles, tile, K, 0, None)
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, o_ref, xw, sem):
-        _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win)
-        xg = jnp.take(xw[:], c_ref[0], axis=0)     # (tile, K) VMEM gather
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles)
+        xg = jnp.take(xw[slot], c_ref[0], axis=0)  # (tile, K) VMEM gather
         o_ref[0] = jnp.sum(v_ref[0] * xg.astype(v_ref.dtype),
                            axis=1).astype(o_ref.dtype)
 
@@ -327,8 +372,9 @@ def windowed_ell_fused(window_starts, cols_local, vals, f, x, w, mode,
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, f_ref, *rest):
         (*w_refs, o_ref, xw, sem) = rest
-        _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win)
-        xg = jnp.take(xw[:], c_ref[0], axis=0)          # (tile, K)
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles)
+        xg = jnp.take(xw[slot], c_ref[0], axis=0)       # (tile, K)
         ax = jnp.sum(v_ref[0] * xg.astype(v_ref.dtype), axis=1)
         acc = f_ref[0].astype(out_dtype) - ax.astype(out_dtype)
         if mode == "residual":
@@ -386,9 +432,10 @@ def windowed_ell_spmv_dots(window_starts, cols_local, vals, x, w=None,
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, xt_ref, *rest):
         (*w_refs, o_ref, dots_ref, xw, sem) = rest
-        _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win)
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles)
         t = pl.program_id(0)
-        xg = jnp.take(xw[:], c_ref[0], axis=0)          # (tile, K)
+        xg = jnp.take(xw[slot], c_ref[0], axis=0)       # (tile, K)
         y = jnp.sum(v_ref[0] * xg.astype(v_ref.dtype),
                     axis=1).astype(out_dtype)
         o_ref[0] = y
@@ -447,6 +494,7 @@ def _well_block_geometry(x, win, bc, n_tiles, tile, K, br, n_vecs,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    nbuf = 2 if _double_buffered() else 1
     xp = jnp.pad(x, (0, win * bc))
     vec_spec = pl.BlockSpec((1, tile * br), lambda t, starts: (t, 0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -460,20 +508,11 @@ def _well_block_geometry(x, win, bc, n_tiles, tile, K, br, n_vecs,
         ] + [vec_spec] * n_vecs + list(extra_specs),
         out_specs=out_specs if out_specs is not None else vec_spec,
         scratch_shapes=[
-            pltpu.VMEM((win * bc,), x.dtype),
-            pltpu.SemaphoreType.DMA,
+            pltpu.VMEM((nbuf, win * bc), x.dtype),
+            pltpu.SemaphoreType.DMA((nbuf,)),
         ],
     )
     return xp, vec_spec, grid_spec
-
-
-def _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc):
-    """Per-tile window DMA of bc-wide block rows (flat, contiguous)."""
-    t = pl.program_id(0)
-    start = starts_smem[t] * np.int32(bc)
-    cp = pltpu.make_async_copy(x_hbm.at[pl.ds(start, win * bc)], xw, sem)
-    cp.start()
-    cp.wait()
 
 
 def _block_gather(c_ref, xw, tile, K, bc):
@@ -500,8 +539,9 @@ def windowed_ell_block_spmv(window_starts, cols_local, vals, x, win, n_out,
                                             br, 0, None)
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, o_ref, xw, sem):
-        _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc)
-        xg = _block_gather(c_ref, xw, tile, K, bc)
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles, bc)
+        xg = _block_gather(c_ref, xw[slot], tile, K, bc)
         y = jnp.einsum("tkij,tkj->ti", v_ref[0], xg.astype(v_ref.dtype),
                        preferred_element_type=out_dtype)
         o_ref[0] = y.reshape(tile * br).astype(o_ref.dtype)
@@ -545,8 +585,9 @@ def windowed_ell_block_fused(window_starts, cols_local, vals, f, x, S,
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, f_ref, *rest):
         (*w_refs, o_ref, xw, sem) = rest
-        _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc)
-        xg = _block_gather(c_ref, xw, tile, K, bc)
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles, bc)
+        xg = _block_gather(c_ref, xw[slot], tile, K, bc)
         ax = jnp.einsum("tkij,tkj->ti", v_ref[0], xg.astype(v_ref.dtype),
                         preferred_element_type=out_dtype)
         acc = f_ref[0].reshape(tile, br).astype(out_dtype) - ax
@@ -591,9 +632,10 @@ def windowed_ell_block_spmv_dots(window_starts, cols_local, vals, x,
 
     def kernel(starts_smem, x_hbm, c_ref, v_ref, xt_ref, *rest):
         (*w_refs, o_ref, dots_ref, xw, sem) = rest
-        _well_block_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, bc)
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles, bc)
         t = pl.program_id(0)
-        xg = _block_gather(c_ref, xw, tile, K, bc)
+        xg = _block_gather(c_ref, xw[slot], tile, K, bc)
         y = jnp.einsum("tkij,tkj->ti", v_ref[0], xg.astype(v_ref.dtype),
                        preferred_element_type=out_dtype
                        ).reshape(tile * br)
