@@ -57,6 +57,70 @@ def pallas_mode(*dtypes):
     return True if pallas_interpret_forced() else None
 
 
+# Double-buffered x-window DMA for the DIA kernels: OPT-IN
+# (AMGCL_TPU_DIA_DB=1), unlike the windowed-ELL default — the serial DIA
+# kernel has a real-chip measurement behind it (round 2: 6x vs XLA) and
+# keeps its EXACT original geometry (1-D scratch, ref slices); the
+# prefetch variant must prove itself in a chip-session A/B before
+# becoming default. Snapshotted at import; the kernels also accept an
+# explicit ``db`` static arg so tests can exercise both modes without
+# stale-trace hazards.
+_DIA_DB = os.environ.get("AMGCL_TPU_DIA_DB", "0") == "1"
+
+
+def window_dma(pl, dma, i, n_tiles, nbuf):
+    """Shared slot machinery for per-tile window-DMA double buffering
+    (used by the DIA kernels here and the windowed-ELL kernels in
+    ops/unstructured.py — one copy of the race-prone part).
+    ``dma(tile_idx, slot)`` builds the async-copy descriptor. Serial
+    (nbuf=1): start+wait tile i. Double (nbuf=2): tile i+1's transfer is
+    issued before waiting on tile i's, riding under this tile's compute
+    (grid steps are sequential and scratch persists across them).
+    Returns the slot holding tile i's window."""
+    if nbuf == 1:
+        dma(i, 0).start()
+        dma(i, 0).wait()
+        return 0
+    ii = jnp.asarray(i, jnp.int32)
+    slot = jax.lax.rem(ii, np.int32(2))
+    nxt = jax.lax.rem(ii + np.int32(1), np.int32(2))
+
+    @pl.when(i == 0)
+    def _warm():
+        dma(0, 0).start()
+
+    @pl.when(i + 1 < n_tiles)
+    def _prefetch():
+        dma(i + 1, nxt).start()
+
+    dma(i, slot).wait()
+    return slot
+
+
+def _dia_dma(pl, pltpu, x_hbm, xw, sem, i, tile, win, n_tiles):
+    """Per-tile window DMA; returns a REF holding tile i's window, so
+    the serial path reads through exactly the original 1-D ref slices
+    (the measured kernel) and the double-buffered path through an
+    ``at[slot]`` view."""
+    serial = len(xw.shape) == 1
+
+    def dma(tile_idx, slot):
+        dst = xw if serial else xw.at[slot]
+        dsem = sem if serial else sem.at[slot]
+        return pltpu.make_async_copy(
+            x_hbm.at[pl.ds(tile_idx * tile, win)], dst, dsem)
+
+    slot = window_dma(pl, dma, i, n_tiles, 1 if serial else 2)
+    return xw if serial else xw.at[slot]
+
+
+def _dia_scratch(pltpu, win, dtype, db):
+    if db:
+        return [pltpu.VMEM((2, win), dtype), pltpu.SemaphoreType.DMA((2,))]
+    # the round-2-measured geometry, bit-for-bit
+    return [pltpu.VMEM((win,), dtype), pltpu.SemaphoreType.DMA]
+
+
 def _dia_window(offsets, data, x, tile, interpret):
     """Shared tile/window geometry + padded operands for the DIA kernels.
 
@@ -91,13 +155,18 @@ def _dia_window(offsets, data, x, tile, interpret):
     return base, win, n_pad, xp, dpad
 
 
-@functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
-def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
+@functools.partial(jax.jit, static_argnames=("offsets", "tile",
+                                              "interpret", "db"))
+def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False,
+             db=None):
     """y = A x for DIA storage. offsets: static tuple; data: (ndiag, n);
-    x: (m,). Rows padded up to a tile multiple; result sliced back."""
+    x: (m,). Rows padded up to a tile multiple; result sliced back.
+    ``db`` overrides the AMGCL_TPU_DIA_DB window double-buffering flag
+    (None = the import-time snapshot)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    db = _DIA_DB if db is None else bool(db)
     n = data.shape[1]
     ndiag = len(offsets)
     base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
@@ -105,13 +174,11 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
 
     def kernel(x_hbm, d_ref, o_ref, scratch, sem):
         i = pl.program_id(0)
-        cp = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(i * tile, win)], scratch, sem)
-        cp.start()
-        cp.wait()
+        row = _dia_dma(pl, pltpu, x_hbm, scratch, sem, i, tile, win,
+                       n_pad // tile)
         acc = jnp.zeros((tile,), dtype=o_ref.dtype)
         for k, d in enumerate(offsets):
-            seg = scratch[pl.ds(base + d, tile)]
+            seg = row[pl.ds(base + d, tile)]
             acc = acc + d_ref[k, :] * seg
         o_ref[:] = acc
 
@@ -130,10 +197,7 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
         out_specs=pl.BlockSpec((tile,), lambda i: (i,)),
         out_shape=jax.ShapeDtypeStruct((n_pad,), jnp.result_type(
             data.dtype, x.dtype)),
-        scratch_shapes=[
-            pltpu.VMEM((win,), x.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=_dia_scratch(pltpu, win, x.dtype, db),
         interpret=interpret,
     )(xp, dpad)
     return out[:n]
@@ -154,11 +218,14 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False):
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("offsets", "mode", "tile", "interpret"))
-def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False):
+                   static_argnames=("offsets", "mode", "tile", "interpret",
+                                    "db"))
+def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False,
+               db=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    db = _DIA_DB if db is None else bool(db)
     n = data.shape[1]
     ndiag = len(offsets)
     base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
@@ -173,17 +240,15 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False):
     def kernel(x_hbm, d_ref, f_ref, *rest):
         (*w_refs, o_ref, scratch, sem) = rest
         i = pl.program_id(0)
-        cp = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(i * tile, win)], scratch, sem)
-        cp.start()
-        cp.wait()
+        row = _dia_dma(pl, pltpu, x_hbm, scratch, sem, i, tile, win,
+                       n_pad // tile)
         acc = f_ref[:].astype(out_dtype)
         for k, d in enumerate(offsets):
-            acc = acc - d_ref[k, :] * scratch[pl.ds(base + d, tile)]
+            acc = acc - d_ref[k, :] * row[pl.ds(base + d, tile)]
         if mode == "residual":
             o_ref[:] = acc
         else:                       # x tile lives in the window already
-            xt = scratch[pl.ds(base, tile)].astype(out_dtype)
+            xt = row[pl.ds(base, tile)].astype(out_dtype)
             o_ref[:] = xt + w_refs[0][:] * acc
 
     grid = (n_pad // tile,)
@@ -197,18 +262,16 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False):
         ] + [vec_spec] * len(vecs),
         out_specs=vec_spec,
         out_shape=jax.ShapeDtypeStruct((n_pad,), out_dtype),
-        scratch_shapes=[
-            pltpu.VMEM((win,), x.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=_dia_scratch(pltpu, win, x.dtype, db),
         interpret=interpret,
     )(xp, dpad, *vecs)
     return out[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("offsets", "tile", "interpret"))
+@functools.partial(jax.jit, static_argnames=("offsets", "tile",
+                                              "interpret", "db"))
 def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
-                  interpret: bool = False):
+                  interpret: bool = False, db=None):
     """(y, <y, y>, <y, x>, <y, w>) in one pass, y = A x (w optional).
 
     The Krylov hot pairs: CG needs <Ap, p>; BiCGStab needs <rhat, v>
@@ -220,6 +283,7 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
+    db = _DIA_DB if db is None else bool(db)
     n = data.shape[1]
     if x.shape[0] != n:
         raise ValueError("dia_spmv_dots needs a square operator")
@@ -236,19 +300,17 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
     def kernel(x_hbm, d_ref, *rest):
         (*w_refs, o_ref, dots_ref, scratch, sem) = rest
         i = pl.program_id(0)
-        cp = pltpu.make_async_copy(
-            x_hbm.at[pl.ds(i * tile, win)], scratch, sem)
-        cp.start()
-        cp.wait()
+        row = _dia_dma(pl, pltpu, x_hbm, scratch, sem, i, tile, win,
+                       n_pad // tile)
         acc = jnp.zeros((tile,), dtype=out_dtype)
         for k, d in enumerate(offsets):
-            acc = acc + d_ref[k, :] * scratch[pl.ds(base + d, tile)]
+            acc = acc + d_ref[k, :] * row[pl.ds(base + d, tile)]
         o_ref[:] = acc
         # padding rows contribute zero (dpad is zero there), so the
         # partials over the full tile equal the true dots
         ya = acc.astype(acc_dtype)
         p_yy = jnp.sum(ya * ya)
-        p_yx = jnp.sum(ya * scratch[pl.ds(base, tile)].astype(acc_dtype))
+        p_yx = jnp.sum(ya * row[pl.ds(base, tile)].astype(acc_dtype))
 
         @pl.when(i == 0)
         def _init():
@@ -276,10 +338,7 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
             jax.ShapeDtypeStruct((n_pad,), out_dtype),
             jax.ShapeDtypeStruct((1, 2 + has_w), acc_dtype),
         ),
-        scratch_shapes=[
-            pltpu.VMEM((win,), x.dtype),
-            pltpu.SemaphoreType.DMA,
-        ],
+        scratch_shapes=_dia_scratch(pltpu, win, x.dtype, db),
         interpret=interpret,
     )(xp, dpad, *wvecs)
     yy = dots[0, 0].astype(out_dtype)
@@ -289,19 +348,22 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
 
 
 def dia_spmv_dot(offsets, data, x, tile: int = 2048,
-                 interpret: bool = False):
+                 interpret: bool = False, db=None):
     """(y, <y, x>) — the CG pair; see dia_spmv_dots."""
-    y, _, yx, _ = dia_spmv_dots(offsets, data, x, None, tile, interpret)
+    y, _, yx, _ = dia_spmv_dots(offsets, data, x, None, tile, interpret,
+                                db)
     return y, yx
 
 
 def dia_residual(offsets, data, f, x, tile: int = 2048,
-                 interpret: bool = False):
+                 interpret: bool = False, db=None):
     """r = f − A x in one pass (A in DIA storage, square or rectangular)."""
-    return _dia_fused(offsets, data, f, x, None, "residual", tile, interpret)
+    return _dia_fused(offsets, data, f, x, None, "residual", tile,
+                      interpret, db)
 
 
 def dia_scaled_correction(offsets, data, w, f, x, tile: int = 2048,
-                          interpret: bool = False):
+                          interpret: bool = False, db=None):
     """x + w ∘ (f − A x) in one pass — a damped-Jacobi/SPAI-0 sweep."""
-    return _dia_fused(offsets, data, f, x, w, "correction", tile, interpret)
+    return _dia_fused(offsets, data, f, x, w, "correction", tile,
+                      interpret, db)

@@ -276,34 +276,18 @@ def _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, n_tiles,
               bc: int = 1):
     """Per-tile x-window DMA (the one access of x). Double-buffered by
     default: tile t+1's window transfer is issued before waiting on tile
-    t's, so the next DMA rides under this tile's compute (grid steps are
-    sequential on TPU and scratch persists across them). Returns the
-    scratch slot holding THIS tile's window."""
-    t = pl.program_id(0)
+    t's, so the next DMA rides under this tile's compute. The slot
+    machinery is shared with the DIA kernels (pallas_spmv.window_dma —
+    one copy of the race-prone part). Returns the scratch slot holding
+    THIS tile's window."""
+    from amgcl_tpu.ops.pallas_spmv import window_dma
 
     def dma(tile_idx, slot):
         start = starts_smem[tile_idx] * np.int32(bc)
         return pltpu.make_async_copy(
             x_hbm.at[pl.ds(start, win * bc)], xw.at[slot], sem.at[slot])
 
-    if xw.shape[0] == 1:                 # serial fallback
-        dma(t, 0).start()
-        dma(t, 0).wait()
-        return 0
-    ti = jnp.asarray(t, jnp.int32)       # program_id dtype varies w/ x64
-    slot = jax.lax.rem(ti, np.int32(2))
-    nxt = jax.lax.rem(ti + np.int32(1), np.int32(2))
-
-    @pl.when(t == 0)
-    def _warm():
-        dma(0, 0).start()
-
-    @pl.when(t + 1 < n_tiles)
-    def _prefetch():
-        dma(t + 1, nxt).start()
-
-    dma(t, slot).wait()
-    return slot
+    return window_dma(pl, dma, pl.program_id(0), n_tiles, xw.shape[0])
 
 
 @functools.partial(jax.jit,

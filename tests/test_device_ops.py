@@ -116,6 +116,39 @@ def test_pallas_dia_spmv_interpret():
     assert np.allclose(np.asarray(y), np.asarray(y_ref))
 
 
+@pytest.mark.parametrize("db", [False, True])
+def test_pallas_dia_kernels_db_modes(db):
+    """The window double-buffering flag (AMGCL_TPU_DIA_DB / the ``db``
+    static arg) must not change numerics in any DIA kernel — the db=True
+    prefetch path is otherwise only exercised in chip sessions."""
+    from amgcl_tpu.ops.pallas_spmv import (dia_spmv, dia_residual,
+                                           dia_scaled_correction,
+                                           dia_spmv_dots)
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, _ = poisson3d(10)
+    M = dev.csr_to_dia(A, jnp.float64)
+    rng = np.random.RandomState(2)
+    x = jnp.asarray(rng.rand(A.nrows))
+    f = jnp.asarray(rng.rand(A.nrows))
+    w = jnp.asarray(rng.rand(A.nrows))
+    y_ref = np.asarray(M.mv(x))
+    y = dia_spmv(M.offsets, M.data, x, tile=256, interpret=True, db=db)
+    assert np.allclose(np.asarray(y), y_ref)
+    r = dia_residual(M.offsets, M.data, f, x, tile=256, interpret=True,
+                     db=db)
+    assert np.allclose(np.asarray(r), np.asarray(f) - y_ref)
+    c = dia_scaled_correction(M.offsets, M.data, w, f, x, tile=256,
+                              interpret=True, db=db)
+    assert np.allclose(np.asarray(c),
+                       np.asarray(x) + np.asarray(w)
+                       * (np.asarray(f) - y_ref))
+    y2, yy, yx, yw = dia_spmv_dots(M.offsets, M.data, x, w, tile=256,
+                                   interpret=True, db=db)
+    assert np.allclose(np.asarray(y2), y_ref)
+    assert np.allclose(float(yx), y_ref @ np.asarray(x))
+    assert np.allclose(float(yw), y_ref @ np.asarray(w))
+
+
 def test_pallas_dia_spmv_rect_interpret():
     from amgcl_tpu.ops.pallas_spmv import dia_spmv
     R = random_csr(300, 100, density=0.1, seed=9)
