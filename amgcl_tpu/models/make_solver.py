@@ -184,6 +184,10 @@ class make_solver:
                 % (np.shape(rhs), n))
         rhs = jnp.asarray(rhs, dtype=self.solver_dtype)
         if x0 is not None:
+            if np.shape(x0) != (n,):
+                raise ValueError(
+                    "x0 has shape %s but the system has %d unknowns"
+                    % (np.shape(x0), n))
             x0 = jnp.asarray(x0, dtype=self.solver_dtype)
         else:
             x0 = jnp.zeros_like(rhs)

@@ -48,14 +48,13 @@ def ensure_live_backend(probe_timeout: float = 60.0) -> str:
     TPU tunnel is alive — same probe discipline as bench.py's supervisor.
     """
     plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
-    if plats:
-        # an explicit platform choice skips the probe: cpu is covered by
-        # apply_if_cpu_requested (package import), and any other explicit
-        # request means the user accepts that backend's init behavior
-        if plats in ("cpu", "cpu,"):
-            force_cpu_backend()
-            return "cpu"
-        return plats.split(",")[0]
+    if plats in ("cpu", "cpu,"):
+        # explicit cpu request: no probe needed, just defeat the plugin
+        # override. Any OTHER value (this image exports
+        # JAX_PLATFORMS=axon globally) still gets the subprocess probe —
+        # that env var is ambient, not a user promise the tunnel works.
+        force_cpu_backend()
+        return "cpu"
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     try:
         r = subprocess.run([sys.executable, "-c", code],

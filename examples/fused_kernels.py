@@ -64,6 +64,27 @@ def main():
              lv0.up is not None,
              getattr(lv0.up, "halo_planes", None)))
 
+    # -- the UNSTRUCTURED fusion tiers (round 5): an irregular FE-class
+    # matrix takes the windowed-ELL format after RCM, and its residual /
+    # smoother sweeps / Krylov dots ride fused single-pass kernels with a
+    # double-buffered window DMA (AMGCL_TPU_WELL_DB=0 for serial)
+    from amgcl_tpu.ops.unstructured import fe_like_problem
+    from amgcl_tpu.ops import device as dev
+    from amgcl_tpu.utils.adapters import cuthill_mckee, permute
+    # small on purpose: under the interpret hook (this example's default
+    # off-TPU) every kernel step is emulated, so the demo problem stays
+    # tiny; on a real TPU scale n up freely
+    Af, rf = fe_like_problem(n=1500, nnz_target=1500 * 12, seed=1)
+    p = cuthill_mckee(Af)
+    Ap, rp = permute(Af, p), rf[p]
+    M = dev.to_device(Ap, "auto", jnp.float32)
+    print("unstructured device format: %s (win=%s)"
+          % (type(M).__name__, getattr(M, "win", "-")))
+    sf = make_solver(Ap, AMGParams(), CG(tol=1e-4, maxiter=60))
+    xf, inf_f = sf(rp)
+    print("FE-class solve: iters %d  resid %.2e" % (inf_f.iters,
+                                                    inf_f.resid))
+
 
 if __name__ == "__main__":
     main()

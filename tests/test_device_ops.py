@@ -145,6 +145,7 @@ def test_pallas_dia_kernels_db_modes(db):
     y2, yy, yx, yw = dia_spmv_dots(M.offsets, M.data, x, w, tile=256,
                                    interpret=True, db=db)
     assert np.allclose(np.asarray(y2), y_ref)
+    assert np.allclose(float(yy), y_ref @ y_ref)
     assert np.allclose(float(yx), y_ref @ np.asarray(x))
     assert np.allclose(float(yw), y_ref @ np.asarray(w))
 
