@@ -288,8 +288,12 @@ class AMG:
                 P_dev, R_dev = build_implicit_transfers(
                     spec, dtype, prm.matrix_format)
             else:
-                P_dev = dev.to_device(P, "ell", dtype)
-                R_dev = dev.to_device(R, "ell", dtype)
+                # auto: banded transfers (RCM-ordered fine rows against
+                # contiguously-numbered aggregates) take windowed ELL /
+                # DIA and ride the same Pallas SpMV as the level
+                # operators; irregular ones fall back to take-ELL
+                P_dev = dev.to_device(P, "auto", dtype)
+                R_dev = dev.to_device(R, "auto", dtype)
             A_dev = dev.to_device(Ai, prm.matrix_format, dtype)
             from amgcl_tpu.ops.pallas_vcycle import (build_fused_down,
                                                      build_fused_up)

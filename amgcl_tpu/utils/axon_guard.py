@@ -50,11 +50,15 @@ def ensure_live_backend(probe_timeout: float = 60.0) -> str:
     plats = os.environ.get("JAX_PLATFORMS", "").strip().lower()
     if plats in ("cpu", "cpu,"):
         # explicit cpu request: no probe needed, just defeat the plugin
-        # override. Any OTHER value (this image exports
-        # JAX_PLATFORMS=axon globally) still gets the subprocess probe —
-        # that env var is ambient, not a user promise the tunnel works.
+        # override
         force_cpu_backend()
         return "cpu"
+    if plats not in ("", "axon"):
+        # a genuinely user-chosen platform (tpu, cuda, ...) is honored
+        # as-is — only the ambient/empty cases get probed: this image
+        # exports JAX_PLATFORMS=axon globally, which is environment
+        # furniture, not a promise the tunnel works
+        return plats.split(",")[0]
     code = "import jax; print('PLATFORM=' + jax.devices()[0].platform)"
     try:
         r = subprocess.run([sys.executable, "-c", code],
