@@ -269,6 +269,21 @@ def test_windowed_bf16_values_interpret():
     assert np.abs(r - (f - y_ref)).max() / denom < 3e-2
 
 
+def test_transfers_take_windowed_format():
+    """Hierarchy P/R go through auto format selection: on an RCM-banded
+    problem with explicit transfers (Ruge-Stuben) they must pick the
+    windowed-ELL device format, riding the same Pallas SpMV as the level
+    operators."""
+    from amgcl_tpu.models.amg import AMG, AMGParams
+    from amgcl_tpu.coarsening.ruge_stuben import RugeStuben
+    A, _ = _small_fe(n=6000, seed=18)
+    Ap = permute(A, cuthill_mckee(A))
+    amg = AMG(Ap, AMGParams(coarsening=RugeStuben()))
+    lv0 = amg.hierarchy.levels[0]
+    assert isinstance(lv0.P, WindowedEllMatrix), type(lv0.P).__name__
+    assert isinstance(lv0.R, WindowedEllMatrix), type(lv0.R).__name__
+
+
 def test_amg_solve_fe_like():
     from amgcl_tpu.models.make_solver import make_solver
     from amgcl_tpu.models.amg import AMGParams
