@@ -576,6 +576,39 @@ def _bench_unstructured(on_tpu):
         elif on_tpu:
             out["well_pallas_us"] = None
             out["note"] = "in-kernel gather not legalized on this backend"
+
+    # end-to-end SOLVE at the poisson3Db profile (BASELINE tutorial rows:
+    # builtin 0.592 s / GTX 1050 Ti CUDA 0.171 s, AMG(SA)+BiCGStab) — a
+    # synthetic same-class matrix, so the comparison is indicative of the
+    # problem CLASS, not the exact SuiteSparse instance. TPU-gated (or
+    # AMGCL_TPU_BENCH_UNSTRUCT_SOLVE=1): the f32 solve on the hard kNN
+    # fixture is minutes on a contended CPU host
+    if not (on_tpu or os.environ.get(
+            "AMGCL_TPU_BENCH_UNSTRUCT_SOLVE") == "1"):
+        return out
+    try:
+        from amgcl_tpu.models.make_solver import make_solver
+        from amgcl_tpu.models.amg import AMGParams
+        from amgcl_tpu.solver.bicgstab import BiCGStab
+        s = make_solver(A, AMGParams(dtype=jnp.float32),
+                        BiCGStab(maxiter=300, tol=1e-8), refine=2)
+        rhs = jnp.asarray(np.ones(A.nrows), jnp.float32)
+        t0 = time.perf_counter()
+        xs, info = s(rhs)
+        jax.block_until_ready(xs)
+        t_setup_solve = time.perf_counter() - t0       # includes compile
+        t0 = time.perf_counter()
+        xs, info = s(rhs)
+        jax.block_until_ready(xs)
+        t_solve = time.perf_counter() - t0
+        out["solve"] = {
+            "solve_s": round(t_solve, 4), "iters": int(info.iters),
+            "resid": float(info.resid),
+            "first_call_s": round(t_setup_solve, 3),
+            "vs_poisson3Db_cpu": round(0.592 / t_solve, 3),
+            "vs_poisson3Db_cuda": round(0.171 / t_solve, 3)}
+    except Exception as e:
+        out["solve"] = {"error": repr(e)}
     return out
 
 
