@@ -54,3 +54,22 @@ st = StripAMGSolver(A, mesh, AMGParams(dtype=jnp.float64), CG(tol=1e-8))
 x, info = st(rhs)
 print("strip-parallel setup: %d iterations, peak strip nnz %d of %d"
       % (info.iters, st.stats["peak_strip_nnz"], A.nnz))
+
+# coarse-level REPARTITIONING (the parmetis/ptscotch role): scramble the
+# row order so every shard couples with every other, then let the k-way
+# partitioner (parallel/partition.py) re-localize the coarse levels; the
+# replicated tail can also be row-sharded across the mesh (rep_rowshard)
+import numpy as np
+from amgcl_tpu.utils.adapters import permute
+
+rng = np.random.RandomState(0)
+perm = rng.permutation(A.nrows)
+As, rs = permute(A, perm), np.asarray(rhs)[perm]
+sp_ = DistAMGSolver(As, mesh, AMGParams(dtype=jnp.float64,
+                                        coarse_enough=100),
+                    CG(tol=1e-8), replicate_below=150,
+                    repartition=0.1, rep_rowshard=True)
+x, info = sp_(rs)
+print("scrambled + repartitioned: %d iterations; levels repartitioned: %s"
+      % (info.iters, [(k, round(b, 2), round(a, 2))
+                      for (k, b, a) in sp_.repartition_report]))
