@@ -217,3 +217,34 @@ def test_rs_classic_native_python_parity(monkeypatch):
         got_python = cf_splitting_classic(A, strong, rows)
         monkeypatch.undo()
         np.testing.assert_array_equal(got_native, got_python)
+
+
+def test_tentative_qr_contract():
+    """Unit test of the batched-QR tentative prolongation (the
+    reference's tests/test_qr.cpp role, amgcl/detail/qr.hpp consumer):
+    P has per-aggregate orthonormal columns (P^T P = I), reproduces the
+    nullspace exactly (P @ Bc = B), uses the deterministic sign
+    convention (diag(R) >= 0), and fails loudly on aggregates smaller
+    than the nullspace dimension."""
+    import scipy.sparse as sp
+    from amgcl_tpu.coarsening.tentative import tentative_prolongation
+    rng = np.random.RandomState(3)
+    n, n_agg, nvec = 60, 12, 3
+    agg = np.repeat(np.arange(n_agg), n // n_agg)
+    B = rng.randn(n, nvec)
+    P, Bc = tentative_prolongation(n, agg, n_agg, nullspace=B)
+    Ps = P.to_scipy()
+    # orthonormal aggregate blocks
+    G = (Ps.T @ Ps).toarray()
+    np.testing.assert_allclose(G, np.eye(n_agg * nvec), atol=1e-12)
+    # exact nullspace reproduction
+    np.testing.assert_allclose(Ps @ Bc, B, atol=1e-12)
+    # deterministic sign: the R factors have nonnegative diagonals
+    R = Bc.reshape(n_agg, nvec, nvec)
+    assert (np.einsum("aii->ai", R) >= 0).all()
+    # rank-deficiency guard: a singleton aggregate with nvec=3
+    agg_bad = agg.copy()
+    agg_bad[agg_bad == 0] = 1
+    agg_bad[0] = 0                      # aggregate 0 has one member
+    with pytest.raises(ValueError, match="smaller than the nullspace"):
+        tentative_prolongation(n, agg_bad, n_agg, nullspace=B)
