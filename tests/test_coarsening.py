@@ -226,7 +226,6 @@ def test_tentative_qr_contract():
     nullspace exactly (P @ Bc = B), uses the deterministic sign
     convention (diag(R) >= 0), and fails loudly on aggregates smaller
     than the nullspace dimension."""
-    import scipy.sparse as sp
     from amgcl_tpu.coarsening.tentative import tentative_prolongation
     rng = np.random.RandomState(3)
     n, n_agg, nvec = 60, 12, 3
