@@ -20,13 +20,19 @@ access pattern instead of translating CSR:
 
 3. **Pallas kernel**: each grid step DMAs the tile's x-window (a contiguous,
    statically-sized slice, start scalar-prefetched from SMEM) from HBM into
-   VMEM once, then gathers from VMEM with ``jnp.take`` — on-chip gather
-   bandwidth instead of HBM-serialized gather. Diagonal data streams
-   through as normal pipelined blocks.
+   VMEM once — double-buffered by default, so tile t+1's transfer rides
+   under tile t's compute — then gathers from VMEM with ``jnp.take``:
+   on-chip gather bandwidth instead of HBM-serialized gather. Diagonal
+   data streams through as normal pipelined blocks.
 
-If Mosaic cannot legalize the in-kernel gather on some TPU generation, the
-matrix silently falls back to the XLA path (global ``jnp.take``), keeping
-numerics identical; the bench harness records which path won.
+The kernel family mirrors the DIA fusion tiers: plain SpMV, fused
+residual, fused scaled-correction sweep, and fused SpMV+dots, each in a
+scalar and a block-valued variant (block columns ride a bc-wide window
+DMA with per-node matvec einsum reductions). Every variant is
+probe-compiled separately per matrix shape (``kernel_supported``); if
+Mosaic cannot legalize one on some TPU generation, just that dispatch
+falls back to the XLA path (global ``jnp.take``), keeping numerics
+identical; the bench harness records which path won.
 """
 
 from __future__ import annotations
