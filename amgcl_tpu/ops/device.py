@@ -365,18 +365,43 @@ def residual(f, A, x):
     if isinstance(A, WindowedEllMatrix):
         ip = A._pallas_mode(x, f, kernel="fused")
         if ip is not None:
-            if A.block == (1, 1):
-                from amgcl_tpu.ops.unstructured import \
-                    windowed_ell_residual
-                return windowed_ell_residual(
-                    A.window_starts, A.cols_local, A.vals, f, x, A.win,
-                    A.shape[0], interpret=ip)
-            from amgcl_tpu.ops.unstructured import \
-                windowed_ell_block_residual
-            return windowed_ell_block_residual(
-                A.window_starts, A.cols_local, A.vals, f, x, A.win,
-                A.shape[0], interpret=ip)
+            from amgcl_tpu.ops.unstructured import (
+                windowed_ell_residual, windowed_ell_block_residual)
+            fn = windowed_ell_residual if A.block == (1, 1) \
+                else windowed_ell_block_residual
+            return fn(A.window_starts, A.cols_local, A.vals, f, x, A.win,
+                      A.shape[0], interpret=ip)
     return f - A.mv(x)
+
+
+def scaled_correction(A, w, f, x):
+    """x + w ∘ (f − A x) in one fused pass when the operator format has a
+    kernel for it (DIA, windowed-ELL scalar; windowed-ELL block with a
+    per-node (b, b) scale), else None — the smoother seam asks here so
+    format dispatch lives next to residual/spmv_dots instead of inside
+    every smoother."""
+    if isinstance(A, DiaMatrix) and w.ndim == 1:
+        ip = A._pallas_mode(x, f, w)
+        if ip is not None:
+            from amgcl_tpu.ops.pallas_spmv import dia_scaled_correction
+            return dia_scaled_correction(A.offsets, A.data, w, f, x,
+                                         interpret=ip)
+    from amgcl_tpu.ops.unstructured import WindowedEllMatrix
+    if isinstance(A, WindowedEllMatrix):
+        scalar_ok = w.ndim == 1 and A.block == (1, 1)
+        block_ok = (w.ndim == 3 and A.block != (1, 1)
+                    and A.block[0] == A.block[1] == w.shape[-1])
+        if scalar_ok or block_ok:
+            ip = A._pallas_mode(x, f, w, kernel="fused")
+            if ip is not None:
+                from amgcl_tpu.ops.unstructured import (
+                    windowed_ell_scaled_correction,
+                    windowed_ell_block_scaled_correction)
+                fn = windowed_ell_scaled_correction if scalar_ok \
+                    else windowed_ell_block_scaled_correction
+                return fn(A.window_starts, A.cols_local, A.vals, w, f, x,
+                          A.win, A.shape[0], interpret=ip)
+    return None
 
 
 def axpby(a, x, b, y):

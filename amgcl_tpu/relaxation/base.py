@@ -34,33 +34,13 @@ class ScaledResidualSmoother:
         return jnp.einsum("nij,nj->ni", self.scale, rb).reshape(r.shape)
 
     def apply_pre(self, A, f, x):
-        if self.scale.ndim == 1 and isinstance(A, dev.DiaMatrix):
-            ip = A._pallas_mode(x, f, self.scale)
-            if ip is not None:
-                # one-pass fused sweep: spmv + subtract + scale + add would
-                # otherwise cross two pallas/XLA boundaries per application
-                from amgcl_tpu.ops.pallas_spmv import dia_scaled_correction
-                return dia_scaled_correction(A.offsets, A.data, self.scale,
-                                             f, x, interpret=ip)
-        from amgcl_tpu.ops.unstructured import WindowedEllMatrix
-        if isinstance(A, WindowedEllMatrix):
-            if self.scale.ndim == 1 and A.block == (1, 1):
-                ip = A._pallas_mode(x, f, self.scale, kernel="fused")
-                if ip is not None:
-                    from amgcl_tpu.ops.unstructured import \
-                        windowed_ell_scaled_correction
-                    return windowed_ell_scaled_correction(
-                        A.window_starts, A.cols_local, A.vals, self.scale,
-                        f, x, A.win, A.shape[0], interpret=ip)
-            if (self.scale.ndim == 3 and A.block != (1, 1)
-                    and A.block[0] == A.block[1] == self.scale.shape[-1]):
-                ip = A._pallas_mode(x, f, self.scale, kernel="fused")
-                if ip is not None:
-                    from amgcl_tpu.ops.unstructured import \
-                        windowed_ell_block_scaled_correction
-                    return windowed_ell_block_scaled_correction(
-                        A.window_starts, A.cols_local, A.vals, self.scale,
-                        f, x, A.win, A.shape[0], interpret=ip)
+        # one-pass fused sweep when the format has a kernel for it: spmv +
+        # subtract + scale + add would otherwise cross two pallas/XLA
+        # boundaries per application (dispatch lives in dev, next to
+        # residual/spmv_dots)
+        got = dev.scaled_correction(A, self.scale, f, x)
+        if got is not None:
+            return got
         return x + self._mul(dev.residual(f, A, x))
 
     apply_post = apply_pre
