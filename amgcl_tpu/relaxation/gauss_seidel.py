@@ -53,15 +53,15 @@ def greedy_coloring(m: sp.csr_matrix, max_colors: int = 64) -> np.ndarray:
 
 @register_pytree_node_class
 class MulticolorGS:
-    """masks: (ncolors, n) float {0,1}; dinv: inverted diagonal."""
+    """masks: (ncolors, n) pre-scaled color masks mask_c ∘ dinv — the
+    per-color correction weights (0 off-color, dinv_i on-color)."""
 
-    def __init__(self, masks, dinv, serial_equiv=True):
+    def __init__(self, masks, serial_equiv=True):
         self.masks = masks
-        self.dinv = dinv
         self.serial_equiv = bool(serial_equiv)
 
     def tree_flatten(self):
-        return (self.masks, self.dinv), (self.serial_equiv,)
+        return (self.masks,), (self.serial_equiv,)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
@@ -69,11 +69,17 @@ class MulticolorGS:
 
     def _sweep(self, A, f, x, order):
         for c in order:
-            mask = self.masks[c]
             # row i: x_i <- dinv_i (f_i - sum_{j != i} a_ij x_j)
-            #       = x_i + dinv_i * (f - A x)_i  (diagonal folded back in);
-            # the residual takes the fused one-pass kernel on the DIA path
-            x = x + mask * (self.dinv * dev.residual(f, A, x))
+            #       = x_i + dinv_i * (f - A x)_i  (diagonal folded back
+            # in). Per color this IS a scaled-residual correction with
+            # w = mask_c ∘ dinv (pre-scaled at build), so the whole
+            # color update rides ONE fused kernel pass where the format
+            # has one (DIA / windowed-ELL); otherwise the fused residual
+            # + XLA tail
+            w = self.masks[c]
+            got = dev.scaled_correction(A, w, f, x)
+            x = got if got is not None \
+                else x + w * dev.residual(f, A, x)
         return x
 
     def apply_pre(self, A, f, x):
@@ -95,7 +101,6 @@ class GaussSeidel:
         color = greedy_coloring(S.to_scipy())
         nc = int(color.max()) + 1
         masks = np.zeros((nc, S.nrows))
-        masks[color, np.arange(S.nrows)] = 1.0
-        dinv = S.diagonal(invert=True)
-        return MulticolorGS(jnp.asarray(masks, dtype=dtype),
-                            jnp.asarray(dinv, dtype=dtype))
+        # pre-scaled: the on-color entries carry dinv directly
+        masks[color, np.arange(S.nrows)] = S.diagonal(invert=True)
+        return MulticolorGS(jnp.asarray(masks, dtype=dtype))
