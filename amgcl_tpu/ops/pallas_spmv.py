@@ -332,7 +332,12 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
         ] + [vec_spec] * len(wvecs),
         out_specs=(
             vec_spec,
-            pl.BlockSpec(memory_space=pltpu.SMEM),
+            # explicit i32 index map: the default map's Python-0 block
+            # indices trace as i64 under jax_enable_x64 and Mosaic fails
+            # to legalize the i64 func.return (first seen on-chip r5)
+            pl.BlockSpec((1, 2 + has_w),
+                         lambda i: (np.int32(0), np.int32(0)),
+                         memory_space=pltpu.SMEM),
         ),
         out_shape=(
             jax.ShapeDtypeStruct((n_pad,), out_dtype),

@@ -260,14 +260,18 @@ def _well_geometry(x, win, n_tiles, tile, K, n_vecs, out_specs):
 
     nbuf = 2 if _double_buffered() else 1
     xp = jnp.pad(x, (0, win))
-    vec_spec = pl.BlockSpec((1, tile), lambda t, starts: (t, 0))
+    # index-map constants must be np.int32: Python 0 traces as i64 under
+    # jax_enable_x64 and Mosaic cannot legalize the i64/mixed-width
+    # func.return (the DIA kernels' round-2 lesson, confirmed on-chip r5)
+    _0 = np.int32(0)
+    vec_spec = pl.BlockSpec((1, tile), lambda t, starts: (t, _0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),          # x stays in HBM
-            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
-            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
+            pl.BlockSpec((1, tile, K), lambda t, starts: (t, _0, _0)),
+            pl.BlockSpec((1, tile, K), lambda t, starts: (t, _0, _0)),
         ] + [vec_spec] * n_vecs,
         out_specs=out_specs if out_specs is not None else vec_spec,
         scratch_shapes=[
@@ -446,8 +450,12 @@ def windowed_ell_spmv_dots(window_starts, cols_local, vals, x, w=None,
     from jax.experimental.pallas import tpu as _pltpu
     xp, _, grid_spec = _well_geometry(
         x, win, n_tiles, tile, K, len(vecs),
-        (pl.BlockSpec((1, tile), lambda t, starts: (t, 0)),
-         pl.BlockSpec(memory_space=_pltpu.SMEM)))
+        (pl.BlockSpec((1, tile), lambda t, starts: (t, np.int32(0))),
+         # explicit i32 map — the default map's i64 indices under x64
+         # fail Mosaic legalization (see _well_geometry)
+         pl.BlockSpec((1, 2 + has_w),
+                      lambda t, starts: (np.int32(0), np.int32(0)),
+                      memory_space=_pltpu.SMEM)))
     y, dots = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
@@ -486,15 +494,17 @@ def _well_block_geometry(x, win, bc, n_tiles, tile, K, br, n_vecs,
 
     nbuf = 2 if _double_buffered() else 1
     xp = jnp.pad(x, (0, win * bc))
-    vec_spec = pl.BlockSpec((1, tile * br), lambda t, starts: (t, 0))
+    # np.int32 index-map constants — see _well_geometry
+    _0 = np.int32(0)
+    vec_spec = pl.BlockSpec((1, tile * br), lambda t, starts: (t, _0))
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(n_tiles,),
         in_specs=[
             pl.BlockSpec(memory_space=pl.ANY),          # x stays in HBM
-            pl.BlockSpec((1, tile, K), lambda t, starts: (t, 0, 0)),
+            pl.BlockSpec((1, tile, K), lambda t, starts: (t, _0, _0)),
             pl.BlockSpec((1, tile, K, br, bc),
-                         lambda t, starts: (t, 0, 0, 0, 0)),
+                         lambda t, starts: (t, _0, _0, _0, _0)),
         ] + [vec_spec] * n_vecs + list(extra_specs),
         out_specs=out_specs if out_specs is not None else vec_spec,
         scratch_shapes=[
@@ -565,8 +575,10 @@ def windowed_ell_block_fused(window_starts, cols_local, vals, f, x, S,
         vecs.append(jnp.pad(x, (0, n_pad - x.shape[0])))
         Sp = jnp.pad(S.reshape(-1, br, br),
                      ((0, n_tiles * tile - S.shape[0]), (0, 0), (0, 0)))
-        extra_specs = (pl.BlockSpec((1, tile, br, br),
-                                    lambda t, starts: (t, 0, 0, 0)),)
+        extra_specs = (pl.BlockSpec(
+            (1, tile, br, br),
+            lambda t, starts: (t, np.int32(0), np.int32(0),
+                               np.int32(0))),)
         extra_args = [Sp.reshape(n_tiles, tile, br, br)]
     xp, _, grid_spec = _well_block_geometry(
         x, win, bc, n_tiles, tile, K, br, len(vecs), None, extra_specs)
@@ -646,8 +658,12 @@ def windowed_ell_block_spmv_dots(window_starts, cols_local, vals, x,
 
     xp, vec_spec, grid_spec = _well_block_geometry(
         x, win, bc, n_tiles, tile, K, br, len(vecs),
-        (pl.BlockSpec((1, tile * br), lambda t, starts: (t, 0)),
-         pl.BlockSpec(memory_space=pltpu.SMEM)))
+        (pl.BlockSpec((1, tile * br),
+                      lambda t, starts: (t, np.int32(0))),
+         # explicit i32 map — see _well_geometry
+         pl.BlockSpec((1, 2 + has_w),
+                      lambda t, starts: (np.int32(0), np.int32(0)),
+                      memory_space=pltpu.SMEM)))
     y, dots = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
