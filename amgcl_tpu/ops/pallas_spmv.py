@@ -42,6 +42,24 @@ def pallas_interpret_forced() -> bool:
     return os.environ.get("AMGCL_TPU_PALLAS_INTERPRET") == "1"
 
 
+def probe_report(name, exc=None, note=""):
+    """AMGCL_TPU_PROBE_VERBOSE=1: report probe-compile / value-check
+    declines to stderr (the default is a silent XLA fallback) — the
+    chip-session debugging hook. A declined kernel is otherwise invisible
+    outside the bench's missing fused tiers (round-5 chip lesson: the
+    first real v5e session spent its opening hour discovering WHICH
+    kernel Mosaic rejected)."""
+    if os.environ.get("AMGCL_TPU_PROBE_VERBOSE") != "1":
+        return
+    import sys
+    import traceback
+    print("[amgcl-tpu probe] %s declined%s"
+          % (name, ": " + note if note else ""), file=sys.stderr)
+    if exc is not None:
+        traceback.print_exception(type(exc), exc, exc.__traceback__,
+                                  file=sys.stderr)
+
+
 def pallas_mode(*dtypes):
     """None = use the XLA path; else the ``interpret`` flag to pass the
     kernels (False on real TPU, True under the CI interpret hook). All

@@ -48,6 +48,8 @@ import jax
 import jax.numpy as jnp
 from jax.tree_util import register_pytree_node_class
 
+from amgcl_tpu.ops.pallas_spmv import probe_report
+
 
 _VMEM_CAP_BYTES = 12 << 20
 _PROBE_OK = {}
@@ -590,7 +592,8 @@ def build_fused_up(A_dev, P_dev, relax):
                     halo_planes=hp)).lower(
                         av, mv, sytv, sxtv, rv, fv, fv, fv).compile()
                 _PROBE_OK[key] = True
-            except Exception:
+            except Exception as e:
+                probe_report("fused_up_sweep%r" % (key,), e)
                 _PROBE_OK[key] = False
         if not _PROBE_OK[key]:
             return None
@@ -605,6 +608,8 @@ def build_fused_up(A_dev, P_dev, relax):
         ucv = jnp.asarray(rng.rand(T.shape[1]), dt)
         want = relax.apply_post(A_dev, fv, uv + P_dev.mv(ucv))
         if not _values_agree(handle(fv, uv, ucv), want, dt):
+            probe_report("fused_up_sweep", note="on-device value check "
+                         "mismatch vs composed path (n=%d)" % n)
             return None
     return handle
 
@@ -682,7 +687,8 @@ def build_fused_down(A_dev, R_dev, relax=None):
                         coarse=T.coarse, H=H, zero_guess=zg)).lower(
                             av, mv, syv, sxv, fvec, fvec).compile()
                     _PROBE_OK[key] = True
-                except Exception:
+                except Exception as e:
+                    probe_report("fused_down_sweep%r" % (key,), e)
                     _PROBE_OK[key] = False
             if not _PROBE_OK[key]:
                 if zg:
@@ -712,11 +718,15 @@ def build_fused_down(A_dev, R_dev, relax=None):
         uv = jnp.asarray(rng.rand(n), dt)
         want = R_dev.mv(_dev.residual(fv, A_dev, uv))
         if not _values_agree(handle(fv, uv), want, dt):
+            probe_report("fused_down_sweep", note="on-device value check "
+                         "mismatch vs composed path (n=%d)" % n)
             return None
         if w is not None:
             uz, fz = handle.zero(fv)
             uw = w * fv
             if not (_values_agree(uz, uw, dt) and _values_agree(
                     fz, R_dev.mv(_dev.residual(fv, A_dev, uw)), dt)):
+                probe_report("fused_down_sweep.zero", note="on-device "
+                             "value check mismatch (n=%d)" % n)
                 handle.w = None     # base kernel fine, zero mode declined
     return handle

@@ -227,7 +227,9 @@ def kernel_supported(win: int = 2 << 20, K: int = 4,
             else:
                 raise ValueError("unknown kernel %r" % kernel)
             _KERNEL_OK[key] = True
-        except Exception:
+        except Exception as e:
+            from amgcl_tpu.ops.pallas_spmv import probe_report
+            probe_report("windowed_ell[%s]%r" % (kernel, key), e)
             _KERNEL_OK[key] = False
     return _KERNEL_OK[key]
 

@@ -1,0 +1,23 @@
+#!/bin/bash
+# Chip watcher: probe the axon tunnel; the moment it answers, run the
+# round-5 chip-session sequence: (1) verbose-probe diagnostics for the
+# fused tiers + windowed-ELL gather (fast when .jax_cache is warm),
+# (2) a full bench.py run with the two-length timing harness.
+# Logs to /tmp/chip_watch.log; artifacts land in BENCH_LAST_GOOD.json.
+cd /root/repo
+LOG=/tmp/chip_watch.log
+echo "[watch] start $(date -u +%T)" >> "$LOG"
+while true; do
+  if timeout 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
+    echo "[watch] TUNNEL ALIVE $(date -u +%T)" >> "$LOG"
+    timeout 1200 python -u /tmp/diag_chip.py fused >> "$LOG" 2>&1
+    echo "[watch] fused diag done rc=$? $(date -u +%T)" >> "$LOG"
+    timeout 900 python -u /tmp/diag_chip.py well >> "$LOG" 2>&1
+    echo "[watch] well diag done rc=$? $(date -u +%T)" >> "$LOG"
+    timeout 2400 python bench.py >> "$LOG" 2>&1
+    echo "[watch] bench done rc=$? $(date -u +%T)" >> "$LOG"
+    break
+  fi
+  sleep 240
+done
+echo "[watch] sequence complete $(date -u +%T)" >> "$LOG"
