@@ -32,6 +32,7 @@ import scipy.sparse as sp
 
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.coarsening.aggregates import _priority
+from amgcl_tpu.coarsening.stall import CoarseningStall
 
 
 def _strength_rs(A: CSR, eps: float):
@@ -252,7 +253,7 @@ class RugeStuben:
             cidx = np.cumsum(is_c) - 1
             nc = int(is_c.sum())
             if nc == 0:
-                raise ValueError("empty coarse level in RS splitting")
+                raise CoarseningStall("empty coarse level in RS splitting")
             Pc = _interp_classic(A, strong, rows, is_c, cidx, nc,
                                  self.do_trunc, self.eps_trunc)
             return Pc, Pc.transpose()
@@ -262,7 +263,7 @@ class RugeStuben:
         cidx = np.cumsum(is_c) - 1          # C-point -> coarse index
         nc = int(is_c.sum())
         if nc == 0:
-            raise ValueError("empty coarse level in RS splitting")
+            raise CoarseningStall("empty coarse level in RS splitting")
 
         dia = A.diagonal()
         # direct interpolation with sign split:

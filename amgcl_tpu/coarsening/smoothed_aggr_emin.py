@@ -24,6 +24,7 @@ from amgcl_tpu.coarsening.aggregates import (
 from amgcl_tpu.coarsening.tentative import tentative_prolongation
 from amgcl_tpu.coarsening.galerkin import galerkin
 from amgcl_tpu.coarsening.smoothed_aggregation import _filtered
+from amgcl_tpu.coarsening.stall import CoarseningStall
 
 
 @dataclass
@@ -51,7 +52,7 @@ class SmoothedAggrEMin:
             agg, n_agg = plain_aggregates(scalar, eps_strong)
             n_pt = scalar.nrows
         if n_agg == 0:
-            raise ValueError("empty coarse level (all rows isolated)")
+            raise CoarseningStall("empty coarse level (all rows isolated)")
         P_tent, Bc = tentative_prolongation(
             n_pt, agg, n_agg, nullspace, bs)
         Pt = (P_tent.unblock() if P_tent.is_block else P_tent).to_scipy()

@@ -20,6 +20,7 @@ from amgcl_tpu.coarsening.aggregates import (
     plain_aggregates, pointwise_aggregates)
 from amgcl_tpu.coarsening.tentative import tentative_prolongation
 from amgcl_tpu.coarsening.galerkin import galerkin
+from amgcl_tpu.coarsening.stall import CoarseningStall
 
 
 @dataclass
@@ -111,7 +112,7 @@ class SmoothedAggregation:
             agg, n_agg = plain_aggregates(scalar, eps_strong)
             n_pt = scalar.nrows
         if n_agg == 0:
-            raise ValueError("empty coarse level (all rows isolated)")
+            raise CoarseningStall("empty coarse level (all rows isolated)")
 
         P_tent, Bc = tentative_prolongation(
             n_pt, agg, n_agg, nullspace, bs)

@@ -1,0 +1,19 @@
+"""The coarsening-stall exception, shared by the serial and strip-parallel
+hierarchy builders.
+
+A stall — no coarse points can be produced for a level (all rows isolated
+under the strength filter, or an empty C/F splitting) — is an EXPECTED
+terminal condition: the builder catches exactly this class and closes the
+hierarchy with whatever levels exist (the reference reaches the analogous
+state via error::empty_level, amgcl/amg.hpp). Every other ValueError from
+a coarsening policy is a real error and must propagate: the round-5 FE
+benchmark fixture spent a chip-session window mislabeled as "coarsening
+stalled" because a bare ``except ValueError`` swallowed the actual
+failure (advisor r4 flagged the same pattern in strip_sa_hierarchy).
+
+Subclasses ValueError for backwards compatibility with callers that
+caught the old bare raises."""
+
+
+class CoarseningStall(ValueError):
+    """A level cannot coarsen further; close the hierarchy here."""

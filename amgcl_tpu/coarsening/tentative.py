@@ -58,10 +58,14 @@ def tentative_prolongation(n: int, agg: np.ndarray, n_agg: int,
     batch[gagg, pos_in_agg] = B[order]
     if n_agg and int(counts.min()) < nvec:
         # an aggregate smaller than the nullspace dimension gives a
-        # rank-deficient QR and a singular coarse basis — fail loudly (the
-        # reference avoids this by enforcing a minimum aggregate size,
-        # pointwise_aggregates min_aggregate)
-        raise ValueError(
+        # rank-deficient QR and a singular coarse basis. This arises
+        # data-dependently at deep levels of multi-vector-nullspace
+        # hierarchies, so it is a STALL (close the hierarchy at the
+        # previous level — safe, just more iterations), not a build
+        # abort; the reference avoids the state by enforcing a minimum
+        # aggregate size (pointwise_aggregates min_aggregate)
+        from amgcl_tpu.coarsening.stall import CoarseningStall
+        raise CoarseningStall(
             "aggregate of size %d is smaller than the nullspace dimension "
             "%d; coarsen more aggressively (larger eps_strong) or reduce "
             "the nullspace" % (int(counts.min()), nvec))

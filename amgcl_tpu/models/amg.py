@@ -20,6 +20,7 @@ from jax.tree_util import register_pytree_node_class
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.ops import device as dev
 from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
+from amgcl_tpu.coarsening.stall import CoarseningStall
 from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.solver.direct import DenseDirectSolver
 
@@ -229,8 +230,11 @@ class AMG:
                and n_prefix + len(host) + 1 < prm.max_levels):
             try:
                 P, R = coarsening.transfer_operators(Acur, ctx)
-            except ValueError:
-                break
+            except CoarseningStall:
+                break     # expected terminal condition: close the
+                          # hierarchy here; other ValueErrors propagate
+                          # (a bare except here once mislabeled a fixture
+                          # bug as a stall — see coarsening/stall.py)
             if P.ncols == 0 or P.ncols >= Acur.ncols:
                 break  # coarsening stalled
             Ac = coarsening.coarse_operator(Acur, P, R, ctx)

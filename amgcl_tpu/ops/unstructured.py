@@ -761,18 +761,33 @@ def fe_like_problem(n: int = 85623, nnz_target: int = 2_370_000,
     MatrixMarket file is not redistributable in this image). Random points
     in a unit cube, k-nearest-neighbor graph, symmetrized graph Laplacian
     plus a small mass term: same irregular sparsity class as a tetrahedral
-    FE discretization."""
+    FE discretization.
+
+    Edge weights scale like a FE stiffness entry, 1/h² with h the node
+    distance — the resulting per-row weight SPREAD (nearest neighbors a
+    few times heavier than the k-th) is what makes the matrix
+    representative for strength-of-connection coarsening: with the
+    near-uniform weights of the first version every |a_ij| sat at ~1/k of
+    the diagonal, below any sensible eps_strong, ALL rows were isolated,
+    and SA (here and in the reference, amg.hpp empty-level error) cannot
+    coarsen at all — a degenerate fixture, not a hard one."""
     rng = np.random.RandomState(seed)
     pts = rng.rand(n, 3)
     k = max(int(round(nnz_target / n)) - 1, 4)
-    # approximate kNN via spatial hashing on a coarse grid (scipy cKDTree
-    # is available but slow for 86k x 27; grid buckets are plenty here)
     from scipy.spatial import cKDTree
     tree = cKDTree(pts)
-    _, idx = tree.query(pts, k=k + 1)
+    dist, idx = tree.query(pts, k=k + 1)
     rows = np.repeat(np.arange(n), k)
     cols = idx[:, 1:].reshape(-1)
-    w = 1.0 + 0.1 * rng.rand(len(rows))
+    d = dist[:, 1:].reshape(-1)
+    # floor the distance at a fraction of the median: random points have
+    # near-coincident pairs that a quality mesh never does, and the
+    # unbounded 1/h² weights they produce (4+ orders of magnitude) are
+    # about f32 conditioning, not coarsening structure
+    d = np.maximum(d, 0.2 * np.median(d))
+    d2 = d * d
+    w = (1.0 / d2) * (0.9 + 0.2 * rng.rand(len(rows)))
+    w *= np.mean(d2)            # O(1) scale, conditioning unaffected
     import scipy.sparse as sp
     G = sp.coo_matrix((w, (rows, cols)), shape=(n, n))
     G = (G + G.T) * 0.5

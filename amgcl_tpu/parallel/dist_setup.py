@@ -54,11 +54,12 @@ __all__ = [
 # communication seam
 # ===========================================================================
 
-class CoarseningStall(ValueError):
-    """Raised when a strip level cannot coarsen further (all rows
-    isolated). The hierarchy builder catches exactly this — not arbitrary
-    ValueErrors — and closes the hierarchy with the replicated tail, the
-    same way the serial build stops (models/amg.py stall guard)."""
+# Shared with the serial builder (and every coarsening policy) since r5;
+# re-exported here because the strip route's callers import it from this
+# module. The strip builder catches exactly this — not arbitrary
+# ValueErrors — and closes the hierarchy with the replicated tail, the
+# same way the serial build stops (models/amg.py stall guard).
+from amgcl_tpu.coarsening.stall import CoarseningStall  # noqa: E402
 
 
 class LocalComm:

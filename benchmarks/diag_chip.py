@@ -1,0 +1,102 @@
+import os, sys, time
+os.environ["AMGCL_TPU_PROBE_VERBOSE"] = "1"
+sys.path.insert(0, "/root/repo")
+if os.environ.get("DIAG_CPU") == "1":
+    from amgcl_tpu.utils import axon_guard
+    axon_guard.force_cpu_backend()
+import jax
+jax.config.update("jax_enable_x64", True)
+jax.config.update("jax_compilation_cache_dir", "/root/repo/.jax_cache")
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+import jax.numpy as jnp
+import numpy as np
+print("backend:", jax.default_backend(), flush=True)
+
+step = sys.argv[1]
+
+def timed_chain(solver, rhs_dev, x0, reps=4, repeats=3):
+    from jax import lax
+    def one(c):
+        r = rhs_dev if c is None else rhs_dev + 0 * c
+        got = solver._solve_fn(solver.A_dev, solver.A_dev64,
+                               solver.precond.hierarchy, r, x0)
+        return got[0].astype(jnp.float32)
+    def many():
+        def body(c, _):
+            return one(c), None
+        out, _ = lax.scan(body, one(None), None, length=reps - 1)
+        return out.sum()
+    f = jax.jit(many)
+    float(f())
+    ts = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        float(f())
+        ts.append(time.perf_counter() - t0)
+    return float(np.median(ts)) / reps
+
+if step == "fused":
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    A, rhs = poisson3d(128)
+    rhs_dev = jnp.asarray(rhs, jnp.float32)
+    x0 = jnp.zeros_like(rhs_dev)
+    t0 = time.time()
+    s3 = make_solver(A, AMGParams(dtype=jnp.float32),
+                     CG(maxiter=100, tol=1e-6), refine=3)
+    print("setup(refine=3) %.1fs" % (time.time() - t0), flush=True)
+    for i, lv in enumerate(s3.precond.hierarchy.levels):
+        print("level", i, "down:", getattr(lv, "down", None) is not None,
+              "up:", getattr(lv, "up", None) is not None, flush=True)
+    x, info = s3(rhs_dev)
+    jax.block_until_ready(x)
+    print("refine=3 iters=%d resid=%.2e" % (info.iters, info.resid),
+          flush=True)
+    t3 = timed_chain(s3, rhs_dev, x0)
+    print("refine=3 chained %.4f s/solve" % t3, flush=True)
+    t0 = time.time()
+    s0 = make_solver(A, AMGParams(dtype=jnp.float32),
+                     CG(maxiter=100, tol=1e-6), refine=0)
+    print("setup(refine=0) %.1fs" % (time.time() - t0), flush=True)
+    x, info = s0(rhs_dev)
+    jax.block_until_ready(x)
+    tr = float(np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64)))
+               / np.linalg.norm(rhs))
+    print("refine=0 iters=%d resid=%.2e true=%.2e" % (
+        info.iters, info.resid, tr), flush=True)
+    t0v = timed_chain(s0, rhs_dev, x0)
+    print("refine=0 chained %.4f s/solve" % t0v, flush=True)
+elif step == "well":
+    from amgcl_tpu.ops.unstructured import kernel_supported
+    for k in ("spmv", "fused", "dots"):
+        t0 = time.time()
+        ok = kernel_supported(win=1 << 14, K=4, kernel=k)
+        print("well[%s] supported=%s (%.1fs)" % (k, ok, time.time() - t0),
+              flush=True)
+    # block variant too (the bench's block3 stage wedged the r5 worker)
+    t0 = time.time()
+    ok = kernel_supported(win=1 << 13, K=4, block=(3, 3), kernel="spmv")
+    print("well[block3 spmv] supported=%s (%.1fs)" % (ok, time.time() - t0),
+          flush=True)
+elif step == "stall":
+    from amgcl_tpu.ops.csr import CSR
+    z = np.load("/root/repo/.bench_fe_cache.npz")
+    A = CSR(z["ptr"], z["col"], z["val"], int(z["n"]))
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+    t0 = time.time()
+    try:
+        s = make_solver(A, AMGParams(dtype=jnp.float32),
+                        BiCGStab(maxiter=300, tol=1e-8), refine=2)
+        print("setup ok %.1fs; levels=%d" % (
+            time.time() - t0,
+            len(s.precond.hierarchy.levels)), flush=True)
+        for i, lv in enumerate(s.precond.hierarchy.levels):
+            print("  level", i, "n=%d" % lv.A.shape[0], flush=True)
+    except Exception as e:
+        print("SETUP FAILED after %.1fs: %r" % (time.time() - t0, e),
+              flush=True)

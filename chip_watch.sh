@@ -10,9 +10,9 @@ echo "[watch] start $(date -u +%T)" >> "$LOG"
 while true; do
   if timeout 75 python -c "import jax; assert jax.devices()[0].platform == 'tpu'" 2>/dev/null; then
     echo "[watch] TUNNEL ALIVE $(date -u +%T)" >> "$LOG"
-    timeout 1200 python -u /tmp/diag_chip.py fused >> "$LOG" 2>&1
+    timeout 1200 python -u /root/repo/benchmarks/diag_chip.py fused >> "$LOG" 2>&1
     echo "[watch] fused diag done rc=$? $(date -u +%T)" >> "$LOG"
-    timeout 900 python -u /tmp/diag_chip.py well >> "$LOG" 2>&1
+    timeout 900 python -u /root/repo/benchmarks/diag_chip.py well >> "$LOG" 2>&1
     echo "[watch] well diag done rc=$? $(date -u +%T)" >> "$LOG"
     timeout 2400 python bench.py >> "$LOG" 2>&1
     echo "[watch] bench done rc=$? $(date -u +%T)" >> "$LOG"

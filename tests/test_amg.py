@@ -260,3 +260,30 @@ def test_singular_coarse_pinv_fallback():
     f = np.asarray(A.to_dense() @ np.linspace(0, 1, n))
     y = np.asarray(ds.solve(jnp.asarray(f)))
     np.testing.assert_allclose(A.to_dense() @ y, f, atol=1e-8)
+
+
+def test_stall_closes_hierarchy_but_real_errors_propagate():
+    """CoarseningStall from a policy closes the hierarchy at the current
+    level (the reference's empty_level terminal state); any OTHER
+    ValueError is a real bug and must propagate — a bare except once
+    mislabeled a degenerate benchmark fixture as 'coarsening stalled'
+    (see coarsening/stall.py)."""
+    from amgcl_tpu.coarsening.stall import CoarseningStall
+
+    A, _ = poisson3d(8)
+
+    class Stalling(SmoothedAggregation):
+        def transfer_operators(self, Acur, ctx):
+            raise CoarseningStall("no coarse points")
+
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarsening=Stalling(),
+                           coarse_enough=100))
+    assert len(amg.host_levels) == 1      # closed at the fine level
+
+    class Broken(SmoothedAggregation):
+        def transfer_operators(self, Acur, ctx):
+            raise ValueError("actual bug in the policy")
+
+    with pytest.raises(ValueError, match="actual bug"):
+        AMG(A, AMGParams(dtype=jnp.float64, coarsening=Broken(),
+                         coarse_enough=100))
