@@ -43,6 +43,8 @@ from __future__ import annotations
 
 import functools
 import os
+import sys
+import time
 
 import numpy as np
 import jax
@@ -361,6 +363,23 @@ def device_build(A: CSR, prm):
     meta = [_LevelMeta(n, A.nnz)]
     dev_levels = []
 
+    # AMGCL_TPU_PROFILE_SETUP=1: per-phase wall breakdown to stderr — the
+    # r5 chip session measured 15.7 s of setup against the K80's scaled
+    # 0.83 s with no way to tell device programs from tunnel round trips
+    # from fused-kernel probe compiles
+    _prof_on = os.environ.get("AMGCL_TPU_PROFILE_SETUP") == "1"
+    _prof_t = [time.perf_counter()]
+
+    def _mark(tag, *block_on):
+        if not _prof_on:
+            return
+        for a in block_on:
+            jax.block_until_ready(a)
+        now = time.perf_counter()
+        print("[setup-prof] %-28s %7.3f s" % (tag, now - _prof_t[0]),
+              file=sys.stderr)
+        _prof_t[0] = now
+
     def leftover_csr():
         """Download the current level and hand it to the host loop with
         its DIA packing and grid dims attached (transfer-only re-use)."""
@@ -388,7 +407,9 @@ def device_build(A: CSR, prm):
             adata, jnp.float32(eps), jnp.float32(c.relax),
             jnp.float32(sm_omega), offs=tuple(offs), dims=dims,
             blocks=blocks, coarse=coarse, relax_kind=relax_kind)
+        _mark("level_setup n=%d" % n, m, ac_all)
         counts_h, axis_h = jax.device_get((counts, axis_strong))
+        _mark("fetch counts/axes")
         # speculation check (ops/stencil.strength_axes semantics): every
         # extent>1 axis must actually be strongly coupled. A mismatch is a
         # SEMICOARSENING problem: rerun the level with the measured axes
@@ -428,13 +449,15 @@ def device_build(A: CSR, prm):
         from amgcl_tpu.ops.pallas_vcycle import (build_fused_down,
                                                  build_fused_up)
         A_lvl = _to_dia_matrix(adata, offs, dims, dtype)
+        _mark("to_dia x3", A_lvl.data, M_dev.data, Mt_dev.data)
         R_lvl = ImplicitSmoothedR(T, Mt_dev)
         P_lvl = ImplicitSmoothedP(T, M_dev)
         relax_lvl = ScaledResidualSmoother(scale.astype(jnp.dtype(dtype)))
-        dev_levels.append(Level(
-            A_lvl, relax_lvl, P_lvl, R_lvl,
-            build_fused_down(A_lvl, R_lvl, relax_lvl),
-            build_fused_up(A_lvl, P_lvl, relax_lvl)))
+        fd = build_fused_down(A_lvl, R_lvl, relax_lvl)
+        _mark("fused_down build")
+        fu = build_fused_up(A_lvl, P_lvl, relax_lvl)
+        _mark("fused_up build")
+        dev_levels.append(Level(A_lvl, relax_lvl, P_lvl, R_lvl, fd, fu))
 
         adata, offs, dims = ac, new_offs, coarse
         n = int(np.prod(dims))
@@ -455,7 +478,9 @@ def device_build(A: CSR, prm):
     if prm.direct_coarse:
         Hl = HostDia(offs, np.asarray(jax.device_get(adata), np.float64),
                      dims)
+        _mark("coarse fetch")
         coarse_solver = DenseDirectSolver.build(Hl.to_csr(), dtype)
+        _mark("coarse direct build")
         dev_levels.append(Level(A_last, None))
     else:
         coarse_solver = None

@@ -1,5 +1,6 @@
 import os, sys, time
 os.environ["AMGCL_TPU_PROBE_VERBOSE"] = "1"
+os.environ["AMGCL_TPU_PROFILE_SETUP"] = "1"
 sys.path.insert(0, "/root/repo")
 if os.environ.get("DIAG_CPU") == "1":
     from amgcl_tpu.utils import axon_guard
