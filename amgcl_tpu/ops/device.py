@@ -64,8 +64,16 @@ class DiaMatrix:
 
     def _pallas_mode(self, *vecs):
         """None = use the XLA path; else the ``interpret`` flag for the
-        Pallas kernels (False on real TPU, True under the CI test hook)."""
-        from amgcl_tpu.ops.pallas_spmv import pallas_mode
+        Pallas kernels (False on real TPU, True under the CI test hook).
+
+        AMGCL_TPU_PALLAS_MIN_NDIAG=k routes levels with fewer than k
+        diagonals to XLA: its DIA lowering fuses fine at few diagonals
+        (fine Poisson levels, 7) and falls off the fusion path as the SA
+        stencil grows (coarse levels, 100+) — the per-level A/B knob for
+        the chip session, default 0 (Pallas everywhere it applies)."""
+        from amgcl_tpu.ops.pallas_spmv import pallas_mode, min_ndiag
+        if len(self.offsets) < min_ndiag():
+            return None
         return pallas_mode(self.dtype, *(v.dtype for v in vecs))
 
     def mv(self, x):

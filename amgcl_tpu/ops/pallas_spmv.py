@@ -42,6 +42,16 @@ def pallas_interpret_forced() -> bool:
     return os.environ.get("AMGCL_TPU_PALLAS_INTERPRET") == "1"
 
 
+def min_ndiag() -> int:
+    """AMGCL_TPU_PALLAS_MIN_NDIAG: smallest diagonal count that still
+    takes the Pallas DIA kernels (see DiaMatrix._pallas_mode). Read per
+    call — cheap, and lets a chip session A/B without reimporting."""
+    try:
+        return int(os.environ.get("AMGCL_TPU_PALLAS_MIN_NDIAG", "0"))
+    except ValueError:
+        return 0
+
+
 def probe_report(name, exc=None, note=""):
     """AMGCL_TPU_PROBE_VERBOSE=1: report probe-compile / value-check
     declines to stderr (the default is a silent XLA fallback) — the

@@ -324,3 +324,27 @@ def test_pallas_wiring_solver_sweep(monkeypatch, name):
     assert i_pal.iters == i_ref.iters
     r = rhs - A.spmv(np.asarray(x_pal, dtype=np.float64))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-4
+
+
+def test_pallas_min_ndiag_routes_to_xla(monkeypatch):
+    """AMGCL_TPU_PALLAS_MIN_NDIAG gates the DIA Pallas kernels by
+    diagonal count (the per-level XLA-vs-Pallas default knob for chip
+    sessions); below the threshold the XLA path must serve mv/residual
+    with identical results."""
+    import numpy as np
+    import jax.numpy as jnp
+    from amgcl_tpu.ops.device import DiaMatrix, residual
+
+    n = 64
+    offsets = (-1, 0, 1)
+    data = jnp.asarray(np.random.RandomState(0).rand(3, n), jnp.float32)
+    M = DiaMatrix(offsets, data, (n, n))
+    x = jnp.asarray(np.random.RandomState(1).rand(n), jnp.float32)
+    f = jnp.asarray(np.random.RandomState(2).rand(n), jnp.float32)
+    y_ref = np.asarray(M.mv(x))
+    r_ref = np.asarray(residual(f, M, x))
+    monkeypatch.setenv("AMGCL_TPU_PALLAS_MIN_NDIAG", "5")
+    assert M._pallas_mode(x) is None          # 3 diagonals < 5 -> XLA
+    np.testing.assert_allclose(np.asarray(M.mv(x)), y_ref, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(residual(f, M, x)), r_ref,
+                               rtol=1e-6)
