@@ -300,6 +300,13 @@ def _worker_watchdog():
     threading.Thread(target=guard, daemon=True).start()
 
 
+def _deadline_left():
+    """Seconds until the worker watchdog fires (AMGCL_TPU_BENCH_DEADLINE
+    is set by the supervisor from its own budget)."""
+    total = float(os.environ.get("AMGCL_TPU_BENCH_DEADLINE", "1500"))
+    return total - (time.time() - _T0)
+
+
 def _dispatch_overhead(reps=5):
     """Median wall time of an already-compiled trivial dispatch + scalar
     fetch — the per-call cost floor imposed by the (possibly tunneled)
@@ -602,6 +609,10 @@ def _bench_unstructured(on_tpu):
     if not (on_tpu or os.environ.get(
             "AMGCL_TPU_BENCH_UNSTRUCT_SOLVE") == "1"):
         return out
+    left = _deadline_left()
+    if left < 150:
+        out["solve"] = {"skipped": "%.0fs left < ~150s solve cost" % left}
+        return out
     try:
         from amgcl_tpu.models.make_solver import make_solver
         from amgcl_tpu.models.amg import AMGParams
@@ -699,6 +710,11 @@ def _bench_extra_configs(on_tpu):
         out["block3"] = {"error": repr(e)}
 
     # config-4 analogue: stabilized Stokes saddle point + Schur PC + FGMRES
+    left = _deadline_left()
+    if left < 150:
+        out["stokes_schur"] = {"skipped": "%.0fs left < ~150s config cost"
+                                          % left}
+        return out
     try:
         n = int(os.environ.get("AMGCL_TPU_BENCH_STOKES_N", "48"))
         T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
@@ -736,6 +752,12 @@ def main_worker():
         # supervisor's tail fallback: never touch the (wedged) tunnel
         from amgcl_tpu.utils.axon_guard import force_cpu_backend
         force_cpu_backend()
+    else:
+        # an explicit JAX_PLATFORMS=cpu must win over the axon plugin's
+        # registration-time override here too — the worker inits the
+        # backend before the package __init__ hook would run
+        from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested
+        apply_if_cpu_requested()
     import jax
     # persistent compilation cache: opportunistic runs during the round
     # pre-warm every per-level setup program and the solve program, so a
@@ -866,27 +888,30 @@ def main_worker():
             _PARTIAL["hbm_frac"] = round(achieved / peak, 3)
             break
 
+    # Optional deep-dive stages, highest decision-leverage first, each
+    # gated on the time left before the watchdog (the r5 chip run burned
+    # half its budget in 'block + stokes configs' and got killed mid-
+    # stage; a skipped stage with a recorded reason beats a wedge). Cost
+    # estimates are the observed r5 stage durations + compile margin.
+    def _enough(key, est):
+        left = _deadline_left()
+        if left > est:
+            return True
+        _PARTIAL[key] = {"skipped": "%.0fs left < ~%.0fs stage cost"
+                                    % (left, est)}
+        return False
+
     levels = None
-    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_LEVELS") == "1":
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_LEVELS") == "1") \
+            and _enough("levels", 180):
         _stage("per-level timings")
         try:
             levels = _bench_levels(solver)
         except Exception as e:       # per-level timing must never kill the
             levels = [{"error": repr(e)}]   # headline number
         _PARTIAL["levels"] = levels
-    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_UNSTRUCT") == "1":
-        _stage("unstructured spmv")
-        try:
-            _PARTIAL["unstructured"] = _bench_unstructured(on_tpu)
-        except Exception as e:
-            _PARTIAL["unstructured"] = {"error": repr(e)}
-    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_EXTRA") == "1":
-        _stage("block + stokes configs")
-        try:
-            _PARTIAL["extra_configs"] = _bench_extra_configs(on_tpu)
-        except Exception as e:
-            _PARTIAL["extra_configs"] = {"error": repr(e)}
-    if on_tpu or os.environ.get("AMGCL_TPU_BENCH_BF16") == "1":
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_BF16") == "1") \
+            and _enough("bf16", 200):
         # the ROADMAP's f32-vs-bf16 hierarchy decision, measured: same
         # problem, bf16 level operators (half the HBM bytes per
         # iteration) + f64-residual refinement; more iterations vs
@@ -911,6 +936,20 @@ def main_worker():
                 "speedup_vs_f32": round(t_solve / t16, 3)}
         except Exception as e:
             _PARTIAL["bf16"] = {"error": repr(e)}
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_UNSTRUCT") == "1") \
+            and _enough("unstructured", 320):
+        _stage("unstructured spmv")
+        try:
+            _PARTIAL["unstructured"] = _bench_unstructured(on_tpu)
+        except Exception as e:
+            _PARTIAL["unstructured"] = {"error": repr(e)}
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_EXTRA") == "1") \
+            and _enough("extra_configs", 300):
+        _stage("block + stokes configs")
+        try:
+            _PARTIAL["extra_configs"] = _bench_extra_configs(on_tpu)
+        except Exception as e:
+            _PARTIAL["extra_configs"] = {"error": repr(e)}
     loadN = os.getloadavg()
     _PARTIAL["telemetry"]["loadavg_end"] = [round(v, 2) for v in loadN]
     _PARTIAL["telemetry"]["contended"] = (
