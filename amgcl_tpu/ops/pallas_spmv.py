@@ -52,13 +52,24 @@ def min_ndiag() -> int:
         return 0
 
 
+# Every probe-compile / value-check decline this process has seen:
+# (kernel name, one-line reason). Always recorded (cheap), so bench.py
+# can embed the decline list in the artifact — the supervisor discards
+# worker stderr, which made an empty ``fused_levels`` undiagnosable from
+# the committed JSON alone.
+PROBE_DECLINES: list = []
+
+
 def probe_report(name, exc=None, note=""):
-    """AMGCL_TPU_PROBE_VERBOSE=1: report probe-compile / value-check
-    declines to stderr (the default is a silent XLA fallback) — the
-    chip-session debugging hook. A declined kernel is otherwise invisible
-    outside the bench's missing fused tiers (round-5 chip lesson: the
-    first real v5e session spent its opening hour discovering WHICH
-    kernel Mosaic rejected)."""
+    """Record a probe-compile / value-check decline; with
+    AMGCL_TPU_PROBE_VERBOSE=1 also print it (default is a silent XLA
+    fallback) — the chip-session debugging hook. A declined kernel is
+    otherwise invisible outside the bench's missing fused tiers (round-5
+    chip lesson: the first real v5e session spent its opening hour
+    discovering WHICH kernel Mosaic rejected)."""
+    reason = note or (repr(exc).splitlines()[0][:200] if exc is not None
+                      else "")
+    PROBE_DECLINES.append((name, reason))
     if os.environ.get("AMGCL_TPU_PROBE_VERBOSE") != "1":
         return
     import sys
@@ -94,6 +105,45 @@ def pallas_mode(*dtypes):
 # explicit ``db`` static arg so tests can exercise both modes without
 # stale-trace hazards.
 _DIA_DB = os.environ.get("AMGCL_TPU_DIA_DB", "0") == "1"
+
+# VMEM budget for _resolve_tile's auto mode: window scratch + pipelined
+# operand blocks must fit comfortably under Mosaic's ~16 MB VMEM (the
+# fused V-cycle kernels budget 12 MB; stay below so spmv coexists with
+# whatever XLA fuses around it)
+_TILE_VMEM_BUDGET = 8 << 20
+
+
+def _resolve_tile(offsets, tile, itemsize, ndiag):
+    """Row-tile size for the DIA kernels.
+
+    Explicit ``tile`` wins. ``None`` reads AMGCL_TPU_DIA_TILE: an integer
+    fixes it; 'auto' picks the smallest 1024-multiple with window
+    redundancy (tile + 2H)/tile <= 1.25 — the r5 chip session measured
+    dia_spmv at tile=2048 within 6% of the redundancy model's prediction
+    on the 128^3 fine level (each tile re-DMAs the +-16384 z-halo, 17.5x
+    its own rows), so the halo, not the row count, must set the tile —
+    halved until the window + pipelined blocks fit the VMEM budget.
+    Resolved at trace time: the first call per static signature binds the
+    env value (A/B arms need fresh processes, like AMGCL_TPU_DIA_DB)."""
+    if tile is not None:
+        return int(tile)
+    # default 'auto' since the r5 v5e sweep: level-0 spmv 316 us at
+    # tile=2048 vs 74 us at 32768+ (the halo amortizes); explicit
+    # AMGCL_TPU_DIA_TILE pins a fixed size for A/B runs
+    v = os.environ.get("AMGCL_TPU_DIA_TILE", "auto")
+    if v != "auto":
+        return int(v)
+    H = max((abs(int(o)) for o in offsets), default=0)
+    t = max(2048, -(-8 * H // 1024) * 1024)
+    while t > 2048:
+        # window scratch (doubled when db) + diag block + ~3 vector tiles
+        # (f/w/out), all double-buffered by the pallas pipeline
+        use = (t + 2 * H + 2048) * itemsize * (2 if _DIA_DB else 1) \
+            + 2 * (ndiag + 3) * t * itemsize
+        if use <= _TILE_VMEM_BUDGET:
+            break
+        t = max(2048, (t // 2048) * 1024)
+    return t
 
 
 def window_dma(pl, dma, i, n_tiles, nbuf):
@@ -185,18 +235,20 @@ def _dia_window(offsets, data, x, tile, interpret):
 
 @functools.partial(jax.jit, static_argnames=("offsets", "tile",
                                               "interpret", "db"))
-def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False,
+def dia_spmv(offsets, data, x, tile=None, interpret: bool = False,
              db=None):
     """y = A x for DIA storage. offsets: static tuple; data: (ndiag, n);
     x: (m,). Rows padded up to a tile multiple; result sliced back.
     ``db`` overrides the AMGCL_TPU_DIA_DB window double-buffering flag
-    (None = the import-time snapshot)."""
+    (None = the import-time snapshot); ``tile=None`` resolves via
+    AMGCL_TPU_DIA_TILE (see _resolve_tile)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     db = _DIA_DB if db is None else bool(db)
     n = data.shape[1]
     ndiag = len(offsets)
+    tile = _resolve_tile(offsets, tile, x.dtype.itemsize, ndiag)
     base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
                                              interpret)
 
@@ -248,7 +300,7 @@ def dia_spmv(offsets, data, x, tile: int = 2048, interpret: bool = False,
 @functools.partial(jax.jit,
                    static_argnames=("offsets", "mode", "tile", "interpret",
                                     "db"))
-def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False,
+def _dia_fused(offsets, data, f, x, w, mode, tile=None, interpret=False,
                db=None):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -256,6 +308,7 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False,
     db = _DIA_DB if db is None else bool(db)
     n = data.shape[1]
     ndiag = len(offsets)
+    tile = _resolve_tile(offsets, tile, x.dtype.itemsize, ndiag)
     base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
                                              interpret)
     fp = jnp.pad(f, (0, n_pad - n))
@@ -298,7 +351,7 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=2048, interpret=False,
 
 @functools.partial(jax.jit, static_argnames=("offsets", "tile",
                                               "interpret", "db"))
-def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
+def dia_spmv_dots(offsets, data, x, w=None, tile=None,
                   interpret: bool = False, db=None):
     """(y, <y, y>, <y, x>, <y, w>) in one pass, y = A x (w optional).
 
@@ -316,6 +369,7 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
     if x.shape[0] != n:
         raise ValueError("dia_spmv_dots needs a square operator")
     ndiag = len(offsets)
+    tile = _resolve_tile(offsets, tile, x.dtype.itemsize, ndiag)
     base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
                                              interpret)
     out_dtype = jnp.result_type(data.dtype, x.dtype)
@@ -380,7 +434,7 @@ def dia_spmv_dots(offsets, data, x, w=None, tile: int = 2048,
     return y[:n], yy, yx, yw
 
 
-def dia_spmv_dot(offsets, data, x, tile: int = 2048,
+def dia_spmv_dot(offsets, data, x, tile=None,
                  interpret: bool = False, db=None):
     """(y, <y, x>) — the CG pair; see dia_spmv_dots."""
     y, _, yx, _ = dia_spmv_dots(offsets, data, x, None, tile, interpret,
@@ -388,14 +442,14 @@ def dia_spmv_dot(offsets, data, x, tile: int = 2048,
     return y, yx
 
 
-def dia_residual(offsets, data, f, x, tile: int = 2048,
+def dia_residual(offsets, data, f, x, tile=None,
                  interpret: bool = False, db=None):
     """r = f − A x in one pass (A in DIA storage, square or rectangular)."""
     return _dia_fused(offsets, data, f, x, None, "residual", tile,
                       interpret, db)
 
 
-def dia_scaled_correction(offsets, data, w, f, x, tile: int = 2048,
+def dia_scaled_correction(offsets, data, w, f, x, tile=None,
                           interpret: bool = False, db=None):
     """x + w ∘ (f − A x) in one pass — a damped-Jacobi/SPAI-0 sweep."""
     return _dia_fused(offsets, data, f, x, w, "correction", tile,

@@ -63,6 +63,14 @@ def vcycle_fusion_enabled() -> bool:
     return os.environ.get("AMGCL_TPU_FUSED_VCYCLE", "1") != "0"
 
 
+def _sslice(v, a, b):
+    """Static slice of an in-register VALUE: Mosaic's TC lowering has no
+    dynamic_slice primitive for values (first real-v5e decline log, r5),
+    but every slice in these kernels has a Python-int start — lax.slice
+    legalizes. Refs are unaffected (pl.ds loads were always fine)."""
+    return jax.lax.slice(v, (int(a),), (int(a) + int(b),))
+
+
 def _round_up(v, m):
     return -(-int(v) // int(m)) * int(m)
 
@@ -175,25 +183,32 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
         up = jnp.zeros(L, dt).at[H:H + n].set(u)
 
     def kernel(af_hbm, mf_hbm, fp_hbm, up_hbm, sy_ref, sx_ref, *rest):
+        # per-diagonal 1-D window scratches (sa/sm lists): Mosaic rejects
+        # DMA into a row view of a 2-D VMEM scratch — memref slices along
+        # the sublane dim must be 8-aligned (r5 on-chip verification
+        # error); separate (W,) buffers are the dia_spmv-proven shape
         if zero_guess:
-            o_ref, o_u, sa, sm, sf, su, sems = rest
+            o_ref, o_u, *scr = rest
         else:
-            o_ref, sa, sm, sf, su, sems = rest
+            o_ref, *scr = rest
             o_u = None
+        sa = scr[:nA]
+        sm = scr[nA:nA + nM]
+        sf, su, sems = scr[nA + nM:]
         c = pl.program_id(0)
         start = c * (2 * s)
         cps = []
         for k in range(nA):
             cps.append(pltpu.make_async_copy(
-                af_hbm.at[pl.ds(k * L + start, W)], sa.at[k], sems.at[k]))
+                af_hbm.at[pl.ds(k * L + start, W)], sa[k], sems.at[np.int32(k)]))
         for k in range(nM):
             cps.append(pltpu.make_async_copy(
-                mf_hbm.at[pl.ds(k * L + start, W)], sm.at[k],
-                sems.at[nA + k]))
+                mf_hbm.at[pl.ds(k * L + start, W)], sm[k],
+                sems.at[np.int32(nA + k)]))
         cps.append(pltpu.make_async_copy(
-            fp_hbm.at[pl.ds(start, W)], sf, sems.at[nA + nM]))
+            fp_hbm.at[pl.ds(start, W)], sf, sems.at[np.int32(nA + nM)]))
         cps.append(pltpu.make_async_copy(
-            up_hbm.at[pl.ds(start, W)], su, sems.at[nA + nM + 1]))
+            up_hbm.at[pl.ds(start, W)], su, sems.at[np.int32(nA + nM + 1)]))
         for cp in cps:
             cp.start()
         for cp in cps:
@@ -202,8 +217,8 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
         if zero_guess:
             # su holds the scale frame: pre-smooth u = w ∘ f in VMEM
             uext = su[:] * sf[:]
-            o_u[:] = jax.lax.dynamic_slice(uext, (H,), (2 * s,))
-            uslice = lambda a, b: jax.lax.dynamic_slice(uext, (a,), (b,))
+            o_u[:] = _sslice(uext, H, 2 * s)
+            uslice = lambda a, b: _sslice(uext, a, b)
         else:
             uslice = lambda a, b: su[pl.ds(a, b)]
 
@@ -211,24 +226,29 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
         # row c·2s − Hr + j; u reads stay inside the W window by hA)
         acc = jnp.zeros((Wr,), dt)
         for k, d in enumerate(offs_a):
-            acc = acc + sa[k, pl.ds(hA, Wr)] * uslice(hA + d, Wr)
+            acc = acc + sa[k][pl.ds(hA, Wr)] * uslice(hA + d, Wr)
         rext = sf[pl.ds(hA, Wr)] - acc
 
         # t = r − Mᵀ r on the 2-plane tile (tile row i ↔ frame Hr + i)
         accm = jnp.zeros((2 * s,), dt)
         for k, d in enumerate(offs_m):
-            accm = accm + sm[k, pl.ds(H, 2 * s)] \
-                * jax.lax.dynamic_slice(rext, (Hr + d,), (2 * s,))
-        t = jax.lax.dynamic_slice(rext, (Hr,), (2 * s,)) - accm
+            accm = accm + sm[k][pl.ds(H, 2 * s)] \
+                * _sslice(rext, Hr + d, 2 * s)
+        t = _sslice(rext, Hr, 2 * s) - accm
 
         # Tᵀ for 2×2×2 blocks: z-pair add, then MXU pairwise sums on the
         # lane-packed plane view (one matmul pair; for f0 < 128 the left
         # operator is I over packed rows and the right one folds both
         # the y- and x-pairs — see _pack_shape)
-        t2 = (jax.lax.dynamic_slice(t, (0,), (s,))
-              + jax.lax.dynamic_slice(t, (s,), (s,))).reshape(fv)
-        red = jnp.dot(sy_ref[:], t2, preferred_element_type=jnp.float32)
-        out = jnp.dot(red, sx_ref[:], preferred_element_type=jnp.float32)
+        t2 = (_sslice(t, 0, s) + _sslice(t, s, s)).reshape(fv)
+        # precision=HIGHEST: inside a Pallas kernel an f32 dot lowers to a
+        # SINGLE bf16 MXU pass by default (no XLA precision pass) — the r5
+        # on-chip value check caught ~3e-3 relative error from exactly
+        # this; the 0/1 pair-sum operators need f32-exact accumulation
+        red = jnp.dot(sy_ref[:], t2, preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
+        out = jnp.dot(red, sx_ref[:], preferred_element_type=jnp.float32,
+                      precision=jax.lax.Precision.HIGHEST)
         o_ref[0] = out.astype(dt)
 
     rc_spec = pl.BlockSpec(
@@ -255,13 +275,12 @@ def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
         ],
         out_specs=out_specs,
         out_shape=out_shape,
-        scratch_shapes=[
-            pltpu.VMEM((nA, W), dt),
-            pltpu.VMEM((nM, W), dt),
-            pltpu.VMEM((W,), dt),
-            pltpu.VMEM((W,), dt),
-            pltpu.SemaphoreType.DMA((nA + nM + 2,)),
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((W,), dt) for _ in range(nA + nM)]
+            + [pltpu.VMEM((W,), dt),
+               pltpu.VMEM((W,), dt),
+               pltpu.SemaphoreType.DMA((nA + nM + 2,))]
+        ),
         interpret=interpret,
     )(a_flat, mt_flat, fp, up, sy, sx)
     return out
@@ -390,25 +409,33 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
     def kernel(*args):
         (mf_hbm, up_hbm, a_ref, f_ref, w_ref) = args[:5]
         planes = args[5:5 + 2 * hp + 1]
-        (syt_ref, sxt_ref, o_ref, sm, su, tuc, sems) = args[5 + 2 * hp + 1:]
+        # sm: per-diagonal 1-D frame scratches (Mosaic rejects DMA into a
+        # row view of 2-D VMEM — sublane slices must be 8-aligned)
+        (syt_ref, sxt_ref, o_ref, *scr) = args[5 + 2 * hp + 1:]
+        sm = scr[:nM]
+        su, tuc, sems = scr[nM:]
         c = pl.program_id(0)
         start = c * (2 * s)
         cps = [pltpu.make_async_copy(
-            up_hbm.at[pl.ds(start, F)], su, sems.at[0])]
+            up_hbm.at[pl.ds(start, F)], su, sems.at[np.int32(0)])]
         for k in range(nM):
             cps.append(pltpu.make_async_copy(
-                mf_hbm.at[pl.ds(k * Lm + start, F)], sm.at[k],
-                sems.at[1 + k]))
+                mf_hbm.at[pl.ds(k * Lm + start, F)], sm[k],
+                sems.at[np.int32(1 + k)]))
         for cp in cps:
             cp.start()
         # T uc on the frame while the DMAs fly: MXU pair expansion of
         # each coarse plane, written to its two fine planes
         for p, ref in enumerate(planes):
             plane = ref[0].astype(jnp.float32)
+            # precision=HIGHEST: see the down kernel — default in-kernel
+            # f32 dots are one bf16 MXU pass
             f2d = jnp.dot(syt_ref[:].astype(jnp.float32),
                           jnp.dot(plane, sxt_ref[:].astype(jnp.float32),
-                                  preferred_element_type=jnp.float32),
-                          preferred_element_type=jnp.float32)
+                                  preferred_element_type=jnp.float32,
+                                  precision=jax.lax.Precision.HIGHEST),
+                          preferred_element_type=jnp.float32,
+                          precision=jax.lax.Precision.HIGHEST)
             flat = f2d.reshape(s).astype(dt)
             tuc[pl.ds(2 * p * s, s)] = flat
             tuc[pl.ds((2 * p + 1) * s, s)] = flat
@@ -420,15 +447,14 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
         # zero-fill)
         accm = jnp.zeros((E,), dt)
         for k, d in enumerate(offs_m):
-            accm = accm + sm[k, pl.ds(seg0, E)] * tuc[pl.ds(seg0 + d, E)]
+            accm = accm + sm[k][pl.ds(seg0, E)] * tuc[pl.ds(seg0 + d, E)]
         upr = su[pl.ds(seg0, E)] + tuc[pl.ds(seg0, E)] - accm
 
         # first post-smooth sweep on the tile (tile i ↔ seg hA + i)
         acc = jnp.zeros((2 * s,), dt)
         for k, d in enumerate(offs_a):
-            acc = acc + a_ref[k, :] \
-                * jax.lax.dynamic_slice(upr, (hA + d,), (2 * s,))
-        o_ref[:] = jax.lax.dynamic_slice(upr, (hA,), (2 * s,)) \
+            acc = acc + a_ref[k, :] * _sslice(upr, hA + d, 2 * s)
+        o_ref[:] = _sslice(upr, hA, 2 * s) \
             + w_ref[:] * (f_ref[:] - acc)
 
     if m_flat.ndim != 1:
@@ -464,12 +490,12 @@ def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,
         ],
         out_specs=vec,
         out_shape=jax.ShapeDtypeStruct((n,), dt),
-        scratch_shapes=[
-            pltpu.VMEM((nM, F), dt),
-            pltpu.VMEM((F,), dt),
-            pltpu.VMEM((F,), dt),
-            pltpu.SemaphoreType.DMA((nM + 1,)),
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((F,), dt) for _ in range(nM)]
+            + [pltpu.VMEM((F,), dt),
+               pltpu.VMEM((F,), dt),
+               pltpu.SemaphoreType.DMA((nM + 1,))]
+        ),
         interpret=interpret,
     )(m_flat, up, a_data, f, w, *([rc3p] * (2 * hp + 1)), syt, sxt)
     return out
@@ -559,6 +585,12 @@ def build_fused_up(A_dev, P_dev, relax):
     # frame expands (hA <= hp*2s follows from the ceil)
     hp, _, vmem = up_geometry(offs_a, offs_m, T.fine)
     if hp > 2 or vmem * dt.itemsize > _VMEM_CAP_BYTES:
+        return None
+    # real-hardware window-redundancy gate (r5 on-chip A/B; interpret-
+    # mode CI still exercises hp = 2): at hp = 2 the frame is 5 planes
+    # per useful pair and the 128^3 level-1 fused up measured a wash vs
+    # the composed path (273 us vs 271 us) — not worth the VMEM
+    if hp > 1 and not interpret:
         return None
     n = A_dev.shape[0]
     nA, nM = len(offs_a), len(offs_m)
@@ -659,6 +691,15 @@ def build_fused_down(A_dev, R_dev, relax=None):
     s = f1 * f0
     H, _, vmem = down_geometry(offs_a, offs_m, T.fine)
     if vmem * dt.itemsize > _VMEM_CAP_BYTES:
+        return None
+    # real-hardware window-redundancy gate (r5 on-chip A/B; interpret-
+    # mode CI still exercises the larger-halo geometry): each grid step
+    # DMAs W = 2s + 2H per operand, so H > 2s re-reads the halo more
+    # than twice per useful row — the 128^3 level-1 fused down measured
+    # 501 us vs 237 us composed (H = 4s) while level 0 won 569 us vs
+    # 2.5 ms (H = 2s). Coarser SA levels keep the composed fused-
+    # residual path on hardware.
+    if H > 2 * s and not interpret:
         return None
     c2, c1, c0 = T.coarse
     n = A_dev.shape[0]

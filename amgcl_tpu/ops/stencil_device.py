@@ -56,6 +56,13 @@ from amgcl_tpu.ops.stencil import HostDia, host_dia_from_csr, _flat
 
 _MAX_DIAGS = 34          # per-level gate; pair scans stay ~10^3 steps
 
+# Per-phase wall breakdown of the most recent profiled device setup
+# (AMGCL_TPU_PROFILE_SETUP=1): list of (tag, seconds). bench.py re-runs
+# setup with profiling on and embeds this in the artifact so a tunneled
+# chip session can tell device programs from round trips from probe
+# compiles without scraping stderr.
+LAST_SETUP_PROFILE: list = []
+
 
 def enabled() -> bool:
     """Device setup is the default on TPU; AMGCL_TPU_DEVICE_SETUP=1 forces
@@ -369,6 +376,8 @@ def device_build(A: CSR, prm):
     # from fused-kernel probe compiles
     _prof_on = os.environ.get("AMGCL_TPU_PROFILE_SETUP") == "1"
     _prof_t = [time.perf_counter()]
+    if _prof_on:
+        LAST_SETUP_PROFILE.clear()
 
     def _mark(tag, *block_on):
         if not _prof_on:
@@ -376,6 +385,7 @@ def device_build(A: CSR, prm):
         for a in block_on:
             jax.block_until_ready(a)
         now = time.perf_counter()
+        LAST_SETUP_PROFILE.append((tag, round(now - _prof_t[0], 4)))
         print("[setup-prof] %-28s %7.3f s" % (tag, now - _prof_t[0]),
               file=sys.stderr)
         _prof_t[0] = now

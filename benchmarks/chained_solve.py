@@ -1,0 +1,81 @@
+"""Honest device-time measurement of the headline solve: chains N
+data-dependent solves inside one jitted scan (operators as jit args, so
+the upload stays small) and reports the two-length difference — no
+dispatch, no fetch, no RTT in the number.
+
+Usage: python benchmarks/chained_solve.py [n]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+    import numpy as np
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+
+    A, rhs = poisson3d(n)
+    solver = make_solver(A, AMGParams(dtype=jnp.float32),
+                         CG(maxiter=100, tol=1e-6), refine=3)
+    rhs_dev = jnp.asarray(rhs, jnp.float32)
+    x0 = jnp.zeros_like(rhs_dev)
+    x, info = solver(rhs_dev)
+    jax.block_until_ready(x)
+
+    ops = (solver.A_dev, solver.A_dev64, solver.precond.hierarchy)
+
+    def chain(r):
+        def many(args):
+            A_dev, A_dev64, hier = args
+
+            def one(c):
+                got = solver._solve_fn(A_dev, A_dev64, hier,
+                                       rhs_dev + 0 * c, x0)
+                return got[0].astype(jnp.float32)
+
+            def body(c, _):
+                return one(c), None
+            out, _ = lax.scan(body, one(x0 * 0), None, length=r - 1)
+            return out.sum()
+        f = jax.jit(many)
+        float(f(ops))
+        ts = []
+        for _ in range(3):
+            t0 = time.perf_counter()
+            float(f(ops))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts))
+
+    t = max(chain(4) - chain(1), 0.0) / 3
+    rec = {"n": n, "platform": jax.devices()[0].platform,
+           "iters": int(info.iters), "solve_s": round(t, 4),
+           "ms_per_iter": round(t / max(int(info.iters), 1) * 1e3, 2),
+           "fused_levels": " ".join(
+               "%d%s%s" % (i, "d" if lv.down is not None else "",
+                           "u" if lv.up is not None else "")
+               for i, lv in enumerate(solver.precond.hierarchy.levels)
+               if lv.down is not None or lv.up is not None)}
+    print(json.dumps(rec))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,113 @@
+"""Targeted chip-session decomposition of the headline solve.
+
+Runs ONE arm per invocation (env knobs are read at import/probe time, so
+each arm needs a fresh process):
+
+    python benchmarks/chip_probe.py baseline      # default config
+    python benchmarks/chip_probe.py noplas        # AMGCL_TPU_PALLAS=0
+    python benchmarks/chip_probe.py nofuse        # AMGCL_TPU_FUSED_VCYCLE=0
+    python benchmarks/chip_probe.py diadb         # AMGCL_TPU_DIA_DB=1
+    python benchmarks/chip_probe.py norefine      # refine=0 (no f64 pass)
+
+Each arm builds the 128^3 Poisson SA+CG+SPAI0 solver, reports which fused
+tiers engaged (+ the probe-decline log), and times the solve PER CALL
+(median of 5, minus a jitted-scalar dispatch floor) — the dispatch-free
+chained scan 413s on the tunnel's remote_compile, so per-call result
+fetch and residual RTT jitter remain in solve_s: read arm DELTAS at the
+10 ms+ scale, not absolute device time (benchmarks/chained_solve.py has
+the honest chained number for the default config). Appends one JSON line
+to /tmp/chip_probe_results.jsonl.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+_ARMS = {
+    "baseline": {},
+    "noplas": {"AMGCL_TPU_PALLAS": "0"},
+    "nofuse": {"AMGCL_TPU_FUSED_VCYCLE": "0"},
+    "diadb": {"AMGCL_TPU_DIA_DB": "1"},
+    # refine=0: drop the f64 outer residual (emulated f64 on TPU streams
+    # the fine operator at software speed even when zero restarts fire)
+    "norefine": {},
+}
+
+
+def main():
+    arm = sys.argv[1] if len(sys.argv) > 1 else "baseline"
+    os.environ.update(_ARMS[arm])
+    os.environ.setdefault("AMGCL_TPU_PROBE_VERBOSE", "1")
+    n = int(os.environ.get("AMGCL_TPU_BENCH_N", "128"))
+
+    import numpy as np
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.ops.pallas_spmv import PROBE_DECLINES
+
+    rec = {"arm": arm, "n": n,
+           "platform": jax.devices()[0].platform}
+    A, rhs = poisson3d(n)
+    t0 = time.perf_counter()
+    solver = make_solver(A, AMGParams(dtype=jnp.float32),
+                         CG(maxiter=100, tol=1e-6),
+                         refine=0 if arm == "norefine" else 3)
+    rec["setup_s"] = round(time.perf_counter() - t0, 3)
+    rec["fused_levels"] = " ".join(
+        "%d%s%s" % (i, "d" if lv.down is not None else "",
+                    "u" if lv.up is not None else "")
+        for i, lv in enumerate(solver.precond.hierarchy.levels)
+        if lv.down is not None or lv.up is not None)
+    rec["declines"] = [list(d) for d in PROBE_DECLINES[:10]]
+
+    rhs_dev = jnp.asarray(rhs, jnp.float32)
+    x, info = solver(rhs_dev)
+    jax.block_until_ready(x)
+    rec["iters"] = int(info.iters)
+
+    # dispatch-overhead floor (the tunneled per-call sync), subtracted
+    # from plain per-call timing. Chained-scan timing would be cleaner
+    # but the tunnel's remote_compile endpoint 413s on the large fresh
+    # chain HLO; at the 100ms+ scale under study the per-call floor is
+    # a small correction.
+    g = jax.jit(lambda s: s * 2.0)
+    float(g(jnp.float32(1.0)))
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(g(jnp.float32(1.0)))
+        ts.append(time.perf_counter() - t0)
+    overhead = float(np.median(ts))
+    rec["dispatch_overhead_s"] = round(overhead, 4)
+
+    ts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        x, info = solver(rhs_dev)
+        jax.block_until_ready(x)
+        ts.append(time.perf_counter() - t0)
+    rec["solve_s"] = round(max(float(np.median(ts)) - overhead, 0.0), 4)
+    rec["ms_per_iter"] = round(rec["solve_s"] / max(rec["iters"], 1)
+                               * 1e3, 2)
+    line = json.dumps(rec)
+    print(line)
+    with open("/tmp/chip_probe_results.jsonl", "a") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

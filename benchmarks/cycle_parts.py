@@ -1,0 +1,105 @@
+"""On-chip decomposition of ONE V-cycle at the headline problem: times
+hierarchy.apply and each level-0/1 building block with two-length
+difference chains (small programs — the tunnel's remote_compile size
+limit only bites on whole-solve chains).
+
+Usage: python benchmarks/cycle_parts.py [n]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+    import numpy as np
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.amg import AMG, AMGParams
+    from amgcl_tpu.ops import device as dev
+
+    m = AMG(poisson3d(n)[0], AMGParams(dtype=jnp.float32))
+    hier = m.hierarchy
+
+    def diff_time(fn, x0, aux=None, reps=(5, 20)):
+        """fn(aux, v) -> v'; ``aux`` (a pytree, e.g. the hierarchy or a
+        level) rides through jit as an ARGUMENT — closing over it would
+        embed the operator data as MLIR constants (~60 MB/diagonal set)
+        and overflow the tunnel's remote_compile upload limit."""
+        def chain(r):
+            def many(a, x):
+                def body(c, _):
+                    return fn(a, c) * 0.5 + x, None
+                out, _ = lax.scan(body, x, None, length=r)
+                return out.sum()
+            f = jax.jit(many)
+            float(f(aux, x0))
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                float(f(aux, x0))
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+        return max(chain(reps[1]) - chain(reps[0]), 0.0) / (reps[1]
+                                                            - reps[0])
+
+    out = {"n": n, "platform": jax.devices()[0].platform}
+    nf = hier.levels[0].A.shape[0]
+    rng = np.random.RandomState(0)
+    r0 = jnp.asarray(rng.rand(nf), jnp.float32)
+
+    out["vcycle_ms"] = round(diff_time(
+        lambda h, v: h.apply(v), r0, aux=hier) * 1e3, 3)
+
+    for li in range(min(2, len(hier.levels) - 1)):
+        lv = hier.levels[li]
+        nl = lv.A.shape[0]
+        nc = lv.R.shape[0]
+        f = jnp.asarray(rng.rand(nl), jnp.float32)
+        u = jnp.asarray(rng.rand(nl), jnp.float32)
+        L = {}
+        L["presmooth_us"] = round(diff_time(
+            lambda a, v: a.relax.apply_pre(a.A, f, v), u, aux=lv) * 1e6, 1)
+        L["resid_us"] = round(diff_time(
+            lambda a, v: dev.residual(f, a.A, v), u, aux=lv) * 1e6, 1)
+        L["restrict_us"] = round(diff_time(
+            lambda a, v: jnp.pad(a.R.mv(v), (0, nl - nc)), u,
+            aux=lv) * 1e6, 1)
+        L["prolong_us"] = round(diff_time(
+            lambda a, v: a.P.mv(v[:nc]), u, aux=lv) * 1e6, 1)
+        L["spmv_us"] = round(diff_time(
+            lambda a, v: a.A.mv(v), u, aux=lv) * 1e6, 1)
+        if hasattr(dev, "spmv_dots"):
+            L["spmv_dots_us"] = round(diff_time(
+                lambda a, v: dev.spmv_dots(a.A, v, None)[0], u,
+                aux=lv) * 1e6, 1)
+        if lv.down is not None:
+            L["fused_down_us"] = round(diff_time(
+                lambda a, v: jnp.pad(a.down(f, v).reshape(-1),
+                                     (0, nl - nc)), u, aux=lv) * 1e6, 1)
+        if lv.up is not None:
+            L["fused_up_us"] = round(diff_time(
+                lambda a, v: a.up(f, v, v[:nc]), u, aux=lv) * 1e6, 1)
+        out["level%d" % li] = L
+
+    line = json.dumps(out)
+    print(line)
+    with open("/tmp/cycle_parts.jsonl", "a") as f:
+        f.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()

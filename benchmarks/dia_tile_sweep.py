@@ -1,0 +1,111 @@
+"""Tile-size sweep for the DIA Pallas kernels at the headline shapes.
+
+The r5 chip artifact measured dia_spmv at 271 us on the 128^3 fine level
+— almost exactly the window-redundancy model's prediction for tile=2048:
+each tile DMAs a (tile + 2*16384)-element x window, 17.5x the tile's own
+rows, so adjacent tiles refetch the z-halo over and over. Larger tiles
+amortize the halo (32768 -> 2x, 131072 -> 1.25x) at the cost of a bigger
+VMEM footprint (win*4B + ndiag*tile*4B per grid step; cap ~12 MB).
+
+Runs on whatever backend answers; only TPU numbers matter. One JSON line
+per (level, tile, db) to stdout and /tmp/dia_tile_sweep.jsonl.
+
+Usage: python benchmarks/dia_tile_sweep.py [n]
+"""
+
+import itertools
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    n = int(sys.argv[1]) if len(sys.argv) > 1 else 128
+
+    import numpy as np
+    import jax
+    jax.config.update("jax_compilation_cache_dir",
+                      os.path.join(os.path.dirname(os.path.dirname(
+                          os.path.abspath(__file__))), ".jax_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.amg import AMG, AMGParams
+    from amgcl_tpu.ops.device import DiaMatrix
+    from amgcl_tpu.ops.pallas_spmv import dia_spmv, dia_residual
+
+    platform = jax.devices()[0].platform
+    interpret = platform != "tpu"
+
+    m = AMG(poisson3d(n)[0], AMGParams(dtype=jnp.float32))
+    levels = [lv.A for lv in m.hierarchy.levels
+              if isinstance(lv.A, DiaMatrix)]
+
+    def diff_time(fn, x0, aux, reps=(10, 60)):
+        """fn(aux, v) -> v'; aux (operator data pytree) rides through jit
+        as an ARGUMENT — closed-over operator arrays become MLIR
+        constants and blow the tunnel's remote_compile upload limit."""
+        def chain(r):
+            def many(a, x):
+                def body(c, _):
+                    return fn(a, c) * 0.5 + x, None
+                out, _ = lax.scan(body, x, None, length=r)
+                return out.sum()
+            f = jax.jit(many)
+            float(f(aux, x0))
+            ts = []
+            for _ in range(5):
+                t0 = time.perf_counter()
+                float(f(aux, x0))
+                ts.append(time.perf_counter() - t0)
+            return float(np.median(ts))
+        return max(chain(reps[1]) - chain(reps[0]), 0.0) / (reps[1]
+                                                            - reps[0])
+
+    out_path = "/tmp/dia_tile_sweep.jsonl"
+    for li, A in enumerate(levels):
+        nrows = A.shape[0]
+        x = jnp.asarray(np.random.RandomState(li).rand(nrows), jnp.float32)
+        f = jnp.asarray(np.random.RandomState(99).rand(nrows), jnp.float32)
+        H = max(abs(o) for o in A.offsets)
+        for tile, db in itertools.product(
+                (2048, 8192, 32768, 131072), (False, True)):
+            if tile > max(2048, nrows):
+                continue
+            win_b = (tile + 2 * H + 2048) * 4 * (2 if db else 1)
+            dia_b = len(A.offsets) * tile * 4
+            if win_b + dia_b > 12 << 20:     # VMEM cap, mirrors the kernel
+                continue
+            try:
+                offs = A.offsets
+                spmv_us = diff_time(
+                    lambda a, v: dia_spmv(offs, a[0], v, tile=tile,
+                                          interpret=interpret, db=db),
+                    x, (A.data,)) * 1e6
+                resid_us = diff_time(
+                    lambda a, v: dia_residual(offs, a[0], a[1], v,
+                                              tile=tile,
+                                              interpret=interpret,
+                                              db=db), x, (A.data, f)) * 1e6
+                rec = {"level": li, "rows": nrows,
+                       "ndiag": len(A.offsets), "halo": H, "tile": tile,
+                       "db": db, "spmv_us": round(spmv_us, 1),
+                       "resid_us": round(resid_us, 1),
+                       "platform": platform}
+            except Exception as e:
+                rec = {"level": li, "tile": tile, "db": db,
+                       "error": repr(e)[:200]}
+            line = json.dumps(rec)
+            print(line, flush=True)
+            with open(out_path, "a") as fh:
+                fh.write(line + "\n")
+
+
+if __name__ == "__main__":
+    main()
