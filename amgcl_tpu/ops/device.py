@@ -319,6 +319,15 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 "windowed-ELL format needs banded column locality; apply "
                 "a Cuthill-McKee reorder first (utils/adapters.Reordered)")
         return W
+    if fmt == "dwin":
+        from amgcl_tpu.ops.densewin import csr_to_dense_window
+        D = csr_to_dense_window(A, dtype)
+        if D is None:
+            raise ValueError(
+                "dense-window format needs banded column locality within "
+                "the storage budget (AMGCL_TPU_DWIN_MAX_BYTES); apply a "
+                "Cuthill-McKee reorder first or raise the budget")
+        return D
     if fmt == "auto":
         if not A.is_block:
             on_tpu = jax.default_backend() == "tpu"
@@ -335,6 +344,17 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                     and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
                 return csr_to_dia(A, dtype)
         if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
+            if not A.is_block and jax.default_backend() == "tpu":
+                # gather-free dense-window blocks (ops/densewin.py): on
+                # real TPU the windowed-ELL Pallas gather does not
+                # legalize and the XLA take path runs at gather speed
+                # (~1/800 of HBM bw, r5 measurement) — trading HBM
+                # capacity (n·win·itemsize, budget-gated) for streaming
+                # wins whenever the matrix has banded locality
+                from amgcl_tpu.ops.densewin import csr_to_dense_window
+                D = csr_to_dense_window(A, dtype, require_kernel=True)
+                if D is not None:
+                    return D
             # unstructured but banded (e.g. after Cuthill-McKee): windowed
             # ELL replaces the HBM-serialized gather with per-tile VMEM
             # windows, for scalar AND block values (the budget scales by
@@ -379,6 +399,13 @@ def residual(f, A, x):
                 else windowed_ell_block_residual
             return fn(A.window_starts, A.cols_local, A.vals, f, x, A.win,
                       A.shape[0], interpret=ip)
+    from amgcl_tpu.ops.densewin import DenseWindowMatrix
+    if isinstance(A, DenseWindowMatrix):
+        ip = A._pallas_mode(x, f, kernel="fused")
+        if ip is not None:
+            from amgcl_tpu.ops.densewin import dense_window_residual
+            return dense_window_residual(A.window_starts, A.blocks, f, x,
+                                         A.win, A.shape[0], interpret=ip)
     return f - A.mv(x)
 
 
@@ -409,6 +436,15 @@ def scaled_correction(A, w, f, x):
                     else windowed_ell_block_scaled_correction
                 return fn(A.window_starts, A.cols_local, A.vals, w, f, x,
                           A.win, A.shape[0], interpret=ip)
+    from amgcl_tpu.ops.densewin import DenseWindowMatrix
+    if isinstance(A, DenseWindowMatrix) and w.ndim == 1:
+        ip = A._pallas_mode(x, f, w, kernel="fused")
+        if ip is not None:
+            from amgcl_tpu.ops.densewin import (
+                dense_window_scaled_correction)
+            return dense_window_scaled_correction(
+                A.window_starts, A.blocks, w, f, x, A.win, A.shape[0],
+                interpret=ip)
     return None
 
 

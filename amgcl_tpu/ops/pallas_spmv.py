@@ -67,8 +67,21 @@ def probe_report(name, exc=None, note=""):
     otherwise invisible outside the bench's missing fused tiers (round-5
     chip lesson: the first real v5e session spent its opening hour
     discovering WHICH kernel Mosaic rejected)."""
-    reason = note or (repr(exc).splitlines()[0][:200] if exc is not None
-                      else "")
+    if note:
+        reason = note
+    elif exc is not None:
+        # the useful Mosaic line is buried ~1.5 KB into the tunnel's
+        # HTTP wrapper — extract it so the artifact's decline log is
+        # diagnosable (r5: the first dense-window failure was opaque
+        # until a by-hand rerun)
+        import re
+        txt = str(exc)
+        m = re.search(r"(Mosaic failed[^\n]*|Internal: AOT PJRT "
+                      r"error:[^\n]*|verification error[^\n]*|"
+                      r"Unimplemented[^\n]*|NotImplemented[^\n]*)", txt)
+        reason = (m.group(0) if m else repr(exc).splitlines()[0])[:300]
+    else:
+        reason = ""
     PROBE_DECLINES.append((name, reason))
     if os.environ.get("AMGCL_TPU_PROBE_VERBOSE") != "1":
         return
@@ -106,11 +119,14 @@ def pallas_mode(*dtypes):
 # stale-trace hazards.
 _DIA_DB = os.environ.get("AMGCL_TPU_DIA_DB", "0") == "1"
 
-# VMEM budget for _resolve_tile's auto mode: window scratch + pipelined
-# operand blocks must fit comfortably under Mosaic's ~16 MB VMEM (the
-# fused V-cycle kernels budget 12 MB; stay below so spmv coexists with
-# whatever XLA fuses around it)
-_TILE_VMEM_BUDGET = 8 << 20
+# VMEM budget for _resolve_tile's auto mode, in ESTIMATE units (window
+# scratch + pipelined operand blocks). Mosaic's real scoped-vmem stack
+# runs ~4x the naive operand estimate (r5 bench: a bf16 33-diagonal
+# level estimated 4.7 MB and hit the 16 MB limit at 21.3 MB), so the
+# estimate cap is 3 MB — which also happens to land every measured
+# level on its empirically-best tile (L0 32768 == 74 us plateau,
+# L1 8192, L2 2048)
+_TILE_VMEM_BUDGET = 3 << 20
 
 
 def _resolve_tile(offsets, tile, itemsize, ndiag):

@@ -135,23 +135,59 @@ def _collapse_plan(s_offs, dims, blocks, coarse):
 
 
 def _fnma_scan(out, src, dst_pad, pairs, pad, n):
-    """out[ko] -= src[ka] * dst_pad[kb, pad+s : pad+s+n] for every pair —
-    one scan step per pair, each a streamed fused multiply-add (the device
-    analogue of native_dia_fnma_batch)."""
+    """out[ko] -= src[ka] * dst_pad[kb, pad+s : pad+s+n] for every pair.
+
+    Grouped by OUTPUT row so the row index is STATIC. The original
+    formulation scanned over pairs with a traced-row dynamic_update_slice
+    into the whole (rows, n) carry — XLA copies the full carry every
+    step (r5 on-chip setup profile: 2.3 s per 128³ level for ~100 GB of
+    carry copies against ~9 GB of useful traffic). Per output row the
+    pair list is short at fine levels (unrolled static slices — XLA
+    fuses the fma chain); long lists (coarse SA stencils, hundreds of
+    source pairs) use a per-row lax.scan whose carry is ONE row, so the
+    worst-case copy is (n,) not (rows, n)."""
     if not pairs:
         return out
-    parr = jnp.asarray(np.asarray(pairs, np.int32))
+    if jax.default_backend() != "tpu":
+        # CPU (tests on the virtual mesh): the original pair scan — the
+        # unrolled form below multiplies the traced op count per shard
+        # and blows the 8-virtual-device sharded compile time ~6x
+        parr = jnp.asarray(np.asarray(pairs, np.int32))
 
-    def body(acc, p):
-        ka, kb, s, ko = p[0], p[1], p[2], p[3]
-        zero = jnp.zeros((), ka.dtype)   # match index dtypes under x64
-        b = lax.dynamic_slice(dst_pad, (kb, pad + s), (1, n))[0]
-        a = lax.dynamic_slice(src, (ka, zero), (1, n))[0]
-        row = lax.dynamic_slice(acc, (ko, zero), (1, n))[0] - a * b
-        return lax.dynamic_update_slice(acc, row[None], (ko, zero)), None
+        def sbody(acc, p):
+            ka, kb, s, ko = p[0], p[1], p[2], p[3]
+            zero = jnp.zeros((), ka.dtype)
+            b = lax.dynamic_slice(dst_pad, (kb, pad + s), (1, n))[0]
+            a = lax.dynamic_slice(src, (ka, zero), (1, n))[0]
+            row = lax.dynamic_slice(acc, (ko, zero), (1, n))[0] - a * b
+            return lax.dynamic_update_slice(acc, row[None], (ko, zero)), \
+                None
 
-    out, _ = lax.scan(body, out, parr)
-    return out
+        out, _ = lax.scan(sbody, out, parr)
+        return out
+    by_out = {}
+    for ka, kb, s, ko in pairs:
+        by_out.setdefault(int(ko), []).append((int(ka), int(kb), int(s)))
+    rows = [out[k] for k in range(out.shape[0])]
+    for ko, plist in by_out.items():
+        acc = rows[ko]
+        if len(plist) <= 24:
+            for ka, kb, s in plist:
+                b = lax.slice(dst_pad, (kb, pad + s), (kb + 1, pad + s + n))
+                acc = acc - src[ka] * b[0]
+        else:
+            parr = jnp.asarray(np.asarray(plist, np.int32))
+
+            def body(a_row, p):
+                ka, kb, s = p[0], p[1], p[2]
+                b = lax.dynamic_slice(dst_pad, (kb, pad + s), (1, n))[0]
+                av = lax.dynamic_slice(
+                    src, (ka, jnp.zeros((), ka.dtype)), (1, n))[0]
+                return a_row - av * b, None
+
+            acc, _ = lax.scan(body, acc, parr)
+        rows[ko] = acc
+    return jnp.stack(rows)
 
 
 # -- the per-level device program --------------------------------------------
@@ -246,18 +282,39 @@ def _level_setup(adata, eps_strong, relax_scale, smoother_omega, offs,
     n_c = c2 * c1 * c0
     acc0 = jnp.zeros((len(c_offs), n_c), dt)
 
-    def cbody(acc, inp):
-        row, slots = inp
-        v3 = row.reshape(f2, f1, f0)
-        if dims_p != tuple(dims):
-            v3 = jnp.pad(v3, ((0, dims_p[0] - f2), (0, dims_p[1] - f1),
-                              (0, dims_p[2] - f0)))
-        for j, (pz, py, px) in enumerate(parities):
-            sl = v3[pz::b2, py::b1, px::b0].reshape(-1)
-            acc = acc.at[slots[j]].add(sl)
-        return acc, None
+    if jax.default_backend() == "tpu":
+        # static unrolled collapse: the table is host-known, so every
+        # destination row index is STATIC — a scan carrying the whole
+        # (c_offs, n_c) accumulator with traced scatter rows forced a
+        # full carry copy per step (same disease as the old _fnma_scan;
+        # r5 setup profile: ~2.2 s per 128³ level)
+        acc = acc0
+        for i in range(len(s_offs)):
+            v3 = S[i].reshape(f2, f1, f0)
+            if dims_p != tuple(dims):
+                v3 = jnp.pad(v3, ((0, dims_p[0] - f2),
+                                  (0, dims_p[1] - f1),
+                                  (0, dims_p[2] - f0)))
+            for j, (pz, py, px) in enumerate(parities):
+                sl = v3[pz::b2, py::b1, px::b0].reshape(-1)
+                acc = acc.at[int(table[i, j])].add(sl)
+        ac_all = acc
+    else:
+        # CPU (virtual-mesh tests): scan keeps the traced op count per
+        # shard bounded — see _fnma_scan's backend branch
+        def cbody(acc, inp):
+            row, slots = inp
+            v3 = row.reshape(f2, f1, f0)
+            if dims_p != tuple(dims):
+                v3 = jnp.pad(v3, ((0, dims_p[0] - f2),
+                                  (0, dims_p[1] - f1),
+                                  (0, dims_p[2] - f0)))
+            for j, (pz, py, px) in enumerate(parities):
+                sl = v3[pz::b2, py::b1, px::b0].reshape(-1)
+                acc = acc.at[slots[j]].add(sl)
+            return acc, None
 
-    ac_all, _ = lax.scan(cbody, acc0, (S, jnp.asarray(table)))
+        ac_all, _ = lax.scan(cbody, acc0, (S, jnp.asarray(table)))
     ac_counts = jnp.sum(ac_all != 0, axis=1).astype(jnp.int32)
 
     # 6. smoother diagonal from the ORIGINAL operator

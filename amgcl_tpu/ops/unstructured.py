@@ -295,7 +295,10 @@ def _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, n_tiles,
     from amgcl_tpu.ops.pallas_spmv import window_dma
 
     def dma(tile_idx, slot):
-        start = starts_smem[tile_idx] * np.int32(bc)
+        # builder floors starts to _WIN_ALIGN; multiple_of carries the
+        # alignment invariant Mosaic cannot infer from an SMEM value
+        start = pl.multiple_of(starts_smem[tile_idx] * np.int32(bc),
+                               _WIN_ALIGN * bc)
         return pltpu.make_async_copy(
             x_hbm.at[pl.ds(start, win * bc)], xw.at[slot], sem.at[slot])
 
@@ -697,6 +700,33 @@ def windowed_ell_block_scaled_correction(window_starts, cols_local, vals,
                                     S, "correction", win, n_out, interpret)
 
 
+def tile_windows(A: CSR, tile: int):
+    """Per-row-tile aligned column windows, shared by the windowed-ELL
+    and dense-window builders (one copy of the DMA-shape rules):
+    returns (n_tiles, rows, tiles, starts, win) with ``starts`` floored
+    to _WIN_ALIGN — Mosaic cannot prove a runtime window start aligned,
+    and an unaligned 1-D DMA start is a legalization failure on real
+    hardware (r5 chip session) — and ``win`` the _WIN_ALIGN-rounded max
+    span. Empty tiles point past the matrix and read zero padding."""
+    n, m = A.shape
+    n_tiles = -(-n // tile)
+    rows = A.expanded_rows()
+    tiles = rows // tile
+    starts = np.full(n_tiles, m, dtype=np.int64)
+    ends = np.zeros(n_tiles, dtype=np.int64)
+    if A.nnz:
+        np.minimum.at(starts, tiles, A.col)
+        np.maximum.at(ends, tiles, A.col + 1)
+    empty = ends <= starts          # tiles with no entries read padding
+    starts[empty] = m
+    ends[empty] = m + 1
+    starts = (starts // _WIN_ALIGN) * _WIN_ALIGN
+    span = ends - starts
+    win = int(span.max()) if n_tiles else 1
+    win = -(-win // _WIN_ALIGN) * _WIN_ALIGN
+    return n_tiles, rows, tiles, starts, win
+
+
 def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,
                         max_win_bytes: int = 8 << 20):
     """Pack a host CSR (scalar or block-valued BCSR) into windowed ELL.
@@ -707,25 +737,10 @@ def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,
     DMA budget scales by the block column width."""
     br, bc = A.block_size
     n, m = A.shape                  # block units for BCSR
-    n_tiles = -(-n // tile)
     nnz_row = A.row_nnz()
     K = max(4, int(nnz_row.max()) if n else 1)
     K = -(-K // 4) * 4
-
-    rows = A.expanded_rows()
-    tiles = rows // tile
-    # per-tile column windows
-    starts = np.full(n_tiles, m, dtype=np.int64)
-    ends = np.zeros(n_tiles, dtype=np.int64)
-    if A.nnz:
-        np.minimum.at(starts, tiles, A.col)
-        np.maximum.at(ends, tiles, A.col + 1)
-    empty = ends <= starts          # tiles with no entries read padding
-    starts[empty] = m
-    ends[empty] = m + 1
-    span = ends - starts
-    win = int(span.max()) if n_tiles else 1
-    win = -(-win // _WIN_ALIGN) * _WIN_ALIGN
+    n_tiles, rows, tiles, starts, win = tile_windows(A, tile)
     # VMEM budget: window + one cols/vals/out tile must fit comfortably
     if win * bc * np.dtype(np.float32).itemsize > max_win_bytes:
         return None
