@@ -43,7 +43,7 @@ class make_solver:
 
     def __init__(self, A, precond: Any = None, solver: Any = None,
                  solver_dtype=None, matrix_format: str = "auto",
-                 refine: int = 0):
+                 refine: int = 0, refine_dtype: str = "auto"):
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.A_host = A
@@ -78,21 +78,59 @@ class make_solver:
             self.A_dev = hier_A
         else:
             self.A_dev = dev.to_device(A, matrix_format, self.solver_dtype)
-        # refinement needs the operator in f64 for the outer residual: the
-        # f32 evaluation of b - A x floors around eps32·||A||·||x||/||b||,
-        # far above 1e-6 for large stiff systems
+        # refinement needs the outer residual b - A x evaluated more
+        # accurately than the working precision (the f32 evaluation
+        # floors around eps32·||A||·||x||/||b||, far above 1e-6 for
+        # large stiff systems). Two routes:
+        #   'float64' — the wide operator (reference spirit; on TPU the
+        #               f64 pass runs in software emulation);
+        #   'df32'    — compensated two-f32 arithmetic (ops/dfloat.py):
+        #               the same accuracy class at f32 hardware speed,
+        #               DIA operators only; the f32 rhs is treated as
+        #               exact (b_lo = 0).
+        # 'auto' picks df32 on TPU for real-f32 DIA systems, float64
+        # elsewhere.
         self.A_dev64 = None
+        self.refine_mode = None
         if self.refine > 0:
             import jax as _jax
-            if not _jax.config.jax_enable_x64:
-                import warnings
-                warnings.warn(
-                    "refine>0 requires jax_enable_x64; without it the "
-                    "float64 residual silently truncates to float32 and "
-                    "refinement gains nothing — enable x64 or drop refine")
-            self.A_dev64 = dev.to_device(A, matrix_format,
-                                         self._wide_dtype())
+            if refine_dtype == "auto":
+                use_df = (_jax.default_backend() == "tpu"
+                          and isinstance(self.A_dev, dev.DiaMatrix)
+                          and jnp.dtype(self.solver_dtype)
+                          == jnp.dtype(jnp.float32))
+                refine_dtype = "df32" if use_df else "float64"
+            if refine_dtype == "df32":
+                # the lo operator is the f32 rounding remainder and the
+                # Dekker splitter is f32-specific — the hi half must be
+                # exactly float32
+                if not isinstance(self.A_dev, dev.DiaMatrix) \
+                        or jnp.dtype(self.solver_dtype) \
+                        != jnp.dtype(jnp.float32):
+                    raise ValueError(
+                        "refine_dtype='df32' needs a float32 DIA system "
+                        "matrix; use refine_dtype='float64'")
+                self.refine_mode = "df32"
+                self.A_dev64 = self._build_lo_operator(A)
+            else:
+                if not _jax.config.jax_enable_x64:
+                    import warnings
+                    warnings.warn(
+                        "refine>0 with refine_dtype='float64' requires "
+                        "jax_enable_x64; without it the float64 residual "
+                        "silently truncates to float32 and refinement "
+                        "gains nothing — enable x64, drop refine, or use "
+                        "refine_dtype='df32'")
+                self.refine_mode = "float64"
+                self.A_dev64 = dev.to_device(A, matrix_format,
+                                             self._wide_dtype())
         self._compiled = None
+
+    def _build_lo_operator(self, A):
+        """DIA matrix of the f32 rounding remainders: A ≈ A_hi + A_lo
+        with A_hi = self.A_dev (the f32 operator) — the low half of the
+        double-float pair, same offsets/layout (ops/dfloat.py)."""
+        return dev.csr_to_dia_remainder(A, self.A_dev)
 
     def rebuild(self, A):
         """Fast path for time-dependent problems: rebuild the hierarchy
@@ -108,8 +146,17 @@ class make_solver:
         self.A_host = A
         self.A_dev = dev.to_device(A, self.matrix_format, self.solver_dtype)
         if self.refine > 0:
-            self.A_dev64 = dev.to_device(A, self.matrix_format,
-                                         self._wide_dtype())
+            if self.refine_mode == "df32":
+                if not isinstance(self.A_dev, dev.DiaMatrix):
+                    raise ValueError(
+                        "rebuilt matrix is no longer DIA-eligible; "
+                        "df32 refinement needs a DIA system matrix — "
+                        "rebuild with matrix_format='dia' or construct "
+                        "a new solver with refine_dtype='float64'")
+                self.A_dev64 = self._build_lo_operator(A)
+            else:
+                self.A_dev64 = dev.to_device(A, self.matrix_format,
+                                             self._wide_dtype())
         self._compiled = None
 
     def _wide_dtype(self):
@@ -129,52 +176,115 @@ class make_solver:
         hist = got[3] if len(got) > 3 else None
         hist_n = iters          # history covers the initial solve only
         if self.refine > 0:
-            # correction-form iterative refinement (classic mixed-precision
-            # recipe, mixing.hpp's spirit taken further): the outer residual
-            # r = b − A x is evaluated in float64, the correction solve runs
-            # in the working precision — recovers true residuals far below
-            # the f32 evaluation floor at the cost of one f64 SpMV per
-            # restart
-            from jax import lax as _lax
-            A64 = A_dev64
-            wide = self._wide_dtype()
-            rhs64 = rhs.astype(wide)
-            nb = jnp.sqrt(jnp.abs(dev.inner_product(rhs64, rhs64)))
-            scale = jnp.where(nb > 0, nb, 1.0)
-            tol = getattr(self.solver, "tol", 1e-6)
+            # correction-form iterative refinement (classic mixed-
+            # precision recipe, mixing.hpp's spirit taken further): the
+            # outer residual r = b − A x is evaluated beyond the working
+            # precision, the correction solve runs in the working
+            # precision. Two residual evaluators share ONE loop:
+            #   float64 — wide operator (on TPU: software-emulated f64;
+            #             the r5 chip session measured it at ~1/3 of the
+            #             whole solve);
+            #   df32    — compensated two-f32 arithmetic (ops/dfloat.py)
+            #             at f32 hardware speed; the f32 rhs is treated
+            #             as exact (b_lo = 0) — for f64-critical rhs use
+            #             refine_dtype='float64'.
+            if self.refine_mode == "df32":
+                from amgcl_tpu.ops.dfloat import (dia_residual_df,
+                                                  df_add_vec)
+                A_lo = A_dev64      # the slot carries the lo operator
+                zeros = jnp.zeros_like(rhs)
 
-            def true_res(x64):
-                r = dev.residual(rhs64, A64, x64)
-                return r, jnp.sqrt(jnp.abs(dev.inner_product(r, r))) / scale
+                def true_res(st):
+                    xh, xl = st
+                    return dia_residual_df(
+                        A_dev.offsets, A_dev.data, A_lo.data, rhs,
+                        zeros, xh, xl)
 
-            def cond(st):
-                x64, r64, it, k, rt = st
-                return (rt > tol) & (k < self.refine)
+                def accumulate(st, dx):
+                    return df_add_vec(st[0], st[1], dx)
 
-            # stop correction solves exactly at the global absolute target
-            # when the solver supports a dynamic abstol (CG does)
-            import inspect
-            has_abstol = "abstol" in inspect.signature(
-                self.solver.solve).parameters
+                def finalize(st, rt, scale):
+                    import jax as _jax
+                    xh, xl = st
+                    if _jax.config.jax_enable_x64:
+                        # one wide combine at the very end — the loop
+                        # itself never touches emulated f64
+                        wide = self._wide_dtype()
+                        return xh.astype(wide) + xl.astype(wide), rt
+                    # without x64 the pair collapses back to ONE f32:
+                    # report the residual of the x actually returned,
+                    # not of the pair (which can be far better)
+                    xc = xh + xl
+                    r = dia_residual_df(A_dev.offsets, A_dev.data,
+                                        A_lo.data, rhs, zeros, xc,
+                                        zeros)
+                    return xc, jnp.sqrt(jnp.abs(
+                        dev.inner_product(r, r))) / scale
 
-            def body(st):
-                x64, r64, it, k, rt = st
-                kw = {}
-                if has_abstol:
-                    kw["abstol"] = jnp.abs(tol * scale).astype(
-                        rhs.real.dtype)
-                dx, it2 = self.solver.solve(
-                    A_dev, apply_precond, r64.astype(rhs.dtype),
-                    jnp.zeros_like(rhs), **kw)[:2]
-                x64 = x64 + dx.astype(wide)
-                r64, rt2 = true_res(x64)
-                return (x64, r64, it + it2, k + 1, rt2)
+                state0 = (x, zeros)
+                norm_src = rhs
+            else:
+                wide = self._wide_dtype()
+                rhs64 = rhs.astype(wide)
 
-            x64 = x.astype(wide)
-            r0, rt0 = true_res(x64)
-            x, _, iters, _, resid = _lax.while_loop(
-                cond, body, (x64, r0, iters, 0, rt0))
+                def true_res(st):
+                    return dev.residual(rhs64, A_dev64, st)
+
+                def accumulate(st, dx):
+                    return st + dx.astype(wide)
+
+                def finalize(st, rt, scale):
+                    return st, rt
+
+                state0 = x.astype(wide)
+                norm_src = rhs64
+            x, iters, resid = self._refine_loop(
+                A_dev, apply_precond, rhs, state0, iters, norm_src,
+                true_res, accumulate, finalize)
         return x, iters, resid, hist, hist_n
+
+    def _refine_loop(self, A_dev, apply_precond, rhs, state0, iters,
+                     norm_src, true_res, accumulate, finalize):
+        """Shared refinement scaffolding: while the scaled residual norm
+        of ``true_res(state)`` exceeds tol (up to ``refine`` restarts),
+        solve the correction in working precision and ``accumulate`` it
+        into the solution state; ``finalize`` maps the final state to
+        (x, resid)."""
+        from jax import lax as _lax
+        nb = jnp.sqrt(jnp.abs(dev.inner_product(norm_src, norm_src)))
+        scale = jnp.where(nb > 0, nb, 1.0)
+        tol = getattr(self.solver, "tol", 1e-6)
+
+        def res_norm(r):
+            return jnp.sqrt(jnp.abs(dev.inner_product(r, r))) / scale
+
+        def cond(st):
+            state, r, it, k, rt = st
+            return (rt > tol) & (k < self.refine)
+
+        # stop correction solves exactly at the global absolute target
+        # when the solver supports a dynamic abstol (CG does)
+        import inspect
+        has_abstol = "abstol" in inspect.signature(
+            self.solver.solve).parameters
+
+        def body(st):
+            state, r, it, k, rt = st
+            kw = {}
+            if has_abstol:
+                kw["abstol"] = jnp.abs(tol * scale).astype(rhs.real.dtype)
+            dx, it2 = self.solver.solve(
+                A_dev, apply_precond, r.astype(rhs.dtype),
+                jnp.zeros_like(rhs), **kw)[:2]
+            state = accumulate(state, dx)
+            r = true_res(state)
+            return (state, r, it + it2, k + 1, res_norm(r))
+
+        r0 = true_res(state0)
+        state, _, iters, _, rt = _lax.while_loop(
+            cond, body, (state0, r0, iters, 0, res_norm(r0)))
+        x, resid = finalize(state, rt, scale.astype(rhs.dtype))
+        return x, iters, resid
 
     def __call__(self, rhs, x0=None):
         n = self.A_host.nrows * self.A_host.block_size[0]

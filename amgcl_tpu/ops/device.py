@@ -278,6 +278,32 @@ def csr_to_dia(A: CSR, dtype=jnp.float32) -> DiaMatrix:
     return DiaMatrix(offsets.tolist(), jnp.asarray(data, dtype=dtype), A.shape)
 
 
+def csr_to_dia_remainder(A: CSR, hi: "DiaMatrix") -> "DiaMatrix":
+    """f32 DIA matrix of the rounding remainders A64 − f32(A64), laid
+    out along ``hi``'s offsets — the low half of the double-float
+    operator pair the df32 refinement residual streams (ops/dfloat.py).
+    Built against hi's offset order by construction, so it pairs with
+    any DIA build route (scatter, native, stencil-device)."""
+    assert not A.is_block
+    offs = np.asarray(hi.offsets, np.int64)
+    order = np.argsort(offs)
+    rows = A.expanded_rows()
+    d = A.col.astype(np.int64) - rows
+    idx_sorted = np.searchsorted(offs[order], d)
+    idx_sorted = np.clip(idx_sorted, 0, len(offs) - 1)
+    k = order[idx_sorted]
+    if not np.array_equal(offs[k], d):
+        raise ValueError(
+            "system matrix has entries outside the device operator's "
+            "diagonal set — cannot build the df32 low operator")
+    val64 = np.asarray(A.val, np.float64)
+    lo_val = (val64 - val64.astype(np.float32).astype(np.float64)) \
+        .astype(np.float32)
+    data = np.zeros((len(offs), A.nrows), np.float32)
+    data[k, rows] = lo_val
+    return DiaMatrix(hi.offsets, jnp.asarray(data), A.shape)
+
+
 def dia_efficiency(A: CSR):
     """(ndiags, fill_ratio) for the DIA packing of A — used by auto format
     selection; fill_ratio = stored / nnz. Only the offsets are computed —
