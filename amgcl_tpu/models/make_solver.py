@@ -112,6 +112,28 @@ class make_solver:
                         "matrix; use refine_dtype='float64'")
                 self.refine_mode = "df32"
                 self.A_dev64 = self._build_lo_operator(A)
+                if not self._df32_selfcheck(A):
+                    # error-free transforms assume every f32 op rounds
+                    # once — a backend compiling them with excess
+                    # precision or reassociation silently degrades the
+                    # compensated residual to the plain-f32 floor; ONE
+                    # on-device check against a host f64 reference
+                    # catches that class before it becomes a
+                    # convergence mystery
+                    import warnings
+                    warnings.warn(
+                        "df32 compensated residual failed its on-device "
+                        "accuracy self-check; falling back to "
+                        "refine_dtype='float64'")
+                    if not _jax.config.jax_enable_x64:
+                        warnings.warn(
+                            "refine>0 with refine_dtype='float64' "
+                            "requires jax_enable_x64; without it the "
+                            "float64 residual silently truncates to "
+                            "float32 and refinement gains nothing")
+                    self.refine_mode = "float64"
+                    self.A_dev64 = dev.to_device(A, matrix_format,
+                                                 self._wide_dtype())
             else:
                 if not _jax.config.jax_enable_x64:
                     import warnings
@@ -131,6 +153,37 @@ class make_solver:
         with A_hi = self.A_dev (the f32 operator) — the low half of the
         double-float pair, same offsets/layout (ops/dfloat.py)."""
         return dev.csr_to_dia_remainder(A, self.A_dev)
+
+    def _df32_selfcheck(self, A) -> bool:
+        """One-shot device-vs-host check of the compensated residual:
+        ||r_df − r64|| must sit well below the plain-f32 evaluation
+        floor on a random probe vector."""
+        from amgcl_tpu.ops.dfloat import dia_residual_df
+        rng = np.random.RandomState(23)
+        n = A.nrows
+        x32 = rng.rand(n).astype(np.float32)
+        # b = f32-rounded A x makes the true residual eps-small, i.e.
+        # TOTAL cancellation: the plain-f32 evaluation is ~100% wrong
+        # there (that is the floor refinement exists to beat) while a
+        # working compensated evaluation recovers it to ~eps² — the
+        # discriminating scenario (a random b would make r O(1) and
+        # both evaluations agree to eps·||r||)
+        ax64 = A.spmv(x32.astype(np.float64))
+        b32 = ax64.astype(np.float32)
+        r64 = b32.astype(np.float64) - ax64
+        zeros = jnp.zeros(n, jnp.float32)
+        # JITTED, like the production residual inside _solve_fn — an
+        # eager evaluation would not exercise the fused compilation
+        # regime whose reassociation the check exists to catch
+        r_df = np.asarray(jax.jit(dia_residual_df, static_argnums=0)(
+            self.A_dev.offsets, self.A_dev.data, self.A_dev64.data,
+            jnp.asarray(b32), zeros, jnp.asarray(x32), zeros),
+            np.float64)
+        r_f32 = np.asarray(dev.residual(
+            jnp.asarray(b32), self.A_dev, jnp.asarray(x32)), np.float64)
+        err_df = float(np.linalg.norm(r_df - r64))
+        err_f32 = float(np.linalg.norm(r_f32 - r64))
+        return err_df < 1e-2 * err_f32 + 1e-12 * n
 
     def rebuild(self, A):
         """Fast path for time-dependent problems: rebuild the hierarchy

@@ -355,7 +355,7 @@ def _timed_chain(fn_ops, reps, repeats, overhead):
     return (float(np.median(ts)) - overhead) / reps
 
 
-def _diff_timeit(fn, x0, reps=(50, 250), carry_plus_x0=False):
+def _diff_timeit(fn, x0, reps=(50, 250), carry_plus_x0=False, aux=None):
     """Per-op seconds for a shape-preserving ``fn`` by timing ONE jitted
     scan at two lengths and dividing the difference by the length delta.
     The per-dispatch tunnel round trip (~66 ms on the axon link, ms-scale
@@ -372,19 +372,22 @@ def _diff_timeit(fn, x0, reps=(50, 250), carry_plus_x0=False):
     r1, r2 = reps
 
     def chain(r):
-        def many(x):
+        # ``aux`` (operator arrays) rides through jit as an ARGUMENT —
+        # closure constants embed the data in the uploaded MLIR and the
+        # tunnel's remote_compile rejects multi-GB programs
+        def many(a, x):
             def body(c, _):
-                out = fn(c) * 0.5
+                out = (fn(a, c) if aux is not None else fn(c)) * 0.5
                 return (out + x if carry_plus_x0 else out), None
             out, _ = lax.scan(body, x, None, length=r)
             return out.sum()
 
         f = jax.jit(many)
-        float(f(x0))                    # compile + warm
+        float(f(aux, x0))               # compile + warm
         ts = []
         for _ in range(5):
             t0 = time.perf_counter()
-            float(f(x0))
+            float(f(aux, x0))
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts))
 
@@ -604,6 +607,32 @@ def _bench_unstructured(on_tpu):
         elif on_tpu:
             out["well_pallas_us"] = None
             out["note"] = "in-kernel gather not legalized on this backend"
+
+    # gather-free dense-window format (ops/densewin.py): storage-for-
+    # bandwidth trade; on TPU this is the production unstructured path
+    # (auto-selected), so its SpMV row is the one the solve runs on
+    try:
+        from amgcl_tpu.ops.densewin import (csr_to_dense_window,
+                                            dense_window_spmv)
+        # TPU-only: the build materializes multi-GB dense blocks and
+        # nothing times them off-chip
+        D = csr_to_dense_window(A, jnp.float32, require_kernel=True) \
+            if on_tpu else None
+        if D is not None:
+            out["dwin_win"] = D.win
+            out["dwin_gb"] = round(D.bytes() / 1e9, 2)
+            if on_tpu:
+                out["dwin_spmv_us"] = round(_diff_timeit(
+                    lambda a, v: dense_window_spmv(
+                        a[0], a[1], v, D.win, D.shape[0]),
+                    x, reps=(10, 30), carry_plus_x0=True,
+                    aux=(D.window_starts, D.blocks)) * 1e6, 1)
+                out["dwin_speedup_vs_take"] = round(
+                    out["ell_take_us"] / _floor(out["dwin_spmv_us"]), 2)
+        else:
+            out["dwin_win"] = None
+    except Exception as e:
+        out["dwin_error"] = repr(e)[:200]
 
     # end-to-end SOLVE at the poisson3Db profile (BASELINE tutorial rows:
     # builtin 0.592 s / GTX 1050 Ti CUDA 0.171 s, AMG(SA)+BiCGStab) — a
