@@ -29,3 +29,16 @@ x, info = solve(rhs)
 r = np.linalg.norm(rhs - A.spmv(np.asarray(x))) / np.linalg.norm(rhs)
 print("f32 precond / f64 solver: %d iterations, true residual %.2e"
       % (info.iters, r))
+
+# The TPU-native alternative: solve ENTIRELY in f32 and recover the
+# accuracy with iterative refinement whose outer residual is evaluated
+# in compensated two-f32 arithmetic (ops/dfloat.py) — float64-class
+# residuals without touching f64 compute, which TPUs emulate in
+# software (refine_dtype='auto' picks this on TPU automatically).
+solve_df = make_solver(A, AMGParams(dtype=jnp.float32),
+                       CG(tol=1e-7), refine=3, refine_dtype="df32")
+x2, info2 = solve_df(rhs)
+r2 = np.linalg.norm(rhs - A.spmv(np.asarray(x2, np.float64))) \
+    / np.linalg.norm(rhs)
+print("f32 + df32-refinement:    %d iterations, true residual %.2e"
+      % (info2.iters, r2))
