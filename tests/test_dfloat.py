@@ -97,6 +97,23 @@ def test_refine_df32_end_to_end():
     assert tr < tr0 or tr < 1e-7
 
 
+def test_refine_df32_bicgstab():
+    """df32 refinement through a solver WITHOUT the abstol kwarg (the
+    has_abstol=False leg of the shared loop)."""
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+    A, rhs = poisson3d(16)
+    s = make_solver(A, AMGParams(dtype=jnp.float32),
+                    BiCGStab(maxiter=100, tol=1e-7), refine=3,
+                    refine_dtype="df32")
+    assert s.refine_mode == "df32"
+    x, info = s(rhs)
+    tr = np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64))) \
+        / np.linalg.norm(rhs)
+    assert tr < 2e-7, tr
+
+
 def test_refine_df32_needs_dia():
     from amgcl_tpu.models.make_solver import make_solver
     from amgcl_tpu.models.amg import AMGParams
