@@ -115,6 +115,30 @@ class TestTentativeOps:
         np.testing.assert_allclose(np.asarray(T.rmv(jnp.asarray(yf))),
                                    P.T @ yf, rtol=1e-12)
 
+    def test_grid_tentative_mxu_formulation_matches(self):
+        """The TPU matmul route (0/1 pair-sum operators on the MXU)
+        must agree with the explicit tentative P — including
+        non-multiple extents and odd blocks. (Compared against the CSR
+        ground truth, NOT against T.mv/rmv, which dispatch to this very
+        route on TPU backends.)"""
+        for dims, blocks in (((5, 7, 6), (2, 2, 2)),
+                             ((8, 8, 8), (2, 2, 2)),
+                             ((4, 9, 5), (1, 3, 2))):
+            agg, n_agg, coarse, _ = grid_aggregates(dims, blocks)
+            T = GridTentative(dims, blocks, coarse)
+            n = int(np.prod(dims))
+            P = sp.csr_matrix(
+                (np.ones(n), (np.arange(n), np.asarray(agg))),
+                shape=(n, n_agg))
+            xc = np.random.RandomState(3).rand(n_agg)
+            yf = np.random.RandomState(4).rand(n)
+            np.testing.assert_allclose(
+                np.asarray(T._mv_mxu(jnp.asarray(xc, jnp.float32))),
+                P @ xc, rtol=1e-6)
+            np.testing.assert_allclose(
+                np.asarray(T._rmv_mxu(jnp.asarray(yf, jnp.float32))),
+                P.T @ yf, rtol=1e-6)
+
     def test_agg_tentative_matches_csr(self):
         rng = np.random.RandomState(2)
         n, n_agg = 200, 37
