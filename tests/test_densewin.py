@@ -92,6 +92,44 @@ def test_budget_gates():
     assert csr_to_dense_window(Ap, jnp.complex64) is None
 
 
+def test_amg_solve_on_dense_window_hierarchy(monkeypatch):
+    """End-to-end AMG+BiCGStab with dense-window level operators driven
+    through the Pallas kernels in interpret mode — the closest possible
+    rehearsal of the TPU auto-selected path, which no CPU CI reaches
+    through to_device's backend gate."""
+    import jax.numpy as jnp
+    from amgcl_tpu.ops.densewin import DenseWindowMatrix
+    monkeypatch.setenv("AMGCL_TPU_PALLAS_INTERPRET", "1")
+    real_to_device = dev.to_device
+
+    def dwin_to_device(A, fmt="auto", dtype=jnp.float32, **kw):
+        if fmt == "auto" and not A.is_block:
+            D = csr_to_dense_window(A, dtype)
+            if D is not None:
+                return D
+        return real_to_device(A, fmt, dtype, **kw)
+
+    monkeypatch.setattr(dev, "to_device", dwin_to_device)
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.bicgstab import BiCGStab
+    Ap, rhs = _small_fe(n=1500, seed=6)
+    # coarse_enough forces a real multilevel hierarchy at this size so
+    # the dwin transfers/smoother seams all engage
+    s = make_solver(Ap, AMGParams(dtype=jnp.float32, coarse_enough=200),
+                    BiCGStab(maxiter=200, tol=1e-7))
+    assert isinstance(s.A_dev, DenseWindowMatrix)
+    assert any(isinstance(lv.A, DenseWindowMatrix)
+               for lv in s.precond.hierarchy.levels)
+    x, info = s(rhs)
+    tr = np.linalg.norm(rhs - Ap.spmv(np.asarray(x, np.float64))) \
+        / np.linalg.norm(rhs)
+    # the 1/h² fixture floors an UNREFINED f32 solve around 2e-4; the
+    # reference-format run measures the same (1.7e-4) — the assertion
+    # is format-equivalence, not refined accuracy
+    assert tr < 1e-3, (tr, int(info.iters))
+
+
 def test_empty_tile_rows():
     # a matrix whose second 64-row tile is entirely empty
     from amgcl_tpu.ops.csr import CSR
