@@ -370,13 +370,18 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                     and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
                 return csr_to_dia(A, dtype)
         if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
-            if not A.is_block and jax.default_backend() == "tpu":
+            if not A.is_block and A.shape[0] == A.shape[1] \
+                    and jax.default_backend() == "tpu":
                 # gather-free dense-window blocks (ops/densewin.py): on
                 # real TPU the windowed-ELL Pallas gather does not
                 # legalize and the XLA take path runs at gather speed
                 # (~1/800 of HBM bw, r5 measurement) — trading HBM
                 # capacity (n·win·itemsize, budget-gated) for streaming
-                # wins whenever the matrix has banded locality
+                # wins whenever the matrix has banded locality. SQUARE
+                # operators only: auto-converting every rectangular
+                # transfer too would multiply the per-matrix budget by
+                # the hierarchy depth with no global accounting
+                # (explicit fmt='dwin' remains available)
                 from amgcl_tpu.ops.densewin import csr_to_dense_window
                 D = csr_to_dense_window(A, dtype, require_kernel=True)
                 if D is not None:
