@@ -43,7 +43,10 @@ from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.ops.pallas_spmv import pallas_mode, probe_report
 
 _TILE = 64                 # rows per dense block
-_WIN_ALIGN = 1024          # window starts/extent alignment (1-D DMA tiling)
+# window starts/extent alignment — the SAME constant tile_windows()
+# floors with (a local copy could drift and make pl.multiple_of assert
+# an alignment the builder no longer guarantees)
+from amgcl_tpu.ops.unstructured import _WIN_ALIGN  # noqa: E402
 _DWIN_OK: dict = {}
 
 
