@@ -39,6 +39,10 @@ def main(argv=None):
                          "assembly — precond.class=strip_amg)")
     ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
     ap.add_argument("-x", "--x0", help="initial guess file")
+    ap.add_argument("--telemetry", metavar="PATH",
+                    help="append JSONL telemetry (solve report, hierarchy "
+                         "stats, profiler tree) to PATH; the solver's own "
+                         "'solve' event rides the same sink")
     args = ap.parse_args(argv)
 
     # honor 64-bit dtype requests before any jax array is created
@@ -54,8 +58,16 @@ def main(argv=None):
     from amgcl_tpu.models.runtime import make_solver_from_config
     from amgcl_tpu.utils.adapters import Reordered
     from amgcl_tpu.ops.csr import CSR
+    from amgcl_tpu import telemetry
 
-    prof = Profiler()
+    if args.telemetry:
+        # process-global sink: make_solver's 'solve' event and the CLI's
+        # own records all land in the same JSONL file
+        telemetry.set_default_sink(telemetry.JsonlSink(args.telemetry))
+
+    # device-synced scopes: totals mean wall-clock device time, not
+    # dispatch time (utils/profiler.py)
+    prof = Profiler.device()
 
     with prof.scope("read"):
         if args.size:
@@ -115,10 +127,22 @@ def main(argv=None):
 
     inner = getattr(solve, "solve", solve)
     print(getattr(inner, "__repr__", lambda: "")() or "")
-    print("Iterations: %d" % info.iters)
-    print("Error:      %.6e" % info.resid)
+    print(info)          # SolveReport.__str__: iterations/error/rate/wall
     print()
     print(prof)
+
+    if args.telemetry:
+        # structured duplicates of the text report, one JSONL record each
+        precond = getattr(inner, "precond", None) \
+            or getattr(inner, "host_amg", None)
+        stats = getattr(precond, "hierarchy_stats", None)
+        cli_rec = info.to_dict(with_history=False)
+        cli_rec.pop("hierarchy", None)   # the dedicated event below
+        telemetry.emit(event="cli", argv=list(argv) if argv else
+                       sys.argv[1:], **cli_rec)
+        if callable(stats):
+            telemetry.emit(event="hierarchy", **stats())
+        telemetry.emit(event="profile", **prof.to_dict())
 
     if args.output:
         xa = np.asarray(x)

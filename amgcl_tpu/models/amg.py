@@ -23,6 +23,7 @@ from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
 from amgcl_tpu.coarsening.stall import CoarseningStall
 from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.solver.direct import DenseDirectSolver
+from amgcl_tpu.telemetry.tracing import phase
 
 
 @dataclass
@@ -87,45 +88,60 @@ class Hierarchy:
     # -- the multigrid cycle (reference: amgcl/amg.hpp:514-553) -------------
 
     def cycle(self, i, f):
-        """One multigrid cycle at level i for rhs f, zero initial guess."""
+        """One multigrid cycle at level i for rhs f, zero initial guess.
+
+        Every stage is wrapped in a ``jax.named_scope`` (telemetry/
+        tracing.py) so a ``jax.profiler`` trace groups device time into the
+        reference profiler tree's five phases — pre_smooth / restrict /
+        coarse_solve / prolong / post_smooth — per level; the fused
+        whole-leg kernels get their own down_fused / up_fused scopes."""
         lv = self.levels[i]
         if i == len(self.levels) - 1:
-            if self.coarse is not None:
-                return self.coarse.solve(f)
-            u = lv.relax.apply(lv.A, f)
-            return u
+            with phase("level%d/coarse_solve" % i):
+                if self.coarse is not None:
+                    return self.coarse.solve(f)
+                u = lv.relax.apply(lv.A, f)
+                return u
         fc = None
         if self.npre == 1 and lv.down is not None \
                 and lv.down.w is not None:
             # whole down-sweep in one pass: pre-smooth from zero,
             # residual, filtered tentative restriction
-            u, fc = lv.down.zero(f)
+            with phase("level%d/down_fused" % i):
+                u, fc = lv.down.zero(f)
         else:
-            if self.npre > 0:
-                u = lv.relax.apply(lv.A, f)   # first pre-sweep from zero
-                for _ in range(self.npre - 1):
-                    u = lv.relax.apply_pre(lv.A, f, u)
-            else:
-                u = dev.clear(f)
+            with phase("level%d/pre_smooth" % i):
+                if self.npre > 0:
+                    u = lv.relax.apply(lv.A, f)  # first pre-sweep from zero
+                    for _ in range(self.npre - 1):
+                        u = lv.relax.apply_pre(lv.A, f, u)
+                else:
+                    u = dev.clear(f)
             if lv.down is not None:
                 # one-pass residual + filtered tentative restriction
-                fc = lv.down(f, u)
+                with phase("level%d/restrict" % i):
+                    fc = lv.down(f, u)
         if fc is None:
-            r = dev.residual(f, lv.A, u)
-            fc = dev.spmv(lv.R, r)
+            with phase("level%d/restrict" % i):
+                r = dev.residual(f, lv.A, u)
+                fc = dev.spmv(lv.R, r)
         uc = self.cycle(i + 1, fc)
         for _ in range(self.ncycle - 1):      # W-cycle: extra coarse visits
             rc = dev.residual(fc, self.levels[i + 1].A, uc)
             uc = uc + self.cycle(i + 1, rc)
         if lv.up is not None and self.npost >= 1:
             # one-pass prolong + correct + first post-smoothing sweep
-            u = lv.up(f, u, uc)
+            with phase("level%d/up_fused" % i):
+                u = lv.up(f, u, uc)
             extra = self.npost - 1
         else:
-            u = u + dev.spmv(lv.P, uc)
+            with phase("level%d/prolong" % i):
+                u = u + dev.spmv(lv.P, uc)
             extra = self.npost
-        for _ in range(extra):
-            u = lv.relax.apply_post(lv.A, f, u)
+        if extra > 0:
+            with phase("level%d/post_smooth" % i):
+                for _ in range(extra):
+                    u = lv.relax.apply_post(lv.A, f, u)
         return u
 
     def apply(self, r):
@@ -335,27 +351,59 @@ class AMG:
 
     # -- observability (reference: amgcl/amg.hpp:560-598) -------------------
 
+    def hierarchy_stats(self):
+        """Structured hierarchy report: per-level rows/nnz/dtype/device
+        format plus grid and operator complexity — the machine-readable
+        source both ``__repr__`` and the JSONL telemetry path render from
+        (reference prints this as text only, amg.hpp:560-598)."""
+        host = self.host_levels
+        nnz0 = host[0][0].nnz
+        rows0 = host[0][0].nrows
+        dev_levels = self.hierarchy.levels
+        levels = []
+        for i, (Ai, _, _) in enumerate(host):
+            lv = dev_levels[i] if i < len(dev_levels) else None
+            A_dev = getattr(lv, "A", None)
+            levels.append({
+                "level": i,
+                "rows": int(Ai.nrows),
+                # device-built meta rows carry nrows/nnz but no block info
+                "unknowns": int(Ai.nrows
+                                * getattr(Ai, "block_size", (1, 1))[0]),
+                "nnz": int(Ai.nnz),
+                "format": type(A_dev).__name__ if A_dev is not None
+                else None,
+                "fused": ("d" if getattr(lv, "down", None) is not None
+                          else "")
+                + ("u" if getattr(lv, "up", None) is not None else ""),
+            })
+        return {
+            "n_levels": len(host),
+            "operator_complexity":
+                sum(l[0].nnz for l in host) / max(nnz0, 1),
+            "grid_complexity":
+                sum(l[0].nrows for l in host) / max(rows0, 1),
+            "dtype": str(jnp.dtype(self.prm.dtype)),
+            "bytes": int(self.bytes()),
+            "levels": levels,
+        }
+
     def __repr__(self):
-        nnz0 = self.host_levels[0][0].nnz
-        total_nnz = sum(l[0].nnz for l in self.host_levels)
+        st = self.hierarchy_stats()
         lines = [
-            "Number of levels:    %d" % len(self.host_levels),
-            "Operator complexity: %.2f" % (total_nnz / max(nnz0, 1)),
-            "Grid complexity:     %.2f" % (
-                sum(l[0].nrows for l in self.host_levels)
-                / max(self.host_levels[0][0].nrows, 1)),
-            "Memory footprint:    %s" % _human_bytes(self.bytes()),
+            "Number of levels:    %d" % st["n_levels"],
+            "Operator complexity: %.2f" % st["operator_complexity"],
+            "Grid complexity:     %.2f" % st["grid_complexity"],
+            "Memory footprint:    %s" % _human_bytes(st["bytes"]),
             "",
             "level     unknowns       nonzeros",
             "---------------------------------",
         ]
-        for i, (Ai, _, _) in enumerate(self.host_levels):
-            lines.append("%5d %12d %14d" % (i, Ai.nrows, Ai.nnz))
-        fused = [
-            "%d%s%s" % (i, "d" if lv.down is not None else "",
-                        "u" if lv.up is not None else "")
-            for i, lv in enumerate(self.hierarchy.levels)
-            if lv.down is not None or lv.up is not None]
+        for lv in st["levels"]:
+            lines.append("%5d %12d %14d"
+                         % (lv["level"], lv["rows"], lv["nnz"]))
+        fused = ["%d%s" % (lv["level"], lv["fused"])
+                 for lv in st["levels"] if lv["fused"]]
         if fused:
             lines.append("fused V-cycle kernels (level+direction): "
                          + " ".join(fused))

@@ -10,8 +10,8 @@ the apply casts the residual down and the correction back up.
 
 from __future__ import annotations
 
-from dataclasses import dataclass
-from typing import Any, Optional
+import time
+from typing import Any
 
 import numpy as np
 import jax
@@ -21,17 +21,12 @@ from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.ops import device as dev
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.telemetry import SolveReport, phase, emit as telemetry_emit
 
-
-@dataclass
-class SolverInfo:
-    iters: int
-    resid: float
-    history: Any = None   # per-iteration relative residuals when recorded
-
-    def __iter__(self):  # (iters, resid) tuple-unpacking like the reference
-        yield self.iters
-        yield self.resid
+#: historical name — every solve now returns the full structured report
+#: (telemetry/report.py); the old (iters, resid, history) construction and
+#: ``iters, error = info`` unpacking are preserved by SolveReport itself.
+SolverInfo = SolveReport
 
 
 class make_solver:
@@ -211,6 +206,7 @@ class make_solver:
                 self.A_dev64 = dev.to_device(A, self.matrix_format,
                                              self._wide_dtype())
         self._compiled = None
+        self._hier_stats_cache = None
 
     def _wide_dtype(self):
         return jnp.complex128 if jnp.issubdtype(
@@ -221,10 +217,12 @@ class make_solver:
         pdtype = self.precond_dtype
 
         def apply_precond(r):
-            z = hier.apply(r.astype(pdtype))
+            with phase("precond"):
+                z = hier.apply(r.astype(pdtype))
             return z.astype(rhs.dtype)
 
-        got = self.solver.solve(A_dev, apply_precond, rhs, x0)
+        with phase("krylov/" + type(self.solver).__name__):
+            got = self.solver.solve(A_dev, apply_precond, rhs, x0)
         x, iters, resid = got[:3]
         hist = got[3] if len(got) > 3 else None
         hist_n = iters          # history covers the initial solve only
@@ -354,7 +352,9 @@ class make_solver:
             x0 = jnp.asarray(x0, dtype=self.solver_dtype)
         else:
             x0 = jnp.zeros_like(rhs)
-        if self._compiled is None:
+        t0 = time.perf_counter()
+        first_call = self._compiled is None
+        if first_call:
             self._compiled = jax.jit(self._solve_fn)
         got = self._compiled(self.A_dev, self.A_dev64,
                              self.precond.hierarchy, rhs, x0)
@@ -371,7 +371,31 @@ class make_solver:
             # slice by the recorded count — NaN filtering would also drop
             # genuine NaN residuals from a breakdown
             hist = np.asarray(fetched[2])[:int(fetched[3])]
-        return x, SolverInfo(int(iters), float(resid), hist)
+        wall = time.perf_counter() - t0
+        report = SolveReport(
+            int(iters), float(resid), hist, wall_time_s=wall,
+            solver=type(self.solver).__name__,
+            hierarchy=self._hierarchy_stats(),
+            # the first call's wall time includes jit trace + compile —
+            # flag it so sink consumers can separate it from steady state
+            extra={"first_call": True} if first_call else {})
+        # process-global JSONL sink (telemetry/sink.py); the NullSink check
+        # keeps the unconfigured hot path free of the to_dict() conversion
+        # (this function already fights per-call host overhead — see the
+        # single-fetch comment above)
+        from amgcl_tpu.telemetry.sink import NullSink, get_default_sink
+        if not isinstance(get_default_sink(), NullSink):
+            telemetry_emit(report.to_dict(), event="solve", n=n)
+        return x, report
+
+    def _hierarchy_stats(self):
+        # invariant per built hierarchy — cached; rebuild() invalidates
+        cached = getattr(self, "_hier_stats_cache", None)
+        if cached is None:
+            stats = getattr(self.precond, "hierarchy_stats", None)
+            cached = stats() if callable(stats) else None
+            self._hier_stats_cache = cached
+        return cached
 
     def __repr__(self):
         return ("make_solver\n===========\nSolver: %s\n\nPreconditioner:\n%r"

@@ -31,6 +31,7 @@ from jax import lax
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.telemetry.tracing import phase as _phase
 
 # Pad ELL row widths up to a multiple of this (lane friendliness / fewer
 # distinct compiled shapes across levels).
@@ -401,10 +402,15 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
 
 
 # -- backend primitives (reference: amgcl/backend/interface.hpp:253-443) ----
+#
+# The hot primitives carry a named scope (telemetry/tracing.py) tagged with
+# the operator's device format, so a jax.profiler trace attributes device
+# time to "spmv/DiaMatrix", "residual/EllMatrix", ... — zero runtime cost.
 
 def spmv(A, x):
     """y = A x."""
-    return A.mv(x)
+    with _phase("spmv/" + type(A).__name__):
+        return A.mv(x)
 
 
 def residual(f, A, x):
@@ -415,6 +421,11 @@ def residual(f, A, x):
     A x because XLA cannot fuse across the pallas_call boundary. Plain
     ELL/Dense stay composed: their mv is pure XLA, and XLA fuses the
     subtraction into the gather/matmul consumer already."""
+    with _phase("residual/" + type(A).__name__):
+        return _residual(f, A, x)
+
+
+def _residual(f, A, x):
     if isinstance(A, DiaMatrix):
         ip = A._pallas_mode(x, f)
         if ip is not None:
@@ -446,6 +457,11 @@ def scaled_correction(A, w, f, x):
     per-node (b, b) scale), else None — the smoother seam asks here so
     format dispatch lives next to residual/spmv_dots instead of inside
     every smoother."""
+    with _phase("scaled_correction/" + type(A).__name__):
+        return _scaled_correction(A, w, f, x)
+
+
+def _scaled_correction(A, w, f, x):
     if isinstance(A, DiaMatrix) and w.ndim == 1:
         ip = A._pallas_mode(x, f, w)
         if ip is not None:
@@ -508,6 +524,11 @@ def spmv_dots(A, x, w=None, ip=inner_product):
     OUTSIDE the kernel, and complex dtypes need the conjugating vdot;
     both fall back — the itemsize gate in _pallas_mode already excludes
     complex)."""
+    with _phase("spmv_dots/" + type(A).__name__):
+        return _spmv_dots(A, x, w, ip)
+
+
+def _spmv_dots(A, x, w=None, ip=inner_product):
     if isinstance(A, DiaMatrix) and ip is inner_product \
             and A.shape[0] == A.shape[1]:
         m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)

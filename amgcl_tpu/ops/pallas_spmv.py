@@ -25,6 +25,8 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from amgcl_tpu.telemetry.tracing import phase as _tel_phase
+
 
 def pallas_enabled() -> bool:
     """Default ON (the kernel is 6x faster than XLA's lowering for the
@@ -461,12 +463,14 @@ def dia_spmv_dot(offsets, data, x, tile=None,
 def dia_residual(offsets, data, f, x, tile=None,
                  interpret: bool = False, db=None):
     """r = f − A x in one pass (A in DIA storage, square or rectangular)."""
-    return _dia_fused(offsets, data, f, x, None, "residual", tile,
-                      interpret, db)
+    with _tel_phase("pallas/dia_residual"):
+        return _dia_fused(offsets, data, f, x, None, "residual", tile,
+                          interpret, db)
 
 
 def dia_scaled_correction(offsets, data, w, f, x, tile=None,
                           interpret: bool = False, db=None):
     """x + w ∘ (f − A x) in one pass — a damped-Jacobi/SPAI-0 sweep."""
-    return _dia_fused(offsets, data, f, x, w, "correction", tile,
-                      interpret, db)
+    with _tel_phase("pallas/dia_scaled_correction"):
+        return _dia_fused(offsets, data, f, x, w, "correction", tile,
+                          interpret, db)

@@ -49,6 +49,7 @@ import jax.numpy as jnp
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.pallas_spmv import probe_report
+from amgcl_tpu.telemetry.tracing import phase as _tel_phase
 
 
 _VMEM_CAP_BYTES = 12 << 20
@@ -326,19 +327,21 @@ class FusedDownSweep:
         return cls(*children, *aux)
 
     def __call__(self, f, u):
-        rc = fused_down_sweep(
-            self.a_flat, self.mt_flat, self.sy, self.sx, f, u,
-            self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
-            zero_guess=False, interpret=self.interpret)
+        with _tel_phase("pallas/fused_down"):
+            rc = fused_down_sweep(
+                self.a_flat, self.mt_flat, self.sy, self.sx, f, u,
+                self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
+                zero_guess=False, interpret=self.interpret)
         return rc.reshape(-1)
 
     def zero(self, f):
         """(u, fc) from rhs alone — the whole npre=1 down-sweep."""
         n = int(np.prod(self.dims))
-        rc, u = fused_down_sweep(
-            self.a_flat, self.mt_flat, self.sy, self.sx, f, self.w,
-            self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
-            zero_guess=True, interpret=self.interpret)
+        with _tel_phase("pallas/fused_down_zero"):
+            rc, u = fused_down_sweep(
+                self.a_flat, self.mt_flat, self.sy, self.sx, f, self.w,
+                self.offs_a, self.offs_m, self.dims, self.coarse, self.H,
+                zero_guess=True, interpret=self.interpret)
         return u[:n], rc.reshape(-1)
 
     def bytes(self):
@@ -543,10 +546,11 @@ class FusedUpSweep:
                                self.coarse[1], self.coarse[2])
         rc3p = jnp.pad(uc.reshape(c2, cv[0], cv[1]),
                        ((hp, hp), (0, 0), (0, 0)))
-        return fused_up_sweep(
-            self.a_data, self.m_flat, self.syt, self.sxt, rc3p,
-            f, self.w, u, self.offs_a, self.offs_m, self.dims,
-            self.coarse, halo_planes=hp, interpret=self.interpret)
+        with _tel_phase("pallas/fused_up"):
+            return fused_up_sweep(
+                self.a_data, self.m_flat, self.syt, self.sxt, rc3p,
+                f, self.w, u, self.offs_a, self.offs_m, self.dims,
+                self.coarse, halo_planes=hp, interpret=self.interpret)
 
     def bytes(self):
         return sum(a.size * a.dtype.itemsize

@@ -1,0 +1,35 @@
+"""shard_map import/kwarg compatibility across jax versions.
+
+Newer jax exposes ``jax.shard_map`` with a ``check_vma`` flag; older
+releases (<= 0.4.x) only have ``jax.experimental.shard_map.shard_map``
+whose equivalent flag is ``check_rep``. The distributed layer is written
+against the new surface; this shim maps it onto whichever one exists so
+``from amgcl_tpu.parallel.compat import shard_map`` works everywhere.
+"""
+
+from __future__ import annotations
+
+try:
+    from jax import shard_map as _shard_map          # jax >= 0.5
+    _FLAG = "check_vma"
+except ImportError:                                  # jax 0.4.x
+    from jax.experimental.shard_map import shard_map as _shard_map
+    _FLAG = "check_rep"
+
+
+def shard_map(f, **kw):
+    for a, b in (("check_vma", "check_rep"), ("check_rep", "check_vma")):
+        if a in kw and _FLAG == b:
+            kw[b] = kw.pop(a)
+    return _shard_map(f, **kw)
+
+
+def axis_size(name) -> int:
+    """Static size of a named mesh axis from inside shard_map —
+    ``jax.lax.axis_size`` on new jax, ``jax.core.axis_frame`` (which
+    returns the int directly) on 0.4.x."""
+    from jax import lax as _lax
+    if hasattr(_lax, "axis_size"):
+        return _lax.axis_size(name)
+    import jax.core as _core
+    return int(_core.axis_frame(name))

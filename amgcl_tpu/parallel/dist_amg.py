@@ -27,7 +27,9 @@ from typing import Any, Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from amgcl_tpu.parallel.compat import shard_map, \
+    axis_size as _axis_size
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
@@ -300,7 +302,7 @@ class DistHierarchy:
         lv = rep.levels[0]
         A = lv.A
         n = A.shape[0]
-        nd = lax.axis_size(ROWS_AXIS)
+        nd = _axis_size(ROWS_AXIS)
         nloc = -(-n // nd)
         n_pad = nloc * nd
         s = lax.axis_index(ROWS_AXIS)
@@ -406,7 +408,7 @@ class DistHierarchy:
         nloc = r.shape[0]
         r_full = lax.all_gather(r, ROWS_AXIS, tiled=True)[:n_rep]
         u_full = self.rep.apply(r_full)
-        pad = jnp.zeros(nloc * lax.axis_size(ROWS_AXIS), u_full.dtype)
+        pad = jnp.zeros(nloc * _axis_size(ROWS_AXIS), u_full.dtype)
         pad = lax.dynamic_update_slice(pad, u_full, (0,))
         s = lax.axis_index(ROWS_AXIS)
         return lax.dynamic_slice(pad, (s * nloc,), (nloc,))
@@ -755,11 +757,24 @@ class DistAMGSolver:
         x0_p = jnp.zeros_like(rhs_p) if x0 is None else put_sharded(
             _pad_vec(np.asarray(x0), self.n_pad // nd, nd, dtype),
             self.mesh)
-        if self._compiled is None:
+        import time as _time
+        t0 = _time.perf_counter()
+        first_call = self._compiled is None
+        if first_call:
             self._compiled = self._build_compiled()
         x, it, res = self._compiled(self.hier, rhs_p, x0_p)
         from amgcl_tpu.parallel.mesh import host_full
-        return host_full(x)[:self.n], SolverInfo(int(it), float(res))
+        from amgcl_tpu.telemetry import emit as _tel_emit
+        # it/res land here already mesh-reduced (psum dots, replicated
+        # out-specs) — the report is identical on every shard
+        info = SolverInfo(
+            int(it), float(res),
+            wall_time_s=_time.perf_counter() - t0,
+            solver=type(self.solver).__name__,
+            extra={"devices": int(nd),
+                   **({"first_call": True} if first_call else {})})
+        _tel_emit(info.to_dict(), event="dist_solve", n=self.n)
+        return host_full(x)[:self.n], info
 
     def __repr__(self):
         return ("DistAMGSolver over %d devices\n%r"

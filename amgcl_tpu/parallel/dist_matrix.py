@@ -26,6 +26,7 @@ from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
 from amgcl_tpu.ops.device import csr_to_dia
+from amgcl_tpu.parallel.compat import axis_size as _axis_size
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
 
 
@@ -106,7 +107,7 @@ def dia_halo_mv(data_l, flat_offs, x_l):
         return sum(data_l[k] * x_l for k in range(len(flat_offs))) \
             if flat_offs else jnp.zeros(nl, acc_dt)
 
-    nd = jax.lax.axis_size(ROWS_AXIS)
+    nd = _axis_size(ROWS_AXIS)
     if nd > 1 and w > nl:
         # Diagonal reach exceeds one neighbour slab: a single ring
         # exchange cannot supply the halo (x_l[-w:] would clamp to nl

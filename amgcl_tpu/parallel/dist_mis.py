@@ -25,7 +25,8 @@ from functools import lru_cache
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from amgcl_tpu.parallel.compat import shard_map
 from jax.sharding import PartitionSpec as P
 
 from amgcl_tpu.ops.csr import CSR

@@ -171,8 +171,12 @@ class MultihostComm(LocalComm):
 
     def _allgather_np(self, arr, combine):
         from jax.experimental import multihost_utils
-        g = multihost_utils.process_allgather(np.asarray(arr))
-        return combine(np.asarray(g), axis=0)
+        a = np.asarray(arr)
+        g = np.asarray(multihost_utils.process_allgather(a))
+        # jax versions disagree on whether the process axis is stacked
+        # (nproc, *shape) or tiled ((nproc*n0, ...)); normalize to stacked
+        g = g.reshape((-1,) + a.shape)
+        return combine(g, axis=0)
 
     def max_scalar(self, per_shard) -> float:
         vals = [v for v in per_shard if v is not None]
@@ -402,7 +406,8 @@ class MultihostComm(LocalComm):
 def _compiled_alltoall(mesh, C, kind):
     """One jitted shard_map all_to_all for (nd, nd, C, ...) payloads."""
     import jax
-    from jax import lax, shard_map
+    from jax import lax
+    from amgcl_tpu.parallel.compat import shard_map
     from jax.sharding import PartitionSpec as P
 
     def run(idx, val):

@@ -15,7 +15,8 @@ from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from amgcl_tpu.parallel.compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from amgcl_tpu.parallel.mesh import ROWS_AXIS
@@ -65,13 +66,25 @@ def _compiled_dist_cg(mesh, offsets, shape, maxiter, tol):
     return jax.jit(fn)
 
 
+class _DistResult(tuple):
+    """(x, iters, rel_resid) that additionally carries ``.report`` — the
+    telemetry SolveReport built from the mesh-reduced scalars (the iters/
+    residual out-specs are already psum-globalized and replicated)."""
+    report = None
+
+
 def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
             maxiter: int = 200, tol: float = 1e-6):
     """Jacobi-preconditioned distributed CG. ``dinv`` is the (sharded)
     inverted diagonal; identity preconditioning when None.
 
-    Returns (x, iters, rel_resid) with x sharded over rows."""
+    Returns (x, iters, rel_resid) with x sharded over rows; the tuple's
+    ``.report`` attribute holds the structured SolveReport and the record
+    is emitted through the process-global telemetry sink."""
+    import time as _time
     from amgcl_tpu.parallel.mesh import put_with_sharding
+    from amgcl_tpu.telemetry import SolveReport, emit as _tel_emit
+    t0 = _time.perf_counter()
     vec = NamedSharding(mesh, P(ROWS_AXIS))
     rhs = put_with_sharding(rhs, vec)
     x0 = jnp.zeros_like(rhs) if x0 is None else put_with_sharding(x0, vec)
@@ -79,4 +92,10 @@ def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
                                                                      vec)
     fn = _compiled_dist_cg(mesh, A.offsets, A.shape, int(maxiter), float(tol))
     x, it, res = fn(A.data, rhs, x0, dinv)
-    return x, int(it), float(res)
+    report = SolveReport(
+        int(it), float(res), wall_time_s=_time.perf_counter() - t0,
+        solver="dist_cg", extra={"devices": int(mesh.shape[ROWS_AXIS])})
+    _tel_emit(report.to_dict(), event="dist_solve", n=int(A.shape[0]))
+    out = _DistResult((x, int(it), float(res)))
+    out.report = report
+    return out

@@ -33,7 +33,9 @@ from typing import Any, Optional
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import lax, shard_map
+from jax import lax
+from amgcl_tpu.parallel.compat import shard_map, \
+    axis_size as _axis_size
 from jax.sharding import NamedSharding, PartitionSpec as P
 from jax.tree_util import register_pytree_node_class
 
@@ -51,7 +53,7 @@ def _halo_extend(arr, w):
     boundary shards see zeros (global zero-fill shift semantics)."""
     if w == 0:
         return arr
-    nd = lax.axis_size(ROWS_AXIS)
+    nd = _axis_size(ROWS_AXIS)
     if nd == 1:
         return jnp.pad(arr, ((0, 0), (w, w)))
     fwd = [(i, i + 1) for i in range(nd - 1)]
@@ -532,7 +534,7 @@ class DistStencilHierarchy:
     def shard_cycle(self, i, f):
         if i == len(self.levels):
             # replicated tail: gather, serial hierarchy apply, slice local
-            nd = lax.axis_size(ROWS_AXIS)
+            nd = _axis_size(ROWS_AXIS)
             idx = lax.axis_index(ROWS_AXIS)
             nl = f.shape[0]
             full = lax.all_gather(f, ROWS_AXIS, tiled=True)[:self.n_rep]
@@ -673,7 +675,11 @@ class DistStencilSolver:
             self._compiled = jax.jit(fn)
         x, it, res = self._compiled(self.hier, f, x0p)
         x = np.asarray(x)[: self.n]
-        return x, SolverInfo(int(it), float(res))
+        from amgcl_tpu.telemetry import emit as _tel_emit
+        info = SolverInfo(int(it), float(res), solver="dist_stencil_cg",
+                          extra={"devices": int(nd)})
+        _tel_emit(info.to_dict(), event="dist_solve", n=self.n)
+        return x, info
 
     def __repr__(self):
         rows = ["DistStencilSolver over %d devices (sharded setup)"

@@ -65,6 +65,9 @@ class solver:
         self._bundle_for = None
         self.iterations = 0
         self.error = 0.0
+        #: full telemetry SolveReport of the most recent call (None before
+        #: the first solve); tuple(report) is the pyamgcl (iters, error)
+        self.last_report = None
 
     def _get_bundle(self, A):
         key = id(A) if A is not None else None
@@ -84,8 +87,11 @@ class solver:
         else:
             raise TypeError("solver() takes (rhs) or (A, rhs)")
         x, info = bundle(np.asarray(rhs))
-        self.iterations = info.iters
-        self.error = info.resid
+        # info is a telemetry SolveReport: keep the pyamgcl attribute
+        # surface (iterations/error) AND the structured record; the
+        # reference's (x, (iters, error)) shape is tuple(info) itself
+        self.iterations, self.error = info
+        self.last_report = info
         return np.array(x)   # writable copy
 
     def __repr__(self):

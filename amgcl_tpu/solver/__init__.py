@@ -3,9 +3,24 @@ called as ``solve(A, precond, rhs, x0) -> (x, iters, resid)``, with the whole
 iteration compiled as a single ``lax.while_loop`` XLA program (reference
 contract: amgcl/solver/cg.hpp:63-252). The ``inner_product`` argument is the
 seam the distributed layer uses to globalize reductions (reference:
-amgcl/solver/detail/default_inner_product.hpp)."""
+amgcl/solver/detail/default_inner_product.hpp).
+
+Every solver mixes in :class:`amgcl_tpu.telemetry.history.HistoryMixin`:
+with ``record_history=True`` the per-iteration relative residuals are
+recorded inside the device loop and returned as a trailing element
+(``(x, iters, resid, history)``), which ``make_solver`` folds into the
+:class:`~amgcl_tpu.telemetry.SolveReport`.
+"""
 
 from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.solver.bicgstab import BiCGStab
+from amgcl_tpu.solver.bicgstabl import BiCGStabL
+from amgcl_tpu.solver.gmres import GMRES, FGMRES
+from amgcl_tpu.solver.lgmres import LGMRES
+from amgcl_tpu.solver.idrs import IDRs
+from amgcl_tpu.solver.richardson import Richardson
+from amgcl_tpu.solver.preonly import PreOnly
 from amgcl_tpu.solver.direct import DenseDirectSolver
 
-__all__ = ["CG", "DenseDirectSolver"]
+__all__ = ["CG", "BiCGStab", "BiCGStabL", "GMRES", "FGMRES", "LGMRES",
+           "IDRs", "Richardson", "PreOnly", "DenseDirectSolver"]

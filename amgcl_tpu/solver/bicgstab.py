@@ -13,14 +13,16 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 @dataclass
-class BiCGStab:
+class BiCGStab(HistoryMixin):
     maxiter: int = 100
     tol: float = 1e-8
     abstol: float = 0.0
     precond_side: str = "right"
+    record_history: bool = False  # per-iteration relative residuals
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         if self.precond_side not in ("left", "right"):
@@ -52,11 +54,11 @@ class BiCGStab:
         one = jnp.ones((), rhs.dtype)
 
         def cond(st):
-            (x, r, p, v, rho, alpha, omega, it, res) = st
+            (x, r, p, v, rho, alpha, omega, it, res, hist) = st
             return (it < self.maxiter) & (res > eps)
 
         def body(st):
-            (x, r, p, v, rho, alpha, omega, it, res) = st
+            (x, r, p, v, rho, alpha, omega, it, res, hist) = st
             rho_new = dot(rhat, r)
             beta = (rho_new / jnp.where(rho == 0, 1, rho)) \
                 * (alpha / jnp.where(omega == 0, 1, omega))
@@ -84,12 +86,13 @@ class BiCGStab:
             x = x + alpha * phat + omega * shat
             r = s - omega * t
             res = jnp.sqrt(jnp.abs(dot(r, r)))
-            return (x, r, p, v, rho_new, alpha, omega, it + 1, res)
+            hist = self._hist_put(hist, it, res / scale)
+            return (x, r, p, v, rho_new, alpha, omega, it + 1, res, hist)
 
         res0 = jnp.sqrt(jnp.abs(dot(r, r)))
         st = (x, r, jnp.zeros_like(r), jnp.zeros_like(r),
-              one, one, one, 0, res0)
-        (x, r, p, v, rho, alpha, omega, it, res) = \
+              one, one, one, 0, res0, self._hist_init(rhs.real.dtype))
+        (x, r, p, v, rho, alpha, omega, it, res, hist) = \
             lax.while_loop(cond, body, st)
         x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
-        return x, it, res / scale
+        return self._hist_result(x, it, res / scale, hist)

@@ -19,10 +19,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 @dataclass
-class BiCGStabL:
+class BiCGStabL(HistoryMixin):
     """``delta`` enables the reliable-update scheme of bicgstabl.hpp:
     386-409 — when the recursive residual has dropped far enough below
     its running peaks, the TRUE residual of the inner operator is
@@ -35,6 +36,7 @@ class BiCGStabL:
     tol: float = 1e-8
     pside: str = "right"  # the reference default (bicgstabl.hpp:137)
     delta: float = 0.0    # reliable-update threshold (bicgstabl.hpp:110)
+    record_history: bool = False  # per-iteration relative residuals
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -91,9 +93,9 @@ class BiCGStabL:
         def body(st):
             if use_delta:
                 (x, R, U, rho, alpha, omega, it, res,
-                 xbase, B, rnc, rnt) = st
+                 xbase, B, rnc, rnt, hist) = st
             else:
-                x, R, U, rho, alpha, omega, it, res = st
+                x, R, U, rho, alpha, omega, it, res, hist = st
             # the reference exits the whole solve the moment ||R[0]|| drops
             # below eps INSIDE the BiCG stage (bicgstabl.hpp:296-299,
             # `goto done`) — without that, a near-exact preconditioner
@@ -124,6 +126,8 @@ class BiCGStabL:
                 Rc = Rc.at[j + 1].set(op(Rc[j]))
                 xc = x + alpha_c * Uc[0]
                 zeta = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
+                hist = self._hist_put(hist, it + took, zeta / scale,
+                                      keep=live)
                 took = took + live.astype(jnp.int32)
                 x, R, U, rho, alpha, res = commit(
                     (xc, Rc, Uc, rho1, alpha_c, zeta),
@@ -149,8 +153,12 @@ class BiCGStabL:
             res_c = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
             x, R, U, omega, res = commit(
                 (xc, Rc, Uc, gam[Lp - 1], res_c), (x, R, U, omega, res))
+            # the cycle's last counted step ends at the post-MR committed
+            # residual — overwrite its slot so history[-1] == returned res
+            hist = self._hist_put(hist, it + took - 1, res / scale,
+                                  keep=took > 0)
             if not use_delta:
-                return (x, R, U, rho, alpha, omega, it + took, res)
+                return (x, R, U, rho, alpha, omega, it + took, res, hist)
 
             # -- reliable updates (bicgstabl.hpp:386-409): recompute the
             # true inner-operator residual when the recursive one has
@@ -185,7 +193,7 @@ class BiCGStabL:
                 recomp, do_flush, lambda a: a,
                 (x, R, xbase, B, rnc, rnt))
             return (x, R, U, rho, alpha, omega, it + took, res,
-                    xbase, B, rnc, rnt)
+                    xbase, B, rnc, rnt, hist)
 
         R0 = jnp.zeros((Lp + 1, n), dtype).at[0].set(r0)
         U0 = jnp.zeros((Lp + 1, n), dtype)
@@ -193,11 +201,12 @@ class BiCGStabL:
         st = (x, R0, U0, one, jnp.zeros((), dtype), one, 0, zeta0)
         if use_delta:
             st = st + (x_init, r0, zeta0, zeta0)
+        st = st + (self._hist_init(rhs.real.dtype, overshoot=Lp),)
         out = lax.while_loop(cond, body, st)
-        x, it, res = out[0], out[6], out[7]
+        x, it, res, hist = out[0], out[6], out[7], out[-1]
         if use_delta:
             xbase = out[8]
             x = xbase + (precond(x) if right else x)
         elif right:
             x = x_init + precond(x)
-        return x, it, res / scale
+        return self._hist_result(x, it, res / scale, hist)

@@ -13,10 +13,11 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 @dataclass
-class CG:
+class CG(HistoryMixin):
     maxiter: int = 100
     tol: float = 1e-8
     abstol: float = 0.0
@@ -56,8 +57,7 @@ class CG:
             x = dev.axpby(alpha, p, 1.0, x)
             r = dev.axpby(-alpha, q, 1.0, r)
             res = jnp.sqrt(jnp.abs(dot(r, r)))
-            if self.record_history:
-                hist = hist.at[it].set((res / norm_scale).real)
+            hist = self._hist_put(hist, it, res / norm_scale)
             if self.verbose:
                 import jax
                 jax.lax.cond(
@@ -68,8 +68,7 @@ class CG:
             return (x, r, p, rho, it + 1, res, hist)
 
         res0 = jnp.sqrt(jnp.abs(dot(r, r)))
-        hist0 = jnp.full(self.maxiter if self.record_history else 1,
-                         jnp.nan, dtype=rhs.real.dtype)
+        hist0 = self._hist_init(rhs.real.dtype)
         state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype), 0, res0,
                  hist0)
         x, r, p, rho, iters, res, hist = lax.while_loop(cond, body, state)
@@ -78,6 +77,4 @@ class CG:
             # iterates from a nonzero x0 approach a null-space vector
             # instead (reference cg.hpp:163-168)
             x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
-        if self.record_history:
-            return x, iters, res / norm_scale, hist
-        return x, iters, res / norm_scale
+        return self._hist_result(x, iters, res / norm_scale, hist)

@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 def _givens(a, b):
@@ -37,14 +38,18 @@ def _givens(a, b):
     return (absa / h).astype(a.dtype), pha * jnp.conj(b) / h
 
 
-def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None):
+def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
+                   hist=None, hist_base=0, hist_scale=1.0):
     """One restart cycle. apply_op(v) -> (w, z) where z is the direction to
     accumulate into x (z == v for plain GMRES, z == M v for flexible).
 
     ``direction(j, V)`` optionally overrides the expansion direction at step
     j (LGMRES passes its stored corrections for the augmented tail);
-    ``n_steps`` (traced or static) caps the cycle below m.
-    Returns (dx, steps, res)."""
+    ``n_steps`` (traced or static) caps the cycle below m. When ``hist`` is
+    given (the caller's history buffer), each step writes its relative
+    residual ``res / hist_scale`` at slot ``hist_base + j`` — inside the
+    device loop, no host sync (telemetry/history.py).
+    Returns (dx, steps, res, hist)."""
     n = r0.shape[0]
     dtype = r0.dtype
     beta = jnp.sqrt(jnp.abs(dot(r0, r0)))
@@ -57,13 +62,18 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None):
     cs0 = jnp.ones(m, dtype)
     sn0 = jnp.zeros(m, dtype)
     cap = m if n_steps is None else n_steps
+    record = hist is not None
+    if not record:       # 1-slot dummy keeps the carry structure static
+        hist = jnp.zeros(1, r0.real.dtype)
 
     def cond(st):
-        V, Z, R, g, cs, sn, j, res = st
+        V, Z, R, g, cs, sn, j, res, hst = st
         return (j < cap) & (res > eps)
 
     def body(st):
-        V, Z, R, g, cs, sn, j, res = st
+        # hst is the residual-history buffer; h below is the Hessenberg
+        # column — distinct names, both live in the carry
+        V, Z, R, g, cs, sn, j, res, hst = st
         v = V[j] if direction is None else direction(j, V)
         w, z = apply_op(v)
         Z = Z.at[j].set(z)
@@ -104,18 +114,21 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None):
         col = jnp.where(jnp.arange(m) <= j, h[:m], R[:, j])
         R = R.at[:, j].set(col)
         res = jnp.abs(g[j + 1])
-        return (V, Z, R, g, cs, sn, j + 1, res)
+        if record:
+            hst = hst.at[hist_base + j].set(
+                (res / hist_scale).real.astype(hst.dtype))
+        return (V, Z, R, g, cs, sn, j + 1, res, hst)
 
-    st = (V0, Z0, R0, g0, cs0, sn0, 0, beta)
-    V, Z, R, g, cs, sn, j, res = lax.while_loop(cond, body, st)
+    st = (V0, Z0, R0, g0, cs0, sn0, 0, beta, hist)
+    V, Z, R, g, cs, sn, j, res, hist = lax.while_loop(cond, body, st)
     # masked triangular solve: unwritten columns have R[k,k]=1, g[k]=0
     y = jax.scipy.linalg.solve_triangular(R, g[:m], lower=False)
     dx = Z.T @ y
-    return dx, j, res
+    return dx, j, res, hist
 
 
 @dataclass
-class GMRES:
+class GMRES(HistoryMixin):
     """Restarted GMRES(M) (reference default M=30). ``pside`` selects the
     preconditioning side (reference: amgcl/solver/precond_side.hpp,
     gmres.hpp:77-96 — the reference defaults to right; here the historical
@@ -125,6 +138,7 @@ class GMRES:
     maxiter: int = 100
     tol: float = 1e-8
     pside: str = "left"
+    record_history: bool = False  # per-iteration relative residuals
 
     flexible = False
 
@@ -156,19 +170,24 @@ class GMRES:
         eps = self.tol * scale
 
         def cond(st):
-            x, it, res = st
+            x, it, res, hist = st
             return (it < self.maxiter) & (res > eps)
 
         def body(st):
-            x, it, res = st
+            x, it, res, hist = st
             r = resid0(x)
-            dx, steps, res = _arnoldi_cycle(apply_op, r, self.M, eps, dot)
-            return (x + dx, it + steps, res)
+            dx, steps, res, hist = _arnoldi_cycle(
+                apply_op, r, self.M, eps, dot,
+                hist=hist if self.record_history else None,
+                hist_base=it, hist_scale=scale)
+            return (x + dx, it + steps, res, hist)
 
         r0 = resid0(x)
-        st = (x, 0, jnp.sqrt(jnp.abs(dot(r0, r0))))
-        x, it, res = lax.while_loop(cond, body, st)
-        return x, it, res / scale
+        # a restart cycle started at it = maxiter - 1 may run M more steps
+        hist0 = self._hist_init(rhs.real.dtype, overshoot=self.M)
+        st = (x, 0, jnp.sqrt(jnp.abs(dot(r0, r0))), hist0)
+        x, it, res, hist = lax.while_loop(cond, body, st)
+        return self._hist_result(x, it, res / scale, hist)
 
 
 @dataclass

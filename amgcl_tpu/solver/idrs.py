@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 def _shadow_block(s, row_index, n_valid, dtype, dot):
@@ -45,11 +46,12 @@ def _shadow_block(s, row_index, n_valid, dtype, dot):
 
 
 @dataclass
-class IDRs:
+class IDRs(HistoryMixin):
     s: int = 4
     maxiter: int = 100
     tol: float = 1e-8
     replacement: bool = False   # interface parity; smoothing not needed here
+    record_history: bool = False  # per-iteration relative residuals
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
               row_index=None, n_valid=None):
@@ -72,11 +74,11 @@ class IDRs:
         r0 = dev.residual(rhs, A, x)
 
         def cond(st):
-            x, r, G, U, M, om, it, res = st
+            x, r, G, U, M, om, it, res, hist = st
             return (it < self.maxiter) & (res > eps)
 
         def body(st):
-            x, r, G, U, M, om, it, res = st
+            x, r, G, U, M, om, it, res, hist = st
             f = pdots(P, r)                           # (s,)
             for k in range(s):
                 # solve the lower-right (s-k) system M[k:,k:] c = f[k:],
@@ -102,6 +104,11 @@ class IDRs:
                 r = r - beta * G[k]
                 x = x + beta * U[k]
                 f = f - beta * M[:, k]
+                if self.record_history:
+                    # the extra dot per sub-step only exists when history
+                    # is requested — the default path is untouched
+                    hist = self._hist_put(
+                        hist, it + k, jnp.sqrt(jnp.abs(dot(r, r))) / scale)
             # dimension-reduction step into the next Sonneveld space
             # (fused spmv + <t,t>/<t,r> on the DIA path — one HBM pass)
             v = precond(r)
@@ -110,10 +117,12 @@ class IDRs:
             x = x + om * v
             r = r - om * t
             res = jnp.sqrt(jnp.abs(dot(r, r)))
-            return (x, r, G, U, M, om, it + s + 1, res)
+            hist = self._hist_put(hist, it + s, res / scale)
+            return (x, r, G, U, M, om, it + s + 1, res, hist)
 
         st = (x, r0, jnp.zeros((s, n), dtype), jnp.zeros((s, n), dtype),
               jnp.eye(s, dtype=dtype), jnp.ones((), dtype), 0,
-              jnp.sqrt(jnp.abs(dot(r0, r0))))
-        x, r, G, U, M, om, it, res = lax.while_loop(cond, body, st)
-        return x, it, res / scale
+              jnp.sqrt(jnp.abs(dot(r0, r0))),
+              self._hist_init(rhs.real.dtype, overshoot=s + 1))
+        x, r, G, U, M, om, it, res, hist = lax.while_loop(cond, body, st)
+        return self._hist_result(x, it, res / scale, hist)

@@ -19,10 +19,11 @@ from jax import lax
 
 from amgcl_tpu.ops import device as dev
 from amgcl_tpu.solver.gmres import _arnoldi_cycle
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 @dataclass
-class LGMRES:
+class LGMRES(HistoryMixin):
     """``pside`` selects the preconditioning side (reference:
     amgcl/solver/lgmres.hpp params, default side::right there; here the
     historical default stays left). With ``pside='right'`` the Arnoldi
@@ -34,6 +35,7 @@ class LGMRES:
     maxiter: int = 100
     tol: float = 1e-8
     pside: str = "left"
+    record_history: bool = False  # per-iteration relative residuals
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -67,20 +69,22 @@ class LGMRES:
         eps = self.tol * scale
 
         def outer_cond(st):
-            x, aug, n_aug, it, res = st
+            x, aug, n_aug, it, res, hist = st
             return (it < self.maxiter) & (res > eps)
 
         def outer_body(st):
-            x, aug, n_aug, it, res = st
+            x, aug, n_aug, it, res, hist = st
             r = presid(x)
 
             def direction(j, V):
                 return jnp.where(j < mk, V[jnp.minimum(j, mk - 1)],
                                  aug[jnp.clip(j - mk, 0, K - 1)])
 
-            dx, steps, res = _arnoldi_cycle(
+            dx, steps, res, hist = _arnoldi_cycle(
                 apply_op, r, m, eps, dot, direction=direction,
-                n_steps=mk + jnp.minimum(n_aug, K))
+                n_steps=mk + jnp.minimum(n_aug, K),
+                hist=hist if self.record_history else None,
+                hist_base=it, hist_scale=scale)
             # augmentation stores the W-space correction for BOTH sides
             # (lgmres.hpp:363-371 normalizes dx before the P application)
             nrm = jnp.sqrt(jnp.abs(dot(dx, dx)))
@@ -88,10 +92,13 @@ class LGMRES:
                 dx / jnp.where(nrm == 0, 1.0, nrm))
             step = dx if left else precond(dx)
             return (x + step, aug, jnp.minimum(n_aug + 1, K),
-                    it + steps, res)
+                    it + steps, res, hist)
 
         r0 = presid(x)
+        # a cycle runs up to mk + K steps — more than m when K >= M
         st = (x, jnp.zeros((K, n), dtype), 0, 0,
-              jnp.sqrt(jnp.abs(dot(r0, r0))))
-        x, aug, n_aug, it, res = lax.while_loop(outer_cond, outer_body, st)
-        return x, it, res / scale
+              jnp.sqrt(jnp.abs(dot(r0, r0))),
+              self._hist_init(rhs.real.dtype, overshoot=mk + K))
+        x, aug, n_aug, it, res, hist = lax.while_loop(
+            outer_cond, outer_body, st)
+        return self._hist_result(x, it, res / scale, hist)

@@ -8,16 +8,20 @@ from dataclasses import dataclass
 import jax.numpy as jnp
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 @dataclass
-class PreOnly:
+class PreOnly(HistoryMixin):
     maxiter: int = 1   # unused; kept for interface parity
     tol: float = 0.0
+    record_history: bool = False
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         x = precond(rhs)
         r = dev.residual(rhs, A, x)
         nr = jnp.sqrt(jnp.abs(inner_product(r, r)))
         nb = jnp.sqrt(jnp.abs(inner_product(rhs, rhs)))
-        return x, 1, nr / jnp.where(nb > 0, nb, 1.0)
+        rel = nr / jnp.where(nb > 0, nb, 1.0)
+        hist = self._hist_put(self._hist_init(rhs.real.dtype), 0, rel)
+        return self._hist_result(x, 1, rel, hist)

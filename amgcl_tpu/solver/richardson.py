@@ -9,13 +9,15 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.telemetry.history import HistoryMixin
 
 
 @dataclass
-class Richardson:
+class Richardson(HistoryMixin):
     maxiter: int = 100
     tol: float = 1e-8
     damping: float = 1.0
+    record_history: bool = False  # per-iteration relative residuals
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -25,17 +27,19 @@ class Richardson:
         eps = self.tol * scale
 
         def cond(st):
-            x, r, it, res = st
+            x, r, it, res, hist = st
             return (it < self.maxiter) & (res > eps)
 
         def body(st):
-            x, r, it, _ = st
+            x, r, it, _, hist = st
             x = x + self.damping * precond(r)
             r = dev.residual(rhs, A, x)
             res = jnp.sqrt(jnp.abs(dot(r, r)))
-            return (x, r, it + 1, res)
+            hist = self._hist_put(hist, it, res / scale)
+            return (x, r, it + 1, res, hist)
 
         r0 = dev.residual(rhs, A, x)
-        st = (x, r0, 0, jnp.sqrt(jnp.abs(dot(r0, r0))))
-        x, r, it, res = lax.while_loop(cond, body, st)
-        return x, it, res / scale
+        st = (x, r0, 0, jnp.sqrt(jnp.abs(dot(r0, r0))),
+              self._hist_init(rhs.real.dtype))
+        x, r, it, res, hist = lax.while_loop(cond, body, st)
+        return self._hist_result(x, it, res / scale, hist)

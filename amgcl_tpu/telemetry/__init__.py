@@ -1,0 +1,30 @@
+"""Telemetry — the uniform observability layer every part of the stack
+reports through (the reference's profiler tree + per-level printouts +
+per-iteration residual logging, amgcl/profiler.hpp / amg.hpp:560-598 /
+cg.hpp:199, reworked as structured data instead of text).
+
+Four pieces:
+
+* :mod:`report`  — :class:`SolveReport`, the structured convergence record
+  returned by every solver bundle (iters, final relative residual,
+  per-iteration history, convergence rate, wall time, hierarchy stats).
+* :mod:`history` — :class:`HistoryMixin`, per-iteration residual capture
+  *inside* the ``lax.while_loop`` (no per-iteration host syncs), shared by
+  all Krylov solvers.
+* :mod:`tracing` — ``phase(name)`` named scopes so ``jax.profiler`` traces
+  of the V-cycle read like the reference's profiler tree.
+* :mod:`sink`    — JSONL metrics sink with a process-global default that
+  bench.py, cli.py and the distributed solvers all emit through.
+  Deliberately stdlib-only so the bench supervisor can load it without
+  importing jax.
+"""
+
+from amgcl_tpu.telemetry.report import SolveReport
+from amgcl_tpu.telemetry.history import HistoryMixin
+from amgcl_tpu.telemetry.tracing import phase, annotate
+from amgcl_tpu.telemetry.sink import (JsonlSink, NullSink, emit,
+                                      get_default_sink, set_default_sink)
+
+__all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
+           "JsonlSink", "NullSink", "emit", "get_default_sink",
+           "set_default_sink"]

@@ -1,0 +1,57 @@
+"""Per-iteration residual history captured INSIDE the device loop.
+
+The reference logs residuals with a host-side print each iteration
+(cg.hpp:199); on TPU a per-iteration host sync would serialize the whole
+``lax.while_loop``, so instead each solver carries a preallocated
+``(maxiter + overshoot,)`` buffer through the loop state and writes the
+relative residual at its iteration slot with ``hist.at[it].set(...)`` —
+pure device work, fetched once after the loop with everything else.
+
+``HistoryMixin`` is deliberately NOT a dataclass: each solver declares its
+own ``record_history: bool = False`` field LAST so positional construction
+(``CG(100, 1e-8)``) keeps its meaning; the class attribute here is only the
+default for anything that never declares the field.
+
+Slots never written stay NaN and are sliced off by the recorded count
+(make_solver fetches ``history[:iters]``), so a genuine NaN residual from a
+breakdown inside the recorded range is preserved, not filtered.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+class HistoryMixin:
+    """Shared history plumbing for Krylov solvers (cg, bicgstab, bicgstabl,
+    gmres, lgmres, idrs, richardson, preonly)."""
+
+    record_history = False
+
+    def _hist_init(self, dtype, overshoot: int = 0):
+        """Loop-state buffer: maxiter + overshoot slots when recording
+        (solvers whose counter advances by more than 1 per loop trip pass
+        the per-trip overshoot), else a 1-slot dummy so the while-loop
+        carry keeps a static shape either way."""
+        n = int(getattr(self, "maxiter", 1)) + int(overshoot) \
+            if self.record_history else 1
+        return jnp.full(max(n, 1), jnp.nan, dtype=dtype)
+
+    def _hist_put(self, hist, idx, value, keep=None):
+        """hist[idx] = value (real part, cast to the buffer dtype) when
+        recording; ``keep`` optionally masks the write (traced bool — used
+        by solvers whose unrolled steps commit conditionally)."""
+        if not self.record_history:
+            return hist
+        v = jnp.real(value).astype(hist.dtype)
+        if keep is not None:
+            v = jnp.where(keep, v, hist[idx])
+        return hist.at[idx].set(v)
+
+    def _hist_result(self, x, iters, resid, hist):
+        """The uniform solver return: ``(x, iters, resid)`` —
+        ``(..., hist)`` appended when recording (make_solver slices it by
+        the recorded count)."""
+        if self.record_history:
+            return x, iters, resid, hist
+        return x, iters, resid

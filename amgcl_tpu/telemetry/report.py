@@ -1,0 +1,104 @@
+"""SolveReport — the structured convergence record of one solve.
+
+The reference reports convergence as three loose pieces: the
+``(iters, error)`` pair out of ``make_solver::operator()``, the hierarchy
+printout of ``amg::operator<<`` and the per-iteration residual prints of
+``cg.hpp:199``. Here all of it lands in one dataclass so the text report,
+the JSONL sink and programmatic consumers read the same numbers.
+
+Constructor stays positionally compatible with the historical
+``SolverInfo(iters, resid, history)`` so every existing call site and
+tuple-unpack (``iters, error = info``) keeps working.
+
+(Reached through the package import, which pulls in jax — supervisors
+that must stay jax-free load ``telemetry/sink.py`` by file path instead;
+see bench.py.)
+"""
+
+from __future__ import annotations
+
+import json
+import math
+from dataclasses import dataclass, field
+from typing import Any, Dict, Optional
+
+# sink.py stays self-contained (bench.py loads it by file path, jax-free);
+# this module is only ever imported through the package, so it shares the
+# converter instead of duplicating it
+from amgcl_tpu.telemetry.sink import _clean, _jsonable
+
+
+@dataclass
+class SolveReport:
+    """Uniform solve outcome. ``resid`` is the final RELATIVE residual in
+    whatever norm the solver tracks (preconditioned for left-preconditioned
+    methods, true otherwise — same convention as the reference).
+
+    ``len(history) == iters`` for a plain solve; under iterative
+    refinement (``make_solver(..., refine>0)``) the history covers the
+    INITIAL solve only while ``iters`` also counts the correction solves,
+    so ``len(history) <= iters`` there (and ``convergence_rate``, derived
+    from the history when present, describes the initial solve)."""
+
+    iters: int
+    resid: float
+    history: Any = None           # per-iteration relative residuals, or None
+    convergence_rate: Optional[float] = None  # avg per-iter reduction factor
+    wall_time_s: Optional[float] = None
+    solver: Optional[str] = None  # Krylov solver class name
+    hierarchy: Optional[Dict[str, Any]] = None  # AMG.hierarchy_stats() dict
+    extra: Dict[str, Any] = field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.convergence_rate is None:
+            self.convergence_rate = self._rate()
+
+    def _rate(self):
+        """Geometric-mean residual reduction per iteration. History (which
+        starts from a relative residual of ~1 at a zero initial guess) is
+        preferred; otherwise fall back to resid**(1/iters)."""
+        try:
+            if self.history is not None and len(self.history) > 0:
+                last = float(self.history[-1])
+                if last > 0 and math.isfinite(last):
+                    return last ** (1.0 / len(self.history))
+            if self.iters and self.resid and self.resid > 0 \
+                    and math.isfinite(self.resid):
+                return float(self.resid) ** (1.0 / int(self.iters))
+        except (TypeError, ValueError, OverflowError):
+            pass
+        return None
+
+    # (iters, resid) tuple-unpacking like the reference / pyamgcl shape
+    def __iter__(self):
+        yield self.iters
+        yield self.resid
+
+    def to_dict(self, with_history: bool = True) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "iters": int(self.iters),
+            "resid": float(self.resid),
+            "convergence_rate": self.convergence_rate,
+            "wall_time_s": self.wall_time_s,
+            "solver": self.solver,
+        }
+        if with_history and self.history is not None:
+            out["history"] = [float(v) for v in self.history]
+        if self.hierarchy is not None:
+            out["hierarchy"] = self.hierarchy
+        if self.extra:
+            out.update(self.extra)
+        return out
+
+    def to_json(self, **kw) -> str:
+        return json.dumps(_clean(self.to_dict(**kw)),
+                          default=_jsonable)
+
+    def __str__(self):
+        lines = ["Iterations: %d" % self.iters,
+                 "Error:      %.6e" % self.resid]
+        if self.convergence_rate is not None:
+            lines.append("Rate:       %.3g /iter" % self.convergence_rate)
+        if self.wall_time_s is not None:
+            lines.append("Wall time:  %.4f s" % self.wall_time_s)
+        return "\n".join(lines)

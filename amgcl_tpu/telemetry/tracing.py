@@ -1,0 +1,41 @@
+"""Named-scope device tracing — the profiler-tree counterpart for
+``jax.profiler`` traces.
+
+``phase(name)`` wraps traced code in ``jax.named_scope`` so the compiled
+ops carry an ``amgcl/...`` scope path: a ``jax.profiler.trace()`` capture of
+one V-cycle then groups device time under pre_smooth / restrict /
+coarse_solve / prolong / post_smooth exactly like the reference's tic/toc
+tree (amgcl/profiler.hpp). Zero runtime cost — scopes only annotate op
+metadata at trace time.
+
+``annotate(name)`` is the host-side sibling (``jax.profiler
+.TraceAnnotation``) for un-traced phases: setup, host packing, dispatch.
+
+Both degrade to no-ops when the underlying jax API is unavailable, so
+telemetry never becomes a hard dependency of the numerics.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+
+PREFIX = "amgcl/"
+
+
+def phase(name: str):
+    """Trace-time named scope ``amgcl/<name>`` for device code."""
+    try:
+        import jax
+        return jax.named_scope(PREFIX + name)
+    except Exception:
+        return nullcontext()
+
+
+def annotate(name: str):
+    """Host-side profiler annotation ``amgcl/<name>`` for un-traced work
+    (shows as a span on the host timeline of a ``jax.profiler`` trace)."""
+    try:
+        from jax.profiler import TraceAnnotation
+        return TraceAnnotation(PREFIX + name)
+    except Exception:
+        return nullcontext()

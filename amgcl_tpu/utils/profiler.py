@@ -13,6 +13,12 @@ Usage::
     print(prof)
 
 or ``prof.tic("setup") ... prof.toc("setup")`` like the reference macros.
+
+``Profiler.device()`` builds a sync-aware instance: every tic/toc first
+drains the default device's dispatch queue, so scope totals include the
+device time of everything launched inside them (JAX is async — without the
+sync a scope only measures Python dispatch). ``to_dict()`` exports the tree
+for the JSONL telemetry sink.
 """
 
 from __future__ import annotations
@@ -33,12 +39,38 @@ class _Node:
         self._started = None
 
 
+_sync_barrier = None
+
+
+def device_sync():
+    """Block until the default device has executed everything dispatched so
+    far (JAX executes in dispatch order per device, so blocking on a fresh
+    trivial computation drains the queue). The no-op barrier is compiled
+    once and cached — a per-call jit(lambda) would retrace every sync and
+    bill the compile time to the scope being measured. No-op when jax is
+    unavailable."""
+    global _sync_barrier
+    try:
+        import jax
+        if _sync_barrier is None:
+            _sync_barrier = jax.jit(lambda: 0.0)
+        jax.block_until_ready(_sync_barrier())
+    except Exception:
+        pass
+
+
 class Profiler:
     def __init__(self, sync: Optional[Callable[[], None]] = None):
         self.root = _Node("[root]")
         self._stack = [self.root]
         self._t0 = time.perf_counter()
         self._sync = sync
+
+    @classmethod
+    def device(cls) -> "Profiler":
+        """Sync-aware profiler: scope boundaries drain the device queue so
+        totals mean device wall-clock, not dispatch time."""
+        return cls(sync=device_sync)
 
     def tic(self, name: str):
         if self._sync:
@@ -51,22 +83,57 @@ class Profiler:
         self._stack.append(node)
 
     def toc(self, name: str):
+        """Close the innermost scope, which must be ``name`` — a mismatch
+        is a hard error (and leaves the stack untouched, so the report
+        still shows where the pairing went wrong)."""
         if self._sync:
             self._sync()
-        node = self._stack.pop()
+        node = self._stack[-1]
         if node.name != name:
             raise RuntimeError("profiler scope mismatch: toc(%r) inside %r"
                                % (name, node.name))
+        self._stack.pop()
         node.total += time.perf_counter() - node._started
         node.count += 1
 
+    def _unwind(self, depth: int):
+        """Close every scope above ``depth`` — abandoned by an exception
+        that escaped between a tic and its toc inside a ``scope()``."""
+        now = time.perf_counter()
+        while len(self._stack) > depth:
+            node = self._stack.pop()
+            node.total += now - node._started
+            node.count += 1
+
     @contextmanager
     def scope(self, name: str):
+        depth = len(self._stack)
         self.tic(name)
         try:
             yield
-        finally:
+        except BaseException:
+            # the exception may have escaped between an inner tic and its
+            # toc: close the abandoned scopes so this toc pairs correctly
+            # and subsequent tic/toc pairing is not corrupted
+            self._unwind(depth + 1)
             self.toc(name)
+            raise
+        else:
+            # clean exit keeps strict pairing: a forgotten inner toc still
+            # surfaces as the scope-mismatch RuntimeError
+            self.toc(name)
+
+    def to_dict(self) -> dict:
+        """Nested export for the JSONL sink: {"total_s", "scopes": {name:
+        {"total_s", "count", "children": {...}}}} — same tree as __str__."""
+        def walk(node):
+            return {name: {"total_s": ch.total, "count": ch.count,
+                           **({"children": walk(ch)} if ch.children
+                              else {})}
+                    for name, ch in node.children.items()}
+
+        return {"total_s": time.perf_counter() - self._t0,
+                "scopes": walk(self.root)}
 
     def __str__(self):
         lines = ["Profile:"]

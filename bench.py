@@ -21,10 +21,19 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
     python bench.py --worker        # one measurement pass (internal)
     python bench.py --opportunistic # background loop: bench whenever the
                                     # tunnel is alive, refresh last-good
+    python bench.py --check [paths] # run the tier-1 pytest line and emit
+                                    # a JSONL record with DOTS_PASSED
+
+All JSON emission routes through the telemetry sink
+(amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
+is stdlib-only while the package __init__ pulls in jax, which this
+supervisor must never import (a wedged tunnel can hang backend init).
 """
 
+import importlib.util
 import json
 import os
+import re
 import subprocess
 import sys
 import threading
@@ -35,6 +44,22 @@ _LAST_GOOD_PATH = os.path.join(_REPO, "BENCH_LAST_GOOD.json")
 _N = int(os.environ.get("AMGCL_TPU_BENCH_N", "128"))
 _METRIC = "poisson3d_%d_sa_cg_spai0_solve_time" % _N
 
+
+def _load_sink():
+    spec = importlib.util.spec_from_file_location(
+        "_amgcl_tpu_sink",
+        os.path.join(_REPO, "amgcl_tpu", "telemetry", "sink.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+_sink = _load_sink()
+#: one JSON line to stdout — the contract the driver parses; no stamping
+#: or NaN-cleaning so the line matches the historical print(json.dumps())
+_stdout_sink = _sink.JsonlSink(stream=sys.stdout, stamp_records=False,
+                               clean_records=False)
+
 # HBM peak bandwidth per chip by device_kind substring (GB/s) — public
 # figures; used only for the hbm_frac observability field.
 _HBM_PEAK_GBPS = [
@@ -44,12 +69,7 @@ _HBM_PEAK_GBPS = [
 
 
 def _git_head():
-    try:
-        return subprocess.run(
-            ["git", "-C", _REPO, "rev-parse", "--short", "HEAD"],
-            capture_output=True, text=True, timeout=10).stdout.strip()
-    except Exception:
-        return None
+    return _sink.git_commit(_REPO)
 
 
 def _load_last_good():
@@ -61,14 +81,12 @@ def _load_last_good():
 
 
 def _save_last_good(out):
-    rec = dict(out)
-    rec["ts"] = time.time()
-    rec["ts_iso"] = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
+    # stamp() + write_json_atomic() reproduce the historical record
+    # byte-for-byte: same key order (ts, ts_iso, commit appended), same
+    # json.dump defaults, same tmp+rename
+    rec = _sink.stamp(dict(out))
     rec["commit"] = _git_head()
-    tmp = _LAST_GOOD_PATH + ".tmp"
-    with open(tmp, "w") as f:
-        json.dump(rec, f)
-    os.replace(tmp, _LAST_GOOD_PATH)
+    _sink.write_json_atomic(_LAST_GOOD_PATH, rec)
     return rec
 
 
@@ -171,8 +189,10 @@ def main_supervisor():
         return deadline - (time.time() - t0)
 
     def emit(out):
-        print(json.dumps(out))
-        sys.stdout.flush()
+        # stdout line for the driver + a copy through the process-global
+        # sink (AMGCL_TPU_TELEMETRY) for anyone collecting metrics
+        _stdout_sink.emit(out)
+        _sink.emit(dict(out), event="bench")
 
     def finish(result):
         if result.get("device_platform") == "tpu" \
@@ -240,6 +260,7 @@ def main_opportunistic():
     Run with nohup/background during a build round so any alive-window of
     the tunnel produces a stored artifact."""
     log_path = os.path.join(_REPO, "BENCH_OPPORTUNISTIC.jsonl")
+    log = _sink.JsonlSink(log_path)
     period = float(os.environ.get("AMGCL_TPU_OPP_PERIOD", "900"))
     while True:
         t0 = time.time()
@@ -256,8 +277,7 @@ def main_opportunistic():
             else:
                 rec["error"] = err or "worker failed"
                 rec["stages"] = stages
-        with open(log_path, "a") as f:
-            f.write(json.dumps(rec) + "\n")
+        log.emit(rec)
         time.sleep(max(period - (time.time() - t0), 30))
 
 
@@ -293,8 +313,7 @@ def _worker_watchdog():
                         % (last, total),
                "stages_reached": {n: round(t - _T0, 1) for n, t in _STAGES}}
         out.update(_PARTIAL)
-        print(json.dumps(out))
-        sys.stdout.flush()
+        _stdout_sink.emit(out)
         os._exit(2)
 
     threading.Thread(target=guard, daemon=True).start()
@@ -1029,8 +1048,62 @@ def main_worker():
     out.update(_PARTIAL)
     if levels is not None:
         out["levels"] = levels
-    print(json.dumps(out))
-    sys.stdout.flush()
+    _stdout_sink.emit(out)
+    _sink.emit(dict(out), event="bench_worker")
+
+
+# ===========================================================================
+# tier-1 check: run the ROADMAP pytest line, emit DOTS_PASSED as JSONL
+# ===========================================================================
+
+_DOTS_RE = re.compile(r"^[.FEsx]+( *\[ *[0-9]+%\])?$")
+
+# the ROADMAP tier-1 invocation, minus the shell plumbing
+_TIER1_ARGS = ["-m", "pytest", "-q", "-m", "not slow",
+               "--continue-on-collection-errors", "-p", "no:cacheprovider",
+               "-p", "no:xdist", "-p", "no:randomly"]
+
+
+def count_dots(text: str) -> int:
+    """DOTS_PASSED: '.' characters on pytest -q progress lines — the same
+    grep the ROADMAP tier-1 line applies to its log (char class kept
+    identical on purpose, quirks included, so the two metrics never
+    disagree)."""
+    return sum(line.count(".") for line in text.splitlines()
+               if _DOTS_RE.match(line.strip()))
+
+
+def main_check(targets=None):
+    """Run the tier-1 pytest line in a subprocess (CPU-forced, like the
+    driver) and emit ONE JSONL record carrying DOTS_PASSED, the return
+    code and the duration — to stdout and the process-global sink.
+
+    ``targets``: optional pytest paths/flags replacing the default
+    ``tests/`` target (lets callers check a subset quickly)."""
+    timeout = float(os.environ.get("AMGCL_TPU_CHECK_TIMEOUT", "870"))
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    cmd = [sys.executable] + _TIER1_ARGS \
+        + (list(targets) if targets else ["tests/"])
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=_REPO, env=env)
+        rc, text = r.returncode, r.stdout + "\n" + r.stderr
+        err = None
+    except subprocess.TimeoutExpired as e:
+        rc = -1
+        text = (e.stdout or b"").decode("utf-8", "replace") if isinstance(
+            e.stdout, bytes) else (e.stdout or "")
+        err = "pytest timed out after %.0fs" % timeout
+    rec = {"event": "tier1_check", "metric": "tier1_dots_passed",
+           "value": count_dots(text), "unit": "tests",
+           "rc": rc, "duration_s": round(time.time() - t0, 1),
+           "commit": _git_head()}
+    if err:
+        rec["error"] = err
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    return 0 if rc == 0 else 1
 
 
 if __name__ == "__main__":
@@ -1038,5 +1111,8 @@ if __name__ == "__main__":
         main_worker()
     elif "--opportunistic" in sys.argv:
         main_opportunistic()
+    elif "--check" in sys.argv:
+        extra = sys.argv[sys.argv.index("--check") + 1:]
+        sys.exit(main_check(extra))
     else:
         main_supervisor()

@@ -1,0 +1,313 @@
+"""Telemetry layer: per-iteration history inside the device loop for every
+Krylov solver, structured hierarchy stats, the JSONL sink, named-scope
+device tracing of the V-cycle, and the profiler's exception safety."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.models.make_solver import make_solver, SolverInfo
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.solver import (CG, BiCGStab, BiCGStabL, GMRES, FGMRES,
+                              LGMRES, IDRs, Richardson, PreOnly)
+from amgcl_tpu.telemetry import SolveReport, JsonlSink
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.mark.parametrize("solver", [
+    CG(maxiter=100, tol=1e-8, record_history=True),
+    BiCGStab(maxiter=100, tol=1e-8, record_history=True),
+    BiCGStabL(L=2, maxiter=100, tol=1e-8, record_history=True),
+    GMRES(maxiter=100, tol=1e-8, record_history=True),
+    FGMRES(maxiter=100, tol=1e-8, record_history=True),
+    LGMRES(maxiter=100, tol=1e-8, record_history=True),
+    IDRs(s=2, maxiter=100, tol=1e-8, record_history=True),
+    Richardson(maxiter=200, tol=1e-8, record_history=True),
+    PreOnly(record_history=True),
+], ids=lambda s: type(s).__name__)
+def test_history_length_matches_iters(solver):
+    """Every Krylov solver records one history entry per counted iteration
+    (inside the lax.while_loop — no host syncs), ending at the returned
+    residual."""
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        solver)
+    x, info = solve(rhs)
+    h = np.asarray(info.history)
+    name = type(solver).__name__
+    assert len(h) == info.iters, name
+    assert not np.any(np.isnan(h)), name
+    assert abs(h[-1] - info.resid) <= 1e-12 + 1e-6 * abs(info.resid), name
+
+
+def test_lgmres_history_small_restart_large_k():
+    """K >= M: a restart cycle runs mk + K > M steps — the history buffer
+    must still hold one slot per counted iteration (regression: overshoot
+    was sized M, clamping the final cycle's writes)."""
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        LGMRES(M=2, K=3, maxiter=39, tol=1e-30,
+                               record_history=True))
+    x, info = solve(rhs)
+    assert len(info.history) == info.iters
+
+
+def test_emit_never_raises(tmp_path):
+    """A broken sink path must not discard a converged solve — module-level
+    emit warns once and drops instead of raising."""
+    from amgcl_tpu import telemetry
+    from amgcl_tpu.telemetry import sink as sink_mod
+    telemetry.set_default_sink(
+        JsonlSink(str(tmp_path / "no-such-dir" / "out.jsonl")))
+    old = sink_mod._emit_warned
+    sink_mod._emit_warned = False
+    try:
+        with pytest.warns(UserWarning, match="telemetry sink emit failed"):
+            rec = telemetry.emit(event="x", value=1)
+        assert rec["value"] == 1          # record still returned
+        telemetry.emit(event="y")         # second drop is silent
+    finally:
+        telemetry.set_default_sink(None)
+        sink_mod._emit_warned = old
+
+
+def test_cg_history_monotone_ish():
+    """AMG-preconditioned CG on Poisson: broadly decreasing residuals (no
+    order-of-magnitude regressions between consecutive iterations)."""
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        CG(maxiter=100, tol=1e-10, record_history=True))
+    x, info = solve(rhs)
+    vals = np.asarray(info.history)
+    assert len(vals) >= 3
+    assert np.all(np.diff(np.log10(vals)) < 1)
+
+
+def test_solve_report_fields_and_compat():
+    A, rhs = poisson3d(10)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        CG(maxiter=100, tol=1e-8, record_history=True))
+    x, info = solve(rhs)
+    # report is the SolverInfo (historical alias) and unpacks like pyamgcl
+    assert isinstance(info, SolveReport) and SolverInfo is SolveReport
+    it, err = info
+    assert (it, err) == (info.iters, info.resid)
+    assert info.solver == "CG"
+    assert info.wall_time_s is not None and info.wall_time_s > 0
+    assert 0 < info.convergence_rate < 1
+    assert info.hierarchy is not None and info.hierarchy["n_levels"] >= 2
+    # the whole report serializes to JSON
+    rec = json.loads(info.to_json())
+    assert rec["iters"] == info.iters
+    assert len(rec["history"]) == info.iters
+
+
+def test_hierarchy_stats_match_repr():
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    st = amg.hierarchy_stats()
+    text = repr(amg)
+    assert ("Number of levels:    %d" % st["n_levels"]) in text
+    assert ("Operator complexity: %.2f" % st["operator_complexity"]) in text
+    assert ("Grid complexity:     %.2f" % st["grid_complexity"]) in text
+    for lv in st["levels"]:
+        assert ("%5d %12d %14d" % (lv["level"], lv["rows"], lv["nnz"])) \
+            in text
+    # complexity identities against the host levels
+    nnz = [l["nnz"] for l in st["levels"]]
+    assert st["operator_complexity"] == pytest.approx(sum(nnz) / nnz[0])
+    json.dumps(st)     # structured path must be JSON-clean
+
+
+def test_vcycle_named_phases_in_trace():
+    """A lowered V-cycle carries the five named phases as jax.named_scope
+    paths (what a jax.profiler trace groups device time by)."""
+    A, rhs = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    low = jax.jit(lambda h, r: h.apply(r)).lower(
+        amg.hierarchy, jnp.asarray(rhs))
+    asm = low.compiler_ir(dialect="stablehlo").operation.get_asm(
+        enable_debug_info=True)
+    for name in ("pre_smooth", "restrict", "coarse_solve", "prolong",
+                 "post_smooth"):
+        assert "amgcl/level" in asm and name in asm, name
+
+
+def test_jsonl_sink_roundtrip(tmp_path):
+    path = str(tmp_path / "metrics.jsonl")
+    sink = JsonlSink(path)
+    sink.emit({"event": "a", "value": 1.5})
+    sink.emit(event="b", nested={"x": [1, 2, 3]},
+              npval=np.float32(2.5), nparr=np.arange(3))
+    lines = open(path).read().splitlines()
+    assert len(lines) == 2
+    recs = [json.loads(ln) for ln in lines]       # every line valid JSON
+    assert recs[0]["event"] == "a" and "ts" in recs[0] \
+        and "ts_iso" in recs[0]
+    assert recs[1]["npval"] == 2.5 and recs[1]["nparr"] == [0, 1, 2]
+    # breakdown records stay STRICT JSON: non-finite floats become their
+    # string names instead of bare NaN/Infinity tokens
+    sink.emit(event="breakdown", resid=float("nan"),
+              history=[1.0, float("inf")])
+    last = open(path).read().splitlines()[-1]
+    assert "NaN" not in last and "Infinity" not in last
+    rec = json.loads(last, parse_constant=lambda c: pytest.fail(c))
+    assert rec["resid"] == "nan" and rec["history"] == [1.0, "inf"]
+
+
+def test_default_sink_captures_solve_events(tmp_path):
+    from amgcl_tpu import telemetry
+    path = str(tmp_path / "solves.jsonl")
+    telemetry.set_default_sink(JsonlSink(path))
+    try:
+        A, rhs = poisson3d(10)
+        solve = make_solver(A, AMGParams(dtype=jnp.float64,
+                                         coarse_enough=200),
+                            CG(maxiter=100, tol=1e-8))
+        solve(rhs)
+        solve(rhs)
+    finally:
+        telemetry.set_default_sink(None)
+    recs = [json.loads(ln) for ln in open(path)]
+    assert len(recs) == 2
+    assert all(r["event"] == "solve" and r["iters"] > 0 for r in recs)
+
+
+def test_profiler_survives_exception_in_scope():
+    """An exception inside a scope (even with an unbalanced inner tic) must
+    not corrupt subsequent tic/toc pairing (ISSUE 1 satellite)."""
+    from amgcl_tpu.utils.profiler import Profiler
+    p = Profiler()
+    with pytest.raises(ValueError):
+        with p.scope("outer"):
+            p.tic("inner")                 # never toc'd: the exception
+            raise ValueError("boom")       # escapes before the toc
+    assert p._stack == [p.root]            # stack fully restored
+    with p.scope("after"):
+        pass                               # pairing still works
+    d = p.to_dict()
+    assert "outer" in d["scopes"] and "after" in d["scopes"]
+    assert "inner" in d["scopes"]["outer"]["children"]
+    # a toc with no matching open scope is still a hard error
+    p.tic("a")
+    with pytest.raises(RuntimeError):
+        p.toc("b")
+    p.toc("a")
+    # strict pairing on the CLEAN path too: a forgotten inner toc is
+    # surfaced, not silently absorbed by the scope's exit
+    p2 = Profiler()
+    with pytest.raises(RuntimeError):
+        with p2.scope("outer"):
+            p2.tic("inner")
+
+
+def test_profiler_device_mode_and_dict():
+    from amgcl_tpu.utils.profiler import Profiler
+    p = Profiler.device()                  # sync-aware scopes
+    with p.scope("compute"):
+        jnp.ones(16).sum()
+    d = p.to_dict()
+    assert d["scopes"]["compute"]["count"] == 1
+    assert d["scopes"]["compute"]["total_s"] >= 0
+    json.dumps(d)
+
+
+def test_dist_cg_report(tmp_path):
+    """Distributed CG: mesh-reduced iters/residual land in a SolveReport
+    and the record goes through the process-global sink."""
+    from amgcl_tpu import telemetry
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+    from amgcl_tpu.parallel.dist_solver import dist_cg
+    path = str(tmp_path / "dist.jsonl")
+    telemetry.set_default_sink(JsonlSink(path))
+    try:
+        mesh = make_mesh(4)
+        A, rhs = poisson3d(8)
+        M = DistDiaMatrix.from_csr(A, mesh, jnp.float64)
+        out = dist_cg(M, mesh, jnp.asarray(rhs), maxiter=50, tol=1e-8)
+        x, it, res = out
+    finally:
+        telemetry.set_default_sink(None)
+    assert out.report.iters == it and out.report.resid == res
+    assert out.report.extra["devices"] == 4
+    recs = [json.loads(ln) for ln in open(path)]
+    assert recs and recs[-1]["event"] == "dist_solve" \
+        and recs[-1]["solver"] == "dist_cg"
+
+
+def test_pyamgcl_compat_report_shape():
+    import amgcl_tpu.pyamgcl_compat as pyamgcl
+    A, rhs = poisson3d(10)
+    P = pyamgcl.amgcl(A, {"dtype": "float64", "coarse_enough": "200"})
+    solve = pyamgcl.solver(P, {"type": "cg", "tol": 1e-8})
+    x = solve(rhs)
+    assert solve.iterations > 0 and solve.error < 1e-8
+    # the pyamgcl-style (x, (iters, error)) shape via the report
+    it, err = solve.last_report
+    assert (it, err) == (solve.iterations, solve.error)
+
+
+@pytest.mark.parametrize("mesh", [0, 4], ids=["serial", "mesh4"])
+def test_cli_telemetry_smoke(tmp_path, mesh):
+    """`python -m amgcl_tpu.cli --telemetry out.jsonl` end to end on CPU
+    with 8 virtual devices (ISSUE 1 satellite)."""
+    out = tmp_path / "cli.jsonl"
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8")
+               .strip())
+    cmd = [sys.executable, "-m", "amgcl_tpu.cli", "-n", "10",
+           "-p", "solver.type=cg", "-p", "solver.record_history=true",
+           "--telemetry", str(out)]
+    if mesh:
+        cmd += ["--mesh", str(mesh)]
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=600,
+                       cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Iterations:" in r.stdout and "Profile:" in r.stdout
+    recs = [json.loads(ln) for ln in open(out)]
+    events = {r_["event"] for r_ in recs}
+    assert {"cli", "profile"} <= events, events
+    assert "solve" in events or "dist_solve" in events, events
+    solve_rec = [r_ for r_ in recs
+                 if r_["event"] in ("solve", "dist_solve")][-1]
+    assert solve_rec["iters"] > 0 and solve_rec["resid"] < 1e-6
+
+
+def test_bench_check_emits_dots():
+    """bench.py --check runs the tier-1 pytest line (here narrowed to one
+    fast file) and emits a JSONL record carrying DOTS_PASSED."""
+    env = dict(os.environ, AMGCL_TPU_CHECK_TIMEOUT="480")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--check",
+         "tests/test_telemetry.py::test_jsonl_sink_roundtrip",
+         "tests/test_telemetry.py::test_profiler_survives_exception_in_scope"],
+        capture_output=True, text=True, timeout=540, cwd=_REPO, env=env)
+    line = [ln for ln in r.stdout.splitlines() if ln.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["event"] == "tier1_check"
+    assert rec["metric"] == "tier1_dots_passed"
+    assert rec["value"] == 2, rec
+    assert rec["rc"] == 0 and r.returncode == 0
+
+
+def test_bench_count_dots():
+    """The DOTS_PASSED parser matches the ROADMAP grep contract."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    text = "collected 5 items\n....F      [100%]\nsome log line\n..\n"
+    assert bench.count_dots(text) == 6
+    assert bench.count_dots("no dots here\n") == 0
