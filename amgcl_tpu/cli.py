@@ -43,6 +43,13 @@ def main(argv=None):
                     help="append JSONL telemetry (solve report, hierarchy "
                          "stats, profiler tree) to PATH; the solver's own "
                          "'solve' event rides the same sink")
+    ap.add_argument("--ledger", action="store_true",
+                    help="print the resource ledger (per-level device "
+                         "bytes by format, cycle FLOP/byte roofline, "
+                         "dense-window budget, setup profile) and, with "
+                         "--telemetry, emit it as a 'ledger' event; also "
+                         "cross-checks the analytic cycle cost against "
+                         "XLA's cost analysis where available")
     args = ap.parse_args(argv)
 
     # honor 64-bit dtype requests before any jax array is created
@@ -130,6 +137,41 @@ def main(argv=None):
     print(info)          # SolveReport.__str__: iterations/error/rate/wall
     print()
     print(prof)
+
+    if args.ledger:
+        from amgcl_tpu.telemetry.ledger import (format_ledger,
+                                                xla_cost_analysis)
+        precond_obj = getattr(inner, "precond", None) \
+            or getattr(inner, "host_amg", None)
+        rl = getattr(inner, "resource_ledger", None) \
+            or getattr(precond_obj, "resource_ledger", None)
+        if callable(rl):
+            led = rl()
+            print()
+            if "levels" in led:
+                print(format_ledger(led))
+                # one compiled-cost cross-check of the analytic cycle
+                # model (skipped silently where the backend exposes none)
+                hier = getattr(precond_obj, "hierarchy", None)
+                if hier is not None:
+                    import jax.numpy as jnp_
+                    r0 = jnp_.zeros(hier.system_matrix.shape[0],
+                                    hier.system_matrix.dtype)
+                    xc = xla_cost_analysis(lambda r: hier.apply(r), r0)
+                    if xc:
+                        print("XLA cost analysis (one cycle): "
+                              "%s flops, %s bytes accessed"
+                              % (xc.get("flops"),
+                                 xc.get("bytes_accessed")))
+                        led = dict(led, xla_cycle=xc)
+            else:
+                # distributed ledger: comm + memory summary
+                import json as _json
+                print("Resource ledger (distributed):")
+                print(_json.dumps(led, indent=2, default=str))
+            telemetry.emit(event="ledger", **led)
+        else:
+            print("(no resource ledger: %r exposes none)" % type(inner))
 
     if args.telemetry:
         # structured duplicates of the text report, one JSONL record each

@@ -23,7 +23,7 @@ from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
 from amgcl_tpu.coarsening.stall import CoarseningStall
 from amgcl_tpu.relaxation.spai0 import Spai0
 from amgcl_tpu.solver.direct import DenseDirectSolver
-from amgcl_tpu.telemetry.tracing import phase
+from amgcl_tpu.telemetry.tracing import phase, setup_scope
 
 
 @dataclass
@@ -193,6 +193,14 @@ class AMG:
         prm = self.prm
         self._device_built = False
         self._dev_prefix = []
+        self._ledger_cache = None
+        # setup-phase profiler (PR 1 instrumented the SOLVE phase only):
+        # device-synced tic/toc scopes + amgcl/setup/* host annotations
+        # around coarsening / galerkin / device transfer / smoother
+        # setup, exported through hierarchy_stats()["setup"] and the
+        # resource ledger
+        from amgcl_tpu.utils.profiler import Profiler
+        prof = self.setup_profile = Profiler.device()
         n_prefix = 0
         eps_override = None
         if self._device_filter is None:
@@ -202,7 +210,8 @@ class AMG:
             # (ops/stencil_device.py); None -> host path, same numerics
             from amgcl_tpu.ops import stencil_device as sdev
             if sdev.enabled():
-                got = sdev.device_build(A, prm)
+                with setup_scope(prof, "device_build"):
+                    got = sdev.device_build(A, prm)
                 if got is not None:
                     self._device_built = True
                     meta_rows = [(m_, None, None) for m_ in got["meta"]]
@@ -244,8 +253,10 @@ class AMG:
         Acur = A
         while (Acur.nrows * Acur.block_size[0] > prm.coarse_enough
                and n_prefix + len(host) + 1 < prm.max_levels):
+            lvl = "level%d" % (n_prefix + len(host))
             try:
-                P, R = coarsening.transfer_operators(Acur, ctx)
+                with setup_scope(prof, lvl + "/coarsening"):
+                    P, R = coarsening.transfer_operators(Acur, ctx)
             except CoarseningStall:
                 break     # expected terminal condition: close the
                           # hierarchy here; other ValueErrors propagate
@@ -253,7 +264,8 @@ class AMG:
                           # bug as a stall — see coarsening/stall.py)
             if P.ncols == 0 or P.ncols >= Acur.ncols:
                 break  # coarsening stalled
-            Ac = coarsening.coarse_operator(Acur, P, R, ctx)
+            with setup_scope(prof, lvl + "/galerkin"):
+                Ac = coarsening.coarse_operator(Acur, P, R, ctx)
             host.append((Acur, P, R))
             Acur = Ac
         host.append((Acur, None, None))
@@ -276,11 +288,15 @@ class AMG:
             # build; the transfer structure is re-derived identically
             self._build(A)
             return
+        from amgcl_tpu.utils.profiler import Profiler
+        prof = self.setup_profile = Profiler.device()
+        self._ledger_cache = None
         host = []
         Acur = A
-        for (_, P, R) in self.host_levels[:-1]:
+        for i, (_, P, R) in enumerate(self.host_levels[:-1]):
             host.append((Acur, P, R))
-            Acur = self._coarse_op(Acur, P, R)
+            with setup_scope(prof, "level%d/galerkin" % i):
+                Acur = self._coarse_op(Acur, P, R)
         host.append((Acur, None, None))
         self.host_levels = host
         self._to_device_levels()
@@ -291,6 +307,14 @@ class AMG:
         dtype = prm.dtype
         dev_levels = []
         prefix = getattr(self, "_dev_prefix", [])
+        prof = getattr(self, "setup_profile", None)
+        # ONE dense-window HBM budget for the whole hierarchy: every
+        # to_device('auto') below draws from it, so the storage-hungry
+        # format cannot stack its per-matrix allowance level after level
+        # (the round-5 ADVICE finding). rebuild() re-enters here with a
+        # fresh pool — the old hierarchy's buffers are dropped with it.
+        from amgcl_tpu.telemetry.ledger import dense_window_budget
+        self._dwin_budget = dense_window_budget()
         for i, (Ai, P, R) in enumerate(host[:-1]):
             if i < len(prefix):
                 # device-built level (ops/stencil_device.py) — already
@@ -301,27 +325,37 @@ class AMG:
                     i, Ai.nrows * Ai.block_size[0], False):
                 dev_levels.append(Level(None, None, None, None))
                 continue
+            lvl = "level%d" % i
             spec = getattr(P, "_implicit_spec", None)
-            if spec is not None:
-                # matrix-free smoothed transfers: no gather-heavy device P/R
-                from amgcl_tpu.ops.structured import build_implicit_transfers
-                P_dev, R_dev = build_implicit_transfers(
-                    spec, dtype, prm.matrix_format)
-            else:
-                # auto: banded transfers (RCM-ordered fine rows against
-                # contiguously-numbered aggregates) take windowed ELL /
-                # DIA and ride the same Pallas SpMV as the level
-                # operators; irregular ones fall back to take-ELL
-                P_dev = dev.to_device(P, "auto", dtype)
-                R_dev = dev.to_device(R, "auto", dtype)
-            A_dev = dev.to_device(Ai, prm.matrix_format, dtype)
+            with setup_scope(prof, lvl + "/transfer"):
+                if spec is not None:
+                    # matrix-free smoothed transfers: no gather-heavy
+                    # device P/R
+                    from amgcl_tpu.ops.structured import \
+                        build_implicit_transfers
+                    P_dev, R_dev = build_implicit_transfers(
+                        spec, dtype, prm.matrix_format)
+                else:
+                    # auto: banded transfers (RCM-ordered fine rows
+                    # against contiguously-numbered aggregates) take
+                    # windowed ELL / DIA and ride the same Pallas SpMV as
+                    # the level operators; irregular ones fall back to
+                    # take-ELL
+                    P_dev = dev.to_device(P, "auto", dtype,
+                                          budget=self._dwin_budget)
+                    R_dev = dev.to_device(R, "auto", dtype,
+                                          budget=self._dwin_budget)
+                A_dev = dev.to_device(Ai, prm.matrix_format, dtype,
+                                      budget=self._dwin_budget)
             from amgcl_tpu.ops.pallas_vcycle import (build_fused_down,
                                                      build_fused_up)
-            relax_state = prm.relax.build(Ai, dtype)
-            dev_levels.append(Level(
-                A_dev, relax_state, P_dev, R_dev,
-                build_fused_down(A_dev, R_dev, relax_state),
-                build_fused_up(A_dev, P_dev, relax_state)))
+            with setup_scope(prof, lvl + "/relax_setup"):
+                relax_state = prm.relax.build(Ai, dtype)
+            with setup_scope(prof, lvl + "/fused_kernels"):
+                fd = build_fused_down(A_dev, R_dev, relax_state)
+                fu = build_fused_up(A_dev, P_dev, relax_state)
+            dev_levels.append(Level(A_dev, relax_state, P_dev, R_dev,
+                                    fd, fu))
         Alast = host[-1][0]
         n_last = Alast.nrows * Alast.block_size[0]
         if prm.direct_coarse and n_last > max(4 * prm.coarse_enough, 20000):
@@ -333,13 +367,16 @@ class AMG:
                 "cannot build a dense coarse solver this large — adjust "
                 "coarsening parameters or set direct_coarse=False"
                 % (n_last, prm.coarse_enough))
-        if prm.direct_coarse:
-            coarse = DenseDirectSolver.build(Alast, dtype)
-            last = Level(dev.to_device(Alast, prm.matrix_format, dtype), None)
-        else:
-            coarse = None
-            last = Level(dev.to_device(Alast, prm.matrix_format, dtype),
-                         prm.relax.build(Alast, dtype))
+        with setup_scope(prof, "coarse_solver"):
+            if prm.direct_coarse:
+                coarse = DenseDirectSolver.build(Alast, dtype)
+                last = Level(dev.to_device(Alast, prm.matrix_format, dtype,
+                                           budget=self._dwin_budget), None)
+            else:
+                coarse = None
+                last = Level(dev.to_device(Alast, prm.matrix_format, dtype,
+                                           budget=self._dwin_budget),
+                             prm.relax.build(Alast, dtype))
         dev_levels.append(last)
         self.hierarchy = Hierarchy(
             dev_levels, coarse, prm.npre, prm.npost, prm.ncycle,
@@ -351,20 +388,39 @@ class AMG:
 
     # -- observability (reference: amgcl/amg.hpp:560-598) -------------------
 
+    def resource_ledger(self):
+        """Full resource ledger (telemetry/ledger.py): per-level device
+        bytes by format, analytic FLOP/byte per cycle stage, dense-window
+        budget use, and the setup-phase profile. Cached per build —
+        rebuild() invalidates."""
+        cached = getattr(self, "_ledger_cache", None)
+        if cached is None:
+            from amgcl_tpu.telemetry.ledger import hierarchy_ledger
+            cached = hierarchy_ledger(
+                self.hierarchy, self.host_levels,
+                budget=getattr(self, "_dwin_budget", None),
+                setup_profile=getattr(self, "setup_profile", None))
+            self._ledger_cache = cached
+        return cached
+
     def hierarchy_stats(self):
         """Structured hierarchy report: per-level rows/nnz/dtype/device
         format plus grid and operator complexity — the machine-readable
         source both ``__repr__`` and the JSONL telemetry path render from
-        (reference prints this as text only, amg.hpp:560-598)."""
+        (reference prints this as text only, amg.hpp:560-598). Each level
+        additionally carries its device-byte breakdown and analytic SpMV
+        cost from the resource ledger, and the top level the whole-cycle
+        FLOP/byte totals."""
         host = self.host_levels
         nnz0 = host[0][0].nnz
         rows0 = host[0][0].nrows
         dev_levels = self.hierarchy.levels
+        led = self.resource_ledger()
         levels = []
         for i, (Ai, _, _) in enumerate(host):
             lv = dev_levels[i] if i < len(dev_levels) else None
             A_dev = getattr(lv, "A", None)
-            levels.append({
+            row = {
                 "level": i,
                 "rows": int(Ai.nrows),
                 # device-built meta rows carry nrows/nnz but no block info
@@ -376,8 +432,12 @@ class AMG:
                 "fused": ("d" if getattr(lv, "down", None) is not None
                           else "")
                 + ("u" if getattr(lv, "up", None) is not None else ""),
-            })
-        return {
+            }
+            if i < len(led["levels"]):
+                row["bytes"] = led["levels"][i]["bytes"]
+                row["spmv"] = led["levels"][i]["spmv"]
+            levels.append(row)
+        out = {
             "n_levels": len(host),
             "operator_complexity":
                 sum(l[0].nnz for l in host) / max(nnz0, 1),
@@ -386,7 +446,11 @@ class AMG:
             "dtype": str(jnp.dtype(self.prm.dtype)),
             "bytes": int(self.bytes()),
             "levels": levels,
+            "cycle": dict(led["cycle"]["total"]),
         }
+        if led.get("dense_window") is not None:
+            out["dense_window"] = led["dense_window"]
+        return out
 
     def __repr__(self):
         st = self.hierarchy_stats()

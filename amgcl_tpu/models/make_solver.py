@@ -72,7 +72,12 @@ class make_solver:
             # prebuilt preconditioner may wrap a different operator.)
             self.A_dev = hier_A
         else:
-            self.A_dev = dev.to_device(A, matrix_format, self.solver_dtype)
+            # share the hierarchy's dense-window HBM budget when there is
+            # one — the Krylov-side copy draws from the same pool as the
+            # level operators instead of claiming a fresh allowance
+            self.A_dev = dev.to_device(
+                A, matrix_format, self.solver_dtype,
+                budget=getattr(self.precond, "_dwin_budget", None))
         # refinement needs the outer residual b - A x evaluated more
         # accurately than the working precision (the f32 evaluation
         # floors around eps32·||A||·||x||/||b||, far above 1e-6 for
@@ -192,7 +197,12 @@ class make_solver:
                             % type(self.precond).__name__)
         self.precond.rebuild(A)
         self.A_host = A
-        self.A_dev = dev.to_device(A, self.matrix_format, self.solver_dtype)
+        # same budget sharing as __init__: precond.rebuild() made a fresh
+        # hierarchy-wide pool — the Krylov-side copy must draw from it,
+        # not claim a second full dense-window allowance
+        self.A_dev = dev.to_device(
+            A, self.matrix_format, self.solver_dtype,
+            budget=getattr(self.precond, "_dwin_budget", None))
         if self.refine > 0:
             if self.refine_mode == "df32":
                 if not isinstance(self.A_dev, dev.DiaMatrix):
@@ -207,6 +217,7 @@ class make_solver:
                                              self._wide_dtype())
         self._compiled = None
         self._hier_stats_cache = None
+        self._resources_cache = None
 
     def _wide_dtype(self):
         return jnp.complex128 if jnp.issubdtype(
@@ -372,10 +383,18 @@ class make_solver:
             # genuine NaN residuals from a breakdown
             hist = np.asarray(fetched[2])[:int(fetched[3])]
         wall = time.perf_counter() - t0
+        if first_call and self.refine_mode == "df32":
+            # satellite of _df32_selfcheck: the standalone-jit check ran
+            # the residual kernel ALONE — the full _solve_fn program fuses
+            # it into the refinement loop, where reassociation can undo
+            # the compensation. Validate the first compiled call's
+            # reported residual against a host f64 residual once.
+            self._check_df32_runtime(rhs, x, float(resid))
         report = SolveReport(
             int(iters), float(resid), hist, wall_time_s=wall,
             solver=type(self.solver).__name__,
             hierarchy=self._hierarchy_stats(),
+            resources=self._resources(),
             # the first call's wall time includes jit trace + compile —
             # flag it so sink consumers can separate it from steady state
             extra={"first_call": True} if first_call else {})
@@ -396,6 +415,67 @@ class make_solver:
             cached = stats() if callable(stats) else None
             self._hier_stats_cache = cached
         return cached
+
+    def _resources(self):
+        """SolveReport.resources: hierarchy memory totals, the per-stage
+        cycle FLOP/byte model, the per-Krylov-iteration model, dense-
+        window budget use and the setup-phase profile (telemetry/
+        ledger.py). Cached per build; never raises — a ledger bug must
+        not turn a converged solve into a failure."""
+        cached = getattr(self, "_resources_cache", None)
+        if cached is None:
+            try:
+                from amgcl_tpu.telemetry import ledger as _ledger
+                rl = getattr(self.precond, "resource_ledger", None)
+                led = rl() if callable(rl) else None
+                cycle = led["cycle"]["total"] if led else None
+                pre_cycles = getattr(getattr(self.precond, "prm", None),
+                                     "pre_cycles", 1)
+                cached = {"per_iteration": _ledger.krylov_iteration_model(
+                    type(self.solver).__name__, self.A_dev, cycle,
+                    pre_cycles)}
+                if led is not None:
+                    cached["memory"] = {
+                        "bytes": led["totals"]["bytes"],
+                        "by_format": led["totals"]["by_format"],
+                        "coarse_solver_bytes": led["coarse_solver_bytes"]}
+                    cached["cycle"] = led["cycle"]
+                    for key in ("dense_window", "setup"):
+                        if led.get(key) is not None:
+                            cached[key] = led[key]
+            except Exception as e:
+                cached = {"error": repr(e)[:200]}
+            self._resources_cache = cached
+        return cached
+
+    def _check_df32_runtime(self, rhs_dev, x, reported):
+        """One-shot validation of the compiled df32 refinement: the
+        REPORTED relative residual of the first _solve_fn call must be
+        consistent with the host-f64 residual of the returned solution.
+        The standalone-jit selfcheck misses fusion/reassociation drift
+        that only appears when the compensated kernel is compiled INSIDE
+        the refinement loop; this catches it where it matters. Returns
+        the host-f64 relative residual (None when unscored)."""
+        b64 = np.asarray(rhs_dev, np.float64)
+        x64 = np.asarray(x, np.float64)
+        nb = float(np.linalg.norm(b64))
+        if nb == 0 or not np.all(np.isfinite(x64)):
+            return None
+        actual = float(np.linalg.norm(b64 - self.A_host.spmv(x64)) / nb)
+        tol = float(getattr(self.solver, "tol", 1e-6))
+        if actual > max(10.0 * reported, 2.0 * tol) \
+                and actual > 1e-12 * len(b64):
+            import warnings
+            warnings.warn(
+                "df32 refinement drift: the compiled solve reports a "
+                "relative residual of %.3e but the host float64 residual "
+                "of the returned solution is %.3e — the fused compilation "
+                "likely reassociated the compensated arithmetic; use "
+                "refine_dtype='float64' (trusted residuals) or report "
+                "this configuration" % (reported, actual))
+        telemetry_emit(event="df32_check", reported=reported,
+                       actual=actual, n=len(b64))
+        return actual
 
     def __repr__(self):
         return ("make_solver\n===========\nSolver: %s\n\nPreconditioner:\n%r"

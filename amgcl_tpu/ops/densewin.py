@@ -51,10 +51,13 @@ _DWIN_OK: dict = {}
 
 
 def max_total_bytes() -> int:
-    """Per-matrix storage budget (AMGCL_TPU_DWIN_MAX_BYTES, default 6 GB
-    — the 85k-row FE fine level at f32 is 3.9 GB on 16 GB HBM; the
-    hierarchy's coarse levels add a fraction of that, and make_solver
-    reuses the fine-level operator instead of converting twice)."""
+    """Dense-window storage budget (AMGCL_TPU_DWIN_MAX_BYTES, default
+    6 GB — the 85k-row FE fine level at f32 is 3.9 GB on 16 GB HBM).
+    Hierarchy builds thread a shared :class:`telemetry.ledger
+    .DeviceMemoryBudget` seeded from this value through every conversion
+    (models/amg.py), so the cap bounds the SUM across the hierarchy; a
+    standalone ``csr_to_dense_window`` call without a budget still
+    applies it per matrix."""
     try:
         return int(os.environ.get("AMGCL_TPU_DWIN_MAX_BYTES",
                                   str(6 << 30)))
@@ -117,18 +120,22 @@ class DenseWindowMatrix:
     def _mv_xla(self, x):
         # testing / fallback path: per-tile dynamic-slice windows (lowers
         # to a gather of window slices — fine on CPU, slow on TPU; the
-        # Pallas kernel is the production path there)
+        # Pallas kernel is the production path there). The product runs
+        # at the DECLARED result_type(blocks, x) — a wider x (f64 rhs
+        # against f32 blocks) must not be silently demoted to the block
+        # dtype before the multiply.
         n_tiles, tile, win = self.blocks.shape
+        out_dtype = jnp.result_type(self.dtype, x.dtype)
         xp = jnp.pad(x, (0, win))
 
         def one(start, blk):
             xw = lax.dynamic_slice(xp, (start,), (win,))
-            return jnp.sum(blk * xw[None, :].astype(blk.dtype), axis=1)
+            return jnp.sum(blk.astype(out_dtype)
+                           * xw[None, :].astype(out_dtype), axis=1)
 
         y = jax.vmap(one)(self.window_starts.astype(jnp.int32),
                           self.blocks)
-        return y.reshape(n_tiles * tile)[:self.shape[0]].astype(
-            jnp.result_type(self.dtype, x.dtype))
+        return y.reshape(n_tiles * tile)[:self.shape[0]]
 
 
 def kernel_supported(win: int, tile: int = _TILE, dtype=jnp.float32,
@@ -216,8 +223,12 @@ def dense_window_spmv(window_starts, blocks, x, win, n_out,
 
     def kernel(starts_smem, x_hbm, b_ref, o_ref, xw, sem):
         row = _dwin_dma(pl, pltpu, starts_smem, x_hbm, xw, sem)
-        prod = b_ref[0] * row[:][None, :].astype(b_ref.dtype)
-        o_ref[0] = jnp.sum(prod, axis=1).astype(o_ref.dtype)
+        # promote BOTH operands to the declared result dtype — computing
+        # at the block dtype would silently round a wider x down (and a
+        # bf16-block * f32-x product to bf16)
+        prod = b_ref[0].astype(out_dtype) \
+            * row[:][None, :].astype(out_dtype)
+        o_ref[0] = jnp.sum(prod, axis=1)
 
     out = pl.pallas_call(
         kernel,
@@ -251,9 +262,12 @@ def dense_window_fused(window_starts, blocks, f, x, w, mode, win, n_out,
     def kernel(starts_smem, x_hbm, b_ref, f_ref, *rest):
         (*wx_refs, o_ref, xw, sem) = rest
         row = _dwin_dma(pl, pltpu, starts_smem, x_hbm, xw, sem)
-        prod = b_ref[0] * row[:][None, :].astype(b_ref.dtype)
-        r = f_ref[0].astype(out_dtype) \
-            - jnp.sum(prod, axis=1).astype(out_dtype)
+        # same promotion rule as dense_window_spmv: the A x product runs
+        # at the declared result dtype, never at the (possibly narrower)
+        # block dtype
+        prod = b_ref[0].astype(out_dtype) \
+            * row[:][None, :].astype(out_dtype)
+        r = f_ref[0].astype(out_dtype) - jnp.sum(prod, axis=1)
         if mode == "residual":
             o_ref[0] = r
         else:
@@ -283,13 +297,21 @@ def dense_window_scaled_correction(window_starts, blocks, w, f, x, win,
 
 def csr_to_dense_window(A: CSR, dtype=jnp.float32, tile: int = _TILE,
                         max_bytes: int | None = None,
-                        require_kernel: bool = False):
+                        require_kernel: bool = False,
+                        budget=None):
     """Build the dense-window form of a scalar CSR, or None when any row
     tile's column span exceeds the storage budget (no banded locality —
     apply RCM first). The dense blocks are materialized ON DEVICE from
     the compact (cols, vals) arrays via K one-hot accumulation passes —
     a host-side dense build would ship n·win floats through the
-    interconnect; this ships ~nnz and streams the output once."""
+    interconnect; this ships ~nnz and streams the output once.
+
+    ``budget`` (telemetry.ledger.DeviceMemoryBudget) is the shared
+    hierarchy-wide HBM pool: when given, the build declines once the
+    block storage would overdraw what earlier conversions left, and
+    charges the pool on success — so ``to_device('auto')`` across a whole
+    hierarchy can never materialize more dense-window bytes than ONE
+    budget, instead of one budget per matrix."""
     if A.is_block or np.dtype(dtype).kind == "c":
         return None
     n, m = A.shape
@@ -298,8 +320,13 @@ def csr_to_dense_window(A: CSR, dtype=jnp.float32, tile: int = _TILE,
     from amgcl_tpu.ops.unstructured import tile_windows
     n_tiles, rows, tiles, starts, win = tile_windows(A, tile)
     itemsize = jnp.dtype(dtype).itemsize
-    budget = max_total_bytes() if max_bytes is None else max_bytes
-    if n_tiles * tile * win * itemsize > budget:
+    need = n_tiles * tile * win * itemsize
+    if budget is not None:
+        cap = budget.remaining() if max_bytes is None \
+            else min(budget.remaining(), max_bytes)
+    else:
+        cap = max_total_bytes() if max_bytes is None else max_bytes
+    if need > cap:
         return None
     # VMEM: the pipeline double-buffers the (tile, win) block + window
     if (2 * tile + 4) * win * itemsize > 10 << 20:
@@ -330,5 +357,10 @@ def csr_to_dense_window(A: CSR, dtype=jnp.float32, tile: int = _TILE,
         return B
 
     B = jax.jit(build)(cols3, vals3)
+    if budget is not None:
+        # commit only for a build that actually materialized; the charge
+        # cannot fail — `need` was checked against remaining() above and
+        # nothing else draws from the pool between (single-threaded setup)
+        budget.try_charge(need, tag="dwin n=%d win=%d" % (n, win))
     return DenseWindowMatrix(jnp.asarray(starts.astype(np.int32)), B,
                              A.shape, win)

@@ -316,13 +316,19 @@ def dia_efficiency(A: CSR):
 
 def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
               max_diags: int | None = None, max_fill: float | None = None,
-              dense_cutoff: int = 2048):
+              dense_cutoff: int = 2048, budget=None):
     """Move a host matrix to the device in a TPU-friendly format.
 
     ``fmt``: 'auto' | 'ell' | 'dia' | 'dense'. Auto picks DIA when the
     matrix is banded enough (zero-gather SpMV), dense below a size cutoff,
     ELL otherwise. This is the host→device boundary of the setup phase
-    (reference: amgcl/amg.hpp:356-364 `copy_matrix`)."""
+    (reference: amgcl/amg.hpp:356-364 `copy_matrix`).
+
+    ``budget`` (telemetry.ledger.DeviceMemoryBudget): shared HBM pool the
+    dense-window conversion draws from — a hierarchy build passes ONE
+    budget for all its levels (models/amg.py), so auto-selection can
+    never stack per-matrix allowances into an OOM. Without a budget the
+    conversion falls back to the per-matrix env cap."""
     from amgcl_tpu.ops.stencil import HostDia
     if isinstance(A, HostDia):
         # stencil-setup smoother operators live in DIA layout already
@@ -348,7 +354,7 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
         return W
     if fmt == "dwin":
         from amgcl_tpu.ops.densewin import csr_to_dense_window
-        D = csr_to_dense_window(A, dtype)
+        D = csr_to_dense_window(A, dtype, budget=budget)
         if D is None:
             raise ValueError(
                 "dense-window format needs banded column locality within "
@@ -381,10 +387,12 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 # wins whenever the matrix has banded locality. SQUARE
                 # operators only: auto-converting every rectangular
                 # transfer too would multiply the per-matrix budget by
-                # the hierarchy depth with no global accounting
-                # (explicit fmt='dwin' remains available)
+                # the hierarchy depth without an accounting seam — the
+                # shared ``budget`` (one per hierarchy build) is that
+                # seam (explicit fmt='dwin' remains available)
                 from amgcl_tpu.ops.densewin import csr_to_dense_window
-                D = csr_to_dense_window(A, dtype, require_kernel=True)
+                D = csr_to_dense_window(A, dtype, require_kernel=True,
+                                        budget=budget)
                 if D is not None:
                     return D
             # unstructured but banded (e.g. after Cuthill-McKee): windowed

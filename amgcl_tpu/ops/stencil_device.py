@@ -75,6 +75,20 @@ def enabled() -> bool:
     return jax.default_backend() == "tpu"
 
 
+def tpu_setup_path() -> bool:
+    """Which _level_setup formulation to trace: the TPU-tuned static
+    unrolled branches (fnma rows / parity collapse), or the scan
+    formulation that keeps per-shard op counts bounded on CPU.
+
+    AMGCL_TPU_FORCE_TPU_SETUP_PATH=1 forces the TPU branches on other
+    backends so CPU CI can exercise and cross-check them (they were
+    previously reachable only on real hardware). The flag is read at
+    TRACE time: flipping it between builds of the same shapes needs a
+    ``_level_setup.clear_cache()`` (the jit cache does not key on env)."""
+    return (jax.default_backend() == "tpu"
+            or os.environ.get("AMGCL_TPU_FORCE_TPU_SETUP_PATH") == "1")
+
+
 # -- static-plan helpers ------------------------------------------------------
 
 def _osum(a, b):
@@ -148,10 +162,11 @@ def _fnma_scan(out, src, dst_pad, pairs, pad, n):
     worst-case copy is (n,) not (rows, n)."""
     if not pairs:
         return out
-    if jax.default_backend() != "tpu":
+    if not tpu_setup_path():
         # CPU (tests on the virtual mesh): the original pair scan — the
         # unrolled form below multiplies the traced op count per shard
         # and blows the 8-virtual-device sharded compile time ~6x
+        # (AMGCL_TPU_FORCE_TPU_SETUP_PATH=1 overrides, see tpu_setup_path)
         parr = jnp.asarray(np.asarray(pairs, np.int32))
 
         def sbody(acc, p):
@@ -282,7 +297,7 @@ def _level_setup(adata, eps_strong, relax_scale, smoother_omega, offs,
     n_c = c2 * c1 * c0
     acc0 = jnp.zeros((len(c_offs), n_c), dt)
 
-    if jax.default_backend() == "tpu":
+    if tpu_setup_path():
         # static unrolled collapse: the table is host-known, so every
         # destination row index is STATIC — a scan carrying the whole
         # (c_offs, n_c) accumulator with traced scatter rows forced a

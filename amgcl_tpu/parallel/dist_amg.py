@@ -771,10 +771,79 @@ class DistAMGSolver:
             int(it), float(res),
             wall_time_s=_time.perf_counter() - t0,
             solver=type(self.solver).__name__,
+            resources=self.resource_ledger(),
             extra={"devices": int(nd),
                    **({"first_call": True} if first_call else {})})
         _tel_emit(info.to_dict(), event="dist_solve", n=self.n)
         return host_full(x)[:self.n], info
+
+    def resource_ledger(self):
+        """Distributed resource ledger: per-sharded-level halo comm per
+        SpMV, aggregated cycle/iteration wire volume across the mesh, and
+        the memory side (sharded device bytes + the replicated tail's
+        hierarchy ledger). Cached per build; never raises."""
+        cached = getattr(self, "_resources_cache", None)
+        if cached is not None:
+            return cached
+        from amgcl_tpu.telemetry import ledger as L
+        try:
+            nd = int(self.mesh.shape[ROWS_AXIS])
+            itemsize = jnp.dtype(self.prm.dtype).itemsize
+            sweeps = self.prm.npre + self.prm.npost + 1  # sweeps + resid
+            lv_rows = []
+            cyc = {"msgs": 0, "bytes": 0}
+            for k, lv in enumerate(self.hier.levels):
+                c = L.comm_model(lv.A, nd) or {"msgs": 0, "bytes": 0}
+                row = {"level": k, "per_spmv": c,
+                       "spmvs_per_cycle": sweeps}
+                cyc["msgs"] += c["msgs"] * sweeps
+                cyc["bytes"] += c["bytes"] * sweeps
+                for T in (lv.P_op, lv.R_op):
+                    tc = L.comm_model(T, nd) if T is not None else None
+                    if tc:
+                        cyc["msgs"] += tc["msgs"]
+                        cyc["bytes"] += tc["bytes"]
+                lv_rows.append(row)
+            if self.hier.trans is not None:
+                # transition restrict psums the FULL replicated coarse
+                # vector across the mesh once per cycle
+                nc = int(self.hier.trans.r_cols.shape[1])
+                red = L.allreduce_model(nd, nc, itemsize)
+                cyc["msgs"] += red["msgs"]
+                cyc["bytes"] += red["bytes"]
+                lv_rows.append({"level": "transition",
+                                "allreduce": {"count": nc, **red}})
+            pre_cycles = max(int(self.prm.pre_cycles), 1)
+            top = self.hier.top_A if self.hier.top_A is not None \
+                else (self.hier.levels[0].A if self.hier.levels else None)
+            sname = type(self.solver).__name__
+            spmvs, papps, dots, _ = L.KRYLOV_OPS.get(sname, (1, 1, 4, 4))
+            top_comm = (L.comm_model(top, nd) if top is not None
+                        else None) or {"msgs": 0, "bytes": 0}
+            red1 = L.allreduce_model(nd, 1, itemsize)
+            per_iter = {
+                "msgs": (spmvs * top_comm["msgs"]
+                         + papps * pre_cycles * cyc["msgs"]
+                         + dots * red1["msgs"]),
+                "bytes": (spmvs * top_comm["bytes"]
+                          + papps * pre_cycles * cyc["bytes"]
+                          + dots * red1["bytes"])}
+            cached = {
+                "comm": {"devices": nd, "levels": lv_rows,
+                         "per_cycle": cyc, "per_iteration": per_iter},
+                "memory": {
+                    # global logical bytes of the sharded arrays (each
+                    # shard holds 1/nd of these)
+                    "sharded_bytes": L._leaf_bytes(
+                        (self.hier.levels, self.hier.trans,
+                         self.hier.top_A)),
+                    # the replicated tail lives whole on EVERY shard
+                    "replicated_bytes": L._leaf_bytes(self.hier.rep),
+                }}
+        except Exception as e:
+            cached = {"error": repr(e)[:200]}
+        self._resources_cache = cached
+        return cached
 
     def __repr__(self):
         return ("DistAMGSolver over %d devices\n%r"

@@ -64,6 +64,23 @@ class DistEllMatrix:
         return DistEllMatrix(s, s, s, s, P(ROWS_AXIS, None, None),
                              self.shape, self.nloc, self.ncloc)
 
+    def halo_comm(self, nd: int):
+        """Wire model of ONE halo-exchange SpMV (the ledger hook,
+        telemetry/ledger.comm_model): the all_to_all moves each shard's
+        C-slot slab to every other shard — nd(nd−1) wire messages of C
+        values (the self-slab never leaves the chip). C is the static
+        padded slab width from the halo plan, so this is the scheduled
+        volume, an upper bound on the useful halo values."""
+        nd = int(nd)
+        if nd <= 1 or self.send_idx is None:
+            return {"pattern": "all_to_all", "msgs": 0, "bytes": 0}
+        C = int(self.send_idx.shape[-1])
+        itemsize = np.dtype(self.loc_vals.dtype).itemsize \
+            if self.loc_vals is not None else 4
+        msgs = nd * (nd - 1)
+        return {"pattern": "all_to_all", "msgs": msgs,
+                "bytes": msgs * C * itemsize, "slab_width": C}
+
     # -- device kernel (inside shard_map) ----------------------------------
 
     def shard_mv(self, x_local):

@@ -49,6 +49,23 @@ class DistDiaMatrix:
             return 0
         return max(max(self.offsets), -min(self.offsets), 0)
 
+    def halo_comm(self, nd: int):
+        """Wire model of ONE halo-exchange SpMV over ``nd`` shards (the
+        ledger hook, telemetry/ledger.comm_model): the ring exchange in
+        dia_halo_mv moves the w-row edge slab in each direction between
+        every adjacent pair — 2(nd−1) messages of w elements. The thin-
+        slab all_gather fallbacks move more; this models the production
+        regime (w ≤ shard size)."""
+        nd = int(nd)
+        w = self.halo
+        if nd <= 1 or w == 0:
+            return {"pattern": "ring", "msgs": 0, "bytes": 0}
+        itemsize = np.dtype(self.data.dtype).itemsize \
+            if self.data is not None else 4
+        msgs = 2 * (nd - 1)
+        return {"pattern": "ring", "msgs": msgs,
+                "bytes": msgs * w * itemsize, "halo_width": w}
+
     def tree_flatten(self):
         return (self.data,), (self.offsets, self.shape)
 

@@ -1119,6 +1119,12 @@ class StripAMGSolver:
         from amgcl_tpu.parallel.dist_amg import DistAMGSolver
         return DistAMGSolver.__call__(self, rhs, x0)
 
+    # ... and so is the resource ledger __call__ attaches to the report
+    # (hier/prm/mesh carry everything the comm/memory models read)
+    def resource_ledger(self):
+        from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+        return DistAMGSolver.resource_ledger(self)
+
     def __repr__(self):
         lines = ["StripAMGSolver over %d devices (strip-parallel setup)"
                  % self.mesh.shape[ROWS_AXIS]]

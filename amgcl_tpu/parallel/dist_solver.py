@@ -92,9 +92,21 @@ def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
                                                                      vec)
     fn = _compiled_dist_cg(mesh, A.offsets, A.shape, int(maxiter), float(tol))
     x, it, res = fn(A.data, rhs, x0, dinv)
+    nd = int(mesh.shape[ROWS_AXIS])
+    # halo/psum wire model (telemetry/ledger.py): the Jacobi-CG body runs
+    # one halo SpMV and three psum'd dots per iteration
+    from amgcl_tpu.telemetry.ledger import comm_model, krylov_comm_model
+    spmv_comm = comm_model(A, nd)
+    resources = {"comm": {
+        "devices": nd,
+        "per_spmv": spmv_comm,
+        "per_iteration": krylov_comm_model(
+            spmv_comm, nd, jnp.dtype(rhs.dtype).itemsize,
+            spmvs=1, dots=3)}}
     report = SolveReport(
         int(it), float(res), wall_time_s=_time.perf_counter() - t0,
-        solver="dist_cg", extra={"devices": int(mesh.shape[ROWS_AXIS])})
+        solver="dist_cg", resources=resources,
+        extra={"devices": nd})
     _tel_emit(report.to_dict(), event="dist_solve", n=int(A.shape[0]))
     out = _DistResult((x, int(it), float(res)))
     out.report = report

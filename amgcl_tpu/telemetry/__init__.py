@@ -21,10 +21,22 @@ Four pieces:
 
 from amgcl_tpu.telemetry.report import SolveReport
 from amgcl_tpu.telemetry.history import HistoryMixin
-from amgcl_tpu.telemetry.tracing import phase, annotate
+from amgcl_tpu.telemetry.tracing import phase, annotate, setup_scope
 from amgcl_tpu.telemetry.sink import (JsonlSink, NullSink, emit,
                                       get_default_sink, set_default_sink)
+from amgcl_tpu.telemetry.ledger import (DeviceMemoryBudget,
+                                        dense_window_budget,
+                                        hierarchy_ledger, summarize_ledger,
+                                        format_ledger, mv_cost,
+                                        cycle_cost_model,
+                                        krylov_iteration_model, comm_model,
+                                        allreduce_model, krylov_comm_model,
+                                        xla_cost_analysis)
 
 __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
-           "JsonlSink", "NullSink", "emit", "get_default_sink",
-           "set_default_sink"]
+           "setup_scope", "JsonlSink", "NullSink", "emit",
+           "get_default_sink", "set_default_sink", "DeviceMemoryBudget",
+           "dense_window_budget", "hierarchy_ledger", "summarize_ledger",
+           "format_ledger", "mv_cost", "cycle_cost_model",
+           "krylov_iteration_model", "comm_model", "allreduce_model",
+           "krylov_comm_model", "xla_cost_analysis"]

@@ -1,0 +1,469 @@
+"""Resource ledger — where the hierarchy's bytes, FLOPs and messages go.
+
+The reference reports *time* (profiler.hpp) and *structure* (the level
+table of amg.hpp:560-598); what it never accounts is the resource side
+that actually limits a sparse solver on an accelerator: device memory by
+storage format, HBM traffic per cycle stage, and (distributed) halo
+bytes on the wire. This module is the single place those models live:
+
+* :class:`DeviceMemoryBudget` — a shared byte budget one hierarchy build
+  threads through every ``to_device('auto')`` call, so storage-hungry
+  formats (the dense-window blocks, ops/densewin.py) decrement ONE
+  hierarchy-wide pool instead of each matrix consulting the per-matrix
+  ``AMGCL_TPU_DWIN_MAX_BYTES`` cap independently.
+* :func:`mv_cost` — analytic (flops, HBM bytes) of one SpMV per device
+  format; :func:`cycle_cost_model` composes them into the per-stage
+  FLOP/byte map of one multigrid cycle, :func:`krylov_iteration_model`
+  into the per-iteration cost of the outer Krylov loop. Divide the two
+  numbers and you have the roofline x-coordinate of each stage.
+* :func:`hierarchy_ledger` — the per-level device-memory map (operator /
+  transfer / smoother / fused-kernel bytes, by format) whose totals are
+  DEFINED as the leaf-byte sum of the hierarchy pytree, so they can never
+  drift from the live buffers (tests assert ledger total == AMG.bytes()).
+* :func:`comm_model` / :func:`allreduce_model` — halo-exchange message
+  counts and wire bytes per SpMV for the distributed matrix types, and
+  the ring-allreduce model for psum'd dots.
+* :func:`xla_cost_analysis` — optional cross-check of the analytic
+  numbers against XLA's own compiled cost analysis, where the backend
+  exposes one.
+
+Everything returned is plain JSON-clean data (ints/floats/strings) so it
+rides the telemetry sink unmodified.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+# ---------------------------------------------------------------------------
+# shared device-memory budget
+# ---------------------------------------------------------------------------
+
+class DeviceMemoryBudget:
+    """Byte budget shared across one hierarchy build.
+
+    Consumers ask ``remaining()`` before materializing a storage-hungry
+    buffer and ``try_charge(nbytes, tag)`` when they commit one; the
+    charge log keeps per-matrix attribution for the ledger. Exceeding the
+    budget is impossible by construction — ``try_charge`` refuses instead
+    of overdrawing."""
+
+    def __init__(self, total_bytes: int, name: str = "dense_window"):
+        self.total = int(total_bytes)
+        self.name = name
+        self.used = 0
+        self.charges = []           # [(tag, bytes), ...]
+
+    def remaining(self) -> int:
+        return self.total - self.used
+
+    def try_charge(self, nbytes: int, tag: str = "") -> bool:
+        nbytes = int(nbytes)
+        if nbytes < 0 or self.used + nbytes > self.total:
+            return False
+        self.used += nbytes
+        self.charges.append((tag, nbytes))
+        return True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "total_bytes": self.total,
+                "used_bytes": self.used,
+                "remaining_bytes": self.remaining(),
+                "charges": [{"tag": t, "bytes": b}
+                            for t, b in self.charges]}
+
+    def __repr__(self):
+        return "DeviceMemoryBudget(%s: %d/%d bytes)" % (
+            self.name, self.used, self.total)
+
+
+def dense_window_budget() -> DeviceMemoryBudget:
+    """Fresh hierarchy-wide dense-window budget from
+    ``AMGCL_TPU_DWIN_MAX_BYTES`` (same knob as before, new semantics: the
+    cap now bounds the SUM over every dense-window conversion that
+    shares the budget, not each matrix separately)."""
+    from amgcl_tpu.ops.densewin import max_total_bytes
+    return DeviceMemoryBudget(max_total_bytes(), name="dense_window")
+
+
+# ---------------------------------------------------------------------------
+# per-format analytic SpMV cost
+# ---------------------------------------------------------------------------
+
+def _leaf_bytes(tree) -> int:
+    """Device bytes of every array leaf in a pytree (0 for None)."""
+    if tree is None:
+        return 0
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def _vec_dims(M):
+    """Scalar-expanded (rows, cols) of an operator (block-aware; a
+    GridTentative's 3-D ``block`` names grid coarsening factors, not a
+    value block — only 2-tuples scale the vector dims)."""
+    blk = getattr(M, "block", None)
+    br, bc = blk if isinstance(blk, tuple) and len(blk) == 2 else (1, 1)
+    return M.shape[0] * br, M.shape[1] * bc
+
+
+def _itemsize(M) -> int:
+    try:
+        return int(np.dtype(M.dtype).itemsize)
+    except Exception:
+        return 4
+
+
+def mv_cost(M) -> Dict[str, int]:
+    """Analytic cost of one ``y = M x``: ``{"flops", "bytes"}``.
+
+    The byte count is the HBM-traffic model (stored operator streamed
+    once + x read + y written), which is what bounds these kernels on
+    TPU; gather-paying formats move more in practice — this is the
+    roofline floor, not a measurement."""
+    if M is None:
+        return {"flops": 0, "bytes": 0}
+    name = type(M).__name__
+    rows, cols = _vec_dims(M)
+    itemsize = _itemsize(M)
+    stored = _leaf_bytes(M)
+    vec = (rows + cols) * itemsize
+    flops = None
+    if name in ("DiaMatrix", "DistDiaMatrix"):
+        flops = 2 * len(M.offsets) * rows
+    elif name == "EllMatrix":
+        flops = 2 * int(M.vals.size)
+    elif name == "DenseMatrix":
+        flops = 2 * rows * cols
+    elif name == "DenseWindowMatrix":
+        flops = 2 * int(M.blocks.size)
+    elif name == "WindowedEllMatrix":
+        flops = 2 * int(M.vals.size)
+    elif name in ("GridTentative", "AggTentative"):
+        # piecewise-constant transfer: one add per fine point
+        flops = rows
+    elif name in ("TentativeP", "TentativeR"):
+        inner = mv_cost(M.T)
+        return {"flops": inner["flops"], "bytes": inner["bytes"]}
+    elif name == "ImplicitSmoothedP":
+        inner = mv_cost(M.M)
+        return {"flops": mv_cost(M.T)["flops"] + inner["flops"] + rows,
+                "bytes": stored + vec}
+    elif name == "ImplicitSmoothedR":
+        inner = mv_cost(M.Mt)
+        return {"flops": mv_cost(M.T)["flops"] + inner["flops"] + rows,
+                "bytes": stored + vec}
+    if flops is None:
+        # generic fallback: two flops per stored value
+        flops = 2 * max(stored // max(itemsize, 1), 1)
+    return {"flops": int(flops), "bytes": int(stored + vec)}
+
+
+# ---------------------------------------------------------------------------
+# cycle / iteration cost models
+# ---------------------------------------------------------------------------
+
+def _add(a, b):
+    return {"flops": a["flops"] + b["flops"], "bytes": a["bytes"] + b["bytes"]}
+
+
+def _scale(a, k):
+    return {"flops": a["flops"] * k, "bytes": a["bytes"] * k}
+
+
+def cycle_cost_model(hier) -> Dict[str, Any]:
+    """Per-stage FLOPs/HBM-bytes of ONE multigrid cycle of ``hier``
+    (models/amg.Hierarchy or compatible). Stage model per level: a
+    smoother sweep streams the operator once plus ~3 vector passes
+    (f, x in, x out); the residual the operator plus two vectors;
+    transfers stream themselves plus their two vectors. W-cycles visit
+    level i ``ncycle**i`` times."""
+    levels = getattr(hier, "levels", [])
+    npre = getattr(hier, "npre", 1)
+    npost = getattr(hier, "npost", 1)
+    ncycle = max(getattr(hier, "ncycle", 1), 1)
+    coarse = getattr(hier, "coarse", None)
+    stages = []
+    total = {"flops": 0, "bytes": 0}
+    for i, lv in enumerate(levels):
+        A = getattr(lv, "A", None)
+        visits = ncycle ** i
+        if A is None:
+            stages.append({"level": i, "visits": visits, "skipped": True})
+            continue
+        n, _ = _vec_dims(A)
+        itemsize = _itemsize(A)
+        vec = n * itemsize
+        a_cost = mv_cost(A)
+        row: Dict[str, Any] = {"level": i, "visits": visits}
+        if i == len(levels) - 1:
+            if coarse is not None:
+                cb = _leaf_bytes(coarse)
+                row["coarse_solve"] = {"flops": 2 * n * n,
+                                       "bytes": cb + 2 * vec}
+            else:
+                # smoother-as-coarse-solve: one standalone application
+                row["coarse_solve"] = _add(
+                    {"flops": n, "bytes": 2 * vec},
+                    {"flops": 0, "bytes": _leaf_bytes(lv.relax)})
+            level_total = row["coarse_solve"]
+        else:
+            sweep = _add(a_cost, {"flops": 3 * n, "bytes": 3 * vec})
+            resid = _add(a_cost, {"flops": n, "bytes": 2 * vec})
+            row["pre_smooth"] = _scale(sweep, npre)
+            row["restrict"] = _add(resid, mv_cost(lv.R))
+            row["prolong"] = _add(mv_cost(lv.P),
+                                  {"flops": n, "bytes": 2 * vec})
+            row["post_smooth"] = _scale(sweep, npost)
+            level_total = {"flops": 0, "bytes": 0}
+            for key in ("pre_smooth", "restrict", "prolong", "post_smooth"):
+                level_total = _add(level_total, row[key])
+        total = _add(total, _scale(level_total, visits))
+        stages.append(row)
+    out = {"stages": stages, "total": dict(total)}
+    if total["bytes"]:
+        out["total"]["flop_per_byte"] = round(
+            total["flops"] / total["bytes"], 4)
+    return out
+
+
+#: per-iteration operation counts (spmv, precond applies, dots, axpys) —
+#: the documented model behind krylov_iteration_model; approximate for the
+#: restarted methods (counts are per inner step).
+KRYLOV_OPS = {
+    "CG":         (1, 1, 3, 3),
+    "BiCGStab":   (2, 2, 7, 6),
+    "BiCGStabL":  (2, 2, 8, 8),
+    "GMRES":      (1, 1, 4, 4),
+    "FGMRES":     (1, 1, 4, 4),
+    "LGMRES":     (1, 1, 6, 6),
+    "IDRs":       (2, 2, 8, 8),
+    "Richardson": (1, 1, 1, 2),
+    "PreOnly":    (0, 1, 0, 0),
+}
+
+
+def krylov_iteration_model(solver_name: str, A_dev,
+                           cycle_total: Optional[Dict[str, int]] = None,
+                           pre_cycles: int = 1) -> Dict[str, Any]:
+    """FLOPs/HBM-bytes of one outer Krylov iteration: the solver's SpMVs
+    and vector work plus ``pre_cycles`` multigrid cycles per
+    preconditioner application (``cycle_total`` from cycle_cost_model)."""
+    spmv, papp, dots, axpys = KRYLOV_OPS.get(solver_name, (1, 1, 4, 4))
+    n, _ = _vec_dims(A_dev) if A_dev is not None else (0, 0)
+    itemsize = _itemsize(A_dev) if A_dev is not None else 4
+    vec = n * itemsize
+    cost = _scale(mv_cost(A_dev), spmv)
+    cost = _add(cost, {"flops": (2 * dots + 2 * axpys) * n,
+                       "bytes": (2 * dots + 3 * axpys) * vec})
+    if cycle_total:
+        cost = _add(cost, _scale(
+            {"flops": cycle_total["flops"], "bytes": cycle_total["bytes"]},
+            papp * max(int(pre_cycles), 1)))
+    out = {"solver": solver_name, "spmvs": spmv, "precond_applies": papp,
+           "dots": dots, "axpys": axpys, **cost}
+    if cost["bytes"]:
+        out["flop_per_byte"] = round(cost["flops"] / cost["bytes"], 4)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# hierarchy memory ledger
+# ---------------------------------------------------------------------------
+
+def hierarchy_ledger(hier, host_levels=None,
+                     budget: Optional[DeviceMemoryBudget] = None,
+                     setup_profile=None) -> Dict[str, Any]:
+    """Per-level device-memory map of a hierarchy.
+
+    Totals are the leaf-byte sums of exactly the pytree slots a Level
+    carries (A, relax, P, R, down, up) plus the coarse solver — the same
+    leaves ``AMG.bytes()`` walks, so ``totals.bytes`` equals the live
+    buffer total by construction."""
+    levels = []
+    by_format: Dict[str, int] = {}
+    tot = {"operator": 0, "transfer": 0, "relax": 0, "fused": 0}
+    for i, lv in enumerate(getattr(hier, "levels", [])):
+        A = getattr(lv, "A", None)
+        op_b = _leaf_bytes(A)
+        p_b = _leaf_bytes(getattr(lv, "P", None))
+        r_b = _leaf_bytes(getattr(lv, "R", None))
+        rx_b = _leaf_bytes(getattr(lv, "relax", None))
+        fu_b = _leaf_bytes(getattr(lv, "down", None)) \
+            + _leaf_bytes(getattr(lv, "up", None))
+        fmt = type(A).__name__ if A is not None else None
+        row = {
+            "level": i,
+            "format": fmt,
+            "bytes": {"operator": op_b, "P": p_b, "R": r_b,
+                      "relax": rx_b, "fused": fu_b,
+                      "total": op_b + p_b + r_b + rx_b + fu_b},
+            "spmv": mv_cost(A),
+        }
+        if host_levels is not None and i < len(host_levels):
+            Ai = host_levels[i][0]
+            row["rows"] = int(Ai.nrows)
+            row["nnz"] = int(Ai.nnz)
+        levels.append(row)
+        if fmt:
+            by_format[fmt] = by_format.get(fmt, 0) + op_b
+        for Tm in (getattr(lv, "P", None), getattr(lv, "R", None)):
+            if Tm is not None:
+                tname = "transfer/" + type(Tm).__name__
+                by_format[tname] = by_format.get(tname, 0) + _leaf_bytes(Tm)
+        tot["operator"] += op_b
+        tot["transfer"] += p_b + r_b
+        tot["relax"] += rx_b
+        tot["fused"] += fu_b
+    coarse_b = _leaf_bytes(getattr(hier, "coarse", None))
+    out: Dict[str, Any] = {
+        "levels": levels,
+        "coarse_solver_bytes": coarse_b,
+        "totals": {**tot,
+                   "bytes": sum(tot.values()) + coarse_b,
+                   "by_format": by_format},
+        "cycle": cycle_cost_model(hier),
+    }
+    if budget is not None:
+        out["dense_window"] = budget.to_dict()
+    if setup_profile is not None:
+        to_dict = getattr(setup_profile, "to_dict", None)
+        out["setup"] = to_dict() if callable(to_dict) else setup_profile
+    return out
+
+
+def summarize_ledger(led: Dict[str, Any]) -> Dict[str, Any]:
+    """Compact one-record summary of a hierarchy ledger — what bench.py
+    embeds (and the regression gate compares as 'peak ledger bytes')."""
+    out = {
+        "hierarchy_bytes": led["totals"]["bytes"],
+        "by_format": led["totals"]["by_format"],
+        "cycle_flops": led["cycle"]["total"]["flops"],
+        "cycle_bytes": led["cycle"]["total"]["bytes"],
+    }
+    fpb = led["cycle"]["total"].get("flop_per_byte")
+    if fpb is not None:
+        out["cycle_flop_per_byte"] = fpb
+    dw = led.get("dense_window")
+    if dw is not None:
+        out["dense_window_used"] = dw["used_bytes"]
+        out["dense_window_total"] = dw["total_bytes"]
+    return out
+
+
+def _human_bytes(n: float) -> str:
+    for unit in ("B", "K", "M", "G"):
+        if abs(n) < 1024 or unit == "G":
+            return "%.2f %s" % (n, unit)
+        n /= 1024.0
+
+
+def format_ledger(led: Dict[str, Any]) -> str:
+    """Human-readable rendering of a hierarchy ledger (the CLI's
+    ``--ledger`` table)."""
+    lines = ["Resource ledger:",
+             "level  format            operator  transfer     relax"
+             "     fused   F/B(spmv)",
+             "-" * 78]
+    for row in led["levels"]:
+        b = row["bytes"]
+        sp = row["spmv"]
+        fpb = (sp["flops"] / sp["bytes"]) if sp["bytes"] else 0.0
+        lines.append("%5d  %-16s %9s %9s %9s %9s %9.3f" % (
+            row["level"], row["format"] or "-",
+            _human_bytes(b["operator"]), _human_bytes(b["P"] + b["R"]),
+            _human_bytes(b["relax"]), _human_bytes(b["fused"]), fpb))
+    t = led["totals"]
+    lines.append("-" * 78)
+    lines.append("total device bytes: %s  (operator %s, transfer %s, "
+                 "relax %s, fused %s, coarse %s)" % (
+                     _human_bytes(t["bytes"]), _human_bytes(t["operator"]),
+                     _human_bytes(t["transfer"]), _human_bytes(t["relax"]),
+                     _human_bytes(t["fused"]),
+                     _human_bytes(led["coarse_solver_bytes"])))
+    cyc = led["cycle"]["total"]
+    lines.append("one cycle: %.3g MFLOP / %s streamed  ->  %.3f flop/byte"
+                 % (cyc["flops"] / 1e6, _human_bytes(cyc["bytes"]),
+                    cyc.get("flop_per_byte", 0.0)))
+    dw = led.get("dense_window")
+    if dw is not None:
+        lines.append("dense-window budget: %s / %s used" % (
+            _human_bytes(dw["used_bytes"]), _human_bytes(dw["total_bytes"])))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# distributed communication models
+# ---------------------------------------------------------------------------
+
+def comm_model(M, nd: int) -> Optional[Dict[str, Any]]:
+    """Halo-exchange messages and wire bytes of ONE distributed SpMV.
+
+    Delegates to the matrix's own ``halo_comm(nd)`` (dist_matrix /
+    dist_ell define it next to the exchange they model); None when the
+    operator has no distributed exchange."""
+    fn = getattr(M, "halo_comm", None)
+    if callable(fn):
+        return fn(int(nd))
+    return None
+
+
+def allreduce_model(nd: int, count: int, itemsize: int) -> Dict[str, int]:
+    """Ring-allreduce wire model of ``lax.psum`` over ``count`` elements:
+    2(nd-1) steps, each moving count/nd elements per device pair —
+    ~2·count·itemsize total on the wire for large nd."""
+    nd = max(int(nd), 1)
+    if nd == 1:
+        return {"msgs": 0, "bytes": 0}
+    msgs = 2 * (nd - 1)
+    return {"msgs": msgs, "bytes": int(2 * (nd - 1) / nd * count * itemsize)}
+
+
+def krylov_comm_model(spmv_comm: Optional[Dict[str, Any]], nd: int,
+                      itemsize: int, spmvs: int = 1,
+                      dots: int = 3) -> Dict[str, Any]:
+    """Per-iteration comm of a distributed Krylov loop: the SpMV halo
+    exchanges plus one scalar allreduce per inner product."""
+    base = {"msgs": 0, "bytes": 0}
+    if spmv_comm:
+        base = {"msgs": spmv_comm["msgs"] * spmvs,
+                "bytes": spmv_comm["bytes"] * spmvs}
+    red = allreduce_model(nd, 1, itemsize)
+    return {"msgs": base["msgs"] + dots * red["msgs"],
+            "bytes": base["bytes"] + dots * red["bytes"],
+            "spmvs": spmvs, "dots": dots}
+
+
+# ---------------------------------------------------------------------------
+# XLA cross-check
+# ---------------------------------------------------------------------------
+
+def xla_cost_analysis(fn, *args) -> Optional[Dict[str, float]]:
+    """Compile ``fn(*args)`` and read XLA's own cost analysis — the
+    cross-check for the analytic models above. Returns
+    ``{"flops", "bytes_accessed"}`` or None when the backend does not
+    expose cost analysis (never raises)."""
+    try:
+        import jax
+        c = jax.jit(fn).lower(*args).compile().cost_analysis()
+        if isinstance(c, (list, tuple)):
+            c = c[0] if c else None
+        if not c:
+            return None
+        out = {}
+        if c.get("flops") is not None:
+            out["flops"] = float(c["flops"])
+        ba = c.get("bytes accessed", c.get("bytes_accessed"))
+        if ba is not None:
+            out["bytes_accessed"] = float(ba)
+        return out or None
+    except Exception:
+        return None

@@ -47,6 +47,10 @@ class SolveReport:
     wall_time_s: Optional[float] = None
     solver: Optional[str] = None  # Krylov solver class name
     hierarchy: Optional[Dict[str, Any]] = None  # AMG.hierarchy_stats() dict
+    #: resource ledger (telemetry/ledger.py): per-level device bytes by
+    #: format, analytic FLOP/byte per cycle and per Krylov iteration,
+    #: dense-window budget use, (distributed) halo bytes per iteration
+    resources: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -86,6 +90,8 @@ class SolveReport:
             out["history"] = [float(v) for v in self.history]
         if self.hierarchy is not None:
             out["hierarchy"] = self.hierarchy
+        if self.resources is not None:
+            out["resources"] = self.resources
         if self.extra:
             out.update(self.extra)
         return out

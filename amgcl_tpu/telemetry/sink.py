@@ -151,15 +151,20 @@ class NullSink:
 
 
 _default_sink = None
+_default_sink_explicit = False   # a set_default_sink(NullSink()) must
+#                                  stick — only env-derived NullSinks are
+#                                  re-resolved against the env var
 
 
 def get_default_sink():
     """The process-global sink, from ``AMGCL_TPU_TELEMETRY`` (a JSONL
     path) when set, else a NullSink. The env var is re-checked while the
-    default is still a NullSink, so exporting it after the first solve
-    still takes effect (an explicit set_default_sink always wins)."""
+    default is still an env-derived NullSink, so exporting it after the
+    first solve still takes effect — but an explicit set_default_sink
+    (including an explicit NullSink opt-out) always wins."""
     global _default_sink
-    if _default_sink is None or isinstance(_default_sink, NullSink):
+    if not _default_sink_explicit and (
+            _default_sink is None or isinstance(_default_sink, NullSink)):
         path = os.environ.get("AMGCL_TPU_TELEMETRY")
         if path:
             _default_sink = JsonlSink(path)
@@ -170,8 +175,9 @@ def get_default_sink():
 
 def set_default_sink(sink) -> None:
     """Install ``sink`` (None resets to the env-driven default)."""
-    global _default_sink
+    global _default_sink, _default_sink_explicit
     _default_sink = sink
+    _default_sink_explicit = sink is not None
 
 
 _emit_warned = False

@@ -17,7 +17,7 @@ telemetry never becomes a hard dependency of the numerics.
 
 from __future__ import annotations
 
-from contextlib import nullcontext
+from contextlib import contextmanager, nullcontext
 
 PREFIX = "amgcl/"
 
@@ -39,3 +39,20 @@ def annotate(name: str):
         return TraceAnnotation(PREFIX + name)
     except Exception:
         return nullcontext()
+
+
+@contextmanager
+def setup_scope(prof, name: str):
+    """Setup-phase instrumentation in one wrapper: a tic/toc scope on
+    ``prof`` (utils/profiler.Profiler — wall time, optionally device-
+    synced) AND an ``amgcl/setup/<name>`` host annotation so a
+    ``jax.profiler`` capture of the build shows the same tree. ``prof``
+    may be None (annotation only) — the numerics never depend on a
+    profiler being attached."""
+    ann = annotate("setup/" + name)
+    if prof is None:
+        with ann:
+            yield
+        return
+    with ann, prof.scope(name):
+        yield

@@ -23,6 +23,12 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # tunnel is alive, refresh last-good
     python bench.py --check [paths] # run the tier-1 pytest line and emit
                                     # a JSONL record with DOTS_PASSED
+                                    # (also runs the regression gate)
+    python bench.py --gate [cand]   # regression gate: compare a candidate
+                                    # record (default: the last-good run
+                                    # itself) against BENCH_LAST_GOOD.json
+                                    # under AMGCL_TPU_GATE_* tolerances;
+                                    # exit nonzero on regression
 
 All JSON emission routes through the telemetry sink
 (amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
@@ -944,6 +950,16 @@ def main_worker():
         "value": round(t_solve, 4),
         "vs_baseline": round(solve_base / t_solve, 3)})
 
+    # resource ledger (telemetry/ledger.py): hierarchy bytes by format,
+    # analytic cycle FLOP/byte, dense-window budget use — the gate's
+    # 'peak ledger bytes' source and the roofline x-coordinate
+    try:
+        from amgcl_tpu.telemetry.ledger import summarize_ledger
+        _PARTIAL["ledger"] = summarize_ledger(
+            solver.precond.resource_ledger())
+    except Exception as e:
+        _PARTIAL["ledger"] = {"error": repr(e)[:200]}
+
     # bandwidth observability: documented traffic model / measured time
     per_iter_bytes = _traffic_model(solver, prm.npre, prm.npost,
                                     prm.pre_cycles)
@@ -1053,6 +1069,120 @@ def main_worker():
 
 
 # ===========================================================================
+# regression gate: compare a candidate bench record against the last-good
+# ===========================================================================
+
+def gate_tolerances():
+    """Gate tolerances, env-tunable so the supervisor can tighten them as
+    the bench trajectory stabilizes:
+
+      AMGCL_TPU_GATE_ITERS  — allowed ABSOLUTE iteration increase (def 2)
+      AMGCL_TPU_GATE_TIME   — allowed solve-time ratio (default 1.25:
+                              chained timings still jitter ~10-15% across
+                              chip sessions, see BENCH_r0*.json)
+      AMGCL_TPU_GATE_BYTES  — allowed peak-ledger-bytes ratio (def 1.10)
+    """
+    def _f(name, default):
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return float(default)
+
+    return {"iters": _f("AMGCL_TPU_GATE_ITERS", 2),
+            "time": _f("AMGCL_TPU_GATE_TIME", 1.25),
+            "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10)}
+
+
+def _record_ledger_bytes(rec):
+    """Peak hierarchy bytes of a bench record: the ledger summary when the
+    record carries one, else the hierarchy stats' total (older records),
+    else None (comparison skipped)."""
+    led = rec.get("ledger") or {}
+    v = led.get("hierarchy_bytes")
+    if v is None:
+        v = (rec.get("hierarchy") or {}).get("bytes")
+    return v
+
+
+def run_gate(candidate, last_good, tol=None):
+    """Compare ``candidate`` against ``last_good`` under the tolerances.
+
+    Returns (ok, checks): one check row per metric — iterations (absolute
+    slack), solve time and peak ledger bytes (ratios). A metric missing
+    on either side is 'skipped', not a regression (pre-ledger records
+    carry no byte accounting)."""
+    tol = tol or gate_tolerances()
+    checks = []
+
+    def check(name, cand, base, limit):
+        if cand is None or base is None:
+            checks.append({"check": name, "status": "skipped",
+                           "candidate": cand, "last_good": base})
+            return
+        checks.append({"check": name, "candidate": cand,
+                       "last_good": base, "limit": round(limit, 6),
+                       "status": "ok" if cand <= limit else "regression"})
+
+    it0 = last_good.get("iters")
+    check("iters", candidate.get("iters"), it0,
+          it0 + tol["iters"] if it0 is not None else 0)
+    t0 = last_good.get("value")
+    check("solve_time", candidate.get("value"), t0,
+          t0 * tol["time"] if t0 is not None else 0)
+    b0 = _record_ledger_bytes(last_good)
+    check("ledger_bytes", _record_ledger_bytes(candidate), b0,
+          b0 * tol["bytes"] if b0 is not None else 0)
+    ok = not any(c["status"] == "regression" for c in checks)
+    return ok, checks
+
+
+def _gate_last_good():
+    """Gate baseline record: AMGCL_TPU_GATE_LAST_GOOD overrides the repo
+    BENCH_LAST_GOOD.json (tests and ad-hoc comparisons)."""
+    path = os.environ.get("AMGCL_TPU_GATE_LAST_GOOD", _LAST_GOOD_PATH)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except Exception:
+        return None
+
+
+def main_gate(args=None):
+    """``bench.py --gate [candidate.json]``: exit 0 when the candidate
+    (default: the last-good record itself — the self-consistency run CI
+    gets) stays within tolerances of the last-good record, 1 on a
+    regression, 2 on an unreadable candidate. Emits ONE JSONL record
+    either way."""
+    tol = gate_tolerances()
+    lg = _gate_last_good()
+    cand_src = "last_good"
+    cand = lg
+    if args:
+        cand_src = args[0]
+        try:
+            with open(cand_src) as f:
+                cand = json.load(f)
+        except Exception as e:
+            rec = {"event": "bench_gate", "ok": False,
+                   "error": "unreadable candidate %r: %r" % (cand_src, e)}
+            _stdout_sink.emit(rec)
+            _sink.emit(dict(rec))
+            return 2
+    if lg is None or cand is None:
+        rec = {"event": "bench_gate", "ok": True, "status": "no_baseline",
+               "tolerances": tol}
+        _stdout_sink.emit(rec)
+        _sink.emit(dict(rec))
+        return 0
+    ok, checks = run_gate(cand, lg, tol)
+    rec = {"event": "bench_gate", "ok": ok, "candidate_src": cand_src,
+           "tolerances": tol, "checks": checks, "commit": _git_head()}
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    return 0 if ok else 1
+
+
+# ===========================================================================
 # tier-1 check: run the ROADMAP pytest line, emit DOTS_PASSED as JSONL
 # ===========================================================================
 
@@ -1076,7 +1206,13 @@ def count_dots(text: str) -> int:
 def main_check(targets=None):
     """Run the tier-1 pytest line in a subprocess (CPU-forced, like the
     driver) and emit ONE JSONL record carrying DOTS_PASSED, the return
-    code and the duration — to stdout and the process-global sink.
+    code and the duration — to stdout and the process-global sink. The
+    bench regression gate rides along (AMGCL_TPU_GATE_IN_CHECK=0 opts
+    out): the record gains a ``gate`` field and a gate regression fails
+    the check, so CI inherits the gate for free. The gate candidate
+    defaults to the last-good record itself (a self-consistency pass);
+    point AMGCL_TPU_GATE_CANDIDATE at a fresh bench record to score a
+    new run.
 
     ``targets``: optional pytest paths/flags replacing the default
     ``tests/`` target (lets callers check a subset quickly)."""
@@ -1101,9 +1237,36 @@ def main_check(targets=None):
            "commit": _git_head()}
     if err:
         rec["error"] = err
+    gate_ok = True
+    if os.environ.get("AMGCL_TPU_GATE_IN_CHECK", "1") != "0":
+        lg = _gate_last_good()
+        cand = lg
+        cand_src = "last_good"
+        cpath = os.environ.get("AMGCL_TPU_GATE_CANDIDATE")
+        if cpath:
+            cand_src = cpath
+            try:
+                with open(cpath) as f:
+                    cand = json.load(f)
+            except Exception:
+                cand = None
+        if cpath and cand is None:
+            # an unreadable EXPLICIT candidate is a failure regardless of
+            # the baseline — the caller asked to score it (same contract
+            # as `--gate <path>`'s exit 2)
+            gate_ok = False
+            rec["gate"] = {"ok": False, "status": "unreadable_candidate",
+                           "candidate_src": cand_src}
+        elif lg is None:
+            gate_ok = True
+            rec["gate"] = {"ok": True, "status": "no_baseline"}
+        else:
+            gate_ok, checks = run_gate(cand, lg)
+            rec["gate"] = {"ok": gate_ok, "candidate_src": cand_src,
+                           "checks": checks}
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
-    return 0 if rc == 0 else 1
+    return 0 if (rc == 0 and gate_ok) else 1
 
 
 if __name__ == "__main__":
@@ -1114,5 +1277,8 @@ if __name__ == "__main__":
     elif "--check" in sys.argv:
         extra = sys.argv[sys.argv.index("--check") + 1:]
         sys.exit(main_check(extra))
+    elif "--gate" in sys.argv:
+        extra = sys.argv[sys.argv.index("--gate") + 1:]
+        sys.exit(main_gate(extra))
     else:
         main_supervisor()

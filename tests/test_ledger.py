@@ -1,0 +1,480 @@
+"""Resource ledger (ISSUE 2): hierarchy-wide HBM accounting, the shared
+dense-window budget, FLOP/byte and comm-volume models, setup-phase
+profiling, the bench regression gate, and the satellite fixes
+(forced TPU setup path, dense-window mixed-dtype promotion, df32
+runtime residual validation)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import scipy.sparse as sp
+import jax
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.telemetry.ledger import (DeviceMemoryBudget, mv_cost,
+                                        cycle_cost_model,
+                                        krylov_iteration_model,
+                                        comm_model, allreduce_model,
+                                        format_ledger, summarize_ledger,
+                                        xla_cost_analysis)
+from amgcl_tpu.utils.sample_problem import poisson3d, poisson3d_block
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _tridiag(n=256):
+    T = sp.diags([-np.ones(n - 1), 2 * np.ones(n), -np.ones(n - 1)],
+                 [-1, 0, 1]).tocsr()
+    return CSR.from_scipy(T)
+
+
+# ---------------------------------------------------------------------------
+# shared dense-window budget
+# ---------------------------------------------------------------------------
+
+def test_budget_object_semantics():
+    b = DeviceMemoryBudget(100, name="t")
+    assert b.try_charge(60, "a") and b.used == 60 and b.remaining() == 40
+    assert not b.try_charge(41, "too big")      # refuse, never overdraw
+    assert b.used == 60
+    assert b.try_charge(40, "b") and b.remaining() == 0
+    assert not b.try_charge(1)
+    d = b.to_dict()
+    assert d["used_bytes"] == 100 and d["total_bytes"] == 100
+    assert [c["tag"] for c in d["charges"]] == ["a", "b"]
+    json.dumps(d)
+
+
+def test_dense_window_draws_from_shared_budget():
+    """Two conversions against one budget: the second declines once the
+    pool cannot cover it — the per-matrix env cap no longer stacks."""
+    from amgcl_tpu.ops.densewin import csr_to_dense_window
+    A = _tridiag()
+    D0 = csr_to_dense_window(A, jnp.float32)
+    assert D0 is not None
+    need = int(D0.blocks.size) * 4
+    b = DeviceMemoryBudget(need + need // 2)
+    D1 = csr_to_dense_window(A, jnp.float32, budget=b)
+    assert D1 is not None and b.used == need
+    # pool cannot cover a second full conversion
+    assert csr_to_dense_window(A, jnp.float32, budget=b) is None
+    assert b.used == need                        # no partial charge
+
+
+def test_to_device_dwin_respects_budget():
+    from amgcl_tpu.ops import device as dev
+    A = _tridiag()
+    D = dev.to_device(A, "dwin", jnp.float32)
+    b = DeviceMemoryBudget(int(D.blocks.size) * 4)
+    assert dev.to_device(A, "dwin", jnp.float32, budget=b) is not None
+    with pytest.raises(ValueError, match="budget"):
+        dev.to_device(A, "dwin", jnp.float32, budget=b)
+
+
+def test_hierarchy_build_shares_one_budget(monkeypatch):
+    """Every to_device call of one AMG build receives the SAME budget
+    object (the hierarchy-wide pool), including the coarse level."""
+    from amgcl_tpu.ops import device as dev
+    seen = []
+    orig = dev.to_device
+
+    def spy(A, fmt="auto", dtype=jnp.float32, **kw):
+        seen.append(kw.get("budget"))
+        return orig(A, fmt, dtype, **kw)
+
+    monkeypatch.setattr(dev, "to_device", spy)
+    A, _ = poisson3d(10)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    budgets = [b for b in seen if b is not None]
+    assert len(budgets) >= 2
+    assert all(b is budgets[0] for b in budgets)
+    assert budgets[0] is amg._dwin_budget
+    # the Krylov-side copy and a rebuild() draw from the same pool too
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        CG(), matrix_format="dia")
+    seen.clear()
+    solve.rebuild(A)
+    budgets = [b for b in seen if b is not None]
+    assert budgets and all(b is solve.precond._dwin_budget
+                           for b in budgets)
+
+
+# ---------------------------------------------------------------------------
+# hierarchy ledger invariants
+# ---------------------------------------------------------------------------
+
+def test_ledger_totals_match_live_bytes_scalar():
+    """Ledger totals are DEFINED as the leaf-byte sum of the hierarchy
+    pytree — they must equal AMG.bytes() exactly."""
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    led = amg.resource_ledger()
+    assert led["totals"]["bytes"] == amg.bytes()
+    per_level = sum(lv["bytes"]["total"] for lv in led["levels"])
+    assert per_level + led["coarse_solver_bytes"] == amg.bytes()
+    # by-format operator classification covers the operator total
+    ops = sum(v for k, v in led["totals"]["by_format"].items()
+              if not k.startswith("transfer/"))
+    assert ops == led["totals"]["operator"]
+    json.dumps(led)                         # JSONL-sink clean
+    assert "Resource ledger" in format_ledger(led)
+    s = summarize_ledger(led)
+    assert s["hierarchy_bytes"] == amg.bytes()
+    assert s["cycle_flops"] > 0 and s["cycle_bytes"] > 0
+
+
+def test_ledger_totals_match_live_bytes_block():
+    A, _ = poisson3d_block(6, 3)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=100))
+    led = amg.resource_ledger()
+    assert led["totals"]["bytes"] == amg.bytes()
+    assert led["levels"][0]["format"] in ("EllMatrix", "WindowedEllMatrix")
+    assert led["levels"][0]["spmv"]["flops"] > 0
+
+
+def test_hierarchy_stats_carries_ledger_fields():
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    st = amg.hierarchy_stats()
+    lv0 = st["levels"][0]
+    assert lv0["bytes"]["operator"] > 0
+    assert lv0["spmv"]["flops"] > 0 and lv0["spmv"]["bytes"] > 0
+    assert st["cycle"]["flops"] > 0 and st["cycle"]["bytes"] > 0
+    assert 0 < st["cycle"]["flop_per_byte"] < 10
+    json.dumps(st)
+
+
+def test_setup_profile_covers_build_phases():
+    """ISSUE 2 tentpole (d): the setup phase is profiled — coarsening,
+    galerkin, device transfer, smoother setup, coarse solver."""
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    scopes = amg.setup_profile.to_dict()["scopes"]
+    names = set(scopes)
+    assert "level0/coarsening" in names
+    assert "level0/galerkin" in names
+    assert "level0/transfer" in names
+    assert "level0/relax_setup" in names
+    assert "coarse_solver" in names
+    assert all(v["total_s"] >= 0 for v in scopes.values())
+    led = amg.resource_ledger()
+    assert "level0/coarsening" in led["setup"]["scopes"]
+
+
+def test_cycle_model_against_xla_cost_analysis():
+    """The analytic cycle FLOPs cross-check against XLA's own compiled
+    cost analysis (where exposed): same order of magnitude."""
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    hier = amg.hierarchy
+    r0 = jnp.zeros(hier.system_matrix.shape[0], jnp.float64)
+    xc = xla_cost_analysis(lambda r: hier.apply(r), r0)
+    if xc is None or not xc.get("flops"):
+        pytest.skip("backend exposes no cost analysis")
+    model = cycle_cost_model(hier)["total"]["flops"]
+    assert 0.2 < model / xc["flops"] < 5.0
+
+
+def test_solve_report_resources():
+    A, rhs = poisson3d(12)
+    solve = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                        CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    res = info.resources
+    assert res["memory"]["bytes"] == solve.precond.bytes()
+    assert res["per_iteration"]["flops"] > 0
+    assert res["per_iteration"]["solver"] == "CG"
+    assert res["cycle"]["total"]["bytes"] > 0
+    rec = json.loads(info.to_json())
+    assert rec["resources"]["memory"]["bytes"] == res["memory"]["bytes"]
+    # second call reuses the cached ledger (same object)
+    x, info2 = solve(rhs)
+    assert info2.resources is res
+
+
+def test_mv_cost_formats():
+    from amgcl_tpu.ops import device as dev
+    A = _tridiag()
+    dia = dev.csr_to_dia(A, jnp.float32)
+    c = mv_cost(dia)
+    assert c["flops"] == 2 * 3 * 256
+    ell = dev.csr_to_ell(A, jnp.float32)
+    assert mv_cost(ell)["flops"] == 2 * ell.vals.size
+    dense = dev.DenseMatrix(jnp.zeros((8, 8), jnp.float32))
+    assert mv_cost(dense) == {"flops": 128, "bytes": 256 + 64}
+    assert mv_cost(None) == {"flops": 0, "bytes": 0}
+
+
+def test_krylov_iteration_model_includes_precond():
+    from amgcl_tpu.ops import device as dev
+    dia = dev.csr_to_dia(_tridiag(), jnp.float32)
+    base = krylov_iteration_model("CG", dia)
+    with_pc = krylov_iteration_model("CG", dia,
+                                     {"flops": 1000, "bytes": 5000})
+    assert with_pc["flops"] == base["flops"] + 1000
+    assert with_pc["bytes"] == base["bytes"] + 5000
+
+
+# ---------------------------------------------------------------------------
+# distributed comm accounting
+# ---------------------------------------------------------------------------
+
+def test_dist_dia_comm_scales_with_partitions():
+    """Halo wire bytes grow with the shard count: 2(nd-1) edge messages
+    of halo_width values."""
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+    from amgcl_tpu.parallel.dist_solver import dist_cg
+    A, rhs = poisson3d(8)
+    per_iter = {}
+    for nd in (2, 4):
+        mesh = make_mesh(nd)
+        M = DistDiaMatrix.from_csr(A, mesh, jnp.float64)
+        c = comm_model(M, nd)
+        assert c["pattern"] == "ring"
+        assert c["msgs"] == 2 * (nd - 1)
+        assert c["bytes"] == 2 * (nd - 1) * M.halo * 8
+        out = dist_cg(M, mesh, jnp.asarray(rhs), maxiter=50, tol=1e-8)
+        res = out.report.resources["comm"]
+        assert res["per_spmv"] == c
+        per_iter[nd] = res["per_iteration"]["bytes"]
+    assert per_iter[4] > per_iter[2]
+
+
+def test_dist_amg_resources():
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+    A, rhs = poisson3d(8)
+    s = DistAMGSolver(A, make_mesh(4),
+                      AMGParams(dtype=jnp.float64, coarse_enough=200))
+    x, info = s(rhs)
+    comm = info.resources["comm"]
+    assert comm["devices"] == 4
+    assert comm["per_cycle"]["bytes"] > 0
+    assert comm["per_iteration"]["bytes"] >= comm["per_cycle"]["bytes"]
+    assert info.resources["memory"]["sharded_bytes"] > 0
+    assert info.resources["memory"]["replicated_bytes"] > 0
+    json.loads(info.to_json())
+
+
+def test_dist_ell_comm_model():
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.parallel.dist_ell import build_dist_ell
+    A, _ = poisson3d(8)
+    nd = 4
+    M = build_dist_ell(A, make_mesh(nd), jnp.float64)
+    c = comm_model(M, nd)
+    assert c["pattern"] == "all_to_all"
+    assert c["msgs"] == nd * (nd - 1)
+    assert c["bytes"] == nd * (nd - 1) * M.send_idx.shape[-1] * 8
+    assert allreduce_model(1, 10, 8) == {"msgs": 0, "bytes": 0}
+    assert allreduce_model(4, 4, 8)["msgs"] == 6
+
+
+# ---------------------------------------------------------------------------
+# bench regression gate
+# ---------------------------------------------------------------------------
+
+def _bench():
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench
+
+
+def test_gate_pass_fail_unit(monkeypatch):
+    bench = _bench()
+    lg = {"value": 1.0, "iters": 10, "ledger": {"hierarchy_bytes": 1000}}
+    ok, checks = bench.run_gate(dict(lg), lg)
+    assert ok and all(c["status"] == "ok" for c in checks)
+    for key, bad in [("value", 2.0), ("iters", 20),
+                     ("ledger", {"hierarchy_bytes": 2000})]:
+        cand = dict(lg, **{key: bad})
+        ok, checks = bench.run_gate(cand, lg)
+        assert not ok, key
+        assert sum(c["status"] == "regression" for c in checks) == 1
+    # tolerances are env-tunable (AMGCL_TPU_GATE_*)
+    monkeypatch.setenv("AMGCL_TPU_GATE_TIME", "3.0")
+    ok, _ = bench.run_gate(dict(lg, value=2.0), lg)
+    assert ok
+    # a pre-ledger baseline skips the byte check instead of failing
+    old = {"value": 1.0, "iters": 10}
+    ok, checks = bench.run_gate(dict(lg), old)
+    assert ok
+    assert [c for c in checks if c["check"] == "ledger_bytes"][0][
+        "status"] == "skipped"
+    # hierarchy-stats bytes serve as the fallback source
+    assert bench._record_ledger_bytes(
+        {"hierarchy": {"bytes": 7}}) == 7
+
+
+def test_gate_subprocess_roundtrip(tmp_path):
+    """bench.py --gate exits 0 on the last-good run and nonzero on an
+    injected time regression (acceptance criterion)."""
+    lg = {"metric": "m", "value": 1.0, "iters": 10, "unit": "s",
+          "ledger": {"hierarchy_bytes": 1000}}
+    lg_path = tmp_path / "last_good.json"
+    lg_path.write_text(json.dumps(lg))
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps(dict(lg, value=5.0)))
+    env = dict(os.environ, AMGCL_TPU_GATE_LAST_GOOD=str(lg_path))
+
+    def run(*args):
+        return subprocess.run(
+            [sys.executable, "bench.py", "--gate", *args],
+            capture_output=True, text=True, timeout=120, cwd=_REPO,
+            env=env)
+
+    r = run()                                   # self vs self
+    assert r.returncode == 0, r.stdout + r.stderr
+    rec = json.loads(r.stdout.splitlines()[-1])
+    assert rec["event"] == "bench_gate" and rec["ok"]
+    r = run(str(bad))
+    assert r.returncode == 1
+    rec = json.loads(r.stdout.splitlines()[-1])
+    assert not rec["ok"]
+    assert any(c["status"] == "regression" for c in rec["checks"])
+    r = run(str(tmp_path / "missing.json"))
+    assert r.returncode == 2
+
+
+def test_gate_rides_check_record(monkeypatch, tmp_path):
+    """--check embeds the gate outcome and fails on a gate regression
+    (CI gets the gate for free)."""
+    bench = _bench()
+    lg = {"value": 1.0, "iters": 10}
+    lg_path = tmp_path / "lg.json"
+    lg_path.write_text(json.dumps(lg))
+    cand = tmp_path / "cand.json"
+    cand.write_text(json.dumps(dict(lg, iters=50)))
+    monkeypatch.setenv("AMGCL_TPU_GATE_LAST_GOOD", str(lg_path))
+    monkeypatch.setenv("AMGCL_TPU_GATE_CANDIDATE", str(cand))
+    recs = []
+    monkeypatch.setattr(bench._stdout_sink, "emit",
+                        lambda rec=None, **kw: recs.append(dict(rec or {})))
+    monkeypatch.setattr(bench, "_TIER1_ARGS", ["-c", "pass"])
+
+    class _R:
+        returncode, stdout, stderr = 0, ". [100%]\n", ""
+
+    monkeypatch.setattr(bench.subprocess, "run",
+                        lambda *a, **k: _R())
+    rc = bench.main_check(["ignored"])
+    assert rc == 1                       # pytest passed, gate regressed
+    assert recs[-1]["gate"]["ok"] is False
+    cand.write_text(json.dumps(lg))      # clean candidate
+    rc = bench.main_check(["ignored"])
+    assert rc == 0 and recs[-1]["gate"]["ok"] is True
+    # an unreadable EXPLICIT candidate fails even with no baseline
+    monkeypatch.setenv("AMGCL_TPU_GATE_LAST_GOOD",
+                       str(tmp_path / "missing.json"))
+    monkeypatch.setenv("AMGCL_TPU_GATE_CANDIDATE",
+                       str(tmp_path / "typo.json"))
+    rc = bench.main_check(["ignored"])
+    assert rc == 1
+    assert recs[-1]["gate"]["status"] == "unreadable_candidate"
+    # ... while a plain missing baseline is a vacuous pass
+    monkeypatch.delenv("AMGCL_TPU_GATE_CANDIDATE")
+    rc = bench.main_check(["ignored"])
+    assert rc == 0 and recs[-1]["gate"]["status"] == "no_baseline"
+
+
+# ---------------------------------------------------------------------------
+# satellites
+# ---------------------------------------------------------------------------
+
+def test_forced_tpu_setup_path_matches_scan(monkeypatch):
+    """AMGCL_TPU_FORCE_TPU_SETUP_PATH=1 exercises the TPU-only unrolled
+    _fnma_scan / static-collapse branches on CPU and reproduces the scan
+    branch bit-for-bit."""
+    from amgcl_tpu.ops import stencil_device as sdev
+    monkeypatch.setenv("AMGCL_TPU_DEVICE_SETUP", "1")
+    A, _ = poisson3d(8)
+    prm = lambda: AMGParams(dtype=jnp.float32, coarse_enough=200)  # noqa
+    amg1 = AMG(A, prm())
+    assert amg1._device_built
+    ref = [np.asarray(lv.A.data) for lv in amg1.hierarchy.levels]
+    # the branch choice is baked in at trace time: clear the jit cache
+    # so the forced build really re-traces (see tpu_setup_path docstring)
+    sdev._level_setup.clear_cache()
+    monkeypatch.setenv("AMGCL_TPU_FORCE_TPU_SETUP_PATH", "1")
+    assert sdev.tpu_setup_path()
+    amg2 = AMG(A, prm())
+    assert amg2._device_built
+    got = [np.asarray(lv.A.data) for lv in amg2.hierarchy.levels]
+    monkeypatch.delenv("AMGCL_TPU_FORCE_TPU_SETUP_PATH")
+    sdev._level_setup.clear_cache()
+    assert len(ref) == len(got) >= 2
+    for a, b in zip(ref, got):
+        np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+
+
+def test_densewin_mixed_dtype_promotes():
+    """f64 x against f32 blocks computes at f64 (previously silently
+    demoted to the block dtype), in the XLA fallback and the
+    interpret-mode kernel alike."""
+    from amgcl_tpu.ops.densewin import csr_to_dense_window, \
+        dense_window_spmv, dense_window_residual
+    A = _tridiag()
+    D = csr_to_dense_window(A, jnp.float32)
+    x = np.random.RandomState(0).rand(256)
+    y = D.mv(jnp.asarray(x, jnp.float64))
+    assert y.dtype == jnp.float64
+    dense = np.zeros((256, 256))
+    rows = A.expanded_rows()
+    dense[rows, A.col] = A.val
+    ref = dense.astype(np.float32).astype(np.float64) @ x
+    np.testing.assert_allclose(np.asarray(y), ref, atol=1e-12)
+    # interpret-mode kernels: bf16 blocks x f32 vectors -> f32 compute
+    Db = csr_to_dense_window(A, jnp.bfloat16)
+    x32 = jnp.asarray(x, jnp.float32)
+    y2 = dense_window_spmv(Db.window_starts, Db.blocks, x32,
+                           Db.win, Db.shape[0], interpret=True)
+    assert y2.dtype == jnp.float32
+    ref16 = np.asarray(Db.blocks, np.float64).reshape(4, 64, Db.win)
+    f = jnp.asarray(np.random.RandomState(1).rand(256), jnp.float32)
+    r = dense_window_residual(Db.window_starts, Db.blocks, f, x32,
+                              Db.win, Db.shape[0], interpret=True)
+    assert r.dtype == jnp.float32
+    # promoted accumulate: within f32 roundoff of the exact bf16-valued
+    # product (a bf16 accumulate would be ~1e-2 off)
+    xpad = np.zeros(max(int(Db.window_starts[t]) + Db.win
+                        for t in range(4)) + 1)
+    xpad[:256] = x
+    exact = np.stack([
+        ref16[t] @ xpad[int(Db.window_starts[t]):
+                        int(Db.window_starts[t]) + Db.win]
+        for t in range(4)]).reshape(-1)[:256]
+    np.testing.assert_allclose(np.asarray(y2), exact, atol=1e-4)
+
+
+def test_df32_runtime_residual_validation():
+    """The first compiled df32 solve validates its reported residual
+    against a host f64 residual; harmful drift (reported converged,
+    true residual above target) warns."""
+    import warnings as _w
+    A, rhs = poisson3d(10)
+    s = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=200),
+                    CG(maxiter=100, tol=1e-6), refine=2,
+                    refine_dtype="df32")
+    with _w.catch_warnings():
+        _w.simplefilter("error")
+        x, info = s(rhs)                 # healthy solve: no warning
+    rhs32 = jnp.asarray(rhs, jnp.float32)
+    actual = s._check_df32_runtime(rhs32, x, float(info.resid))
+    assert actual == pytest.approx(float(info.resid), rel=1e-2)
+    # harmful drift: claimed 1e-15 while the true residual misses a
+    # 1e-12 target by orders of magnitude
+    s.solver.tol = 1e-12
+    with pytest.warns(UserWarning, match="df32 refinement drift"):
+        s._check_df32_runtime(rhs32, x, 1e-15)

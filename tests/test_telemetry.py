@@ -79,6 +79,25 @@ def test_emit_never_raises(tmp_path):
         sink_mod._emit_warned = old
 
 
+def test_explicit_nullsink_beats_env(tmp_path, monkeypatch):
+    """An explicit set_default_sink(NullSink()) opt-out must stick even
+    when AMGCL_TPU_TELEMETRY is exported — only env-derived NullSinks are
+    re-resolved against the env var."""
+    from amgcl_tpu import telemetry
+    from amgcl_tpu.telemetry import NullSink
+    path = tmp_path / "env.jsonl"
+    monkeypatch.setenv("AMGCL_TPU_TELEMETRY", str(path))
+    try:
+        telemetry.set_default_sink(NullSink())
+        telemetry.emit(event="silenced")
+        assert not path.exists()                 # opt-out honored
+        telemetry.set_default_sink(None)         # back to env-driven
+        telemetry.emit(event="audible")
+        assert path.exists()
+    finally:
+        telemetry.set_default_sink(None)
+
+
 def test_cg_history_monotone_ish():
     """AMG-preconditioned CG on Poisson: broadly decreasing residuals (no
     order-of-magnitude regressions between consecutive iterations)."""
