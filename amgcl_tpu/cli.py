@@ -50,6 +50,18 @@ def main(argv=None):
                          "--telemetry, emit it as a 'ledger' event; also "
                          "cross-checks the analytic cycle cost against "
                          "XLA's cost analysis where available")
+    ap.add_argument("--doctor", action="store_true",
+                    help="run the convergence doctor: probe the measured "
+                         "per-level convergence factors and smoother "
+                         "spectral radii (AMG.probe_convergence), then "
+                         "print ranked findings from the solve report, "
+                         "health guards and ledger with suggested "
+                         "parameter changes (telemetry.diagnose)")
+    ap.add_argument("--trace", metavar="OUT.json",
+                    help="write the profiler's scope timings as "
+                         "Chrome/Perfetto trace-event JSON — open in "
+                         "ui.perfetto.dev (includes the hierarchy "
+                         "setup-phase profile as its own track)")
     args = ap.parse_args(argv)
 
     # honor 64-bit dtype requests before any jax array is created
@@ -133,6 +145,10 @@ def main(argv=None):
         x, info = solve(rhs, x0)
 
     inner = getattr(solve, "solve", solve)
+    precond_obj = getattr(inner, "precond", None) \
+        or getattr(inner, "host_amg", None)
+    ledger_fn = getattr(inner, "resource_ledger", None) \
+        or getattr(precond_obj, "resource_ledger", None)
     print(getattr(inner, "__repr__", lambda: "")() or "")
     print(info)          # SolveReport.__str__: iterations/error/rate/wall
     print()
@@ -141,12 +157,8 @@ def main(argv=None):
     if args.ledger:
         from amgcl_tpu.telemetry.ledger import (format_ledger,
                                                 xla_cost_analysis)
-        precond_obj = getattr(inner, "precond", None) \
-            or getattr(inner, "host_amg", None)
-        rl = getattr(inner, "resource_ledger", None) \
-            or getattr(precond_obj, "resource_ledger", None)
-        if callable(rl):
-            led = rl()
+        if callable(ledger_fn):
+            led = ledger_fn()
             print()
             if "levels" in led:
                 print(format_ledger(led))
@@ -173,11 +185,43 @@ def main(argv=None):
         else:
             print("(no resource ledger: %r exposes none)" % type(inner))
 
+    if args.doctor:
+        from amgcl_tpu.telemetry.health import diagnose, format_findings
+        probe = None
+        if hasattr(precond_obj, "probe_convergence"):
+            # measured per-level cycle factors + smoother spectral radii
+            # (telemetry/health.py probes; cached on the AMG object, so
+            # hierarchy_stats()/repeat --doctor runs reuse them)
+            with prof.scope("probe"):
+                probe = precond_obj.probe_convergence()
+            print()
+            print("Per-level convergence probe:")
+            print("level      rows   conv.factor   smoother rho")
+            print("---------------------------------------------")
+            for row in probe:
+                cf = row.get("conv_factor")
+                sr = row.get("smoother_rho")
+                print("%5s %9s %13s %14s"
+                      % (row["level"], row.get("rows", "-"),
+                         "%.4f" % cf if cf is not None else "-",
+                         "%.4f" % sr if sr is not None else "-"))
+        led = None
+        try:
+            led = ledger_fn() if callable(ledger_fn) else None
+        except Exception:
+            pass                     # the doctor works from what exists
+        solver_obj = getattr(inner, "solver", None)
+        findings = diagnose(info, ledger=led, probe=probe,
+                            tol=getattr(solver_obj, "tol", None),
+                            maxiter=getattr(solver_obj, "maxiter", None))
+        print()
+        print(format_findings(findings))
+        telemetry.emit(event="doctor", findings=findings,
+                       **({"probe": probe} if probe is not None else {}))
+
     if args.telemetry:
         # structured duplicates of the text report, one JSONL record each
-        precond = getattr(inner, "precond", None) \
-            or getattr(inner, "host_amg", None)
-        stats = getattr(precond, "hierarchy_stats", None)
+        stats = getattr(precond_obj, "hierarchy_stats", None)
         cli_rec = info.to_dict(with_history=False)
         cli_rec.pop("hierarchy", None)   # the dedicated event below
         telemetry.emit(event="cli", argv=list(argv) if argv else
@@ -185,6 +229,24 @@ def main(argv=None):
         if callable(stats):
             telemetry.emit(event="hierarchy", **stats())
         telemetry.emit(event="profile", **prof.to_dict())
+
+    if args.trace:
+        # Chrome/Perfetto trace-event JSON of the host-side scope
+        # timings; the hierarchy's setup-phase profiler rides along as
+        # its own named track
+        import json as _json
+        trace = prof.to_chrome_trace(tid=0, tid_name="cli")
+        setup_prof = getattr(precond_obj, "setup_profile", None)
+        if setup_prof is not None and setup_prof is not prof:
+            # shared epoch: the setup track's events land where setup
+            # actually ran on the CLI timeline (inside the 'setup' span),
+            # not at t=0 of their own profiler's birth
+            trace["traceEvents"] += setup_prof.to_chrome_trace(
+                tid=1, tid_name="amg setup",
+                epoch=prof._t0)["traceEvents"]
+        with open(args.trace, "w") as f:
+            _json.dump(trace, f)
+        print("trace written to %s (open in ui.perfetto.dev)" % args.trace)
 
     if args.output:
         xa = np.asarray(x)

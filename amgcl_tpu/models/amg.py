@@ -194,6 +194,7 @@ class AMG:
         self._device_built = False
         self._dev_prefix = []
         self._ledger_cache = None
+        self._probe_cache = None
         # setup-phase profiler (PR 1 instrumented the SOLVE phase only):
         # device-synced tic/toc scopes + amgcl/setup/* host annotations
         # around coarsening / galerkin / device transfer / smoother
@@ -291,6 +292,7 @@ class AMG:
         from amgcl_tpu.utils.profiler import Profiler
         prof = self.setup_profile = Profiler.device()
         self._ledger_cache = None
+        self._probe_cache = None
         host = []
         Acur = A
         for i, (_, P, R) in enumerate(self.host_levels[:-1]):
@@ -403,14 +405,36 @@ class AMG:
             self._ledger_cache = cached
         return cached
 
+    def probe_convergence(self, n_iters: int = 12, seed: int = 1234,
+                          with_smoother: bool = True):
+        """Measured per-level convergence diagnostics (telemetry/
+        health.py): for each level, the error-reduction factor of the
+        multigrid cycle rooted there (test-vector cycling on a zero rhs,
+        normalized each step — the asymptotic AMG convergence factor)
+        and the smoother's spectral-radius estimate by power iteration.
+        A level whose factor approaches 1 is where the coarsening fails
+        — identifiable before the first solve. Cached per build (the
+        probe jit-compiles one small program per level);
+        ``hierarchy_stats()`` folds the cached rows into its per-level
+        report and ``cli.py --doctor`` prints them."""
+        cached = getattr(self, "_probe_cache", None)
+        if cached is None:
+            from amgcl_tpu.telemetry.health import probe_hierarchy
+            cached = probe_hierarchy(self.hierarchy, n_iters=n_iters,
+                                     seed=seed,
+                                     with_smoother=with_smoother)
+            self._probe_cache = cached
+        return cached
+
     def hierarchy_stats(self):
         """Structured hierarchy report: per-level rows/nnz/dtype/device
         format plus grid and operator complexity — the machine-readable
         source both ``__repr__`` and the JSONL telemetry path render from
         (reference prints this as text only, amg.hpp:560-598). Each level
         additionally carries its device-byte breakdown and analytic SpMV
-        cost from the resource ledger, and the top level the whole-cycle
-        FLOP/byte totals."""
+        cost from the resource ledger — and, once ``probe_convergence()``
+        has run, the measured convergence factor + smoother spectral
+        radius — and the top level the whole-cycle FLOP/byte totals."""
         host = self.host_levels
         nnz0 = host[0][0].nnz
         rows0 = host[0][0].nrows
@@ -436,6 +460,11 @@ class AMG:
             if i < len(led["levels"]):
                 row["bytes"] = led["levels"][i]["bytes"]
                 row["spmv"] = led["levels"][i]["spmv"]
+            probe = getattr(self, "_probe_cache", None)
+            if probe is not None and i < len(probe):
+                row["conv_factor"] = probe[i].get("conv_factor")
+                if probe[i].get("smoother_rho") is not None:
+                    row["smoother_rho"] = probe[i]["smoother_rho"]
             levels.append(row)
         out = {
             "n_levels": len(host),

@@ -235,7 +235,13 @@ class make_solver:
         with phase("krylov/" + type(self.solver).__name__):
             got = self.solver.solve(A_dev, apply_precond, rhs, x0)
         x, iters, resid = got[:3]
-        hist = got[3] if len(got) > 3 else None
+        # trailing elements by the solver's declared flags: history when
+        # record_history, the HealthState when guard (telemetry/history.py
+        # _hist_result — index arithmetic, not shape-guessing)
+        rec_hist = bool(getattr(self.solver, "record_history", False))
+        hist = got[3] if rec_hist else None
+        hstate = got[3 + rec_hist] \
+            if getattr(self.solver, "guard", False) else None
         hist_n = iters          # history covers the initial solve only
         if self.refine > 0:
             # correction-form iterative refinement (classic mixed-
@@ -300,28 +306,35 @@ class make_solver:
 
                 state0 = x.astype(wide)
                 norm_src = rhs64
-            x, iters, resid = self._refine_loop(
+            x, iters, resid, hstate = self._refine_loop(
                 A_dev, apply_precond, rhs, state0, iters, norm_src,
-                true_res, accumulate, finalize)
-        return x, iters, resid, hist, hist_n
+                true_res, accumulate, finalize, hstate)
+        return x, iters, resid, hist, hist_n, hstate
 
     def _refine_loop(self, A_dev, apply_precond, rhs, state0, iters,
-                     norm_src, true_res, accumulate, finalize):
+                     norm_src, true_res, accumulate, finalize,
+                     hstate=None):
         """Shared refinement scaffolding: while the scaled residual norm
         of ``true_res(state)`` exceeds tol (up to ``refine`` restarts),
         solve the correction in working precision and ``accumulate`` it
         into the solution state; ``finalize`` maps the final state to
-        (x, resid)."""
+        (x, resid). ``hstate`` (the initial solve's HealthState, or None
+        with guards off) accumulates the correction solves' guard flags
+        — a breakdown inside a correction must reach SolveReport.health,
+        not vanish into the ``[:2]`` slice. First-trip iterations keep
+        the earliest record (correction-local indices for flags only a
+        correction tripped)."""
         from jax import lax as _lax
         nb = jnp.sqrt(jnp.abs(dev.inner_product(norm_src, norm_src)))
         scale = jnp.where(nb > 0, nb, 1.0)
         tol = getattr(self.solver, "tol", 1e-6)
+        guard = hstate is not None and getattr(self.solver, "guard", False)
 
         def res_norm(r):
             return jnp.sqrt(jnp.abs(dev.inner_product(r, r))) / scale
 
         def cond(st):
-            state, r, it, k, rt = st
+            state, r, it, k, rt, hflags, hfirst = st
             return (rt > tol) & (k < self.refine)
 
         # stop correction solves exactly at the global absolute target
@@ -331,22 +344,36 @@ class make_solver:
             self.solver.solve).parameters
 
         def body(st):
-            state, r, it, k, rt = st
+            state, r, it, k, rt, hflags, hfirst = st
             kw = {}
             if has_abstol:
                 kw["abstol"] = jnp.abs(tol * scale).astype(rhs.real.dtype)
-            dx, it2 = self.solver.solve(
+            got = self.solver.solve(
                 A_dev, apply_precond, r.astype(rhs.dtype),
-                jnp.zeros_like(rhs), **kw)[:2]
+                jnp.zeros_like(rhs), **kw)
+            dx, it2 = got[:2]
+            if guard:
+                ch = got[-1]          # health is always the last element
+                hflags = hflags | ch.flags
+                hfirst = jnp.where(hfirst >= 0, hfirst, ch.first_it)
             state = accumulate(state, dx)
             r = true_res(state)
-            return (state, r, it + it2, k + 1, res_norm(r))
+            return (state, r, it + it2, k + 1, res_norm(r), hflags,
+                    hfirst)
 
+        if guard:
+            hflags0, hfirst0 = hstate.flags, hstate.first_it
+        else:                         # structural dummies
+            hflags0 = jnp.zeros((), jnp.int32)
+            hfirst0 = jnp.zeros((1,), jnp.int32)
         r0 = true_res(state0)
-        state, _, iters, _, rt = _lax.while_loop(
-            cond, body, (state0, r0, iters, 0, res_norm(r0)))
+        state, _, iters, _, rt, hflags, hfirst = _lax.while_loop(
+            cond, body, (state0, r0, iters, 0, res_norm(r0), hflags0,
+                         hfirst0))
+        if guard:
+            hstate = hstate._replace(flags=hflags, first_it=hfirst)
         x, resid = finalize(state, rt, scale.astype(rhs.dtype))
-        return x, iters, resid
+        return x, iters, resid, hstate
 
     def __call__(self, rhs, x0=None):
         n = self.A_host.nrows * self.A_host.block_size[0]
@@ -373,16 +400,20 @@ class make_solver:
         # ONE device->host round trip for everything the SolverInfo needs —
         # separate int()/float()/np.asarray() conversions each pay a full
         # device sync, which through a remote-device tunnel costs tens of
-        # ms apiece and dominated the measured solve time
-        want_hist = len(got) > 3 and got[3] is not None
-        fetched = jax.device_get(got[1:5] if want_hist else got[1:3])
-        iters, resid = fetched[0], fetched[1]
+        # ms apiece and dominated the measured solve time (the None slots
+        # for hist/health pass through device_get as empty pytree nodes)
+        iters, resid, hist_buf, hist_n, hstate = jax.device_get(got[1:6])
         hist = None
-        if want_hist:
+        if hist_buf is not None:
             # slice by the recorded count — NaN filtering would also drop
             # genuine NaN residuals from a breakdown
-            hist = np.asarray(fetched[2])[:int(fetched[3])]
+            hist = np.asarray(hist_buf)[:int(hist_n)]
+        health = None
+        if hstate is not None:
+            from amgcl_tpu.telemetry import health as _health
+            health = _health.decode(hstate.flags, hstate.first_it)
         wall = time.perf_counter() - t0
+        extra = {"first_call": True} if first_call else {}
         if first_call and self.refine_mode == "df32":
             # satellite of _df32_selfcheck: the standalone-jit check ran
             # the residual kernel ALONE — the full _solve_fn program fuses
@@ -390,14 +421,19 @@ class make_solver:
             # the compensation. Validate the first compiled call's
             # reported residual against a host f64 residual once.
             self._check_df32_runtime(rhs, x, float(resid))
+        if getattr(self, "_df32_drift", None) is not None:
+            # set by _check_df32_runtime on harmful drift — sticky so the
+            # doctor sees it on every later report from this bundle
+            extra["df32_drift"] = self._df32_drift
         report = SolveReport(
             int(iters), float(resid), hist, wall_time_s=wall,
             solver=type(self.solver).__name__,
             hierarchy=self._hierarchy_stats(),
             resources=self._resources(),
+            health=health,
             # the first call's wall time includes jit trace + compile —
             # flag it so sink consumers can separate it from steady state
-            extra={"first_call": True} if first_call else {})
+            extra=extra)
         # process-global JSONL sink (telemetry/sink.py); the NullSink check
         # keeps the unconfigured hot path free of the to_dict() conversion
         # (this function already fights per-call host overhead — see the
@@ -405,6 +441,14 @@ class make_solver:
         from amgcl_tpu.telemetry.sink import NullSink, get_default_sink
         if not isinstance(get_default_sink(), NullSink):
             telemetry_emit(report.to_dict(), event="solve", n=n)
+            if health is not None and not health["ok"]:
+                # a dedicated, easily-grepped event for every unhealthy
+                # solve — the decoded guard record plus the numbers a
+                # dashboard alert needs
+                telemetry_emit(event="health", n=n,
+                               solver=type(self.solver).__name__,
+                               iters=int(iters), resid=float(resid),
+                               **health)
         return x, report
 
     def _hierarchy_stats(self):
@@ -466,6 +510,7 @@ class make_solver:
         if actual > max(10.0 * reported, 2.0 * tol) \
                 and actual > 1e-12 * len(b64):
             import warnings
+            self._df32_drift = {"reported": reported, "actual": actual}
             warnings.warn(
                 "df32 refinement drift: the compiled solve reports a "
                 "relative residual of %.3e but the host float64 residual "

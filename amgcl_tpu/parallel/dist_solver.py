@@ -26,6 +26,7 @@ from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix, dist_inner_product
 @lru_cache(maxsize=64)
 def _compiled_dist_cg(mesh, offsets, shape, maxiter, tol):
     """jit-compiled distributed CG keyed on structure, not data."""
+    from amgcl_tpu.telemetry import health as H
     A = DistDiaMatrix(offsets, None, shape)  # structure only; data is an arg
 
     def body_shard(data, f, x, di):
@@ -37,31 +38,44 @@ def _compiled_dist_cg(mesh, offsets, shape, maxiter, tol):
         eps = tol * scale
 
         def cond(st):
-            x, r, p, rho_p, it, res = st
-            return (it < maxiter) & (res > eps)
+            x, r, p, rho_p, it, res, hs = st
+            return (it < maxiter) & (res > eps) & H.keep_going(hs)
 
         def body(st):
-            x, r, p, rho_p, it, res = st
+            x, r, p, rho_p, it, res, hs = st
             s = di * r
             rho = dot(r, s)
             beta = jnp.where(rho_p == 0, 0.0, rho / rho_p)
-            p = s + beta * p
-            q = spmv(p)
-            alpha = rho / dot(q, p)
-            x = x + alpha * p
-            r = r - alpha * q
-            return (x, r, p, rho, it + 1, jnp.sqrt(jnp.abs(dot(r, r))))
+            p_n = s + beta * p
+            q = spmv(p_n)
+            qp = dot(q, p_n)
+            alpha = rho / jnp.where(qp == 0, 1.0, qp)
+            x_n = x + alpha * p_n
+            r_n = r - alpha * q
+            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # same guard set as the serial CG; every input is already
+            # psum-reduced, so the trips (and the early exit they drive)
+            # are bitwise identical on every shard
+            ok, hs = H.step(
+                hs, it, res_n / scale,
+                ((H.BREAKDOWN_RHO, H.bad_denom(rho)),
+                 (H.BREAKDOWN_ALPHA, H.bad_denom(qp)),
+                 (H.INDEFINITE, jnp.real(qp) < 0, False)))
+            x, r, p, rho, res = H.commit(
+                ok, (x_n, r_n, p_n, rho, res_n), (x, r, p, rho_p, res))
+            return (x, r, p, rho, it + ok.astype(jnp.int32), res, hs)
 
-        st = (x, r, jnp.zeros_like(r), jnp.zeros((), f.dtype), 0,
-              jnp.sqrt(jnp.abs(dot(r, r))))
-        x, r, p, rho, it, res = lax.while_loop(cond, body, st)
-        return x, it, res / scale
+        res0 = jnp.sqrt(jnp.abs(dot(r, r)))
+        st = (x, r, jnp.zeros_like(r), jnp.zeros((), f.dtype),
+              jnp.zeros((), jnp.int32), res0, H.init_state(res0 / scale))
+        x, r, p, rho, it, res, hs = lax.while_loop(cond, body, st)
+        return x, it, res / scale, hs.flags, hs.first_it
 
     fn = shard_map(
         body_shard, mesh=mesh,
         in_specs=(P(None, ROWS_AXIS), P(ROWS_AXIS), P(ROWS_AXIS),
                   P(ROWS_AXIS)),
-        out_specs=(P(ROWS_AXIS), P(), P()),
+        out_specs=(P(ROWS_AXIS), P(), P(), P(), P()),
         check_vma=False)
     return jax.jit(fn)
 
@@ -91,7 +105,9 @@ def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
     dinv = jnp.ones_like(rhs) if dinv is None else put_with_sharding(dinv,
                                                                      vec)
     fn = _compiled_dist_cg(mesh, A.offsets, A.shape, int(maxiter), float(tol))
-    x, it, res = fn(A.data, rhs, x0, dinv)
+    x, it, res, hflags, hfirst = fn(A.data, rhs, x0, dinv)
+    from amgcl_tpu.telemetry.health import decode as _decode_health
+    health = _decode_health(hflags, hfirst)
     nd = int(mesh.shape[ROWS_AXIS])
     # halo/psum wire model (telemetry/ledger.py): the Jacobi-CG body runs
     # one halo SpMV and three psum'd dots per iteration
@@ -105,7 +121,7 @@ def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
             spmvs=1, dots=3)}}
     report = SolveReport(
         int(it), float(res), wall_time_s=_time.perf_counter() - t0,
-        solver="dist_cg", resources=resources,
+        solver="dist_cg", resources=resources, health=health,
         extra={"devices": nd})
     _tel_emit(report.to_dict(), event="dist_solve", n=int(A.shape[0]))
     out = _DistResult((x, int(it), float(res)))

@@ -9,7 +9,11 @@ Every solver mixes in :class:`amgcl_tpu.telemetry.history.HistoryMixin`:
 with ``record_history=True`` the per-iteration relative residuals are
 recorded inside the device loop and returned as a trailing element
 (``(x, iters, resid, history)``), which ``make_solver`` folds into the
-:class:`~amgcl_tpu.telemetry.SolveReport`.
+:class:`~amgcl_tpu.telemetry.SolveReport`. With ``guard=True`` (the
+default) a compact numerical-health state rides the loop as well —
+NaN/breakdown/stagnation/divergence detection with early exit
+(telemetry/health.py) — appended as the final trailing element and
+decoded into ``SolveReport.health``.
 """
 
 from amgcl_tpu.solver.cg import CG

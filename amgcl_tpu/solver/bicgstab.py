@@ -23,6 +23,7 @@ class BiCGStab(HistoryMixin):
     abstol: float = 0.0
     precond_side: str = "right"
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         if self.precond_side not in ("left", "right"):
@@ -53,28 +54,30 @@ class BiCGStab(HistoryMixin):
 
         one = jnp.ones((), rhs.dtype)
 
+        from amgcl_tpu.telemetry import health as H
+
         def cond(st):
-            (x, r, p, v, rho, alpha, omega, it, res, hist) = st
-            return (it < self.maxiter) & (res > eps)
+            (x, r, p, v, rho, alpha, omega, it, res, hist, hs) = st
+            return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def body(st):
-            (x, r, p, v, rho, alpha, omega, it, res, hist) = st
+            (x, r, p, v, rho, alpha, omega, it, res, hist, hs) = st
             rho_new = dot(rhat, r)
             beta = (rho_new / jnp.where(rho == 0, 1, rho)) \
                 * (alpha / jnp.where(omega == 0, 1, omega))
-            p = r + beta * (p - omega * v)
+            p_n = r + beta * (p - omega * v)
             if left:
-                v, phat = apply_op(p)
-                denom = dot(rhat, v)
+                v_n, phat = apply_op(p_n)
+                denom = dot(rhat, v_n)
             else:
                 # fused spmv + <rhat, v> on the DIA path (one HBM pass);
                 # spmv_dots returns <v, rhat> — conjugate for the
                 # complex fallback (identity for real)
-                phat = precond(p)
-                v, _, _, vr = dev.spmv_dots(A, phat, rhat, dot)
+                phat = precond(p_n)
+                v_n, _, _, vr = dev.spmv_dots(A, phat, rhat, dot)
                 denom = jnp.conj(vr)
-            alpha = rho_new / jnp.where(denom == 0, 1, denom)
-            s = r - alpha * v
+            alpha_n = rho_new / jnp.where(denom == 0, 1, denom)
+            s = r - alpha_n * v_n
             if left:
                 t, shat = apply_op(s)
                 tt = dot(t, t)
@@ -82,17 +85,30 @@ class BiCGStab(HistoryMixin):
             else:
                 shat = precond(s)
                 t, tt, _, ts = dev.spmv_dots(A, shat, s, dot)
-            omega = ts / jnp.where(tt == 0, 1, tt)
-            x = x + alpha * phat + omega * shat
-            r = s - omega * t
-            res = jnp.sqrt(jnp.abs(dot(r, r)))
-            hist = self._hist_put(hist, it, res / scale)
-            return (x, r, p, v, rho_new, alpha, omega, it + 1, res, hist)
+            omega_n = ts / jnp.where(tt == 0, 1, tt)
+            x_n = x + alpha_n * phat + omega_n * shat
+            r_n = s - omega_n * t
+            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # the three breakdown modes of the reference (bicgstab.hpp
+            # throws on each): rho-, alpha(denom)- and omega-breakdown
+            ok, hs = self._guard_step(
+                hs, it, res_n / scale,
+                ((H.BREAKDOWN_RHO, H.bad_denom(rho_new)),
+                 (H.BREAKDOWN_ALPHA, H.bad_denom(denom)),
+                 (H.BREAKDOWN_OMEGA, H.bad_denom(omega_n))))
+            x, r, p, v, rho, alpha, omega, res = self._guard_commit(
+                ok, (x_n, r_n, p_n, v_n, rho_new, alpha_n, omega_n, res_n),
+                (x, r, p, v, rho, alpha, omega, res))
+            hist = self._hist_put(hist, it, res_n / scale, keep=ok)
+            return (x, r, p, v, rho, alpha, omega,
+                    it + ok.astype(jnp.int32), res, hist, hs)
 
         res0 = jnp.sqrt(jnp.abs(dot(r, r)))
         st = (x, r, jnp.zeros_like(r), jnp.zeros_like(r),
-              one, one, one, 0, res0, self._hist_init(rhs.real.dtype))
-        (x, r, p, v, rho, alpha, omega, it, res, hist) = \
+              one, one, one, jnp.zeros((), jnp.int32), res0,
+              self._hist_init(rhs.real.dtype),
+              self._guard_init(res0 / scale))
+        (x, r, p, v, rho, alpha, omega, it, res, hist, hs) = \
             lax.while_loop(cond, body, st)
         x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
-        return self._hist_result(x, it, res / scale, hist)
+        return self._hist_result(x, it, res / scale, hist, health=hs)

@@ -37,6 +37,7 @@ class BiCGStabL(HistoryMixin):
     pside: str = "right"  # the reference default (bicgstabl.hpp:137)
     delta: float = 0.0    # reliable-update threshold (bicgstabl.hpp:110)
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True    # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -86,16 +87,19 @@ class BiCGStabL(HistoryMixin):
             # run from Xc = 0 against B = r0, flush into xbase
             x = jnp.zeros_like(rhs)
 
+        from amgcl_tpu.telemetry import health as He
+
         def cond(st):
             res, it = st[7], st[6]
-            return (it < self.maxiter) & (res > eps)
+            return (it < self.maxiter) & (res > eps) \
+                & self._guard_go(st[-1])
 
         def body(st):
             if use_delta:
                 (x, R, U, rho, alpha, omega, it, res,
-                 xbase, B, rnc, rnt, hist) = st
+                 xbase, B, rnc, rnt, hist, hs) = st
             else:
-                x, R, U, rho, alpha, omega, it, res, hist = st
+                x, R, U, rho, alpha, omega, it, res, hist, hs = st
             # the reference exits the whole solve the moment ||R[0]|| drops
             # below eps INSIDE the BiCG stage (bicgstabl.hpp:296-299,
             # `goto done`) — without that, a near-exact preconditioner
@@ -104,10 +108,19 @@ class BiCGStabL(HistoryMixin):
             # unrolled step commits its candidate state only while `live`.
             live = res > eps
             took = jnp.zeros((), jnp.int32)
+            guard_on = bool(self.guard)
+            false0 = jnp.zeros((), bool)
+            trip_rho, trip_gamma, nan_seen = false0, false0, false0
 
-            def commit(new, old):
+            def commit(m, new, old):
                 return jax.tree_util.tree_map(
-                    lambda a, b: jnp.where(live, a, b), new, old)
+                    lambda a, b: jnp.where(m, a, b), new, old)
+
+            def finite_or_pass(z):
+                # when guarding, a non-finite step residual is never
+                # committed (the health flags below stop the loop); with
+                # guards off the historical NaN-exit path is preserved
+                return jnp.isfinite(z) if guard_on else jnp.asarray(True)
 
             rho = -omega * rho
             # -- BiCG part --
@@ -126,18 +139,23 @@ class BiCGStabL(HistoryMixin):
                 Rc = Rc.at[j + 1].set(op(Rc[j]))
                 xc = x + alpha_c * Uc[0]
                 zeta = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
+                if guard_on:
+                    trip_rho = trip_rho | (live & He.bad_denom(rho1))
+                    trip_gamma = trip_gamma | (live & He.bad_denom(gamma))
+                    nan_seen = nan_seen | (live & ~jnp.isfinite(zeta))
+                step_ok = live & finite_or_pass(zeta)
                 hist = self._hist_put(hist, it + took, zeta / scale,
-                                      keep=live)
-                took = took + live.astype(jnp.int32)
+                                      keep=step_ok)
+                took = took + step_ok.astype(jnp.int32)
                 x, R, U, rho, alpha, res = commit(
-                    (xc, Rc, Uc, rho1, alpha_c, zeta),
+                    step_ok, (xc, Rc, Uc, rho1, alpha_c, zeta),
                     (x, R, U, rho, alpha, res))
                 if use_delta:
                     # peaks track EVERY inner step (bicgstabl.hpp:292-294)
                     # so intra-cycle spikes arm the recompute triggers
-                    rnc = jnp.where(live, jnp.maximum(rnc, zeta), rnc)
-                    rnt = jnp.where(live, jnp.maximum(rnt, zeta), rnt)
-                live = live & (zeta > eps)
+                    rnc = jnp.where(step_ok, jnp.maximum(rnc, zeta), rnc)
+                    rnt = jnp.where(step_ok, jnp.maximum(rnt, zeta), rnt)
+                live = live & (zeta > eps) & finite_or_pass(zeta)
             # -- MR part: minimize ||R[0] - sum_j g_j R[j]|| over j=1..L --
             # Gram products go through the inner-product seam (vmapped) so
             # they stay globally reduced inside shard_map; a raw conj(Z)@Z.T
@@ -151,14 +169,26 @@ class BiCGStabL(HistoryMixin):
             Rc = R.at[0].set(R[0] - jnp.tensordot(gam, R[1:], axes=1))
             Uc = U.at[0].set(U[0] - jnp.tensordot(gam, U[1:], axes=1))
             res_c = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
+            if guard_on:
+                nan_seen = nan_seen | (live & ~jnp.isfinite(res_c))
+            mr_ok = live & finite_or_pass(res_c)
             x, R, U, omega, res = commit(
-                (xc, Rc, Uc, gam[Lp - 1], res_c), (x, R, U, omega, res))
+                mr_ok, (xc, Rc, Uc, gam[Lp - 1], res_c), (x, R, U, omega,
+                                                          res))
             # the cycle's last counted step ends at the post-MR committed
             # residual — overwrite its slot so history[-1] == returned res
             hist = self._hist_put(hist, it + took - 1, res / scale,
                                   keep=took > 0)
+            # one guard update per cycle, on the committed (finite)
+            # residual; the per-step trips collected above ride along
+            _, hs = self._guard_step(
+                hs, it + jnp.maximum(took - 1, 0), res / scale,
+                ((He.BREAKDOWN_RHO, trip_rho),
+                 (He.BREAKDOWN_ALPHA, trip_gamma),
+                 (He.NAN, nan_seen)))
             if not use_delta:
-                return (x, R, U, rho, alpha, omega, it + took, res, hist)
+                return (x, R, U, rho, alpha, omega, it + took, res, hist,
+                        hs)
 
             # -- reliable updates (bicgstabl.hpp:386-409): recompute the
             # true inner-operator residual when the recursive one has
@@ -193,7 +223,7 @@ class BiCGStabL(HistoryMixin):
                 recomp, do_flush, lambda a: a,
                 (x, R, xbase, B, rnc, rnt))
             return (x, R, U, rho, alpha, omega, it + took, res,
-                    xbase, B, rnc, rnt, hist)
+                    xbase, B, rnc, rnt, hist, hs)
 
         R0 = jnp.zeros((Lp + 1, n), dtype).at[0].set(r0)
         U0 = jnp.zeros((Lp + 1, n), dtype)
@@ -201,12 +231,13 @@ class BiCGStabL(HistoryMixin):
         st = (x, R0, U0, one, jnp.zeros((), dtype), one, 0, zeta0)
         if use_delta:
             st = st + (x_init, r0, zeta0, zeta0)
-        st = st + (self._hist_init(rhs.real.dtype, overshoot=Lp),)
+        st = st + (self._hist_init(rhs.real.dtype, overshoot=Lp),
+                   self._guard_init(zeta0 / scale))
         out = lax.while_loop(cond, body, st)
-        x, it, res, hist = out[0], out[6], out[7], out[-1]
+        x, it, res, hist, hs = out[0], out[6], out[7], out[-2], out[-1]
         if use_delta:
             xbase = out[8]
             x = xbase + (precond(x) if right else x)
         elif right:
             x = x_init + precond(x)
-        return self._hist_result(x, it, res / scale, hist)
+        return self._hist_result(x, it, res / scale, hist, health=hs)

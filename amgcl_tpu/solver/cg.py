@@ -25,6 +25,7 @@ class CG(HistoryMixin):
     #                          null-space vectors (cg.hpp:90-94,163-168)
     verbose: bool = False   # print residual every 5 iterations (cg.hpp:199)
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
               abstol=None):
@@ -42,22 +43,54 @@ class CG(HistoryMixin):
             abstol = jnp.asarray(self.abstol, rhs.dtype).real
         eps = jnp.maximum(self.tol * norm_scale, abstol)
 
+        from amgcl_tpu.telemetry import health as H
+        # ns_search drives the iterates INTO the null space, where the
+        # breakdown denominators legitimately vanish — guards off there
+        guard_trips = self.guard and not self.ns_search
+
         def cond(state):
-            x, r, p, rho_prev, it, res, hist = state
-            return (it < self.maxiter) & (res > eps)
+            x, r, p, rho_prev, it, res, hist, hs = state
+            return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def body(state):
-            x, r, p, rho_prev, it, res, hist = state
+            x, r, p, rho_prev, it, res, hist, hs = state
             s = precond(r)
             rho = dot(r, s)
             beta = jnp.where(rho_prev == 0, 0.0, rho / rho_prev)
-            p = dev.axpby(1.0, s, beta, p)
-            q, qp = dev.spmv_dot(A, p, dot)
-            alpha = rho / qp
-            x = dev.axpby(alpha, p, 1.0, x)
-            r = dev.axpby(-alpha, q, 1.0, r)
-            res = jnp.sqrt(jnp.abs(dot(r, r)))
-            hist = self._hist_put(hist, it, res / norm_scale)
+            p_n = dev.axpby(1.0, s, beta, p)
+            q, qp = dev.spmv_dot(A, p_n, dot)
+            # guarded: the safe division only protects the candidate that
+            # the breakdown trip below will discard anyway; unguarded:
+            # keep the raw division so a singular direction poisons the
+            # state and the loop NaN-exits through `res > eps` — the
+            # historical failure signal guard=False callers rely on
+            alpha = rho / (jnp.where(qp == 0, 1.0, qp) if guard_trips
+                           else qp)
+            x_n = dev.axpby(alpha, p_n, 1.0, x)
+            r_n = dev.axpby(-alpha, q, 1.0, r)
+            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            if guard_trips:
+                # rho: residual orthogonal to the preconditioned residual;
+                # qp ≈ 0: singular direction; qp < 0: not positive
+                # definite (informational — CG may still proceed)
+                ok, hs = self._guard_step(
+                    hs, it, res_n / norm_scale,
+                    ((H.BREAKDOWN_RHO, H.bad_denom(rho)),
+                     (H.BREAKDOWN_ALPHA, H.bad_denom(qp)),
+                     (H.INDEFINITE, jnp.real(qp) < 0, False)))
+            elif self.guard:
+                # ns_search: the breakdown/stagnation/divergence guards
+                # are off (iterating INTO the null space is the point),
+                # but a NaN residual is still a failure — watch for it so
+                # the returned HealthState stays honest
+                nan_trip = ~jnp.isfinite(jnp.real(res_n))
+                hs = H.trip(hs, it, H.NAN, nan_trip)
+                ok = ~nan_trip
+            else:
+                ok = jnp.asarray(True)
+            x, r, p, rho, res = self._guard_commit(
+                ok, (x_n, r_n, p_n, rho, res_n), (x, r, p, rho_prev, res))
+            hist = self._hist_put(hist, it, res_n / norm_scale, keep=ok)
             if self.verbose:
                 import jax
                 jax.lax.cond(
@@ -65,16 +98,19 @@ class CG(HistoryMixin):
                     lambda: jax.debug.print("iter {i}: resid {r:.6e}",
                                             i=it + 1, r=res / norm_scale),
                     lambda: None)
-            return (x, r, p, rho, it + 1, res, hist)
+            return (x, r, p, rho, it + ok.astype(jnp.int32), res, hist, hs)
 
         res0 = jnp.sqrt(jnp.abs(dot(r, r)))
         hist0 = self._hist_init(rhs.real.dtype)
-        state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype), 0, res0,
-                 hist0)
-        x, r, p, rho, iters, res, hist = lax.while_loop(cond, body, state)
+        state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype),
+                 jnp.zeros((), jnp.int32), res0, hist0,
+                 self._guard_init(res0 / norm_scale))
+        x, r, p, rho, iters, res, hist, hs = lax.while_loop(cond, body,
+                                                            state)
         if not self.ns_search:
             # ||rhs|| == 0 => the solution is x = 0; with ns_search the
             # iterates from a nonzero x0 approach a null-space vector
             # instead (reference cg.hpp:163-168)
             x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
-        return self._hist_result(x, iters, res / norm_scale, hist)
+        return self._hist_result(x, iters, res / norm_scale, hist,
+                                 health=hs)

@@ -39,7 +39,8 @@ def _givens(a, b):
 
 
 def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
-                   hist=None, hist_base=0, hist_scale=1.0):
+                   hist=None, hist_base=0, hist_scale=1.0, health=None,
+                   guard_step=None):
     """One restart cycle. apply_op(v) -> (w, z) where z is the direction to
     accumulate into x (z == v for plain GMRES, z == M v for flexible).
 
@@ -49,7 +50,14 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
     given (the caller's history buffer), each step writes its relative
     residual ``res / hist_scale`` at slot ``hist_base + j`` — inside the
     device loop, no host sync (telemetry/history.py).
-    Returns (dx, steps, res, hist)."""
+
+    ``health``/``guard_step`` thread the caller's HealthState through the
+    cycle (telemetry/health.py): guard_step(hs, it, res, trips) runs each
+    step with the Hessenberg-breakdown trip (h[j+1,j] ≈ 0 while res > eps
+    — a 'lucky' breakdown at convergence is not an error), and a fatal
+    trip masks the step's commits so the assembled correction stays
+    finite. Returns (dx, steps, res, hist, health)."""
+    from amgcl_tpu.telemetry import health as He
     n = r0.shape[0]
     dtype = r0.dtype
     beta = jnp.sqrt(jnp.abs(dot(r0, r0)))
@@ -65,18 +73,20 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
     record = hist is not None
     if not record:       # 1-slot dummy keeps the carry structure static
         hist = jnp.zeros(1, r0.real.dtype)
+    if health is None:   # structural dummy when the caller has no guards
+        health = He.init_state(jnp.real(beta))
 
     def cond(st):
-        V, Z, R, g, cs, sn, j, res, hst = st
-        return (j < cap) & (res > eps)
+        V, Z, R, g, cs, sn, j, res, hst, hs = st
+        go = He.keep_going(hs) if guard_step is not None else True
+        return (j < cap) & (res > eps) & go
 
     def body(st):
         # hst is the residual-history buffer; h below is the Hessenberg
         # column — distinct names, both live in the carry
-        V, Z, R, g, cs, sn, j, res, hst = st
+        V, Z, R, g, cs, sn, j, res, hst, hs = st
         v = V[j] if direction is None else direction(j, V)
         w, z = apply_op(v)
-        Z = Z.at[j].set(z)
         # CGS2: h = V w; w -= V^T h; second pass for stability. The basis
         # dots go through the inner-product seam (vmapped) so the same code
         # is correct inside shard_map, where a raw V @ w would silently
@@ -88,7 +98,6 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
         w = w - V.T @ h2
         h = h1 + h2
         hn = jnp.sqrt(jnp.abs(dot(w, w)))
-        V = V.at[j + 1].set(w / jnp.where(hn == 0, 1.0, hn))
 
         # apply stored rotations k = 0..j-1 to h
         def rot(k, hv):
@@ -103,28 +112,56 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
         h = h.at[j + 1].set(hn)
         h = lax.fori_loop(0, m, rot, h)
         c, s = _givens(h[j], h[j + 1])
-        cs = cs.at[j].set(c)
-        sn = sn.at[j].set(s)
         rjj = c * h[j] + s * h[j + 1]
         h = h.at[j].set(rjj).at[j + 1].set(0.0)
         gj = g[j]
-        g = g.at[j].set(c * gj).at[j + 1].set(-jnp.conj(s) * gj)
+        res_n = jnp.abs(-jnp.conj(s) * gj)
+        if guard_step is not None:
+            # Hessenberg breakdown: the new R diagonal rjj ≈ 0 while the
+            # PRE-step residual is still above eps — the Krylov space
+            # became invariant without solving the system, and accepting
+            # the column would make the triangular solve singular (an
+            # all-NaN dx). A 'lucky' breakdown (hn ≈ 0 with h[j] normal)
+            # keeps rjj = h[j] and converges cleanly; and res_n is NOT
+            # usable here: on a null-space rhs the zero-column Givens
+            # rotation annihilates g[j+1], so the post-rotation residual
+            # reads 0 exactly when the solve is most broken.
+            ok, hs = guard_step(
+                hs, hist_base + j, res_n / hist_scale,
+                ((He.BREAKDOWN_HESSENBERG,
+                  He.bad_denom(rjj) & (res > eps)),))
+        else:
+            ok = jnp.asarray(True)
+        # commits masked by ok: a fatal trip leaves column j unwritten
+        # (identity placeholder, g[j] untouched, Z[j] zero), so the
+        # masked triangular solve assembles dx from committed steps only
+        Z = Z.at[j].set(jnp.where(ok, z, Z[j]))
+        V = V.at[j + 1].set(jnp.where(
+            ok, w / jnp.where(hn == 0, 1.0, hn), V[j + 1]))
+        cs = cs.at[j].set(jnp.where(ok, c, cs[j]))
+        sn = sn.at[j].set(jnp.where(ok, s, sn[j]))
+        g = g.at[j].set(jnp.where(ok, c * gj, g[j])) \
+             .at[j + 1].set(jnp.where(ok, -jnp.conj(s) * gj, g[j + 1]))
         # write column j of R (rows 0..j live; keep the identity placeholder
         # in columns never reached so the masked solve stays nonsingular)
-        col = jnp.where(jnp.arange(m) <= j, h[:m], R[:, j])
+        col = jnp.where(ok & (jnp.arange(m) <= j), h[:m], R[:, j])
         R = R.at[:, j].set(col)
-        res = jnp.abs(g[j + 1])
+        res = jnp.where(ok, res_n, res)
         if record:
-            hst = hst.at[hist_base + j].set(
-                (res / hist_scale).real.astype(hst.dtype))
-        return (V, Z, R, g, cs, sn, j + 1, res, hst)
+            hst = hst.at[hist_base + j].set(jnp.where(
+                ok, (res_n / hist_scale).real.astype(hst.dtype),
+                hst[hist_base + j]))
+        return (V, Z, R, g, cs, sn, j + ok.astype(jnp.int32), res, hst,
+                hs)
 
-    st = (V0, Z0, R0, g0, cs0, sn0, 0, beta, hist)
-    V, Z, R, g, cs, sn, j, res, hist = lax.while_loop(cond, body, st)
+    st = (V0, Z0, R0, g0, cs0, sn0, jnp.zeros((), jnp.int32), beta, hist,
+          health)
+    V, Z, R, g, cs, sn, j, res, hist, health = lax.while_loop(cond, body,
+                                                              st)
     # masked triangular solve: unwritten columns have R[k,k]=1, g[k]=0
     y = jax.scipy.linalg.solve_triangular(R, g[:m], lower=False)
     dx = Z.T @ y
-    return dx, j, res, hist
+    return dx, j, res, hist, health
 
 
 @dataclass
@@ -139,6 +176,7 @@ class GMRES(HistoryMixin):
     tol: float = 1e-8
     pside: str = "left"
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     flexible = False
 
@@ -170,24 +208,27 @@ class GMRES(HistoryMixin):
         eps = self.tol * scale
 
         def cond(st):
-            x, it, res, hist = st
-            return (it < self.maxiter) & (res > eps)
+            x, it, res, hist, hs = st
+            return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def body(st):
-            x, it, res, hist = st
+            x, it, res, hist, hs = st
             r = resid0(x)
-            dx, steps, res, hist = _arnoldi_cycle(
+            dx, steps, res, hist, hs = _arnoldi_cycle(
                 apply_op, r, self.M, eps, dot,
                 hist=hist if self.record_history else None,
-                hist_base=it, hist_scale=scale)
-            return (x + dx, it + steps, res, hist)
+                hist_base=it, hist_scale=scale, health=hs,
+                guard_step=self._guard_step if self.guard else None)
+            return (x + dx, it + steps, res, hist, hs)
 
         r0 = resid0(x)
+        res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
         # a restart cycle started at it = maxiter - 1 may run M more steps
         hist0 = self._hist_init(rhs.real.dtype, overshoot=self.M)
-        st = (x, 0, jnp.sqrt(jnp.abs(dot(r0, r0))), hist0)
-        x, it, res, hist = lax.while_loop(cond, body, st)
-        return self._hist_result(x, it, res / scale, hist)
+        st = (x, jnp.zeros((), jnp.int32), res0, hist0,
+              self._guard_init(res0 / scale))
+        x, it, res, hist, hs = lax.while_loop(cond, body, st)
+        return self._hist_result(x, it, res / scale, hist, health=hs)
 
 
 @dataclass

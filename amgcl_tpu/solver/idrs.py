@@ -52,6 +52,7 @@ class IDRs(HistoryMixin):
     tol: float = 1e-8
     replacement: bool = False   # interface parity; smoothing not needed here
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
               row_index=None, n_valid=None):
@@ -73,13 +74,24 @@ class IDRs(HistoryMixin):
 
         r0 = dev.residual(rhs, A, x)
 
+        from amgcl_tpu.telemetry import health as He
+        guard_on = bool(self.guard)
+
         def cond(st):
-            x, r, G, U, M, om, it, res, hist = st
-            return (it < self.maxiter) & (res > eps)
+            x, r, G, U, M, om, it, res, hist, hs = st
+            return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def body(st):
-            x, r, G, U, M, om, it, res, hist = st
+            x, r, G, U, M, om, it, res, hist, hs = st
             f = pdots(P, r)                           # (s,)
+            # `alive` masks the unrolled sub-steps after a guard trip the
+            # way bicgstabl's `live` masks post-convergence steps: the
+            # candidate state of a broken sub-step is never committed, so
+            # the returned iterate/history stay finite
+            alive = jnp.ones((), bool)
+            false0 = jnp.zeros((), bool)
+            trip_rho, trip_om, nan_seen = false0, false0, false0
+            took = jnp.zeros((), jnp.int32)
             for k in range(s):
                 # solve the lower-right (s-k) system M[k:,k:] c = f[k:],
                 # done as a masked full solve: rows/cols < k act as identity
@@ -101,28 +113,70 @@ class IDRs(HistoryMixin):
                 U = U.at[k].set(u)
                 M = M.at[:, k].set(pdots(P, g))
                 beta = f[k] / jnp.where(M[k, k] == 0, 1.0, M[k, k])
-                r = r - beta * G[k]
-                x = x + beta * U[k]
-                f = f - beta * M[:, k]
-                if self.record_history:
-                    # the extra dot per sub-step only exists when history
-                    # is requested — the default path is untouched
-                    hist = self._hist_put(
-                        hist, it + k, jnp.sqrt(jnp.abs(dot(r, r))) / scale)
+                r_n = r - beta * G[k]
+                x_n = x + beta * U[k]
+                f_n = f - beta * M[:, k]
+                if guard_on:
+                    # M[k,k] = <P_k, g> ≈ 0: the residual left the shadow
+                    # space — the IDR(s) analogue of a rho-breakdown
+                    bad = He.bad_denom(M[k, k])
+                    res_k = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+                    trip_rho = trip_rho | (alive & bad)
+                    nan_seen = nan_seen | (alive & ~jnp.isfinite(res_k))
+                    step_ok = alive & ~bad & jnp.isfinite(res_k)
+                    r, x, f = He.commit(step_ok, (r_n, x_n, f_n),
+                                        (r, x, f))
+                    res = jnp.where(step_ok, res_k, res)
+                    if self.record_history:
+                        hist = self._hist_put(hist, it + k, res_k / scale,
+                                              keep=step_ok)
+                    took = took + step_ok.astype(jnp.int32)
+                    alive = step_ok
+                else:
+                    r, x, f = r_n, x_n, f_n
+                    took = took + 1
+                    if self.record_history:
+                        # the extra dot per sub-step only exists when
+                        # history is requested — the default path is
+                        # untouched
+                        hist = self._hist_put(
+                            hist, it + k,
+                            jnp.sqrt(jnp.abs(dot(r, r))) / scale)
             # dimension-reduction step into the next Sonneveld space
             # (fused spmv + <t,t>/<t,r> on the DIA path — one HBM pass)
             v = precond(r)
             t, tt, _, tr = dev.spmv_dots(A, v, r, dot)
-            om = tr / jnp.where(tt == 0, 1.0, tt)
-            x = x + om * v
-            r = r - om * t
-            res = jnp.sqrt(jnp.abs(dot(r, r)))
-            hist = self._hist_put(hist, it + s, res / scale)
-            return (x, r, G, U, M, om, it + s + 1, res, hist)
+            om_n = tr / jnp.where(tt == 0, 1.0, tt)
+            x_n = x + om_n * v
+            r_n = r - om_n * t
+            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            if guard_on:
+                bad = He.bad_denom(tt)
+                trip_om = trip_om | (alive & bad)
+                nan_seen = nan_seen | (alive & ~jnp.isfinite(res_n))
+                fin_ok = alive & ~bad & jnp.isfinite(res_n)
+                x, r, om = He.commit(fin_ok, (x_n, r_n, om_n), (x, r, om))
+                res = jnp.where(fin_ok, res_n, res)
+                hist = self._hist_put(hist, it + s, res_n / scale,
+                                      keep=fin_ok)
+                took = took + fin_ok.astype(jnp.int32)
+                _, hs = self._guard_step(
+                    hs, it + jnp.maximum(took - 1, 0), res / scale,
+                    ((He.BREAKDOWN_RHO, trip_rho),
+                     (He.BREAKDOWN_OMEGA, trip_om),
+                     (He.NAN, nan_seen)))
+            else:
+                x, r, om, res = x_n, r_n, om_n, res_n
+                hist = self._hist_put(hist, it + s, res / scale)
+                took = took + 1
+            return (x, r, G, U, M, om, it + took, res, hist, hs)
 
+        res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
         st = (x, r0, jnp.zeros((s, n), dtype), jnp.zeros((s, n), dtype),
-              jnp.eye(s, dtype=dtype), jnp.ones((), dtype), 0,
-              jnp.sqrt(jnp.abs(dot(r0, r0))),
-              self._hist_init(rhs.real.dtype, overshoot=s + 1))
-        x, r, G, U, M, om, it, res, hist = lax.while_loop(cond, body, st)
-        return self._hist_result(x, it, res / scale, hist)
+              jnp.eye(s, dtype=dtype), jnp.ones((), dtype),
+              jnp.zeros((), jnp.int32), res0,
+              self._hist_init(rhs.real.dtype, overshoot=s + 1),
+              self._guard_init(res0 / scale))
+        x, r, G, U, M, om, it, res, hist, hs = lax.while_loop(cond, body,
+                                                              st)
+        return self._hist_result(x, it, res / scale, hist, health=hs)

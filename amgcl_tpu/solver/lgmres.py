@@ -36,6 +36,7 @@ class LGMRES(HistoryMixin):
     tol: float = 1e-8
     pside: str = "left"
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -69,22 +70,23 @@ class LGMRES(HistoryMixin):
         eps = self.tol * scale
 
         def outer_cond(st):
-            x, aug, n_aug, it, res, hist = st
-            return (it < self.maxiter) & (res > eps)
+            x, aug, n_aug, it, res, hist, hs = st
+            return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def outer_body(st):
-            x, aug, n_aug, it, res, hist = st
+            x, aug, n_aug, it, res, hist, hs = st
             r = presid(x)
 
             def direction(j, V):
                 return jnp.where(j < mk, V[jnp.minimum(j, mk - 1)],
                                  aug[jnp.clip(j - mk, 0, K - 1)])
 
-            dx, steps, res, hist = _arnoldi_cycle(
+            dx, steps, res, hist, hs = _arnoldi_cycle(
                 apply_op, r, m, eps, dot, direction=direction,
                 n_steps=mk + jnp.minimum(n_aug, K),
                 hist=hist if self.record_history else None,
-                hist_base=it, hist_scale=scale)
+                hist_base=it, hist_scale=scale, health=hs,
+                guard_step=self._guard_step if self.guard else None)
             # augmentation stores the W-space correction for BOTH sides
             # (lgmres.hpp:363-371 normalizes dx before the P application)
             nrm = jnp.sqrt(jnp.abs(dot(dx, dx)))
@@ -92,13 +94,14 @@ class LGMRES(HistoryMixin):
                 dx / jnp.where(nrm == 0, 1.0, nrm))
             step = dx if left else precond(dx)
             return (x + step, aug, jnp.minimum(n_aug + 1, K),
-                    it + steps, res, hist)
+                    it + steps, res, hist, hs)
 
         r0 = presid(x)
+        res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
         # a cycle runs up to mk + K steps — more than m when K >= M
-        st = (x, jnp.zeros((K, n), dtype), 0, 0,
-              jnp.sqrt(jnp.abs(dot(r0, r0))),
-              self._hist_init(rhs.real.dtype, overshoot=mk + K))
-        x, aug, n_aug, it, res, hist = lax.while_loop(
+        st = (x, jnp.zeros((K, n), dtype), 0, jnp.zeros((), jnp.int32),
+              res0, self._hist_init(rhs.real.dtype, overshoot=mk + K),
+              self._guard_init(res0 / scale))
+        x, aug, n_aug, it, res, hist, hs = lax.while_loop(
             outer_cond, outer_body, st)
-        return self._hist_result(x, it, res / scale, hist)
+        return self._hist_result(x, it, res / scale, hist, health=hs)

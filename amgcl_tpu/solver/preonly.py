@@ -16,12 +16,15 @@ class PreOnly(HistoryMixin):
     maxiter: int = 1   # unused; kept for interface parity
     tol: float = 0.0
     record_history: bool = False
+    guard: bool = True      # NaN detection only (no loop to guard)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        from amgcl_tpu.telemetry import health as H
         x = precond(rhs)
         r = dev.residual(rhs, A, x)
         nr = jnp.sqrt(jnp.abs(inner_product(r, r)))
         nb = jnp.sqrt(jnp.abs(inner_product(rhs, rhs)))
         rel = nr / jnp.where(nb > 0, nb, 1.0)
         hist = self._hist_put(self._hist_init(rhs.real.dtype), 0, rel)
-        return self._hist_result(x, 1, rel, hist)
+        hs = H.trip(self._guard_init(rel), 0, H.NAN, ~jnp.isfinite(rel))
+        return self._hist_result(x, 1, rel, hist, health=hs)

@@ -18,6 +18,7 @@ class Richardson(HistoryMixin):
     tol: float = 1e-8
     damping: float = 1.0
     record_history: bool = False  # per-iteration relative residuals
+    guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
         dot = inner_product
@@ -27,19 +28,26 @@ class Richardson(HistoryMixin):
         eps = self.tol * scale
 
         def cond(st):
-            x, r, it, res, hist = st
-            return (it < self.maxiter) & (res > eps)
+            x, r, it, res, hist, hs = st
+            return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def body(st):
-            x, r, it, _, hist = st
-            x = x + self.damping * precond(r)
-            r = dev.residual(rhs, A, x)
-            res = jnp.sqrt(jnp.abs(dot(r, r)))
-            hist = self._hist_put(hist, it, res / scale)
-            return (x, r, it + 1, res, hist)
+            x, r, it, res, hist, hs = st
+            x_n = x + self.damping * precond(r)
+            r_n = dev.residual(rhs, A, x_n)
+            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # no breakdown denominators in a stationary iteration — the
+            # guards watch for NaN, stagnation and divergence only
+            ok, hs = self._guard_step(hs, it, res_n / scale)
+            x, r, res = self._guard_commit(ok, (x_n, r_n, res_n),
+                                           (x, r, res))
+            hist = self._hist_put(hist, it, res_n / scale, keep=ok)
+            return (x, r, it + ok.astype(jnp.int32), res, hist, hs)
 
         r0 = dev.residual(rhs, A, x)
-        st = (x, r0, 0, jnp.sqrt(jnp.abs(dot(r0, r0))),
-              self._hist_init(rhs.real.dtype))
-        x, r, it, res, hist = lax.while_loop(cond, body, st)
-        return self._hist_result(x, it, res / scale, hist)
+        res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
+        st = (x, r0, jnp.zeros((), jnp.int32), res0,
+              self._hist_init(rhs.real.dtype),
+              self._guard_init(res0 / scale))
+        x, r, it, res, hist, hs = lax.while_loop(cond, body, st)
+        return self._hist_result(x, it, res / scale, hist, health=hs)

@@ -3,7 +3,7 @@ reports through (the reference's profiler tree + per-level printouts +
 per-iteration residual logging, amgcl/profiler.hpp / amg.hpp:560-598 /
 cg.hpp:199, reworked as structured data instead of text).
 
-Four pieces:
+Five pieces:
 
 * :mod:`report`  — :class:`SolveReport`, the structured convergence record
   returned by every solver bundle (iters, final relative residual,
@@ -17,6 +17,12 @@ Four pieces:
   bench.py, cli.py and the distributed solvers all emit through.
   Deliberately stdlib-only so the bench supervisor can load it without
   importing jax.
+* :mod:`health`  — the numerics leg: in-loop guard detection (NaN,
+  Krylov breakdowns, stagnation, divergence — a compact bitmask carried
+  through every solver's ``lax.while_loop``, decoded into
+  ``SolveReport.health``), per-level convergence probes
+  (``AMG.probe_convergence()``) and the convergence doctor
+  (:func:`diagnose`, ``cli.py --doctor``).
 """
 
 from amgcl_tpu.telemetry.report import SolveReport
@@ -24,6 +30,9 @@ from amgcl_tpu.telemetry.history import HistoryMixin
 from amgcl_tpu.telemetry.tracing import phase, annotate, setup_scope
 from amgcl_tpu.telemetry.sink import (JsonlSink, NullSink, emit,
                                       get_default_sink, set_default_sink)
+from amgcl_tpu.telemetry.health import (HealthState, decode as decode_health,
+                                        diagnose, format_findings,
+                                        probe_hierarchy, two_grid_factor)
 from amgcl_tpu.telemetry.ledger import (DeviceMemoryBudget,
                                         dense_window_budget,
                                         hierarchy_ledger, summarize_ledger,
@@ -39,4 +48,6 @@ __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "dense_window_budget", "hierarchy_ledger", "summarize_ledger",
            "format_ledger", "mv_cost", "cycle_cost_model",
            "krylov_iteration_model", "comm_model", "allreduce_model",
-           "krylov_comm_model", "xla_cost_analysis"]
+           "krylov_comm_model", "xla_cost_analysis", "HealthState",
+           "decode_health", "diagnose", "format_findings",
+           "probe_hierarchy", "two_grid_factor"]

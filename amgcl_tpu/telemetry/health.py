@@ -1,0 +1,532 @@
+"""Numerical-health layer: in-loop guard detection, per-level convergence
+probes, and the convergence doctor.
+
+The reference reports convergence as ``(iters, error)`` and nothing else
+(make_solver.hpp, cg.hpp) — when CG stalls, BiCGStab hits an
+omega-breakdown, or a mixed-precision solve drifts, the user sees an
+iteration count. This module is the numerics leg of the telemetry
+subsystem (time = PR 1 tracing, space = PR 2 ledger):
+
+* **Guards** — a :class:`HealthState` carried through every Krylov
+  solver's ``lax.while_loop`` (plumbed by ``HistoryMixin``): NaN/Inf
+  residuals, Krylov breakdowns (rho/omega/alpha ≈ 0, Hessenberg
+  breakdown), loss of positive definiteness, stagnation and divergence,
+  recorded as a compact bitmask + per-flag first-trip iteration so the
+  whole thing stays jit-compatible (a handful of scalar ops per
+  iteration — no extra reductions, no host syncs). Fatal trips freeze
+  the iterate at the last committed state and terminate the loop, so a
+  breakdown returns finite history instead of NaN-filled arrays.
+* **Probes** — setup-time diagnostics (:func:`two_grid_factor`,
+  :func:`probe_hierarchy`, surfaced as ``AMG.probe_convergence()``):
+  the measured per-level error-reduction factor of the cycle rooted at
+  each level (test-vector cycling, normalized each step) and the
+  smoother's spectral radius by power iteration — a bad coarsening
+  level is identifiable before the first solve.
+* **Doctor** — :func:`diagnose` turns report + health + ledger + probe
+  into ranked human-readable findings with suggested parameter changes
+  (``cli.py --doctor``).
+
+Thresholds (env-tunable, read at trace time):
+
+  AMGCL_TPU_DIVERGENCE_BREAK  1 (default): a divergence trip terminates
+                              the while_loop instead of burning maxiter
+  AMGCL_TPU_DIV_WINDOW        consecutive diverging iterations before
+                              the divergence flag trips (default 5)
+  AMGCL_TPU_DIV_RTOL          an iteration counts as diverging only when
+                              the residual both grew AND sits this
+                              factor above the best residual seen
+                              (default 10) — BiCGStab/IDR(s) residuals
+                              legitimately oscillate, so plain
+                              consecutive-growth counting would kill
+                              converging solves
+  AMGCL_TPU_STAG_WINDOW       consecutive low-reduction iterations
+                              before the stagnation flag (default 10)
+  AMGCL_TPU_STAG_RTOL         per-iteration reduction factor below
+                              which an iteration counts as stalled
+                              (default 0.99: res > 0.99·prev trips)
+"""
+
+from __future__ import annotations
+
+import math
+import os
+from typing import Any, Dict, List, NamedTuple, Optional
+
+import jax.numpy as jnp
+
+# -- flag bits (compact bitmask carried through the device loop) ------------
+
+NAN = 1                      # non-finite residual
+BREAKDOWN_RHO = 2            # <rhat, r> / shadow-space projection ≈ 0
+BREAKDOWN_OMEGA = 4          # minimal-residual step length ≈ 0
+BREAKDOWN_ALPHA = 8          # search-direction denominator ≈ 0
+BREAKDOWN_HESSENBERG = 16    # Arnoldi h[j+1,j] ≈ 0 before convergence
+INDEFINITE = 32              # p·Ap ≤ 0 under CG (operator not SPD)
+STAGNATION = 64              # reduction below threshold over a window
+DIVERGENCE = 128             # residual grew K consecutive iterations
+
+FLAG_BITS = (NAN, BREAKDOWN_RHO, BREAKDOWN_OMEGA, BREAKDOWN_ALPHA,
+             BREAKDOWN_HESSENBERG, INDEFINITE, STAGNATION, DIVERGENCE)
+FLAG_NAMES = {
+    NAN: "nan", BREAKDOWN_RHO: "breakdown_rho",
+    BREAKDOWN_OMEGA: "breakdown_omega", BREAKDOWN_ALPHA: "breakdown_alpha",
+    BREAKDOWN_HESSENBERG: "breakdown_hessenberg", INDEFINITE: "indefinite",
+    STAGNATION: "stagnation", DIVERGENCE: "divergence"}
+N_FLAGS = len(FLAG_BITS)
+BREAKDOWN_MASK = (BREAKDOWN_RHO | BREAKDOWN_OMEGA | BREAKDOWN_ALPHA
+                  | BREAKDOWN_HESSENBERG)
+_IDX = {bit: i for i, bit in enumerate(FLAG_BITS)}
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def divergence_break_enabled() -> bool:
+    return os.environ.get("AMGCL_TPU_DIVERGENCE_BREAK", "1") != "0"
+
+
+def fatal_mask() -> int:
+    """Flags that terminate the while_loop: NaN and Krylov breakdowns
+    always (the iterate cannot recover and the state would go NaN),
+    divergence behind AMGCL_TPU_DIVERGENCE_BREAK (default on). Read at
+    trace time — a static constant in the compiled cond."""
+    m = NAN | BREAKDOWN_MASK
+    if divergence_break_enabled():
+        m |= DIVERGENCE
+    return m
+
+
+# -- the device-loop state ---------------------------------------------------
+
+class HealthState(NamedTuple):
+    """Compact guard state carried through the ``lax.while_loop``: a
+    bitmask, per-flag first-trip iterations, and the stagnation/
+    divergence window counters. ~40 bytes of scalars — negligible next
+    to the solver's vector carry."""
+    flags: Any       # int32 bitmask of FLAG_BITS
+    first_it: Any    # (N_FLAGS,) int32, -1 until the flag first trips
+    prev_res: Any    # last committed residual norm (real scalar)
+    best_res: Any    # best committed residual norm (divergence anchor)
+    stag: Any        # consecutive iterations with reduction below rtol
+    div: Any         # consecutive diverging iterations
+
+
+def init_state(res0) -> HealthState:
+    r0 = jnp.real(jnp.asarray(res0))
+    return HealthState(
+        jnp.zeros((), jnp.int32),
+        jnp.full((N_FLAGS,), -1, jnp.int32),
+        r0, r0,
+        jnp.zeros((), jnp.int32),
+        jnp.zeros((), jnp.int32))
+
+
+def bad_denom(v):
+    """A denominator that signals breakdown: non-finite, exactly zero,
+    or underflowed to subnormal. Deliberately conservative — legitimate
+    denominators shrink with the residual (rho ~ res²) but stay far
+    above the subnormal threshold at any practical tolerance, so a
+    converging solve never false-trips."""
+    a = jnp.abs(v)
+    tiny = jnp.finfo(a.dtype).tiny
+    return ~jnp.isfinite(a) | (a <= tiny)
+
+
+def trip(hs: HealthState, it, bit: int, cond) -> HealthState:
+    """Set ``bit`` where ``cond`` (traced bool), recording the first-trip
+    iteration."""
+    idx = _IDX[bit]
+    cond = jnp.asarray(cond)
+    flags = jnp.where(cond, hs.flags | bit, hs.flags)
+    first = jnp.where(cond & (hs.first_it[idx] < 0),
+                      jnp.asarray(it, jnp.int32), hs.first_it[idx])
+    return hs._replace(flags=flags, first_it=hs.first_it.at[idx].set(first))
+
+
+def step(hs: HealthState, it, res, trips=()):
+    """One guard update at iteration ``it`` with candidate residual norm
+    ``res`` (the value the solver is about to commit).
+
+    ``trips`` is a sequence of ``(bit, cond)`` or ``(bit, cond, fatal)``
+    tuples for solver-specific breakdown conditions (``fatal`` defaults
+    True; informational flags like INDEFINITE pass False).
+
+    Returns ``(ok, hs)``: ``ok`` is the commit mask — False on a fatal
+    trip (non-finite residual or breakdown), in which case the solver
+    keeps its previous state, skips the history write and does not count
+    the iteration; the loop then exits through :func:`keep_going`.
+    Stagnation/divergence counters advance only on committed steps."""
+    res = jnp.real(res)
+    fatal = ~jnp.isfinite(res)
+    hs = trip(hs, it, NAN, ~jnp.isfinite(res))
+    for t in trips:
+        bit, cond = t[0], jnp.asarray(t[1])
+        is_fatal = t[2] if len(t) > 2 else True
+        hs = trip(hs, it, bit, cond)
+        if is_fatal:
+            fatal = fatal | cond
+    ok = ~fatal
+    stag_rtol = _env_float("AMGCL_TPU_STAG_RTOL", 0.99)
+    stag_win = _env_int("AMGCL_TPU_STAG_WINDOW", 10)
+    div_win = _env_int("AMGCL_TPU_DIV_WINDOW", 5)
+    div_rtol = _env_float("AMGCL_TPU_DIV_RTOL", 10.0)
+    stalled = res > stag_rtol * hs.prev_res
+    # divergence needs BOTH step-to-step growth and a residual well above
+    # the best seen — non-monotone methods (BiCGStab, IDR(s)) routinely
+    # grow for a few iterations near the current floor and then drop;
+    # only sustained growth far off the floor is a genuine runaway
+    grew = (res > hs.prev_res) & (res > div_rtol * hs.best_res)
+    stag = jnp.where(ok, jnp.where(stalled, hs.stag + 1, 0), hs.stag)
+    div = jnp.where(ok, jnp.where(grew, hs.div + 1, 0), hs.div)
+    hs = hs._replace(stag=stag, div=div,
+                     prev_res=jnp.where(ok, res, hs.prev_res),
+                     best_res=jnp.where(ok, jnp.minimum(res, hs.best_res),
+                                        hs.best_res))
+    hs = trip(hs, it, STAGNATION, stag >= stag_win)
+    hs = trip(hs, it, DIVERGENCE, div >= div_win)
+    return ok, hs
+
+
+def keep_going(hs: HealthState):
+    """while_loop continuation term: False once any fatal flag tripped
+    (NaN, breakdown, or — behind AMGCL_TPU_DIVERGENCE_BREAK — an
+    explicit divergence), so a broken solve stops instead of burning
+    ``maxiter``."""
+    return (hs.flags & fatal_mask()) == 0
+
+
+def commit(ok, new, old):
+    """Commit-mask a candidate loop state: ``where(ok, new, old)`` over
+    the tree, so a fatal trip freezes the iterate at the last good
+    state (finite history, finite residual)."""
+    import jax
+    return jax.tree_util.tree_map(
+        lambda a, b: jnp.where(ok, a, b), new, old)
+
+
+# -- host-side decode --------------------------------------------------------
+
+def decode(flags, first_it=None) -> Dict[str, Any]:
+    """Fetched guard state -> the structured ``SolveReport.health``
+    dict: tripped flag names, per-flag first-trip iteration, and the
+    headline booleans (``nan``/``diverged``/``stagnated``) plus the
+    breakdown kind + iteration the acceptance contract names."""
+    flags = int(flags)
+    fi = [int(v) for v in first_it] if first_it is not None \
+        else [-1] * N_FLAGS
+    names = [FLAG_NAMES[b] for b in FLAG_BITS if flags & b]
+    first = {FLAG_NAMES[b]: fi[_IDX[b]] for b in FLAG_BITS
+             if flags & b and fi[_IDX[b]] >= 0}
+    bk_bits = [b for b in FLAG_BITS if (b & BREAKDOWN_MASK) and (flags & b)]
+    bk = None
+    if bk_bits:
+        bk = min(bk_bits, key=lambda b: fi[_IDX[b]] if fi[_IDX[b]] >= 0
+                 else 1 << 30)
+    out = {
+        "ok": flags == 0,
+        "flags": names,
+        "first_trip": first,
+        "nan": bool(flags & NAN),
+        "diverged": bool(flags & DIVERGENCE),
+        "stagnated": bool(flags & STAGNATION),
+        "indefinite": bool(flags & INDEFINITE),
+        "breakdown": FLAG_NAMES[bk] if bk else None,
+    }
+    if bk and fi[_IDX[bk]] >= 0:
+        out["breakdown_iteration"] = fi[_IDX[bk]]
+    return out
+
+
+# -- per-level convergence probes -------------------------------------------
+
+def two_grid_factor(hier, level: int = 0, n_iters: int = 12,
+                    seed: int = 1234, tail: int = 4) -> Dict[str, Any]:
+    """Measured error-reduction factor of the multigrid cycle rooted at
+    ``level``: iterate e <- e - cycle(level, A e) on a random error
+    vector (zero rhs — the exact-solution trick, so the iterate IS the
+    error), normalizing each step; after transients die the per-step
+    norm ratio converges to the asymptotic convergence factor (the
+    standard AMG quality diagnostic — per-level factors near 1 name the
+    level where coarsening fails). Returns the geometric mean of the
+    last ``tail`` factors plus the step series."""
+    import numpy as np
+    import jax
+    from jax import lax
+    from amgcl_tpu.ops import device as dev
+
+    lv = hier.levels[level]
+    A = lv.A
+    n = A.shape[1] * getattr(A, "block", (1, 1))[1]
+    dtype = A.dtype
+    e0 = np.random.RandomState(seed + level).standard_normal(n)
+    e0 = jnp.asarray(e0 / np.linalg.norm(e0), dtype)
+
+    def run(h, e):
+        def body(e, _):
+            Ae = dev.spmv(h.levels[level].A, e)
+            e2 = e - h.cycle(level, Ae)
+            nrm = jnp.sqrt(jnp.abs(dev.inner_product(e2, e2)))
+            return e2 / jnp.where(nrm == 0, 1.0, nrm), nrm
+
+        _, factors = lax.scan(body, e, None, length=n_iters)
+        return factors
+
+    factors = np.asarray(jax.jit(run)(hier, e0), np.float64)
+    good = factors[-tail:][np.isfinite(factors[-tail:])]
+    good = good[good > 0]
+    cf = float(np.exp(np.mean(np.log(good)))) if good.size else None
+    return {"level": int(level), "conv_factor": cf,
+            "factors": [float(f) for f in factors]}
+
+
+def smoother_rho(hier, level: int, n_iters: int = 20,
+                 seed: int = 4321) -> Optional[float]:
+    """Spectral-radius estimate of the smoother's error operator
+    E = I - W A by power iteration (one relaxation sweep on zero rhs is
+    exactly one application of E). rho(E) >= 1 means the smoother alone
+    diverges on that level — the doctor's 'reduce damping' finding."""
+    import numpy as np
+    import jax
+    from jax import lax
+    from amgcl_tpu.ops import device as dev
+
+    lv = hier.levels[level]
+    if lv.relax is None or lv.A is None:
+        return None
+    A = lv.A
+    n = A.shape[1] * getattr(A, "block", (1, 1))[1]
+    v0 = np.random.RandomState(seed + level).standard_normal(n)
+    v0 = jnp.asarray(v0 / np.linalg.norm(v0), A.dtype)
+
+    def run(h, v):
+        lvl = h.levels[level]
+        zero = jnp.zeros_like(v)
+
+        def body(v, _):
+            w = lvl.relax.apply_post(lvl.A, zero, v)
+            nrm = jnp.sqrt(jnp.abs(dev.inner_product(w, w)))
+            return w / jnp.where(nrm == 0, 1.0, nrm), nrm
+
+        _, norms = lax.scan(body, v, None, length=n_iters)
+        return norms
+
+    norms = np.asarray(jax.jit(run)(hier, v0), np.float64)
+    good = norms[-4:][np.isfinite(norms[-4:])]
+    good = good[good > 0]
+    return float(np.exp(np.mean(np.log(good)))) if good.size else None
+
+
+def probe_hierarchy(hier, n_iters: int = 12, seed: int = 1234,
+                    with_smoother: bool = True) -> List[Dict[str, Any]]:
+    """Per-level probe rows: the cycle convergence factor rooted at each
+    level (:func:`two_grid_factor`) and the smoother spectral radius.
+    The coarsest (direct-solved) level is exact by construction and is
+    reported with its measured (eps-level) factor for completeness."""
+    rows = []
+    for i, lv in enumerate(hier.levels):
+        if lv.A is None:      # device_filter placeholder level
+            rows.append({"level": i, "conv_factor": None})
+            continue
+        row = two_grid_factor(hier, i, n_iters=n_iters, seed=seed)
+        row["rows"] = int(lv.A.shape[0] * getattr(lv.A, "block",
+                                                  (1, 1))[0])
+        if with_smoother and lv.relax is not None:
+            row["smoother_rho"] = smoother_rho(hier, i, seed=seed)
+        rows.append(row)
+    return rows
+
+
+# -- the convergence doctor --------------------------------------------------
+
+_SEV_ORDER = {"critical": 0, "warning": 1, "info": 2}
+
+
+def _finding(sev, code, message, suggestion=None):
+    f = {"severity": sev, "code": code, "message": message}
+    if suggestion:
+        f["suggestion"] = suggestion
+    return f
+
+
+def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
+             probe: Optional[List[Dict[str, Any]]] = None,
+             tol: Optional[float] = None,
+             maxiter: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Rank-ordered findings from one solve: report (+ its ``health``
+    guard decode), the resource ledger, and the per-level probe rows.
+    Each finding: {severity, code, message, suggestion}. Pure host-side
+    dict-crunching — never raises on missing pieces."""
+    out: List[Dict[str, Any]] = []
+    health = getattr(report, "health", None) or {}
+    resid = getattr(report, "resid", None)
+    iters = getattr(report, "iters", None)
+    rate = getattr(report, "convergence_rate", None)
+    extra = getattr(report, "extra", None) or {}
+
+    if health.get("nan"):
+        it = health.get("first_trip", {}).get("nan")
+        out.append(_finding(
+            "critical", "nan",
+            "non-finite residual%s — the iterate left the representable "
+            "range" % (" at iteration %d" % it if it is not None else ""),
+            "check matrix scaling / symmetric equilibration, or use "
+            "dtype=float64"))
+    bk = health.get("breakdown")
+    if bk:
+        it = health.get("breakdown_iteration")
+        where = " at iteration %d" % it if it is not None else ""
+        msg = {
+            "breakdown_rho":
+                ("Krylov breakdown (rho ≈ 0)%s — the residual became "
+                 "orthogonal to the shadow space; the operator may be "
+                 "singular" % where,
+                 "try bicgstabl (L>=2), gmres, or verify the system is "
+                 "nonsingular / the rhs is consistent"),
+            "breakdown_omega":
+                ("BiCGStab omega-breakdown%s (minimal-residual step "
+                 "length ≈ 0)" % where,
+                 "use bicgstabl (L>=2) or gmres — both cure "
+                 "omega-stagnation on strongly non-symmetric systems"),
+            "breakdown_alpha":
+                ("search-direction breakdown (p·Ap ≈ 0)%s — "
+                 "singular operator or rhs with a null-space component"
+                 % where,
+                 "project the null space out of the rhs (or use deflation "
+                 "/ ns_search), or switch to gmres"),
+            "breakdown_hessenberg":
+                ("Arnoldi (Hessenberg) breakdown%s before convergence"
+                 % where,
+                 "the Krylov space became invariant — the operator is "
+                 "likely singular; check the system or use a coarser tol"),
+        }.get(bk, ("Krylov breakdown (%s)%s" % (bk, where), None))
+        out.append(_finding("critical", bk, msg[0], msg[1]))
+    if health.get("diverged"):
+        it = health.get("first_trip", {}).get("divergence")
+        out.append(_finding(
+            "critical", "divergence",
+            "residual grew for %s consecutive iterations%s"
+            % (_env_int("AMGCL_TPU_DIV_WINDOW", 5),
+               " (flagged at iteration %d)" % it if it is not None
+               else ""),
+            "cg requires an SPD operator — try bicgstab/gmres; if the "
+            "preconditioner diverges, reduce smoother damping or raise "
+            "npre/npost"))
+    if health.get("indefinite") and not health.get("breakdown"):
+        out.append(_finding(
+            "warning", "indefinite",
+            "p·Ap <= 0 observed under CG — the operator is not "
+            "positive definite",
+            "use bicgstab, bicgstabl or gmres instead of cg"))
+    if tol is not None and resid is not None and \
+            not (math.isfinite(resid) and resid <= tol * 1.0000001):
+        hit_max = maxiter is not None and iters is not None \
+            and iters >= maxiter
+        out.append(_finding(
+            "critical", "not_converged",
+            "did not converge: relative residual %.3e > tol %.1e after "
+            "%s iterations%s" % (resid, tol, iters,
+                                 " (maxiter reached)" if hit_max else ""),
+            "raise maxiter, loosen tol, or strengthen the "
+            "preconditioner (npre/npost, relaxation type, coarsening)"))
+    if health.get("stagnated"):
+        it = health.get("first_trip", {}).get("stagnation")
+        out.append(_finding(
+            "warning", "stagnation",
+            "residual stagnated (reduction < %.0f%% per iteration over "
+            "%d iterations%s)"
+            % (100 * (1 - _env_float("AMGCL_TPU_STAG_RTOL", 0.99)),
+               _env_int("AMGCL_TPU_STAG_WINDOW", 10),
+               ", from iteration %d" % it if it is not None else ""),
+            "raise npre/npost, switch relaxation (chebyshev, ilu0), or "
+            "check for an inconsistent rhs on a singular system"))
+    if "df32_drift" in extra:
+        d = extra["df32_drift"]
+        out.append(_finding(
+            "critical", "df32_drift",
+            "df32 compensated-residual drift detected: reported %.3e vs "
+            "host float64 %.3e — the compiled refinement loop "
+            "reassociated the error-free transforms"
+            % (d.get("reported", float("nan")),
+               d.get("actual", float("nan"))),
+            "use refine_dtype='float64' (trusted residuals) or "
+            "dtype=float64"))
+    if rate is not None and rate > 0.8 and not any(
+            f["code"] in ("divergence", "stagnation") for f in out):
+        out.append(_finding(
+            "warning", "slow_convergence",
+            "slow convergence: average residual reduction %.3f per "
+            "iteration" % rate,
+            "strengthen the cycle: raise npre/npost, try ncycle=2 "
+            "(W-cycle), or a stronger smoother (chebyshev/ilu0)"))
+
+    for row in probe or []:
+        cf = row.get("conv_factor")
+        lvl = row.get("level")
+        if cf is not None and cf >= 0.9:
+            out.append(_finding(
+                "warning", "level_conv_factor",
+                "level %s convergence factor %.2f — error components on "
+                "this level are barely reduced per cycle" % (lvl, cf),
+                "raise npre/npost or switch relaxation; if it persists, "
+                "the coarsening on this level is too aggressive "
+                "(lower eps_strong / aggregate size)"))
+        sr = row.get("smoother_rho")
+        if sr is not None and sr >= 1.0:
+            out.append(_finding(
+                "critical", "smoother_diverges",
+                "smoother diverges on level %s (spectral radius %.2f)"
+                % (lvl, sr),
+                "reduce the smoother damping or switch relaxation "
+                "(chebyshev bounds its spectrum explicitly)"))
+
+    hier = getattr(report, "hierarchy", None) or (ledger or {}).get(
+        "hierarchy")
+    if isinstance(hier, dict):
+        oc = hier.get("operator_complexity")
+        if oc is not None and oc > 2.5:
+            out.append(_finding(
+                "info", "operator_complexity",
+                "high operator complexity %.2f — setup memory and cycle "
+                "cost grow with it" % oc,
+                "use plain (unsmoothed) aggregation or raise the "
+                "strength threshold"))
+    if isinstance(ledger, dict):
+        dw = ledger.get("dense_window") or {}
+        if dw.get("refused"):
+            out.append(_finding(
+                "info", "dense_window_budget",
+                "dense-window conversions were refused by the HBM "
+                "budget (%d refusal(s)) — those levels fell back to "
+                "gather-based SpMV" % len(dw["refused"]),
+                "raise AMGCL_TPU_DWIN_MAX_BYTES if HBM allows"))
+
+    if not out:
+        out.append(_finding(
+            "info", "healthy",
+            "no findings: converged in %s iterations at %.3e"
+            % (iters, resid if resid is not None else float("nan"))))
+    out.sort(key=lambda f: _SEV_ORDER.get(f["severity"], 9))
+    return out
+
+
+def format_findings(findings: List[Dict[str, Any]]) -> str:
+    """Render diagnose() output as the doctor's text report."""
+    tag = {"critical": "CRIT", "warning": "WARN", "info": "INFO"}
+    lines = ["Convergence doctor: %d finding(s)" % len(findings)]
+    for i, f in enumerate(findings, 1):
+        lines.append("%2d. [%s] %s" % (i, tag.get(f["severity"], "????"),
+                                       f["message"]))
+        if f.get("suggestion"):
+            lines.append("      -> %s" % f["suggestion"])
+    return "\n".join(lines)

@@ -15,18 +15,31 @@ default for anything that never declares the field.
 Slots never written stay NaN and are sliced off by the recorded count
 (make_solver fetches ``history[:iters]``), so a genuine NaN residual from a
 breakdown inside the recorded range is preserved, not filtered.
+
+The mixin also plumbs the numerical-health guards (telemetry/health.py,
+``guard=True`` by default): a compact :class:`~amgcl_tpu.telemetry.health
+.HealthState` rides the while-loop carry, each iteration updates it with a
+handful of scalar ops (NaN residual, solver-specific breakdown
+denominators, stagnation/divergence window counters), and a fatal trip
+masks the state commit — the iterate freezes at the last good step, the
+loop exits early, and the fetched bitmask decodes into
+``SolveReport.health``. No extra reductions, no host syncs, no cost on
+the clean path beyond a few scalar compares.
 """
 
 from __future__ import annotations
 
 import jax.numpy as jnp
 
+from amgcl_tpu.telemetry import health as _health
+
 
 class HistoryMixin:
-    """Shared history plumbing for Krylov solvers (cg, bicgstab, bicgstabl,
-    gmres, lgmres, idrs, richardson, preonly)."""
+    """Shared history/health plumbing for Krylov solvers (cg, bicgstab,
+    bicgstabl, gmres, lgmres, idrs, richardson, preonly)."""
 
     record_history = False
+    guard = True
 
     def _hist_init(self, dtype, overshoot: int = 0):
         """Loop-state buffer: maxiter + overshoot slots when recording
@@ -48,10 +61,46 @@ class HistoryMixin:
             v = jnp.where(keep, v, hist[idx])
         return hist.at[idx].set(v)
 
-    def _hist_result(self, x, iters, resid, hist):
+    def _hist_result(self, x, iters, resid, hist, health=None):
         """The uniform solver return: ``(x, iters, resid)`` —
         ``(..., hist)`` appended when recording (make_solver slices it by
-        the recorded count)."""
+        the recorded count), ``(..., health)`` appended when guards are
+        on (make_solver decodes it into ``SolveReport.health``)."""
+        out = (x, iters, resid)
         if self.record_history:
-            return x, iters, resid, hist
-        return x, iters, resid
+            out = out + (hist,)
+        if health is not None and getattr(self, "guard", False):
+            out = out + (health,)
+        return out
+
+    # -- numerical-health guards (telemetry/health.py) ----------------------
+
+    def _guard_init(self, res0):
+        """Initial HealthState for the loop carry (a few scalars; carried
+        even with guard=False so the traced state structure never
+        depends on runtime values — the updates below no-op and XLA
+        dead-code-eliminates the whole thing)."""
+        return _health.init_state(res0)
+
+    def _guard_step(self, hs, it, res, trips=()):
+        """Guard update at iteration ``it`` with candidate residual
+        ``res`` and solver-specific breakdown trips. Returns
+        ``(ok, hs)`` — ``ok`` masks the state commit and the history
+        write; always-True when guards are off."""
+        if not getattr(self, "guard", False):
+            return jnp.asarray(True), hs
+        return _health.step(hs, it, res, trips)
+
+    def _guard_go(self, hs):
+        """while_loop continuation term: False once a fatal guard
+        tripped (NaN, breakdown, or divergence behind
+        AMGCL_TPU_DIVERGENCE_BREAK). Python True when guards are off —
+        folds away in the traced cond."""
+        if not getattr(self, "guard", False):
+            return True
+        return _health.keep_going(hs)
+
+    @staticmethod
+    def _guard_commit(ok, new, old):
+        """where(ok, new, old) over a state tree — the fatal-trip freeze."""
+        return _health.commit(ok, new, old)

@@ -51,6 +51,12 @@ class SolveReport:
     #: format, analytic FLOP/byte per cycle and per Krylov iteration,
     #: dense-window budget use, (distributed) halo bytes per iteration
     resources: Optional[Dict[str, Any]] = None
+    #: numerical-health guard decode (telemetry/health.py): tripped flag
+    #: names, per-flag first-trip iteration, and the headline booleans
+    #: (``nan``/``diverged``/``stagnated``) + breakdown kind/iteration.
+    #: ``{"ok": True, "flags": []}`` for a clean guarded solve, None when
+    #: the solver ran with ``guard=False``
+    health: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -92,6 +98,8 @@ class SolveReport:
             out["hierarchy"] = self.hierarchy
         if self.resources is not None:
             out["resources"] = self.resources
+        if self.health is not None:
+            out["health"] = self.health
         if self.extra:
             out.update(self.extra)
         return out
@@ -107,4 +115,7 @@ class SolveReport:
             lines.append("Rate:       %.3g /iter" % self.convergence_rate)
         if self.wall_time_s is not None:
             lines.append("Wall time:  %.4f s" % self.wall_time_s)
+        if self.health is not None and not self.health.get("ok", True):
+            lines.append("Health:     %s"
+                         % ", ".join(self.health.get("flags", [])))
         return "\n".join(lines)

@@ -4,9 +4,9 @@ cli.py, make_solver and the distributed solvers.
 Schema convention (shared with BENCH_*.json / PROGRESS.jsonl): flat JSON
 objects; every stamped record carries ``ts`` (unix seconds) and ``ts_iso``;
 solver-originated records carry an ``event`` field ("solve", "setup",
-"profile", "bench", "tier1_check", ...) plus the :class:`SolveReport`
-fields (iters, resid, convergence_rate, wall_time_s, solver, history,
-hierarchy).
+"profile", "bench", "tier1_check", "health", "doctor", ...) plus the
+:class:`SolveReport` fields (iters, resid, convergence_rate,
+wall_time_s, solver, history, hierarchy, health).
 
 The process-global default sink is a no-op until configured — either
 programmatically (``set_default_sink(JsonlSink(path))``) or by exporting

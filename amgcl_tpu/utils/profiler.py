@@ -18,7 +18,9 @@ or ``prof.tic("setup") ... prof.toc("setup")`` like the reference macros.
 drains the default device's dispatch queue, so scope totals include the
 device time of everything launched inside them (JAX is async — without the
 sync a scope only measures Python dispatch). ``to_dict()`` exports the tree
-for the JSONL telemetry sink.
+for the JSONL telemetry sink; ``to_chrome_trace()`` exports the recorded
+scope occurrences as Chrome/Perfetto trace-event JSON (``cli.py --trace``)
+so setup/solve profiles open in ui.perfetto.dev.
 """
 
 from __future__ import annotations
@@ -60,11 +62,28 @@ def device_sync():
 
 
 class Profiler:
+    #: per-occurrence event cap for the trace export (a profiler driven
+    #: inside a long loop must not grow without bound; past the cap only
+    #: the aggregated tree keeps accumulating and the export notes the
+    #: drop count)
+    MAX_EVENTS = 100_000
+
     def __init__(self, sync: Optional[Callable[[], None]] = None):
         self.root = _Node("[root]")
         self._stack = [self.root]
         self._t0 = time.perf_counter()
         self._sync = sync
+        #: (path, start_s, end_s) per closed scope occurrence — the
+        #: timeline the Chrome-trace export renders (to_chrome_trace)
+        self.events = []
+        self._events_dropped = 0
+
+    def _record_event(self, node, start, end):
+        if len(self.events) >= self.MAX_EVENTS:
+            self._events_dropped += 1     # saturated: skip the path work
+            return
+        path = "/".join([n.name for n in self._stack[1:]] + [node.name])
+        self.events.append((path, start, end))
 
     @classmethod
     def device(cls) -> "Profiler":
@@ -93,8 +112,10 @@ class Profiler:
             raise RuntimeError("profiler scope mismatch: toc(%r) inside %r"
                                % (name, node.name))
         self._stack.pop()
-        node.total += time.perf_counter() - node._started
+        now = time.perf_counter()
+        node.total += now - node._started
         node.count += 1
+        self._record_event(node, node._started, now)
 
     def _unwind(self, depth: int):
         """Close every scope above ``depth`` — abandoned by an exception
@@ -104,6 +125,7 @@ class Profiler:
             node = self._stack.pop()
             node.total += now - node._started
             node.count += 1
+            self._record_event(node, node._started, now)
 
     @contextmanager
     def scope(self, name: str):
@@ -134,6 +156,42 @@ class Profiler:
 
         return {"total_s": time.perf_counter() - self._t0,
                 "scopes": walk(self.root)}
+
+    def to_chrome_trace(self, tid: int = 0, tid_name: Optional[str] = None,
+                        pid: int = 0,
+                        epoch: Optional[float] = None) -> dict:
+        """Chrome/Perfetto trace-event export of the recorded scope
+        occurrences: ``json.dump`` the returned dict and open it in
+        ui.perfetto.dev (or chrome://tracing, or the TensorBoard trace
+        viewer). Each closed scope becomes a complete ('ph':'X') event
+        with microsecond timestamps relative to the profiler's birth, so
+        the nesting renders as the familiar flame graph of the tic/toc
+        tree. ``tid``/``tid_name`` let multiple profilers (e.g. the CLI
+        wall-clock profiler and the AMG setup profiler) merge into one
+        trace as separate named tracks — concatenate their
+        ``traceEvents`` and pass the SAME ``epoch`` (a
+        ``time.perf_counter()`` reference, e.g. the main profiler's
+        ``_t0``) to every export so the tracks share one timeline; the
+        default epoch is this profiler's own birth."""
+        t0 = self._t0 if epoch is None else epoch
+        events = []
+        if tid_name:
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": tid_name}})
+        for path, start, end in self.events:
+            events.append({
+                "name": path.rsplit("/", 1)[-1],
+                "cat": "amgcl",
+                "ph": "X",
+                "ts": round((start - t0) * 1e6, 3),
+                "dur": round((end - start) * 1e6, 3),
+                "pid": pid, "tid": tid,
+                "args": {"path": path},
+            })
+        out = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if self._events_dropped:
+            out["otherData"] = {"events_dropped": self._events_dropped}
+        return out
 
     def __str__(self):
         lines = ["Profile:"]

@@ -914,6 +914,11 @@ def main_worker():
         "wall_per_call_s": round(wall_per_call, 4),
         "iters": int(info.iters), "resid": float(info.resid),
         "true_resid": true_res})
+    # numerical-health guard decode (telemetry/health.py): the gate's
+    # health check compares this against the last-good record — a
+    # previously-clean problem that now trips any guard is a regression
+    if getattr(info, "health", None) is not None:
+        _PARTIAL["health"] = info.health
 
     # amortized timing: chain solves inside one scan so per-dispatch tunnel
     # latency (absent on a locally-attached device) does not pollute the
@@ -1081,6 +1086,10 @@ def gate_tolerances():
                               chained timings still jitter ~10-15% across
                               chip sessions, see BENCH_r0*.json)
       AMGCL_TPU_GATE_BYTES  — allowed peak-ledger-bytes ratio (def 1.10)
+      AMGCL_TPU_GATE_HEALTH — 1 (default): fail when a previously-clean
+                              record's candidate trips any health guard
+                              (breakdown/NaN/stagnation/divergence);
+                              0 disables the health check
     """
     def _f(name, default):
         try:
@@ -1091,6 +1100,20 @@ def gate_tolerances():
     return {"iters": _f("AMGCL_TPU_GATE_ITERS", 2),
             "time": _f("AMGCL_TPU_GATE_TIME", 1.25),
             "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10)}
+
+
+def _record_health_flags(rec):
+    """Tripped health-guard names of a bench record (sorted list), or
+    None when the record predates health telemetry (comparison
+    skipped)."""
+    h = rec.get("health")
+    if not isinstance(h, dict):
+        return None
+    flags = h.get("flags")
+    if flags is None:
+        ok = h.get("ok")
+        return None if ok is None else ([] if ok else ["unhealthy"])
+    return sorted(str(f) for f in flags)
 
 
 def _record_ledger_bytes(rec):
@@ -1108,9 +1131,11 @@ def run_gate(candidate, last_good, tol=None):
     """Compare ``candidate`` against ``last_good`` under the tolerances.
 
     Returns (ok, checks): one check row per metric — iterations (absolute
-    slack), solve time and peak ledger bytes (ratios). A metric missing
-    on either side is 'skipped', not a regression (pre-ledger records
-    carry no byte accounting)."""
+    slack), solve time and peak ledger bytes (ratios), plus the health
+    check (tripped-guard count must not exceed the baseline's; env
+    AMGCL_TPU_GATE_HEALTH=0 opts out). A metric missing on either side
+    is 'skipped', not a regression (pre-ledger records carry no byte
+    accounting, pre-health records no guard decode)."""
     tol = tol or gate_tolerances()
     checks = []
 
@@ -1132,6 +1157,20 @@ def run_gate(candidate, last_good, tol=None):
     b0 = _record_ledger_bytes(last_good)
     check("ledger_bytes", _record_ledger_bytes(candidate), b0,
           b0 * tol["bytes"] if b0 is not None else 0)
+    if os.environ.get("AMGCL_TPU_GATE_HEALTH", "1") != "0":
+        # flag IDENTITIES, not counts: any guard the baseline did not
+        # trip is a regression (a candidate swapping a warning-level
+        # stagnation for a fatal breakdown must not pass on 1 <= 1)
+        h0 = _record_health_flags(last_good)
+        hc = _record_health_flags(candidate)
+        if h0 is None or hc is None:
+            checks.append({"check": "health_flags", "status": "skipped",
+                           "candidate": hc, "last_good": h0})
+        else:
+            new = sorted(set(hc) - set(h0))
+            checks.append({"check": "health_flags", "candidate": hc,
+                           "last_good": h0, "new_flags": new,
+                           "status": "ok" if not new else "regression"})
     ok = not any(c["status"] == "regression" for c in checks)
     return ok, checks
 

@@ -1,0 +1,491 @@
+"""Numerical-health layer: in-loop guard detection (breakdown / NaN /
+stagnation / divergence with early exit), per-level convergence probes,
+the convergence doctor, the Perfetto trace export, and the bench gate's
+health check."""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.preconditioner import DummyPreconditioner
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.solver import (CG, BiCGStab, BiCGStabL, GMRES, FGMRES,
+                              LGMRES, IDRs, Richardson, PreOnly)
+from amgcl_tpu.telemetry import (JsonlSink, diagnose, format_findings,
+                                 health)
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def neumann_laplacian(n):
+    """Singular 1-D Neumann Laplacian: null space = span(ones). The ones
+    rhs lies entirely in the null space (A @ ones == 0), so every Krylov
+    method breaks down at the first search direction."""
+    main = 2.0 * np.ones(n)
+    main[0] = main[-1] = 1.0
+    L = sp.diags([-np.ones(n - 1), main, -np.ones(n - 1)],
+                 [-1, 0, 1]).tocsr()
+    return dev.to_device(CSR.from_scipy(L), "ell", jnp.float64)
+
+
+# -- breakdown paths (ISSUE 3 satellite: singular/indefinite systems) -------
+
+@pytest.mark.parametrize("solver,kind", [
+    (CG(maxiter=50, tol=1e-8, record_history=True), "breakdown_alpha"),
+    (BiCGStab(maxiter=50, tol=1e-8, record_history=True), None),
+    (IDRs(s=2, maxiter=50, tol=1e-8, record_history=True),
+     "breakdown_rho"),
+], ids=lambda v: v if isinstance(v, str) else type(v).__name__)
+def test_breakdown_on_singular_system(solver, kind):
+    """A singular system with a null-space rhs must set the breakdown
+    flag (with its first-trip iteration) and return FINITE history and
+    iterate — not NaN-filled arrays."""
+    A = neumann_laplacian(8)
+    b = jnp.ones(8, jnp.float64)
+    x, it, res, hist, hs = solver.solve(A, lambda r: r, b)
+    d = health.decode(hs.flags, hs.first_it)
+    assert d["breakdown"] is not None
+    if kind is not None:
+        assert d["breakdown"] == kind
+    assert "breakdown_iteration" in d
+    assert bool(jnp.all(jnp.isfinite(x)))
+    assert np.isfinite(float(res))
+    h = np.asarray(hist)[:int(it)]
+    assert np.all(np.isfinite(h)), type(solver).__name__
+    # the loop exited at the trip instead of burning maxiter
+    assert int(it) < solver.maxiter
+
+
+@pytest.mark.parametrize("solver", [
+    GMRES(M=10, maxiter=50, tol=1e-8, record_history=True),
+    LGMRES(M=10, maxiter=50, tol=1e-8, record_history=True),
+], ids=lambda s: type(s).__name__)
+def test_hessenberg_breakdown_on_singular_system(solver):
+    """GMRES/LGMRES on the null-space rhs: the zero-column Givens
+    rotation annihilates the projected residual, so without the guard
+    the solve reports res=0 'converged' while the singular triangular
+    solve fills x with NaN. The Hessenberg trip (rjj ≈ 0 with the
+    pre-step residual above eps) must fire instead, leaving a finite
+    iterate and an honest residual."""
+    A = neumann_laplacian(8)
+    b = jnp.ones(8, jnp.float64)
+    x, it, res, hist, hs = solver.solve(A, lambda r: r, b)
+    d = health.decode(hs.flags, hs.first_it)
+    assert d["breakdown"] == "breakdown_hessenberg"
+    assert bool(jnp.all(jnp.isfinite(x)))
+    assert np.isfinite(float(res)) and float(res) > 1e-8  # not 'converged'
+    assert np.all(np.isfinite(np.asarray(hist)[:int(it)]))
+
+
+def test_cg_guard_off_keeps_nan_exit():
+    """guard=False restores the historical failure signal on a singular
+    direction: the raw alpha division poisons the state and the loop
+    NaN-exits immediately instead of burning maxiter on a
+    finite-looking garbage iterate."""
+    A = neumann_laplacian(8)
+    b = jnp.ones(8, jnp.float64)
+    x, it, res = CG(maxiter=50, tol=1e-8, guard=False).solve(
+        A, lambda r: r, b)
+    assert int(it) < 50                      # exited at the breakdown
+    assert not np.isfinite(float(res))       # the honest NaN signal
+
+
+def test_cg_indefinite_flags():
+    """CG on a symmetric indefinite diagonal: p·Ap == 0 on the ones rhs
+    — alpha-breakdown at iteration 0, iterate untouched and finite."""
+    D = sp.diags([np.array([1., 1., 1., 1., -1., -1., -1., -1.])],
+                 [0]).tocsr()
+    A = dev.to_device(CSR.from_scipy(D), "ell", jnp.float64)
+    x, it, res, hist, hs = CG(maxiter=50, tol=1e-10,
+                              record_history=True).solve(
+        A, lambda r: r, jnp.ones(8, jnp.float64))
+    d = health.decode(hs.flags, hs.first_it)
+    assert d["breakdown"] == "breakdown_alpha"
+    assert d["breakdown_iteration"] == 0
+    assert int(it) == 0 and np.isfinite(float(res))
+
+
+def test_refine_merges_correction_health():
+    """With refine>0 the correction solves' guard flags must reach
+    SolveReport.health — a breakdown inside a correction cannot vanish
+    into the [:2] slice (clean refined solves stay clean)."""
+    A, rhs = poisson3d(10)
+    s = make_solver(A, AMGParams(dtype=jnp.float64, coarse_enough=200),
+                    CG(maxiter=100, tol=1e-8), refine=2,
+                    refine_dtype="float64")
+    x, info = s(rhs)
+    assert info.health["ok"], info.health
+    # singular operator: the initial solve breaks down AND the refine
+    # restarts rediscover it — either way the flag must be in the report
+    n = 8
+    main = 2.0 * np.ones(n)
+    main[0] = main[-1] = 1.0
+    L = sp.diags([-np.ones(n - 1), main, -np.ones(n - 1)],
+                 [-1, 0, 1]).tocsr()
+    s = make_solver(L, DummyPreconditioner(L, dtype=jnp.float64),
+                    CG(maxiter=50, tol=1e-10), refine=2,
+                    refine_dtype="float64")
+    x, info = s(np.ones(n))
+    assert info.health["breakdown"] == "breakdown_alpha"
+
+
+def test_clean_solves_report_ok():
+    """Guards must stay silent on healthy solves — every solver, AMG-
+    preconditioned Poisson."""
+    A, rhs = poisson3d(10)
+    for solver in [CG(maxiter=100, tol=1e-8),
+                   BiCGStab(maxiter=100, tol=1e-8),
+                   BiCGStabL(L=2, maxiter=100, tol=1e-8),
+                   GMRES(maxiter=100, tol=1e-8),
+                   FGMRES(maxiter=100, tol=1e-8),
+                   LGMRES(maxiter=100, tol=1e-8),
+                   IDRs(s=2, maxiter=100, tol=1e-8),
+                   PreOnly()]:
+        solve = make_solver(A, AMGParams(dtype=jnp.float64,
+                                         coarse_enough=200), solver)
+        x, info = solve(rhs)
+        assert info.health is not None, type(solver).__name__
+        assert info.health["ok"], (type(solver).__name__, info.health)
+        assert info.health["flags"] == []
+
+
+def test_divergence_breaks_early_and_reported():
+    """An explicitly diverging iteration (Richardson, damping 2 on an SPD
+    diagonal: error factor 3 per sweep) trips the divergence guard and
+    terminates the while_loop early instead of burning maxiter; the
+    report marks health.diverged (ISSUE 3 satellite)."""
+    D = sp.diags([2.0 * np.ones(16)], [0]).tocsr()
+    solve = make_solver(D, DummyPreconditioner(D, dtype=jnp.float64),
+                        Richardson(maxiter=200, tol=1e-12, damping=2.0))
+    x, info = solve(np.ones(16))
+    assert info.health["diverged"] is True
+    assert "divergence" in info.health["flags"]
+    assert info.iters < 200          # early exit, not maxiter
+    assert np.isfinite(info.resid)
+
+
+def test_divergence_break_env_off(monkeypatch):
+    """AMGCL_TPU_DIVERGENCE_BREAK=0: the flag still trips but the loop
+    runs to maxiter (the historical behavior)."""
+    monkeypatch.setenv("AMGCL_TPU_DIVERGENCE_BREAK", "0")
+    D = sp.diags([2.0 * np.ones(4)], [0]).tocsr()
+    A = dev.to_device(CSR.from_scipy(D), "ell", jnp.float64)
+    x, it, res, hs = Richardson(maxiter=30, tol=1e-12, damping=2.0).solve(
+        A, lambda r: r, jnp.ones(4, jnp.float64))
+    d = health.decode(hs.flags, hs.first_it)
+    assert d["diverged"] and int(it) == 30
+
+
+def test_stagnation_flag():
+    """Near-unit residual reduction over the window trips the (non-fatal)
+    stagnation flag; the loop keeps going."""
+    I = sp.identity(4, format="csr")
+    A = dev.to_device(CSR.from_scipy(I), "ell", jnp.float64)
+    x, it, res, hs = Richardson(maxiter=40, tol=1e-12,
+                                damping=0.005).solve(
+        A, lambda r: r, jnp.ones(4, jnp.float64))
+    d = health.decode(hs.flags, hs.first_it)
+    assert d["stagnated"] and not d["diverged"]
+    assert int(it) == 40             # informational: no early exit
+
+
+def test_divergence_tolerates_oscillation():
+    """The divergence counter anchors on the best residual seen
+    (AMGCL_TPU_DIV_RTOL): oscillation near the current floor — the
+    normal life of BiCGStab/IDR(s) — must not trip, while sustained
+    growth far off the floor must."""
+    import jax.numpy as jnp_
+    hs = health.init_state(jnp_.asarray(1.0))
+    # grows every other step but never leaves 10x of the floor: clean
+    for it, r in enumerate([0.5, 0.9, 0.4, 0.8, 0.3, 0.7, 0.2, 0.6,
+                            0.15, 0.5, 0.1, 0.4]):
+        ok, hs = health.step(hs, it, jnp_.asarray(r))
+        assert bool(ok)
+    assert int(hs.flags) == 0
+    # now a genuine runaway: strictly growing, far above the floor
+    r = 2.0
+    for it in range(12, 25):
+        ok, hs = health.step(hs, it, jnp_.asarray(r))
+        r *= 3.0
+    d = health.decode(hs.flags, hs.first_it)
+    assert d["diverged"]
+
+
+def test_guard_off_restores_bare_tuple():
+    """guard=False drops the trailing HealthState — the historical
+    (x, iters, resid[, hist]) contract, for callers that unpack."""
+    A, rhs = poisson3d(8)
+    Ad = dev.to_device(A, "ell", jnp.float64)
+    got = CG(maxiter=50, tol=1e-8, guard=False).solve(
+        Ad, lambda r: r, jnp.asarray(rhs))
+    assert len(got) == 3
+    got = CG(maxiter=50, tol=1e-8, record_history=True, guard=False).solve(
+        Ad, lambda r: r, jnp.asarray(rhs))
+    assert len(got) == 4
+
+
+def test_breakdown_through_make_solver_and_sink(tmp_path):
+    """SolveReport.health names the breakdown kind and iteration on a
+    deliberately singular system, and the sink receives a dedicated
+    'health' event (ISSUE 3 acceptance)."""
+    from amgcl_tpu import telemetry
+    n = 8
+    main = 2.0 * np.ones(n)
+    main[0] = main[-1] = 1.0
+    L = sp.diags([-np.ones(n - 1), main, -np.ones(n - 1)],
+                 [-1, 0, 1]).tocsr()
+    path = str(tmp_path / "health.jsonl")
+    telemetry.set_default_sink(JsonlSink(path))
+    try:
+        solve = make_solver(L, DummyPreconditioner(L, dtype=jnp.float64),
+                            CG(maxiter=50, tol=1e-8,
+                               record_history=True))
+        x, info = solve(np.ones(n))
+    finally:
+        telemetry.set_default_sink(None)
+    assert info.health["breakdown"] == "breakdown_alpha"
+    assert info.health["breakdown_iteration"] >= 0
+    assert len(info.history) == info.iters
+    assert np.all(np.isfinite(np.asarray(info.history)))
+    recs = [json.loads(ln) for ln in open(path)]
+    events = {r["event"] for r in recs}
+    assert "health" in events and "solve" in events
+    hrec = [r for r in recs if r["event"] == "health"][-1]
+    assert hrec["breakdown"] == "breakdown_alpha"
+    # the solve record carries the same decode
+    srec = [r for r in recs if r["event"] == "solve"][-1]
+    assert srec["health"]["ok"] is False
+
+
+# -- per-level convergence probes -------------------------------------------
+
+def test_probe_convergence_poisson():
+    """Measured per-level cycle factors on Poisson SA: healthy factors
+    well below 1 on every level, smoother spectral radius in (0, 1),
+    and the probe rows fold into hierarchy_stats()."""
+    A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    probe = amg.probe_convergence()
+    assert len(probe) == len(amg.hierarchy.levels)
+    for row in probe[:-1]:
+        assert 0 < row["conv_factor"] < 0.9, row
+        assert 0 < row["smoother_rho"] < 1, row
+    # coarsest level is direct-solved: factor at the eps level
+    assert probe[-1]["conv_factor"] < 1e-6
+    # cached + folded into the structured stats
+    assert amg.probe_convergence() is probe
+    st = amg.hierarchy_stats()
+    for i, lv in enumerate(st["levels"]):
+        assert lv["conv_factor"] == pytest.approx(
+            probe[i]["conv_factor"], rel=1e-12, abs=1e-30)
+    json.dumps(st)
+
+    # the level-0 factor bounds the cycle's error reduction: a
+    # Richardson iteration preconditioned by one cycle must converge at
+    # ~ that rate, so the probe is a genuine prediction, not a printout
+    solve = make_solver(A, AMGParams(dtype=jnp.float64,
+                                     coarse_enough=200),
+                        Richardson(maxiter=100, tol=1e-10))
+    x, info = solve(np.ones(A.nrows))
+    assert info.convergence_rate < probe[0]["conv_factor"] + 0.1
+
+
+def test_two_grid_factor_single_level():
+    from amgcl_tpu.telemetry.health import two_grid_factor
+    A, _ = poisson3d(10)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    row = two_grid_factor(amg.hierarchy, level=0, n_iters=10)
+    assert row["level"] == 0 and len(row["factors"]) == 10
+    assert 0 < row["conv_factor"] < 0.9
+
+
+# -- the doctor -------------------------------------------------------------
+
+def test_diagnose_rules():
+    from amgcl_tpu.telemetry import SolveReport
+    # diverged health -> critical divergence finding, ranked first
+    rep = SolveReport(20, 1e3, solver="CG",
+                      health={"ok": False, "flags": ["divergence"],
+                              "first_trip": {"divergence": 4},
+                              "nan": False, "diverged": True,
+                              "stagnated": False, "indefinite": False,
+                              "breakdown": None})
+    fins = diagnose(rep, tol=1e-8, maxiter=20)
+    codes = [f["code"] for f in fins]
+    assert codes[0] in ("divergence", "not_converged")
+    assert "divergence" in codes and "not_converged" in codes
+    assert all(f["severity"] == "critical" for f in fins[:2])
+    # breakdown names the kind and the suggestion mentions an alternative
+    rep = SolveReport(3, 1.0, solver="BiCGStab",
+                      health={"ok": False, "flags": ["breakdown_omega"],
+                              "first_trip": {"breakdown_omega": 3},
+                              "nan": False, "diverged": False,
+                              "stagnated": False, "indefinite": False,
+                              "breakdown": "breakdown_omega",
+                              "breakdown_iteration": 3})
+    fins = diagnose(rep)
+    assert fins[0]["code"] == "breakdown_omega"
+    assert "iteration 3" in fins[0]["message"]
+    assert "bicgstabl" in fins[0]["suggestion"]
+    # probe: a bad level names the level and suggests npre/npost
+    rep = SolveReport(80, 1e-7, solver="CG")
+    fins = diagnose(rep, probe=[{"level": 0, "conv_factor": 0.5},
+                                {"level": 2, "conv_factor": 0.94}])
+    bad = [f for f in fins if f["code"] == "level_conv_factor"]
+    assert len(bad) == 1 and "level 2" in bad[0]["message"]
+    assert "npre" in bad[0]["suggestion"]
+    # healthy report -> single info finding; text renderer runs
+    rep = SolveReport(10, 1e-9, solver="CG",
+                      health={"ok": True, "flags": []})
+    fins = diagnose(rep, tol=1e-8)
+    assert [f["code"] for f in fins] == ["healthy"]
+    text = format_findings(fins)
+    assert "Convergence doctor" in text and "[INFO]" in text
+
+
+def test_cli_doctor_and_trace(tmp_path):
+    """cli.py --doctor prints the per-level probe factors + ranked
+    findings, and --trace writes Perfetto-loadable trace-event JSON
+    (ISSUE 3 acceptance / satellite)."""
+    trace = tmp_path / "trace.json"
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "amgcl_tpu.cli", "-n", "16",
+         "-p", "solver.type=cg", "-p", "precond.coarse_enough=200",
+         "--doctor", "--trace", str(trace)],
+        capture_output=True, text=True, timeout=600, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "Per-level convergence probe" in r.stdout
+    assert "Convergence doctor" in r.stdout
+    # the factors the doctor prints ARE probe_convergence()'s numbers:
+    # re-run the probe in-process and compare within 10%
+    A, _ = poisson3d(16)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=200))
+    probe = amg.probe_convergence()
+    printed = []
+    seen = False
+    for line in r.stdout.splitlines():
+        if line.startswith("Per-level convergence probe"):
+            seen = True
+        parts = line.split()
+        if seen and parts and parts[0].isdigit():
+            printed.append(float(parts[2]))
+    assert len(printed) == len(probe)
+    for got, row in zip(printed, probe):
+        assert got == pytest.approx(row["conv_factor"],
+                                    rel=0.1, abs=1e-3)
+    # the trace opens as Chrome trace-event JSON
+    t = json.load(open(trace))
+    evs = [e for e in t["traceEvents"] if e.get("ph") == "X"]
+    names = {e["name"] for e in evs}
+    assert {"setup", "solve", "probe"} <= names
+    assert all(e["dur"] >= 0 and e["ts"] >= 0 for e in evs)
+    # the AMG setup profile rides along as its own track, on the SAME
+    # timeline (shared epoch): its events land inside the CLI's 'setup'
+    # span, where the build actually ran
+    tids = {e["tid"] for e in evs}
+    assert len(tids) >= 2
+    cli_setup = [e for e in evs if e["tid"] == 0
+                 and e["name"] == "setup"][0]
+    setup_track = [e for e in evs if e["tid"] != 0]
+    assert setup_track
+    slop = 1e4    # 10 ms of scope-boundary overhead
+    for e in setup_track:
+        assert e["ts"] >= cli_setup["ts"] - slop
+        assert e["ts"] + e["dur"] <= cli_setup["ts"] + cli_setup["dur"] \
+            + slop
+
+
+def test_profiler_chrome_trace_export():
+    """Profiler.to_chrome_trace(): complete events with microsecond
+    ts/dur, nesting contained in the parent span, JSON-serializable."""
+    import time as _time
+    from amgcl_tpu.utils.profiler import Profiler
+    p = Profiler()
+    with p.scope("outer"):
+        with p.scope("inner"):
+            _time.sleep(0.002)
+        with p.scope("inner"):
+            _time.sleep(0.001)
+    t = p.to_chrome_trace(tid=3, tid_name="test")
+    json.dumps(t)
+    meta = [e for e in t["traceEvents"] if e["ph"] == "M"]
+    assert meta and meta[0]["args"]["name"] == "test"
+    evs = [e for e in t["traceEvents"] if e["ph"] == "X"]
+    assert len(evs) == 3
+    assert all(e["tid"] == 3 for e in evs)
+    inner = [e for e in evs if e["name"] == "inner"]
+    outer = [e for e in evs if e["name"] == "outer"][0]
+    assert len(inner) == 2
+    for e in inner:
+        assert e["args"]["path"] == "outer/inner"
+        assert e["ts"] >= outer["ts"] - 1e-6
+        assert e["ts"] + e["dur"] <= outer["ts"] + outer["dur"] + 1e-6
+
+
+# -- bench gate -------------------------------------------------------------
+
+def test_gate_health_check(monkeypatch):
+    """bench.py --gate: a previously-clean record that now trips any
+    guard is a regression; pre-health records are skipped, and
+    AMGCL_TPU_GATE_HEALTH=0 opts out (ISSUE 3 satellite)."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    lg = {"iters": 10, "value": 1.0,
+          "health": {"ok": True, "flags": []}}
+    bad = {"iters": 10, "value": 1.0,
+           "health": {"ok": False, "flags": ["divergence"]}}
+    ok, checks = bench.run_gate(bad, lg)
+    row = [c for c in checks if c["check"] == "health_flags"][0]
+    assert not ok and row["status"] == "regression"
+    assert row["new_flags"] == ["divergence"]
+    ok, _ = bench.run_gate(lg, lg)
+    assert ok
+    # flag IDENTITIES, not counts: swapping a warning-level stagnation
+    # for a fatal breakdown is a regression even at equal counts
+    stag = {"iters": 10, "value": 1.0,
+            "health": {"ok": False, "flags": ["stagnation"]}}
+    nan = {"iters": 10, "value": 1.0,
+           "health": {"ok": False, "flags": ["nan"]}}
+    ok, checks = bench.run_gate(nan, stag)
+    row = [c for c in checks if c["check"] == "health_flags"][0]
+    assert not ok and row["new_flags"] == ["nan"]
+    # a baseline that already trips the same flag tolerates it
+    ok, _ = bench.run_gate(stag, stag)
+    assert ok
+    # records predating health telemetry: skipped, not failed
+    ok, checks = bench.run_gate({"iters": 10, "value": 1.0},
+                                {"iters": 10, "value": 1.0})
+    row = [c for c in checks if c["check"] == "health_flags"][0]
+    assert ok and row["status"] == "skipped"
+    # opt-out
+    monkeypatch.setenv("AMGCL_TPU_GATE_HEALTH", "0")
+    ok, checks = bench.run_gate(bad, lg)
+    assert ok and not any(c["check"] == "health_flags" for c in checks)
+
+
+def test_dist_cg_health_report():
+    """Distributed CG carries the same guard decode in its report
+    (replicated across shards — the dots are psum'd)."""
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+    from amgcl_tpu.parallel.dist_solver import dist_cg
+    mesh = make_mesh(4)
+    A, rhs = poisson3d(8)
+    M = DistDiaMatrix.from_csr(A, mesh, jnp.float64)
+    out = dist_cg(M, mesh, jnp.asarray(rhs), maxiter=50, tol=1e-8)
+    assert out.report.health is not None
+    assert out.report.health["ok"] and out.report.health["flags"] == []

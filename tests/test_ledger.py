@@ -294,7 +294,8 @@ def _bench():
 
 def test_gate_pass_fail_unit(monkeypatch):
     bench = _bench()
-    lg = {"value": 1.0, "iters": 10, "ledger": {"hierarchy_bytes": 1000}}
+    lg = {"value": 1.0, "iters": 10, "ledger": {"hierarchy_bytes": 1000},
+          "health": {"ok": True, "flags": []}}
     ok, checks = bench.run_gate(dict(lg), lg)
     assert ok and all(c["status"] == "ok" for c in checks)
     for key, bad in [("value", 2.0), ("iters", 20),

@@ -76,7 +76,7 @@ def test_gmres_complex_system():
     A, rhs = poisson3d_complex(8)
     Ad = dev.to_device(A, "ell", jnp.complex128)
     g = GMRES(maxiter=300, tol=1e-8, M=30)
-    x, it, res = g.solve(Ad, lambda r: r, jnp.asarray(rhs))
+    x, it, res = g.solve(Ad, lambda r: r, jnp.asarray(rhs))[:3]
     r = rhs - A.spmv(np.asarray(x))
     assert np.linalg.norm(r) / np.linalg.norm(rhs) < 1e-7
 
