@@ -50,6 +50,19 @@ def main(argv=None):
                          "--telemetry, emit it as a 'ledger' event; also "
                          "cross-checks the analytic cycle cost against "
                          "XLA's cost analysis where available")
+    ap.add_argument("--roofline", action="store_true",
+                    help="measure every V-cycle stage standalone "
+                         "(AMGCL_TPU_ROOFLINE_REPS reps each, device-"
+                         "synced) and print achieved GB/s / GFLOP/s per "
+                         "stage against the ledger's model bytes and the "
+                         "device peaks (auto-detected; AMGCL_TPU_PEAK_"
+                         "{GBPS,FLOPS} override; CPU measures a stream "
+                         "fallback), with compute-/memory-bound "
+                         "classification, ranked bottlenecks, and a "
+                         "per-stage model-vs-XLA byte cross-check; with "
+                         "--telemetry also emits a 'roofline' event, and "
+                         "with --trace adds the stage timeline with an "
+                         "achieved-GB/s counter track")
     ap.add_argument("--doctor", action="store_true",
                     help="run the convergence doctor: probe the measured "
                          "per-level convergence factors and smoother "
@@ -185,6 +198,28 @@ def main(argv=None):
         else:
             print("(no resource ledger: %r exposes none)" % type(inner))
 
+    roofline_rec = None
+    if args.roofline:
+        from amgcl_tpu.telemetry import roofline as _roofline
+        roof_fn = getattr(precond_obj, "roofline", None)
+        if callable(roof_fn):
+            # per-stage measurement (cached on the AMG object) + the
+            # model-vs-XLA byte cross-check of exactly those stage fns
+            with prof.scope("roofline"):
+                roofline_rec = roof_fn()
+            hier = getattr(precond_obj, "hierarchy", None)
+            xla_rows = _roofline.xla_stage_check(hier) \
+                if hier is not None else []
+            print()
+            print(_roofline.format_roofline(roofline_rec, xla_rows))
+            rec = {k: v for k, v in roofline_rec.items()
+                   if not k.startswith("_")}
+            if xla_rows:
+                rec["xla_check"] = xla_rows
+            telemetry.emit(event="roofline", **rec)
+        else:
+            print("(no roofline: %r exposes none)" % type(inner))
+
     if args.doctor:
         from amgcl_tpu.telemetry.health import diagnose, format_findings
         probe = None
@@ -211,9 +246,16 @@ def main(argv=None):
         except Exception:
             pass                     # the doctor works from what exists
         solver_obj = getattr(inner, "solver", None)
+        from amgcl_tpu.telemetry import compile_watch as _cwatch
         findings = diagnose(info, ledger=led, probe=probe,
                             tol=getattr(solver_obj, "tol", None),
-                            maxiter=getattr(solver_obj, "maxiter", None))
+                            maxiter=getattr(solver_obj, "maxiter", None),
+                            # efficiency leg: --roofline's bottleneck
+                            # ranking and the process compile stats ride
+                            # into the same findings list
+                            roofline=roofline_rec,
+                            compile_stats=_cwatch.snapshot()
+                            if _cwatch.enabled() else None)
         print()
         print(format_findings(findings))
         telemetry.emit(event="doctor", findings=findings,
@@ -229,6 +271,11 @@ def main(argv=None):
         if callable(stats):
             telemetry.emit(event="hierarchy", **stats())
         telemetry.emit(event="profile", **prof.to_dict())
+        from amgcl_tpu.telemetry import compile_watch as _cwatch
+        if _cwatch.enabled():
+            # process-wide compile accounting: traces/compiles/compile
+            # seconds per watched function + retrace events
+            telemetry.emit(event="compile", **_cwatch.snapshot())
 
     if args.trace:
         # Chrome/Perfetto trace-event JSON of the host-side scope
@@ -244,6 +291,13 @@ def main(argv=None):
             trace["traceEvents"] += setup_prof.to_chrome_trace(
                 tid=1, tid_name="amg setup",
                 epoch=prof._t0)["traceEvents"]
+        if roofline_rec is not None and roofline_rec.get("_prof"):
+            # the roofline measurement as its own track, with the
+            # achieved-GB/s counter stepping per stage occurrence
+            from amgcl_tpu.telemetry.roofline import counter_map
+            trace["traceEvents"] += roofline_rec["_prof"].to_chrome_trace(
+                tid=2, tid_name="roofline stages", epoch=prof._t0,
+                counters=counter_map(roofline_rec))["traceEvents"]
         with open(args.trace, "w") as f:
             _json.dump(trace, f)
         print("trace written to %s (open in ui.perfetto.dev)" % args.trace)

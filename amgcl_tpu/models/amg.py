@@ -195,6 +195,7 @@ class AMG:
         self._dev_prefix = []
         self._ledger_cache = None
         self._probe_cache = None
+        self._roofline_cache = None
         # setup-phase profiler (PR 1 instrumented the SOLVE phase only):
         # device-synced tic/toc scopes + amgcl/setup/* host annotations
         # around coarsening / galerkin / device transfer / smoother
@@ -293,6 +294,7 @@ class AMG:
         prof = self.setup_profile = Profiler.device()
         self._ledger_cache = None
         self._probe_cache = None
+        self._roofline_cache = None
         host = []
         Acur = A
         for i, (_, P, R) in enumerate(self.host_levels[:-1]):
@@ -403,6 +405,31 @@ class AMG:
                 budget=getattr(self, "_dwin_budget", None),
                 setup_profile=getattr(self, "setup_profile", None))
             self._ledger_cache = cached
+        return cached
+
+    def roofline(self, reps: Optional[int] = None,
+                 peaks: Optional[dict] = None):
+        """Measured roofline attribution (telemetry/roofline.py): drive
+        every V-cycle stage standalone under a device-synced profiler
+        (``AMGCL_TPU_ROOFLINE_REPS`` repetitions each), join the
+        per-stage times to the ledger's FLOP/byte model, and return
+        achieved GB/s / GFLOP/s per stage vs the device peaks
+        (auto-detected; ``AMGCL_TPU_PEAK_{GBPS,FLOPS}`` override) with
+        compute-/memory-bound classification and ranked bottlenecks.
+        Cached per build (the measurement jit-compiles one small program
+        per stage); ``rebuild()`` invalidates. The measurement profiler
+        rides along under ``"_prof"`` (stripped from JSONL exports) so
+        ``cli.py --trace`` can render the stage timeline with the
+        achieved-GB/s counter track. Passing explicit ``reps``/``peaks``
+        re-measures instead of returning the cached default run."""
+        cached = getattr(self, "_roofline_cache", None)
+        if cached is None or reps is not None or peaks is not None:
+            from amgcl_tpu.telemetry import roofline as _roofline
+            prof = _roofline.measure_stages(self.hierarchy, reps=reps)
+            cached = _roofline.roofline(self.hierarchy, prof=prof,
+                                        peaks=peaks)
+            cached["_prof"] = prof
+            self._roofline_cache = cached
         return cached
 
     def probe_convergence(self, n_iters: int = 12, seed: int = 1234,

@@ -22,6 +22,11 @@ from amgcl_tpu.ops import device as dev
 from amgcl_tpu.models.amg import AMG, AMGParams
 from amgcl_tpu.solver.cg import CG
 from amgcl_tpu.telemetry import SolveReport, phase, emit as telemetry_emit
+from amgcl_tpu.telemetry import compile_watch as _cwatch
+
+#: compile-watch label of the fused solve program (one jit cache per
+#: make_solver instance; the watch aggregates them under this name)
+_SOLVE_FN = "make_solver._solve_fn"
 
 #: historical name — every solve now returns the full structured report
 #: (telemetry/report.py); the old (iters, resid, history) construction and
@@ -393,7 +398,13 @@ class make_solver:
         t0 = time.perf_counter()
         first_call = self._compiled is None
         if first_call:
-            self._compiled = jax.jit(self._solve_fn)
+            # observed jit (telemetry/compile_watch.py): traces, backend
+            # compiles and compile seconds of the solve program land in
+            # SolveReport.compile; a retrace on a new shape after warmup
+            # is flagged for the doctor
+            self._compiled = _cwatch.watched_jit(
+                self._solve_fn, name=_SOLVE_FN)
+        cw0 = _cwatch.snapshot(_SOLVE_FN) if _cwatch.enabled() else None
         got = self._compiled(self.A_dev, self.A_dev64,
                              self.precond.hierarchy, rhs, x0)
         x = got[0]
@@ -425,12 +436,40 @@ class make_solver:
             # set by _check_df32_runtime on harmful drift — sticky so the
             # doctor sees it on every later report from this bundle
             extra["df32_drift"] = self._df32_drift
+        compile_rec = None
+        if cw0 is not None:
+            # per-call compile delta: 0 new traces on a warm repeat, 1 on
+            # a fresh shape — the recompile counter the roofline tests
+            # pin down
+            cw1 = _cwatch.snapshot(_SOLVE_FN)
+            compile_rec = {"function": _SOLVE_FN,
+                           **_cwatch.delta(cw0, cw1),
+                           "signatures": cw1["signatures"],
+                           "totals": {"traces": cw1["traces"],
+                                      "compile_s": cw1["compile_s"]}}
+        resources = self._resources()
+        try:
+            # whole-solve roofline (telemetry/roofline.py): achieved
+            # GB/s / GFLOP/s of this call from the ledger's per-iteration
+            # model. Updated IN PLACE on the cached resources dict so the
+            # latest call's numbers win (prior reports alias the dict);
+            # the JSONL 'solve' event below snapshots the current value
+            from amgcl_tpu.telemetry import roofline as _roofline
+            pi = resources.get("per_iteration") if resources else None
+            if pi is not None:
+                rf = _roofline.solve_roofline(pi, int(iters), wall,
+                                              first_call=first_call)
+                if rf is not None:
+                    resources["roofline"] = rf
+        except Exception:
+            pass                 # roofline must never fail a solve
         report = SolveReport(
             int(iters), float(resid), hist, wall_time_s=wall,
             solver=type(self.solver).__name__,
             hierarchy=self._hierarchy_stats(),
-            resources=self._resources(),
+            resources=resources,
             health=health,
+            compile=compile_rec,
             # the first call's wall time includes jit trace + compile —
             # flag it so sink consumers can separate it from steady state
             extra=extra)

@@ -36,6 +36,7 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
 from jax import lax
 from jax.tree_util import register_pytree_node_class
 
@@ -210,7 +211,8 @@ def _dwin_dma(pl, pltpu, starts_smem, x_hbm, xw, sem):
     return xw
 
 
-@functools.partial(jax.jit, static_argnames=("win", "n_out", "interpret"))
+@functools.partial(_watched_jit, name="ops.dense_window_spmv",
+                   static_argnames=("win", "n_out", "interpret"))
 def dense_window_spmv(window_starts, blocks, x, win, n_out,
                       interpret: bool = False):
     """y = A x: window DMA + (tile, win) multiply / lane reduce."""
@@ -239,7 +241,7 @@ def dense_window_spmv(window_starts, blocks, x, win, n_out,
     return out.reshape(n_tiles * tile)[:n_out]
 
 
-@functools.partial(jax.jit,
+@functools.partial(_watched_jit, name="ops.dense_window_fused",
                    static_argnames=("mode", "win", "n_out", "interpret"))
 def dense_window_fused(window_starts, blocks, f, x, w, mode, win, n_out,
                        interpret: bool = False):

@@ -24,6 +24,7 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
 
 from amgcl_tpu.telemetry.tracing import phase as _tel_phase
 
@@ -251,8 +252,9 @@ def _dia_window(offsets, data, x, tile, interpret):
     return base, win, n_pad, xp, dpad
 
 
-@functools.partial(jax.jit, static_argnames=("offsets", "tile",
-                                              "interpret", "db"))
+@functools.partial(_watched_jit, name="ops.dia_spmv",
+                   static_argnames=("offsets", "tile", "interpret",
+                                    "db"))
 def dia_spmv(offsets, data, x, tile=None, interpret: bool = False,
              db=None):
     """y = A x for DIA storage. offsets: static tuple; data: (ndiag, n);
@@ -315,7 +317,7 @@ def dia_spmv(offsets, data, x, tile=None, interpret: bool = False,
 # Mosaic ops, so anywhere dia_spmv legalizes these do too.
 
 
-@functools.partial(jax.jit,
+@functools.partial(_watched_jit, name="ops.dia_fused",
                    static_argnames=("offsets", "mode", "tile", "interpret",
                                     "db"))
 def _dia_fused(offsets, data, f, x, w, mode, tile=None, interpret=False,
@@ -367,8 +369,9 @@ def _dia_fused(offsets, data, f, x, w, mode, tile=None, interpret=False,
     return out[:n]
 
 
-@functools.partial(jax.jit, static_argnames=("offsets", "tile",
-                                              "interpret", "db"))
+@functools.partial(_watched_jit, name="ops.dia_spmv_dots",
+                   static_argnames=("offsets", "tile", "interpret",
+                                    "db"))
 def dia_spmv_dots(offsets, data, x, w=None, tile=None,
                   interpret: bool = False, db=None):
     """(y, <y, y>, <y, x>, <y, w>) in one pass, y = A x (w optional).

@@ -46,6 +46,7 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.pallas_spmv import probe_report
@@ -135,7 +136,8 @@ def _packed_reduce(f0, k, c0, dtype):
     return jnp.asarray(m, dtype=dtype)
 
 
-@functools.partial(jax.jit, static_argnames=(
+@functools.partial(_watched_jit, name="ops.fused_down_sweep",
+                   static_argnames=(
     "offs_a", "offs_m", "dims", "coarse", "H", "zero_guess", "framed",
     "interpret"))
 def fused_down_sweep(a_flat, mt_flat, sy, sx, f, u,
@@ -371,7 +373,8 @@ def _values_agree(got, want, dt):
     return np.linalg.norm(got - want) / denom < tol
 
 
-@functools.partial(jax.jit, static_argnames=(
+@functools.partial(_watched_jit, name="ops.fused_up_sweep",
+                   static_argnames=(
     "offs_a", "offs_m", "dims", "coarse", "halo_planes", "framed",
     "interpret"))
 def fused_up_sweep(a_data, m_flat, syt, sxt, rc3p, f, w, u,

@@ -49,6 +49,7 @@ import time
 import numpy as np
 import jax
 import jax.numpy as jnp
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
 from jax import lax
 
 from amgcl_tpu.ops.csr import CSR
@@ -208,8 +209,8 @@ def _fnma_scan(out, src, dst_pad, pairs, pad, n):
 # -- the per-level device program --------------------------------------------
 
 @functools.partial(
-    jax.jit, static_argnames=("offs", "dims", "blocks", "coarse",
-                              "relax_kind"))
+    _watched_jit, name="ops.level_setup",
+    static_argnames=("offs", "dims", "blocks", "coarse", "relax_kind"))
 def _level_setup(adata, eps_strong, relax_scale, smoother_omega, offs,
                  dims, blocks, coarse, relax_kind):
     """One hierarchy level on device. Static args fix the structure; eps,

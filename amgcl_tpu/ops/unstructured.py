@@ -43,6 +43,7 @@ import os
 import numpy as np
 import jax
 import jax.numpy as jnp
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
 from jax.tree_util import register_pytree_node_class
 
 from amgcl_tpu.ops.csr import CSR
@@ -305,7 +306,7 @@ def _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win, n_tiles,
     return window_dma(pl, dma, pl.program_id(0), n_tiles, xw.shape[0])
 
 
-@functools.partial(jax.jit,
+@functools.partial(_watched_jit, name="ops.windowed_ell_spmv",
                    static_argnames=("win", "n_out", "interpret"))
 def windowed_ell_spmv(window_starts, cols_local, vals, x, win, n_out,
                       interpret: bool = False):
@@ -347,7 +348,7 @@ def windowed_ell_spmv(window_starts, cols_local, vals, x, win, n_out,
 # static-matrix kernels (amgcl/backend/vexcl_static_matrix.hpp:228-1031).
 
 
-@functools.partial(jax.jit,
+@functools.partial(_watched_jit, name="ops.windowed_ell_fused",
                    static_argnames=("mode", "win", "n_out", "interpret"))
 def windowed_ell_fused(window_starts, cols_local, vals, f, x, w, mode,
                        win, n_out, interpret: bool = False):
@@ -406,7 +407,8 @@ def windowed_ell_scaled_correction(window_starts, cols_local, vals, w, f,
                               "correction", win, n_out, interpret)
 
 
-@functools.partial(jax.jit, static_argnames=("win", "n_out", "interpret"))
+@functools.partial(_watched_jit, name="ops.windowed_ell_spmv_dots",
+                   static_argnames=("win", "n_out", "interpret"))
 def windowed_ell_spmv_dots(window_starts, cols_local, vals, x, w=None,
                            win: int = 0, n_out: int = 0,
                            interpret: bool = False):
@@ -529,7 +531,7 @@ def _block_gather(c_ref, xw, tile, K, bc):
                     axis=0).reshape(tile, K, bc)
 
 
-@functools.partial(jax.jit,
+@functools.partial(_watched_jit, name="ops.windowed_ell_block_spmv",
                    static_argnames=("win", "n_out", "interpret"))
 def windowed_ell_block_spmv(window_starts, cols_local, vals, x, win, n_out,
                             interpret: bool = False):
@@ -560,7 +562,7 @@ def windowed_ell_block_spmv(window_starts, cols_local, vals, x, win, n_out,
     return out.reshape(n_tiles * tile * br)[:n_out * br]
 
 
-@functools.partial(jax.jit,
+@functools.partial(_watched_jit, name="ops.windowed_ell_block_fused",
                    static_argnames=("mode", "win", "n_out", "interpret"))
 def windowed_ell_block_fused(window_starts, cols_local, vals, f, x, S,
                              mode, win, n_out, interpret: bool = False):
@@ -616,7 +618,9 @@ def windowed_ell_block_fused(window_starts, cols_local, vals, f, x, S,
     return out.reshape(n_pad)[:n_out * br]
 
 
-@functools.partial(jax.jit, static_argnames=("win", "n_out", "interpret"))
+@functools.partial(_watched_jit,
+                   name="ops.windowed_ell_block_spmv_dots",
+                   static_argnames=("win", "n_out", "interpret"))
 def windowed_ell_block_spmv_dots(window_starts, cols_local, vals, x,
                                  w=None, win: int = 0, n_out: int = 0,
                                  interpret: bool = False):

@@ -77,7 +77,11 @@ def _compiled_dist_cg(mesh, offsets, shape, maxiter, tol):
                   P(ROWS_AXIS)),
         out_specs=(P(ROWS_AXIS), P(), P(), P(), P()),
         check_vma=False)
-    return jax.jit(fn)
+    # observed jit (telemetry/compile_watch.py): a dist_cg that retraces
+    # per solve — a drifting halo plan or maxiter/tol passed non-static —
+    # shows up as a retrace finding instead of silent compile seconds
+    from amgcl_tpu.telemetry.compile_watch import watched_jit
+    return watched_jit(fn, name="parallel.dist_cg")
 
 
 class _DistResult(tuple):

@@ -23,6 +23,17 @@ Five pieces:
   ``SolveReport.health``), per-level convergence probes
   (``AMG.probe_convergence()``) and the convergence doctor
   (:func:`diagnose`, ``cli.py --doctor``).
+
+plus the efficiency leg (PR 4):
+
+* :mod:`roofline` — measured per-stage times x the ledger's FLOP/byte
+  models -> achieved GB/s / GFLOP/s vs device peaks, compute-/memory-
+  bound classification, ranked bottlenecks (``AMG.roofline()``,
+  ``cli.py --roofline``).
+* :mod:`compile_watch` — process-global trace/compile/retrace observer
+  over our jitted entry points (``SolveReport.compile``).
+* :mod:`metrics` — stdlib-only percentile rollups of sink events and
+  bench history, Prometheus-text export (``bench.py --trend``).
 """
 
 from amgcl_tpu.telemetry.report import SolveReport
@@ -41,6 +52,16 @@ from amgcl_tpu.telemetry.ledger import (DeviceMemoryBudget,
                                         krylov_iteration_model, comm_model,
                                         allreduce_model, krylov_comm_model,
                                         xla_cost_analysis)
+# NOTE: the bare function names stay unshadowed — ``telemetry.roofline``
+# / ``telemetry.compile_watch`` must keep naming the MODULES
+from amgcl_tpu.telemetry.roofline import (device_peaks, measure_stages,
+                                          format_roofline,
+                                          solve_roofline, counter_map,
+                                          xla_stage_check)
+from amgcl_tpu.telemetry.compile_watch import (watched_jit,
+                                               compile_snapshot,
+                                               global_watch)
+from amgcl_tpu.telemetry import metrics
 
 __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "setup_scope", "JsonlSink", "NullSink", "emit",
@@ -50,4 +71,7 @@ __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "krylov_iteration_model", "comm_model", "allreduce_model",
            "krylov_comm_model", "xla_cost_analysis", "HealthState",
            "decode_health", "diagnose", "format_findings",
-           "probe_hierarchy", "two_grid_factor"]
+           "probe_hierarchy", "two_grid_factor", "device_peaks",
+           "measure_stages", "format_roofline",
+           "solve_roofline", "counter_map", "xla_stage_check",
+           "watched_jit", "compile_snapshot", "global_watch", "metrics"]

@@ -362,11 +362,17 @@ def _finding(sev, code, message, suggestion=None):
 def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              probe: Optional[List[Dict[str, Any]]] = None,
              tol: Optional[float] = None,
-             maxiter: Optional[int] = None) -> List[Dict[str, Any]]:
+             maxiter: Optional[int] = None,
+             roofline: Optional[Dict[str, Any]] = None,
+             compile_stats: Optional[Dict[str, Any]] = None
+             ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
-    guard decode), the resource ledger, and the per-level probe rows.
-    Each finding: {severity, code, message, suggestion}. Pure host-side
-    dict-crunching — never raises on missing pieces."""
+    guard decode), the resource ledger, the per-level probe rows, and —
+    the efficiency leg — a roofline join (``AMG.roofline()``: its ranked
+    bottleneck stages ride along) and compile-watch stats (retraces
+    after warmup become findings; so does compile time dominating the
+    solve). Each finding: {severity, code, message, suggestion}. Pure
+    host-side dict-crunching — never raises on missing pieces."""
     out: List[Dict[str, Any]] = []
     health = getattr(report, "health", None) or {}
     resid = getattr(report, "resid", None)
@@ -510,6 +516,31 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
                 "budget (%d refusal(s)) — those levels fell back to "
                 "gather-based SpMV" % len(dw["refused"]),
                 "raise AMGCL_TPU_DWIN_MAX_BYTES if HBM allows"))
+
+    # efficiency leg: roofline bottlenecks (telemetry/roofline.py ranks
+    # them; they arrive pre-shaped as findings) and compile-watch smells
+    if isinstance(roofline, dict):
+        out.extend(f for f in roofline.get("bottlenecks", [])
+                   if isinstance(f, dict) and "severity" in f)
+    if isinstance(compile_stats, dict):
+        from amgcl_tpu.telemetry import compile_watch as _cw
+        out.extend(_cw.findings(compile_stats))
+        wall = getattr(report, "wall_time_s", None)
+        # only the PER-CALL delta is comparable to this call's wall time
+        # — the snapshot totals are process-cumulative and would flag
+        # every warm solve after one normal first-call compile
+        comp = compile_stats.get("new_compile_s")
+        first = bool((getattr(report, "extra", None) or {})
+                     .get("first_call"))
+        if wall and comp and not first and comp > 0.5 * wall:
+            out.append(_finding(
+                "warning", "compile_dominates",
+                "XLA compile time (%.2fs) dominates the solve wall time "
+                "(%.2fs) on a non-first call — the program is being "
+                "rebuilt instead of reused" % (comp, wall),
+                "keep the solver bundle alive across solves, enable the "
+                "persistent compilation cache, and check the retrace "
+                "findings for the shape that varies"))
 
     if not out:
         out.append(_finding(

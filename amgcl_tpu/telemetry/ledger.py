@@ -179,13 +179,33 @@ def _scale(a, k):
     return {"flops": a["flops"] * k, "bytes": a["bytes"] * k}
 
 
+def _zero_sweep_cost(relax, n: int, vec: int) -> Optional[Dict[str, int]]:
+    """Cost of ONE smoother application from a ZERO initial guess, where
+    the smoother family makes that cheap: the scaled-residual smoothers
+    (Jacobi/SPAI-0, relaxation/base.py) reduce to ``u = scale ∘ f`` — no
+    operator stream at all (the residual of a zero guess IS f). None for
+    smoother families whose from-zero application still streams the
+    operator (Chebyshev, ILU, GS) — callers fall back to the full-sweep
+    model. Keeping this stage-accurate is what lets the roofline's
+    per-stage model bytes agree with ``xla_cost_analysis`` instead of
+    over-charging the first pre-sweep a full operator pass."""
+    scale = getattr(relax, "scale", None)
+    if scale is None:
+        return None
+    b = int(scale.shape[-1]) if getattr(scale, "ndim", 1) == 3 else 1
+    flops = 2 * n * b if b > 1 else n
+    return {"flops": int(flops), "bytes": _leaf_bytes(relax) + 2 * vec}
+
+
 def cycle_cost_model(hier) -> Dict[str, Any]:
     """Per-stage FLOPs/HBM-bytes of ONE multigrid cycle of ``hier``
     (models/amg.Hierarchy or compatible). Stage model per level: a
-    smoother sweep streams the operator once plus ~3 vector passes
-    (f, x in, x out); the residual the operator plus two vectors;
-    transfers stream themselves plus their two vectors. W-cycles visit
-    level i ``ncycle**i`` times."""
+    smoother sweep streams the operator and its own state once plus ~3
+    vector passes (f, x in, x out) — except the FIRST pre-sweep, which
+    runs from a zero guess and for the scaled-residual family is just
+    ``scale ∘ f`` (see :func:`_zero_sweep_cost`); the residual the
+    operator plus two vectors; transfers stream themselves plus their
+    two vectors. W-cycles visit level i ``ncycle**i`` times."""
     levels = getattr(hier, "levels", [])
     npre = getattr(hier, "npre", 1)
     npost = getattr(hier, "npost", 1)
@@ -216,9 +236,14 @@ def cycle_cost_model(hier) -> Dict[str, Any]:
                     {"flops": 0, "bytes": _leaf_bytes(lv.relax)})
             level_total = row["coarse_solve"]
         else:
-            sweep = _add(a_cost, {"flops": 3 * n, "bytes": 3 * vec})
+            rx_b = _leaf_bytes(getattr(lv, "relax", None))
+            sweep = _add(a_cost, {"flops": 3 * n, "bytes": 3 * vec + rx_b})
             resid = _add(a_cost, {"flops": n, "bytes": 2 * vec})
-            row["pre_smooth"] = _scale(sweep, npre)
+            zero = _zero_sweep_cost(getattr(lv, "relax", None), n, vec)
+            if npre > 0 and zero is not None:
+                row["pre_smooth"] = _add(zero, _scale(sweep, npre - 1))
+            else:
+                row["pre_smooth"] = _scale(sweep, npre)
             row["restrict"] = _add(resid, mv_cost(lv.R))
             row["prolong"] = _add(mv_cost(lv.P),
                                   {"flops": n, "bytes": 2 * vec})

@@ -1,0 +1,260 @@
+"""Fleet metric rollups — percentile aggregation of telemetry events and
+bench history, with Prometheus-text export.
+
+One solve's report answers "what happened"; a fleet serving millions of
+solves needs "what usually happens": p50/p90/p99 of iterations, solve
+time, achieved bandwidth and compile time across a JSONL sink file or
+across the committed ``BENCH_r*.json`` round history. This module is the
+aggregation layer ``bench.py --trend`` and any scrape endpoint render
+from.
+
+IMPORTANT: stdlib-only AND free of package-relative imports, for the same
+reason as ``telemetry/sink.py`` — ``bench.py``'s supervisor (which must
+never import jax) loads it directly by file path with importlib. Keep it
+that way.
+
+Pieces:
+
+* :func:`percentile` / :func:`rollup` — interpolated percentiles and the
+  standard summary ({count, min, p50, p90, p99, max, mean, last}).
+* :func:`extract` — dotted-path field lookup into nested records
+  ("ledger.cycle_bytes", "compile.totals.compile_s"), None when absent —
+  pre-ledger / pre-health / pre-roofline records degrade to gaps, never
+  errors.
+* :func:`bench_history` — the committed ``BENCH_r*.json`` rounds (each a
+  driver record with the worker line under ``"parsed"``), sorted by
+  round.
+* :func:`trend` / :func:`format_trend` — the cross-PR trajectory table of
+  the headline fields, one row per round.
+* :func:`rollup_events` — percentile rollups over JSONL sink records
+  grouped by event type.
+* :func:`prometheus_text` — Prometheus exposition format (summary-style
+  gauges with ``quantile`` labels) for scraping.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional
+
+#: headline trend fields: (column, dotted path into the parsed record)
+TREND_FIELDS = [
+    ("solve_s", "value"),
+    ("vs_baseline", "vs_baseline"),
+    ("iters", "iters"),
+    ("setup_s", "setup_s"),
+    ("gen_s", "gen_s"),
+    ("achieved_gbps", "achieved_gbps"),
+    ("hbm_frac", "hbm_frac"),
+    ("ledger_bytes", "ledger.hierarchy_bytes"),
+    ("compile_s", "compile.totals.compile_s"),
+    ("roofline_frac", "roofline.frac_hbm_peak"),
+]
+
+#: sink-event rollup spec: {event: [(metric, dotted path)]}
+EVENT_FIELDS = {
+    "solve": [("iters", "iters"), ("solve_time_s", "wall_time_s"),
+              ("resid", "resid"),
+              ("convergence_rate", "convergence_rate"),
+              ("achieved_gbps", "resources.roofline.gbps"),
+              ("compile_s", "compile.new_compile_s")],
+    "bench": [("solve_time_s", "value"), ("iters", "iters"),
+              ("achieved_gbps", "achieved_gbps")],
+    "bench_worker": [("solve_time_s", "value"), ("iters", "iters"),
+                     ("achieved_gbps", "achieved_gbps")],
+}
+
+
+def percentile(values: List[float], p: float) -> Optional[float]:
+    """Linear-interpolated percentile of an (unsorted) list; None when
+    empty."""
+    vals = sorted(v for v in values if v is not None
+                  and isinstance(v, (int, float)) and math.isfinite(v))
+    if not vals:
+        return None
+    if len(vals) == 1:
+        return float(vals[0])
+    k = (len(vals) - 1) * (p / 100.0)
+    lo = int(math.floor(k))
+    hi = min(lo + 1, len(vals) - 1)
+    return float(vals[lo] + (vals[hi] - vals[lo]) * (k - lo))
+
+
+def rollup(values: Iterable[Any]) -> Optional[Dict[str, Any]]:
+    """{count, min, p50, p90, p99, max, mean, last} of the finite
+    numeric values; None when nothing numeric survives."""
+    vals = [float(v) for v in values
+            if isinstance(v, (int, float)) and not isinstance(v, bool)
+            and math.isfinite(v)]
+    if not vals:
+        return None
+    return {
+        "count": len(vals),
+        "min": min(vals),
+        "p50": round(percentile(vals, 50), 6),
+        "p90": round(percentile(vals, 90), 6),
+        "p99": round(percentile(vals, 99), 6),
+        "max": max(vals),
+        "mean": round(sum(vals) / len(vals), 6),
+        "last": vals[-1],
+    }
+
+
+def extract(record: Any, path: str) -> Any:
+    """Dotted-path lookup ('a.b.c') into nested dicts; None on any
+    missing hop — tolerant of records predating a field."""
+    cur = record
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def iter_jsonl(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL file, skipping unparseable lines (a torn tail from a
+    crashed writer must not kill the rollup). Reads the rotated sibling
+    ``path.1`` first when present, so a rotation mid-window keeps the
+    full history."""
+    out: List[Dict[str, Any]] = []
+    for p in (path + ".1", path):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict):
+                    out.append(rec)
+    return out
+
+
+_ROUND_RE = re.compile(r"BENCH_r(\d+)\.json$")
+
+
+def bench_history(repo: str) -> List[Dict[str, Any]]:
+    """The committed per-round bench records, sorted by round number.
+    Each returned dict: {"round": int, "path": str, **parsed-worker
+    -record} — the driver wrapper's ``"parsed"`` payload is flattened
+    (older rounds whose worker never produced a value keep whatever
+    fields exist, e.g. only ``error``)."""
+    rows = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        m = _ROUND_RE.search(path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        parsed = rec.get("parsed") if isinstance(rec, dict) else None
+        row = dict(parsed) if isinstance(parsed, dict) else {}
+        row["round"] = int(m.group(1))
+        row["path"] = os.path.basename(path)
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+def trend(history: List[Dict[str, Any]],
+          fields=None) -> List[Dict[str, Any]]:
+    """One row per round with the headline fields extracted (None for
+    fields that round predates) — the cross-PR trajectory."""
+    fields = fields or TREND_FIELDS
+    out = []
+    for rec in history:
+        row: Dict[str, Any] = {"round": rec.get("round")}
+        for col, path in fields:
+            v = extract(rec, path)
+            row[col] = v if isinstance(v, (int, float)) \
+                and not isinstance(v, bool) else None
+        if rec.get("device_platform"):
+            row["device"] = rec["device_platform"]
+        if rec.get("error") and row.get("solve_s") is None:
+            row["error"] = str(rec["error"])[:60]
+        out.append(row)
+    return out
+
+
+def format_trend(rows: List[Dict[str, Any]], fields=None) -> str:
+    """Text table of :func:`trend` rows; '-' for gaps."""
+    fields = fields or TREND_FIELDS
+    cols = ["round"] + [c for c, _ in fields] + ["device"]
+    widths = {c: max(len(c), 9) for c in cols}
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return "%.4g" % v
+        return str(v)
+
+    lines = ["  ".join(c.rjust(widths[c]) for c in cols),
+             "  ".join("-" * widths[c] for c in cols)]
+    for r in rows:
+        lines.append("  ".join(fmt(r.get(c)).rjust(widths[c])
+                               for c in cols))
+        if r.get("error"):
+            lines.append("  (r%s: %s)" % (r.get("round"), r["error"]))
+    return "\n".join(lines)
+
+
+def trend_rollups(rows: List[Dict[str, Any]],
+                  fields=None) -> Dict[str, Dict[str, Any]]:
+    """Percentile rollups per trend column across rounds."""
+    fields = fields or TREND_FIELDS
+    out = {}
+    for col, _ in fields:
+        r = rollup(row.get(col) for row in rows)
+        if r is not None:
+            out[col] = r
+    return out
+
+
+def rollup_events(records: List[Dict[str, Any]],
+                  spec=None) -> Dict[str, Dict[str, Any]]:
+    """Rollups over sink records grouped by ``event`` type:
+    {"solve.iters": {...}, "solve.solve_time_s": {...}, ...} per the
+    spec (default :data:`EVENT_FIELDS`)."""
+    spec = spec or EVENT_FIELDS
+    groups: Dict[str, List[Dict[str, Any]]] = {}
+    for rec in records:
+        ev = rec.get("event")
+        if ev in spec:
+            groups.setdefault(ev, []).append(rec)
+    out = {}
+    for ev, recs in groups.items():
+        for metric, path in spec[ev]:
+            r = rollup(extract(rec, path) for rec in recs)
+            if r is not None:
+                out["%s.%s" % (ev, metric)] = r
+    return out
+
+
+def prometheus_text(rollups: Dict[str, Dict[str, Any]],
+                    prefix: str = "amgcl_tpu") -> str:
+    """Prometheus exposition format of a rollup table: summary-style
+    gauges with ``quantile`` labels plus ``_count``/``_min``/``_max``.
+    Metric names are sanitized to [a-zA-Z0-9_]."""
+    lines = []
+    for name in sorted(rollups):
+        r = rollups[name]
+        metric = "%s_%s" % (prefix, re.sub(r"[^a-zA-Z0-9_]", "_", name))
+        lines.append("# TYPE %s summary" % metric)
+        for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
+            if r.get(key) is not None:
+                lines.append('%s{quantile="%s"} %s' % (metric, q, r[key]))
+        lines.append("%s_count %d" % (metric, r["count"]))
+        lines.append("%s_min %s" % (metric, r["min"]))
+        lines.append("%s_max %s" % (metric, r["max"]))
+    return "\n".join(lines) + ("\n" if lines else "")

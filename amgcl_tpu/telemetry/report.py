@@ -57,6 +57,11 @@ class SolveReport:
     #: ``{"ok": True, "flags": []}`` for a clean guarded solve, None when
     #: the solver ran with ``guard=False``
     health: Optional[Dict[str, Any]] = None
+    #: compile-watch delta for this call (telemetry/compile_watch.py):
+    #: new traces / backend compiles / compile seconds of the solve
+    #: program, cumulative signature count, and whether this call was a
+    #: compile-cache hit. None with AMGCL_TPU_COMPILE_WATCH=0
+    compile: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -100,6 +105,8 @@ class SolveReport:
             out["resources"] = self.resources
         if self.health is not None:
             out["health"] = self.health
+        if self.compile is not None:
+            out["compile"] = self.compile
         if self.extra:
             out.update(self.extra)
         return out

@@ -94,6 +94,15 @@ def write_json_atomic(path: str, record: Dict[str, Any]) -> None:
     os.replace(tmp, path)
 
 
+def max_sink_bytes() -> int:
+    """Size cap for file sinks from ``AMGCL_TPU_TELEMETRY_MAX_BYTES``
+    (0 / unset / unparseable = unbounded, the historical behavior)."""
+    try:
+        return int(os.environ.get("AMGCL_TPU_TELEMETRY_MAX_BYTES", "0"))
+    except ValueError:
+        return 0
+
+
 class JsonlSink:
     """Append-mode JSONL writer. ``path`` XOR ``stream``; file sinks
     open/write/close per record so concurrent emitters (supervisor +
@@ -102,16 +111,36 @@ class JsonlSink:
 
     ``clean_records=False`` opts out of the non-finite-float cleaning for
     surfaces with a pre-existing schema contract (bench.py's stdout line,
-    whose consumers round-trip bare NaN tokens via Python json)."""
+    whose consumers round-trip bare NaN tokens via Python json).
+
+    File sinks rotate: once the file exceeds ``max_bytes`` (default from
+    ``AMGCL_TPU_TELEMETRY_MAX_BYTES``; 0 = unbounded) the next emit
+    renames ``out.jsonl`` -> ``out.jsonl.1`` (replacing any previous
+    ``.1``) and starts fresh — a long-running service holds at most
+    ~2x the cap on disk instead of growing without bound. Rotation is
+    checked before the write, so a single record never splits across
+    the two files."""
 
     def __init__(self, path: Optional[str] = None, stream=None,
-                 stamp_records: bool = True, clean_records: bool = True):
+                 stamp_records: bool = True, clean_records: bool = True,
+                 max_bytes: Optional[int] = None):
         if (path is None) == (stream is None):
             raise ValueError("JsonlSink needs exactly one of path/stream")
         self.path = path
         self.stream = stream
         self.stamp_records = stamp_records
         self.clean_records = clean_records
+        self.max_bytes = max_sink_bytes() if max_bytes is None \
+            else int(max_bytes)
+
+    def _maybe_rotate(self):
+        if not self.max_bytes or self.max_bytes <= 0:
+            return
+        try:
+            if os.path.getsize(self.path) >= self.max_bytes:
+                os.replace(self.path, self.path + ".1")
+        except OSError:
+            pass          # missing file (first write) or a racing rotator
 
     def emit(self, record: Optional[Dict[str, Any]] = None,
              **fields) -> Dict[str, Any]:
@@ -125,6 +154,7 @@ class JsonlSink:
             self.stream.write(line + "\n")
             self.stream.flush()
         else:
+            self._maybe_rotate()
             with open(self.path, "a") as f:
                 f.write(line + "\n")
         return rec

@@ -80,6 +80,17 @@ class Profiler:
 
     def _record_event(self, node, start, end):
         if len(self.events) >= self.MAX_EVENTS:
+            if self._events_dropped == 0:
+                # the cap tripping must be loud ONCE: a silently truncated
+                # trace looks complete in Perfetto and hides exactly the
+                # tail a long-running loop was opened to inspect (the
+                # aggregated tic/toc tree keeps accumulating regardless)
+                import warnings
+                warnings.warn(
+                    "Profiler event cap reached (%d per-occurrence "
+                    "events); further scope occurrences are dropped from "
+                    "the trace export — aggregate totals stay complete"
+                    % self.MAX_EVENTS)
             self._events_dropped += 1     # saturated: skip the path work
             return
         path = "/".join([n.name for n in self._stack[1:]] + [node.name])
@@ -159,7 +170,9 @@ class Profiler:
 
     def to_chrome_trace(self, tid: int = 0, tid_name: Optional[str] = None,
                         pid: int = 0,
-                        epoch: Optional[float] = None) -> dict:
+                        epoch: Optional[float] = None,
+                        counters: Optional[Dict[str, Dict[str, float]]]
+                        = None) -> dict:
         """Chrome/Perfetto trace-event export of the recorded scope
         occurrences: ``json.dump`` the returned dict and open it in
         ui.perfetto.dev (or chrome://tracing, or the TensorBoard trace
@@ -172,7 +185,14 @@ class Profiler:
         ``traceEvents`` and pass the SAME ``epoch`` (a
         ``time.perf_counter()`` reference, e.g. the main profiler's
         ``_t0``) to every export so the tracks share one timeline; the
-        default epoch is this profiler's own birth."""
+        default epoch is this profiler's own birth.
+
+        ``counters`` optionally adds Perfetto COUNTER tracks:
+        ``{track_name: {scope_path: value}}`` — every recorded occurrence
+        of ``scope_path`` emits the value at its start and 0 at its end
+        (``ph:'C'``), so e.g. the roofline's achieved-GB/s per stage
+        renders as a stepped bandwidth track above the flame graph
+        (``telemetry.roofline.counter_map`` builds the mapping)."""
         t0 = self._t0 if epoch is None else epoch
         events = []
         if tid_name:
@@ -188,6 +208,26 @@ class Profiler:
                 "pid": pid, "tid": tid,
                 "args": {"path": path},
             })
+            for track, by_path in (counters or {}).items():
+                val = by_path.get(path)
+                if val is None:
+                    continue
+                for ts, v in ((start, val), (end, 0.0)):
+                    events.append({
+                        "name": track, "cat": "amgcl", "ph": "C",
+                        "ts": round((ts - t0) * 1e6, 3), "pid": pid,
+                        "args": {track: v}})
+        if self._events_dropped:
+            # a visible instant event at the truncation point — the
+            # otherData note alone never shows in the Perfetto UI, so a
+            # truncated trace used to read as a complete one
+            last_end = self.events[-1][2] if self.events else t0
+            events.append({
+                "name": "events_dropped", "cat": "amgcl", "ph": "i",
+                "s": "g", "ts": round((last_end - t0) * 1e6, 3),
+                "pid": pid, "tid": tid,
+                "args": {"dropped": self._events_dropped,
+                         "cap": self.MAX_EVENTS}})
         out = {"traceEvents": events, "displayTimeUnit": "ms"}
         if self._events_dropped:
             out["otherData"] = {"events_dropped": self._events_dropped}

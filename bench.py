@@ -23,12 +23,21 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # tunnel is alive, refresh last-good
     python bench.py --check [paths] # run the tier-1 pytest line and emit
                                     # a JSONL record with DOTS_PASSED
-                                    # (also runs the regression gate)
+                                    # (also runs the regression gate and
+                                    # attaches the cross-round trend +
+                                    # roofline/compile summaries)
     python bench.py --gate [cand]   # regression gate: compare a candidate
                                     # record (default: the last-good run
                                     # itself) against BENCH_LAST_GOOD.json
                                     # under AMGCL_TPU_GATE_* tolerances;
                                     # exit nonzero on regression
+    python bench.py --trend [sink.jsonl]
+                                    # cross-round trajectory: the headline
+                                    # fields of BENCH_r*.json as a table +
+                                    # percentile rollups (p50/p90/p99),
+                                    # optionally rolling up a JSONL sink
+                                    # file too; --prom PATH additionally
+                                    # writes Prometheus exposition text
 
 All JSON emission routes through the telemetry sink
 (amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
@@ -51,13 +60,23 @@ _N = int(os.environ.get("AMGCL_TPU_BENCH_N", "128"))
 _METRIC = "poisson3d_%d_sa_cg_spai0_solve_time" % _N
 
 
-def _load_sink():
+def _load_by_path(name, relpath):
     spec = importlib.util.spec_from_file_location(
-        "_amgcl_tpu_sink",
-        os.path.join(_REPO, "amgcl_tpu", "telemetry", "sink.py"))
+        name, os.path.join(_REPO, *relpath))
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     return mod
+
+
+def _load_sink():
+    return _load_by_path("_amgcl_tpu_sink",
+                         ("amgcl_tpu", "telemetry", "sink.py"))
+
+
+def _load_metrics():
+    # stdlib-only, like the sink: the supervisor aggregates without jax
+    return _load_by_path("_amgcl_tpu_metrics",
+                         ("amgcl_tpu", "telemetry", "metrics.py"))
 
 
 _sink = _load_sink()
@@ -979,6 +998,37 @@ def main_worker():
             _PARTIAL["hbm_frac"] = round(achieved / peak, 3)
             break
 
+    # roofline summary (telemetry/roofline.py): the ledger's per-
+    # iteration model over the CHAINED solve time vs auto-detected peaks
+    # — the trend's roofline_frac column
+    try:
+        from amgcl_tpu.telemetry import roofline as _roofline
+        pi = (info.resources or {}).get("per_iteration")
+        if pi:
+            rf = _roofline.solve_roofline(pi, iters, t_solve)
+            if rf is not None:
+                _PARTIAL["roofline"] = rf
+    except Exception as e:
+        _PARTIAL["roofline"] = {"error": repr(e)[:200]}
+
+    # compile accounting (telemetry/compile_watch.py): per-function
+    # traces/compiles/compile-seconds + retrace events for this run —
+    # a retrace regression shows up in the committed record
+    try:
+        from amgcl_tpu.telemetry import compile_watch as _cwatch
+        if _cwatch.enabled():
+            snap = _cwatch.snapshot()
+            _PARTIAL["compile"] = {
+                "totals": snap["totals"],
+                "functions": {name: {"traces": rec["traces"],
+                                     "compile_s": rec["compile_s"],
+                                     "retraces": rec["retraces"]}
+                              for name, rec in snap["functions"].items()
+                              if rec["traces"] or rec["compile_s"]},
+                "retrace_events": snap["retrace_events"][-10:]}
+    except Exception as e:
+        _PARTIAL["compile"] = {"error": repr(e)[:200]}
+
     # Optional deep-dive stages, highest decision-leverage first, each
     # gated on the time left before the watchdog (the r5 chip run burned
     # half its budget in 'block + stokes configs' and got killed mid-
@@ -1222,6 +1272,60 @@ def main_gate(args=None):
 
 
 # ===========================================================================
+# trend: cross-round trajectory + percentile rollups (stdlib-only)
+# ===========================================================================
+
+def trend_summary(metrics_mod=None):
+    """The cross-PR trend over the committed ``BENCH_r*.json`` rounds:
+    {"rows": per-round headline fields, "rollups": p50/p90/p99 per
+    column}. Pre-ledger/pre-roofline rounds contribute gaps, never
+    errors."""
+    m = metrics_mod or _load_metrics()
+    rows = m.trend(m.bench_history(_REPO))
+    return {"rows": rows, "rollups": m.trend_rollups(rows)}
+
+
+def main_trend(args=None):
+    """``bench.py --trend [sink.jsonl]``: print the cross-round table
+    (BENCH_r01.. on disk) + rollups, optionally aggregate a telemetry
+    JSONL file's solve/bench events too; ``--prom PATH`` writes the
+    rollups as Prometheus exposition text. Emits ONE JSONL record."""
+    m = _load_metrics()
+    args = list(args or [])
+    prom_path = None
+    if "--prom" in args:
+        i = args.index("--prom")
+        prom_path = args[i + 1] if i + 1 < len(args) else None
+        del args[i:i + 2]
+    summ = trend_summary(m)
+    print(m.format_trend(summ["rows"]))
+    rollups = dict(summ["rollups"])
+    rec = {"event": "bench_trend", "rows": summ["rows"],
+           "rollups": summ["rollups"], "commit": _git_head()}
+    if args:
+        sink_records = m.iter_jsonl(args[0])
+        ev_roll = m.rollup_events(sink_records)
+        rec["sink"] = {"path": args[0], "records": len(sink_records),
+                       "rollups": ev_roll}
+        rollups.update(ev_roll)
+        if ev_roll:
+            print("\nsink rollups (%s, %d records):"
+                  % (args[0], len(sink_records)))
+            for name in sorted(ev_roll):
+                r = ev_roll[name]
+                print("  %-28s n=%-4d p50=%-10.4g p90=%-10.4g "
+                      "p99=%.4g" % (name, r["count"], r["p50"],
+                                    r["p90"], r["p99"]))
+    if prom_path:
+        with open(prom_path, "w") as f:
+            f.write(m.prometheus_text(rollups))
+        print("\nprometheus text written to %s" % prom_path)
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    return 0
+
+
+# ===========================================================================
 # tier-1 check: run the ROADMAP pytest line, emit DOTS_PASSED as JSONL
 # ===========================================================================
 
@@ -1303,6 +1407,22 @@ def main_check(targets=None):
             gate_ok, checks = run_gate(cand, lg)
             rec["gate"] = {"ok": gate_ok, "candidate_src": cand_src,
                            "checks": checks}
+        # the CI record carries the efficiency summaries of the record it
+        # gated (roofline frac + compile totals travel with the gate
+        # verdict), plus the cross-round trend rollups — pre-roofline
+        # records simply lack the fields
+        for key in ("roofline", "compile"):
+            if isinstance(cand, dict) and isinstance(cand.get(key), dict):
+                src = cand[key]
+                rec[key] = src.get("totals", src) \
+                    if key == "compile" else {
+                        k: src.get(k) for k in
+                        ("gbps", "gflops", "frac_hbm_peak", "bound")
+                        if src.get(k) is not None}
+    try:
+        rec["trend"] = trend_summary()["rollups"]
+    except Exception as e:
+        rec["trend"] = {"error": repr(e)[:200]}
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0 if (rc == 0 and gate_ok) else 1
@@ -1319,5 +1439,8 @@ if __name__ == "__main__":
     elif "--gate" in sys.argv:
         extra = sys.argv[sys.argv.index("--gate") + 1:]
         sys.exit(main_gate(extra))
+    elif "--trend" in sys.argv:
+        extra = sys.argv[sys.argv.index("--trend") + 1:]
+        sys.exit(main_trend(extra))
     else:
         main_supervisor()
