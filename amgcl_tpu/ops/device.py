@@ -528,23 +528,57 @@ def inner_product(x, y):
 def spmv_dots(A, x, w=None, ip=inner_product):
     """(y, <y,y>, <y,x>, <y,w>) with y = A x — the Krylov hot pairs,
     fused into one Pallas pass on the DIA path when ``ip`` is the plain
-    single-device dot (a swapped seam means a collective must run
-    OUTSIDE the kernel, and complex dtypes need the conjugating vdot;
-    both fall back — the itemsize gate in _pallas_mode already excludes
-    complex)."""
+    single-device dot OR a psum-marked distributed one (``ip.psum_axis``
+    set, e.g. ``parallel.dist_matrix.dist_inner_product``): the kernel
+    computes the SHARD-LOCAL partials and one stacked ``lax.psum``
+    globalizes every dot at once — so distributed solves keep the
+    spmv+dot fusion on the local shard AND merge their collectives.
+    Any other swapped seam (or a complex dtype — the itemsize gate in
+    _pallas_mode excludes those) composes through ``ip``."""
     with _phase("spmv_dots/" + type(A).__name__):
         return _spmv_dots(A, x, w, ip)
 
 
+def _dots_psum_axis(ip):
+    """psum axis of a marked distributed inner product, else None (the
+    plain dot fuses without any reduction)."""
+    if ip is inner_product:
+        return None
+    return getattr(ip, "psum_axis", None)
+
+
+def psum_stacked(dots, axis):
+    """Globalize a tuple of shard-local scalar partials with ONE stacked
+    psum — the merged-reduction primitive shared by spmv_dots and the
+    fused vector tier (ops/fused_vec.py). No-op when ``axis`` is None."""
+    dots = tuple(dots)
+    if axis is None or not dots:
+        return dots
+    red = lax.psum(jnp.stack(list(dots)), axis)
+    return tuple(red[i] for i in range(len(dots)))
+
+
+def _globalize_dots(axis, yy, yx, yw):
+    """psum_stacked over the spmv dot triple (w slot optional)."""
+    if axis is None:
+        return yy, yx, yw
+    red = psum_stacked((yy, yx) + (() if yw is None else (yw,)), axis)
+    return red[0], red[1], (None if yw is None else red[2])
+
+
 def _spmv_dots(A, x, w=None, ip=inner_product):
-    if isinstance(A, DiaMatrix) and ip is inner_product \
+    axis = _dots_psum_axis(ip)
+    fused_ip = ip is inner_product or axis is not None
+    if isinstance(A, DiaMatrix) and fused_ip \
             and A.shape[0] == A.shape[1]:
         m = A._pallas_mode(x) if w is None else A._pallas_mode(x, w)
         if m is not None:
             from amgcl_tpu.ops.pallas_spmv import dia_spmv_dots
-            return dia_spmv_dots(A.offsets, A.data, x, w, interpret=m)
+            y, yy, yx, yw = dia_spmv_dots(A.offsets, A.data, x, w,
+                                          interpret=m)
+            return (y,) + _globalize_dots(axis, yy, yx, yw)
     from amgcl_tpu.ops.unstructured import WindowedEllMatrix
-    if isinstance(A, WindowedEllMatrix) and ip is inner_product \
+    if isinstance(A, WindowedEllMatrix) and fused_ip \
             and A.shape[0] == A.shape[1] and A.block[0] == A.block[1]:
         m = A._pallas_mode(x, kernel="dots") if w is None \
             else A._pallas_mode(x, w, kernel="dots")
@@ -553,9 +587,17 @@ def _spmv_dots(A, x, w=None, ip=inner_product):
                 windowed_ell_spmv_dots, windowed_ell_block_spmv_dots)
             fn = windowed_ell_spmv_dots if A.block == (1, 1) \
                 else windowed_ell_block_spmv_dots
-            return fn(A.window_starts, A.cols_local, A.vals, x, w,
-                      win=A.win, n_out=A.shape[0], interpret=m)
+            y, yy, yx, yw = fn(A.window_starts, A.cols_local, A.vals, x,
+                               w, win=A.win, n_out=A.shape[0],
+                               interpret=m)
+            return (y,) + _globalize_dots(axis, yy, yx, yw)
     y = A.mv(x)
+    if axis is not None:
+        # no kernel, but the merged reduction still applies: local
+        # vdots + ONE stacked psum instead of 2-3 separate collectives
+        return (y,) + _globalize_dots(
+            axis, jnp.vdot(y, y), jnp.vdot(y, x),
+            None if w is None else jnp.vdot(y, w))
     return y, ip(y, y), ip(y, x), (None if w is None else ip(y, w))
 
 

@@ -1,0 +1,372 @@
+"""Fused vector-algebra tier — single-stream compound Krylov primitives.
+
+The roofline layer (PR 4) moved the bottleneck: with the V-cycle legs
+fused, the solve phase's remaining HBM waste is the Krylov OUTER loop,
+where the reference's eight-primitive backend algebra
+(amgcl/backend/interface.hpp:253-443) runs every ``axpby`` and every
+``dot`` as its own full pass over the iteration vectors. XLA cannot fuse
+across the reduction boundaries a dot introduces (and never across a
+``pallas_call``), so a CG iteration pays ~15 n-vector HBM streams where
+the arithmetic needs ~11 — and BiCGStab pays ~32 where ~15 suffice. The
+fix is the HPCG-style merged-kernel move (PAPERS.md: "Effective
+implementation of the HPCG benchmark", pipelined Krylov methods): fuse
+each vector update with the reduction that consumes its result, so the
+updated vector is dotted in-register on the way to HBM instead of being
+re-read by a separate kernel.
+
+Primitives (each one Pallas pass on TPU, plain-XLA composition off it):
+
+* :func:`axpby_dot`      — ``z = a·x + b·y`` and ``⟨z, z⟩`` in one pass.
+* :func:`xr_update`      — the CG/IDR(s) tail: ``x += α·p``,
+  ``r −= α·q`` and ``⟨r, r⟩`` from ONE read of {p, q, x, r} and one
+  write of {x, r}.
+* :func:`bicgstab_tail`  — the BiCGStab tail: ``x += α·phat + ω·shat``,
+  ``r = s − ω·t``, plus BOTH reductions the next iteration needs
+  (``⟨r, r⟩`` and ``⟨rhat, r⟩``) in the same pass — the per-iteration
+  reduction count drops because ``rho`` rides the update.
+* :func:`multi_dot`      — the 2–3 inner products of a BiCGStab/IDR(s)
+  step from one read of their shared operand.
+* :func:`stack_dots` / :func:`block_dots` — batched shadow-space /
+  Gram products through the inner-product seam: one operand read, and
+  for the distributed seam ONE psum of the stacked partials instead of
+  one collective per product (the merged-reduction move).
+* :func:`residual_dot`   — ``r = f − A x`` and ``⟨r, r⟩`` in one
+  operator pass (DIA Pallas kernel; composed elsewhere).
+
+Every primitive takes the same ``ip`` inner-product seam the solvers
+take. Three regimes:
+
+* the plain single-device dot (``ops.device.inner_product``) — full
+  fusion, dots computed inside the kernel;
+* a psum-marked distributed dot (``ip.psum_axis`` set, see
+  ``parallel.dist_matrix.dist_inner_product``) — the kernel computes the
+  SHARD-LOCAL partials, then one ``lax.psum`` of the stacked partial
+  vector globalizes all of them at once;
+* any other seam — the exact reference composition through ``ip``
+  (custom seams keep custom semantics, including complex conjugation).
+
+``AMGCL_TPU_FUSED_VEC=0`` opts the whole tier out: the same API computes
+the reference composition (separate axpby + dot through ``ip``), so the
+fused and unfused paths can be A/B'd — and regression-tested — without
+touching solver code. The env var is read at trace time, like the other
+kernel gates.
+
+Numerics: the in-kernel dots accumulate in f32 (f64 for wider inputs),
+exactly like ``ops.pallas_spmv.dia_spmv_dots``; the health-guard
+denominators the solvers feed from these reductions (telemetry/health.py)
+see the same values to rounding, so guard-trip behavior is identical
+with the tier on or off (asserted in tests/test_fused_vec.py).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
+
+# Row tile for the elementwise kernels: no halo, so the only constraints
+# are the 1024-element DMA alignment and enough rows to amortize the grid
+# step. 8192 f32 elements = 32 KB per operand tile — comfortably inside
+# VMEM with the ~8 operands of the widest kernel double-buffered.
+_VEC_TILE = 8192
+
+
+def fused_vec_enabled() -> bool:
+    """Default ON; ``AMGCL_TPU_FUSED_VEC=0`` opts out (the API then
+    computes the reference composition — separate axpby + dot)."""
+    return os.environ.get("AMGCL_TPU_FUSED_VEC", "1") != "0"
+
+
+def _pallas_mode(*vecs):
+    """None = XLA composition; else the ``interpret`` flag for the
+    kernels. Same gate as the DIA kernels (<=32-bit dtypes, TPU or the
+    CI interpret hook) plus the tier's own opt-out."""
+    if not fused_vec_enabled():
+        return None
+    from amgcl_tpu.ops.pallas_spmv import pallas_mode
+    return pallas_mode(*(v.dtype for v in vecs))
+
+
+def _seam(ip):
+    """('plain' | 'psum' | 'opaque', psum_axis) for an inner-product
+    seam. 'plain' fuses fully; 'psum' fuses the local partials and
+    reduces them in ONE stacked collective; 'opaque' composes through
+    ``ip`` call by call (exact legacy semantics for custom seams)."""
+    from amgcl_tpu.ops import device as dev
+    if ip is None or ip is dev.inner_product:
+        return "plain", None
+    axis = getattr(ip, "psum_axis", None)
+    if axis is not None:
+        return "psum", axis
+    return "opaque", None
+
+
+def _reduce_dots(dots, axis):
+    """Globalize a tuple of scalar partials with ONE stacked psum (the
+    shared merged-reduction primitive, ops.device.psum_stacked)."""
+    from amgcl_tpu.ops import device as dev
+    return dev.psum_stacked(tuple(dots), axis)
+
+
+def _acc_dtype(*vecs):
+    out = jnp.result_type(*(v.dtype for v in vecs))
+    return jnp.float32 if jnp.dtype(out).itemsize <= 4 else jnp.float64
+
+
+# ---------------------------------------------------------------------------
+# the shared elementwise-update + in-register-reduction kernel
+# ---------------------------------------------------------------------------
+#
+# One kernel skeleton serves every primitive: ``mode`` statically selects
+# the update expressions and how many dots accumulate. Scalars (alpha,
+# beta, omega — traced per-iteration values) ride in SMEM; per-tile dot
+# partials reduce in-register and accumulate into SMEM scalars across the
+# sequential grid steps, exactly the dia_spmv_dots pattern.
+
+#: mode -> (n_vec_inputs, n_scalars, n_vec_outputs, n_dots)
+_MODES = {
+    "axpby_dot": (2, 2, 1, 1),       # x, y; a, b        -> z;    <z,z>
+    "xr":        (4, 1, 2, 1),       # p, q, x, r; a     -> x, r; <r,r>
+    "bicg_tail": (6, 2, 2, 2),       # ph, sh, s, t, x, rhat; a, w
+    #                                 -> x, r; <r,r>, <rhat,r>
+}
+
+
+@functools.partial(_watched_jit, name="ops.fused_vec",
+                   static_argnames=("mode", "interpret"))
+def _fused_pass(mode, scalars, vecs, interpret=False):
+    """Run one fused elementwise-update + reduction pass. ``vecs`` is the
+    tuple of same-length input vectors for ``mode``; returns
+    ``(out_vecs..., dots...)``."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_in, n_sc, n_out, n_dots = _MODES[mode]
+    n = vecs[0].shape[0]
+    out_dtype = jnp.result_type(*(v.dtype for v in vecs))
+    acc_dtype = jnp.float32 if jnp.dtype(out_dtype).itemsize <= 4 \
+        else jnp.float64
+    tile = _VEC_TILE
+    n_pad = max(-(-n // tile) * tile, tile)
+    vp = [jnp.pad(v, (0, n_pad - n)) for v in vecs]
+    # scalars in SMEM as a (1, n_sc) row, cast to the accumulator dtype
+    # so the in-kernel arithmetic never widens an operand tile
+    sc = jnp.stack([jnp.asarray(s, out_dtype).reshape(())
+                    for s in scalars]).reshape(1, n_sc)
+
+    def kernel(sc_ref, *rest):
+        in_refs = rest[:n_in]
+        out_refs = rest[n_in:n_in + n_out]
+        dots_ref = rest[n_in + n_out]
+        i = pl.program_id(0)
+        if mode == "axpby_dot":
+            a, b = sc_ref[0, 0], sc_ref[0, 1]
+            x, y = (r[:] for r in in_refs)
+            z = a * x + b * y
+            out_refs[0][:] = z
+            za = z.astype(acc_dtype)
+            partials = (jnp.sum(za * za),)
+        elif mode == "xr":
+            a = sc_ref[0, 0]
+            p, q, x, r = (ref[:] for ref in in_refs)
+            xn = x + a * p
+            rn = r - a * q
+            out_refs[0][:] = xn
+            out_refs[1][:] = rn
+            ra = rn.astype(acc_dtype)
+            partials = (jnp.sum(ra * ra),)
+        else:                                   # bicg_tail
+            a, w = sc_ref[0, 0], sc_ref[0, 1]
+            ph, sh, s, t, x, rhat = (ref[:] for ref in in_refs)
+            xn = x + a * ph + w * sh
+            rn = s - w * t
+            out_refs[0][:] = xn
+            out_refs[1][:] = rn
+            ra = rn.astype(acc_dtype)
+            partials = (jnp.sum(ra * ra),
+                        jnp.sum(rhat.astype(acc_dtype) * ra))
+
+        @pl.when(i == 0)
+        def _init():
+            for j in range(n_dots):
+                dots_ref[0, j] = jnp.zeros((), acc_dtype)
+
+        for j, p_ in enumerate(partials):
+            dots_ref[0, j] += p_
+
+    vec_spec = pl.BlockSpec((tile,), lambda i: (i,))
+    out = pl.pallas_call(
+        kernel,
+        grid=(n_pad // tile,),
+        in_specs=[pl.BlockSpec((1, n_sc), lambda i: (np.int32(0),
+                                                     np.int32(0)),
+                               memory_space=pltpu.SMEM)]
+        + [vec_spec] * n_in,
+        out_specs=tuple([vec_spec] * n_out) + (
+            pl.BlockSpec((1, n_dots), lambda i: (np.int32(0), np.int32(0)),
+                         memory_space=pltpu.SMEM),),
+        out_shape=tuple(jax.ShapeDtypeStruct((n_pad,), out_dtype)
+                        for _ in range(n_out)) + (
+            jax.ShapeDtypeStruct((1, n_dots), acc_dtype),),
+        interpret=interpret,
+    )(sc, *vp)
+    out_vecs = tuple(o[:n] for o in out[:n_out])
+    dots = tuple(out[n_out][0, j].astype(out_dtype)
+                 for j in range(n_dots))
+    return out_vecs + dots
+
+
+def _zero_dot(*vecs):
+    return jnp.zeros((), jnp.result_type(*(v.dtype for v in vecs)))
+
+
+# ---------------------------------------------------------------------------
+# public primitives
+# ---------------------------------------------------------------------------
+
+def axpby_dot(a, x, b, y, ip=None):
+    """``(z, ⟨z, z⟩)`` with ``z = a·x + b·y`` in one pass."""
+    from amgcl_tpu.ops import device as dev
+    kind, axis = _seam(ip)
+    if x.shape[0] == 0:
+        return x, _zero_dot(x, y)
+    m = _pallas_mode(x, y) if kind != "opaque" else None
+    if m is not None:
+        z, zz = _fused_pass("axpby_dot", (a, b), (x, y), interpret=m)
+        (zz,) = _reduce_dots((zz,), axis)
+        return z, zz
+    z = dev.axpby(a, x, b, y)
+    if kind == "psum":
+        (zz,) = _reduce_dots((jnp.vdot(z, z),), axis)
+        return z, zz
+    return z, (ip or dev.inner_product)(z, z)
+
+
+def xr_update(alpha, p, q, x, r, ip=None):
+    """The CG/IDR(s) iteration tail in one pass:
+    ``(x + α·p, r − α·q, ⟨r_new, r_new⟩)`` — one read of {p, q, x, r},
+    one write of {x, r}, residual reduction in-register."""
+    from amgcl_tpu.ops import device as dev
+    kind, axis = _seam(ip)
+    if x.shape[0] == 0:
+        return x, r, _zero_dot(x, r)
+    m = _pallas_mode(p, q, x, r) if kind != "opaque" else None
+    if m is not None:
+        xn, rn, rr = _fused_pass("xr", (alpha,), (p, q, x, r),
+                                 interpret=m)
+        (rr,) = _reduce_dots((rr,), axis)
+        return xn, rn, rr
+    xn = dev.axpby(alpha, p, 1.0, x)
+    rn = dev.axpby(-alpha, q, 1.0, r)
+    if kind == "psum":
+        (rr,) = _reduce_dots((jnp.vdot(rn, rn),), axis)
+        return xn, rn, rr
+    return xn, rn, (ip or dev.inner_product)(rn, rn)
+
+
+def bicgstab_tail(alpha, phat, omega, shat, s, t, x, rhat, ip=None):
+    """The BiCGStab iteration tail in one pass:
+    ``x_n = x + α·phat + ω·shat``, ``r_n = s − ω·t``, returning
+    ``(x_n, r_n, ⟨r_n, r_n⟩, ⟨rhat, r_n⟩)``. The second dot is the NEXT
+    iteration's ``rho`` — fusing it here removes a whole reduction pass
+    (and, distributed, a whole collective) per iteration."""
+    from amgcl_tpu.ops import device as dev
+    kind, axis = _seam(ip)
+    if x.shape[0] == 0:
+        z = _zero_dot(x, s)
+        return x, s, z, z
+    m = _pallas_mode(phat, shat, s, t, x, rhat) if kind != "opaque" \
+        else None
+    if m is not None:
+        xn, rn, rr, rhr = _fused_pass(
+            "bicg_tail", (alpha, omega), (phat, shat, s, t, x, rhat),
+            interpret=m)
+        rr, rhr = _reduce_dots((rr, rhr), axis)
+        return xn, rn, rr, rhr
+    xn = x + alpha * phat + omega * shat
+    rn = dev.axpby(-omega, t, 1.0, s)
+    if kind == "psum":
+        rr, rhr = _reduce_dots((jnp.vdot(rn, rn), jnp.vdot(rhat, rn)),
+                               axis)
+        return xn, rn, rr, rhr
+    dot = ip or dev.inner_product
+    return xn, rn, dot(rn, rn), dot(rhat, rn)
+
+
+def multi_dot(x, ys, ip=None):
+    """``tuple(⟨x, y⟩ for y in ys)`` from one read of ``x``. With the
+    plain seam this is one fused pass' worth of reductions; with the
+    psum seam the local partials globalize in ONE stacked collective
+    instead of ``len(ys)`` separate ones."""
+    from amgcl_tpu.ops import device as dev
+    ys = tuple(ys)
+    kind, axis = _seam(ip)
+    if kind == "opaque":
+        return tuple(ip(x, y) for y in ys)
+    if x.shape[0] == 0:
+        return tuple(_zero_dot(x, y) for y in ys)
+    dots = tuple(jnp.vdot(x, y) for y in ys)
+    return _reduce_dots(dots, axis) if kind == "psum" else dots
+
+
+def stack_dots(V, w, ip=None):
+    """``(len(V),)`` vector of ``⟨V_i, w⟩`` — the batched shadow-space /
+    Arnoldi products. Plain seam: one conjugated matvec (one read of V).
+    Psum seam: local matvec + ONE psum of the whole vector — the merged
+    reduction that collapses the per-basis-vector collectives of a
+    distributed GMRES/IDR(s) step. Opaque seams keep the exact vmapped
+    composition."""
+    kind, axis = _seam(ip)
+    if kind == "opaque":
+        return jax.vmap(lambda vv: ip(vv, w))(V)
+    loc = jnp.conj(V) @ w if jnp.issubdtype(V.dtype, jnp.complexfloating) \
+        else V @ w
+    if kind == "psum":
+        from jax import lax
+        return lax.psum(loc, axis)
+    return loc
+
+
+def block_dots(X, Y, ip=None):
+    """``(len(X), len(Y))`` matrix of ``⟨X_i, Y_j⟩`` — the Gram products
+    of BiCGStab(L)'s MR stage. Plain seam: one matmul; psum seam: local
+    matmul + ONE psum of the matrix (instead of L·(L+1) scalar
+    collectives); opaque: the vmapped composition."""
+    kind, axis = _seam(ip)
+    if kind == "opaque":
+        return jax.vmap(lambda xi: jax.vmap(lambda yj: ip(xi, yj))(Y))(X)
+    Xc = jnp.conj(X) if jnp.issubdtype(X.dtype, jnp.complexfloating) \
+        else X
+    loc = Xc @ Y.T
+    if kind == "psum":
+        from jax import lax
+        return lax.psum(loc, axis)
+    return loc
+
+
+def residual_dot(f, A, x, ip=None):
+    """``(r, ⟨r, r⟩)`` with ``r = f − A x`` — the residual and its norm
+    reduction in ONE operator pass on the DIA Pallas path (the composed
+    form re-reads r from HBM just to reduce it). Other formats compose
+    ``ops.device.residual`` (itself fused where a kernel exists) with
+    the seam dot."""
+    from amgcl_tpu.ops import device as dev
+    kind, axis = _seam(ip)
+    if kind != "opaque" and isinstance(A, dev.DiaMatrix) \
+            and A.shape[0] == A.shape[1] and fused_vec_enabled():
+        m = A._pallas_mode(x, f)
+        if m is not None:
+            from amgcl_tpu.ops.pallas_spmv import dia_residual_dot
+            r, rr = dia_residual_dot(A.offsets, A.data, f, x, interpret=m)
+            (rr,) = _reduce_dots((rr,), axis)
+            return r, rr
+    r = dev.residual(f, A, x)
+    if kind == "psum":
+        (rr,) = _reduce_dots((jnp.vdot(r, r),), axis)
+        return r, rr
+    return r, (ip or dev.inner_product)(r, r)

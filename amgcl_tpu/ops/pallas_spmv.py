@@ -471,6 +471,77 @@ def dia_residual(offsets, data, f, x, tile=None,
                           interpret, db)
 
 
+@functools.partial(_watched_jit, name="ops.dia_residual_dot",
+                   static_argnames=("offsets", "tile", "interpret",
+                                    "db"))
+def dia_residual_dot(offsets, data, f, x, tile=None,
+                     interpret: bool = False, db=None):
+    """(r, <r, r>) with r = f − A x in ONE pass — the residual and its
+    norm reduction of the Krylov outer loop (Richardson's whole body,
+    every solver's init) without re-reading r from HBM. Same window
+    geometry as dia_residual; the per-tile partial reduces in-register
+    and accumulates into an SMEM scalar across the sequential grid
+    steps, like dia_spmv_dots. Square operators only (the caller
+    gates)."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    db = _DIA_DB if db is None else bool(db)
+    n = data.shape[1]
+    if x.shape[0] != n:
+        raise ValueError("dia_residual_dot needs a square operator")
+    ndiag = len(offsets)
+    tile = _resolve_tile(offsets, tile, x.dtype.itemsize, ndiag)
+    base, win, n_pad, xp, dpad = _dia_window(offsets, data, x, tile,
+                                             interpret)
+    fp = jnp.pad(f, (0, n_pad - n))
+    out_dtype = jnp.result_type(data.dtype, x.dtype, f.dtype)
+    acc_dtype = jnp.float32 if jnp.dtype(out_dtype).itemsize <= 4 \
+        else jnp.float64
+    vec_spec = pl.BlockSpec((tile,), lambda i: (i,))
+
+    def kernel(x_hbm, d_ref, f_ref, o_ref, dots_ref, scratch, sem):
+        i = pl.program_id(0)
+        row = _dia_dma(pl, pltpu, x_hbm, scratch, sem, i, tile, win,
+                       n_pad // tile)
+        acc = f_ref[:].astype(out_dtype)
+        for k, d in enumerate(offsets):
+            acc = acc - d_ref[k, :] * row[pl.ds(base + d, tile)]
+        o_ref[:] = acc
+        ra = acc.astype(acc_dtype)
+
+        @pl.when(i == 0)
+        def _init():
+            dots_ref[0, 0] = jnp.zeros((), acc_dtype)
+
+        dots_ref[0, 0] += jnp.sum(ra * ra)
+
+    with _tel_phase("pallas/dia_residual_dot"):
+        r, dots = pl.pallas_call(
+            kernel,
+            grid=(n_pad // tile,),
+            in_specs=[
+                pl.BlockSpec(memory_space=pl.ANY),
+                pl.BlockSpec((ndiag, tile),
+                             lambda i: (np.int32(0), i)),
+                vec_spec,
+            ],
+            out_specs=(
+                vec_spec,
+                pl.BlockSpec((1, 1),
+                             lambda i: (np.int32(0), np.int32(0)),
+                             memory_space=pltpu.SMEM),
+            ),
+            out_shape=(
+                jax.ShapeDtypeStruct((n_pad,), out_dtype),
+                jax.ShapeDtypeStruct((1, 1), acc_dtype),
+            ),
+            scratch_shapes=_dia_scratch(pltpu, win, x.dtype, db),
+            interpret=interpret,
+        )(xp, dpad, fp)
+    return r[:n], dots[0, 0].astype(out_dtype)
+
+
 def dia_scaled_correction(offsets, data, w, f, x, tile=None,
                           interpret: bool = False, db=None):
     """x + w ∘ (f − A x) in one pass — a damped-Jacobi/SPAI-0 sweep."""

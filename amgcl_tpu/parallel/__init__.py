@@ -12,7 +12,7 @@ from amgcl_tpu.parallel.dist_ell import DistEllMatrix, build_dist_ell
 from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix, dist_inner_product
 from amgcl_tpu.parallel.dist_stencil import (DistStencilSolver,
                                              dist_stencil_build)
-from amgcl_tpu.parallel.dist_solver import dist_cg
+from amgcl_tpu.parallel.dist_solver import dist_cg, dist_cg_pipelined
 from amgcl_tpu.parallel.dist_amg import DistAMGSolver
 from amgcl_tpu.parallel.deflation import DistDeflatedSolver
 from amgcl_tpu.parallel.block_precond import DistBlockPreconditioner
@@ -20,6 +20,7 @@ from amgcl_tpu.parallel.dist_cpr import DistCPRSolver
 from amgcl_tpu.parallel.dist_schur import DistSchurSolver
 
 __all__ = ["make_mesh", "ROWS_AXIS", "DistEllMatrix", "build_dist_ell",
-           "DistDiaMatrix", "dist_inner_product", "dist_cg", "DistAMGSolver",
+           "DistDiaMatrix", "dist_inner_product", "dist_cg",
+           "dist_cg_pipelined", "DistAMGSolver",
            "DistDeflatedSolver", "DistBlockPreconditioner", "DistCPRSolver",
            "DistSchurSolver", "DistStencilSolver", "dist_stencil_build"]

@@ -191,3 +191,10 @@ def dist_inner_product(x_local, y_local):
     """Local dot + psum over the rows axis — the distributed InnerProduct
     seam (reference: amgcl/mpi/inner_product.hpp:45-67)."""
     return lax.psum(jnp.vdot(x_local, y_local), ROWS_AXIS)
+
+
+# the psum marker the fused tiers key on (ops/device.spmv_dots,
+# ops/fused_vec): "this seam is local-vdot + psum over THIS axis", so a
+# fused kernel may compute the shard-local partial and globalize all its
+# dots in one stacked collective instead of composing through the seam
+dist_inner_product.psum_axis = ROWS_AXIS

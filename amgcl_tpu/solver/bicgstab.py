@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry.history import HistoryMixin
 
 
@@ -41,8 +42,10 @@ class BiCGStab(HistoryMixin):
 
         if left:
             r = precond(dev.residual(rhs, A, x))
+            rr0 = dot(r, r)
         else:
-            r = dev.residual(rhs, A, x)
+            # fused residual + <r,r> in one operator pass
+            r, rr0 = fv.residual_dot(rhs, A, x, ip=dot)
         rhat = r
 
         def apply_op(p):
@@ -57,12 +60,18 @@ class BiCGStab(HistoryMixin):
         from amgcl_tpu.telemetry import health as H
 
         def cond(st):
-            (x, r, p, v, rho, alpha, omega, it, res, hist, hs) = st
+            (x, r, p, v, rho, rho_c, alpha, omega, it, res, hist,
+             hs) = st
             return (it < self.maxiter) & (res > eps) & self._guard_go(hs)
 
         def body(st):
-            (x, r, p, v, rho, alpha, omega, it, res, hist, hs) = st
-            rho_new = dot(rhat, r)
+            # ``rho_c`` = <rhat, r> of the CURRENT r, computed by the
+            # previous iteration's fused tail (same value the historical
+            # ``dot(rhat, r)`` opened the body with — one reduction pass
+            # per iteration cheaper)
+            (x, r, p, v, rho, rho_c, alpha, omega, it, res, hist,
+             hs) = st
+            rho_new = rho_c
             beta = (rho_new / jnp.where(rho == 0, 1, rho)) \
                 * (alpha / jnp.where(omega == 0, 1, omega))
             p_n = r + beta * (p - omega * v)
@@ -80,15 +89,18 @@ class BiCGStab(HistoryMixin):
             s = r - alpha_n * v_n
             if left:
                 t, shat = apply_op(s)
-                tt = dot(t, t)
-                ts = dot(t, s)
+                # one read of t for both reductions (ops/fused_vec.py)
+                tt, ts = fv.multi_dot(t, (t, s), ip=dot)
             else:
                 shat = precond(s)
                 t, tt, _, ts = dev.spmv_dots(A, shat, s, dot)
             omega_n = ts / jnp.where(tt == 0, 1, tt)
-            x_n = x + alpha_n * phat + omega_n * shat
-            r_n = s - omega_n * t
-            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # fused tail (ops/fused_vec.py): the x/r double-axpby from
+            # ONE read of {phat, shat, s, t, x}, with <r,r> AND the next
+            # iteration's rho = <rhat, r> reduced in the same pass
+            x_n, r_n, rr, rho_next = fv.bicgstab_tail(
+                alpha_n, phat, omega_n, shat, s, t, x, rhat, ip=dot)
+            res_n = jnp.sqrt(jnp.abs(rr))
             # the three breakdown modes of the reference (bicgstab.hpp
             # throws on each): rho-, alpha(denom)- and omega-breakdown
             ok, hs = self._guard_step(
@@ -96,19 +108,23 @@ class BiCGStab(HistoryMixin):
                 ((H.BREAKDOWN_RHO, H.bad_denom(rho_new)),
                  (H.BREAKDOWN_ALPHA, H.bad_denom(denom)),
                  (H.BREAKDOWN_OMEGA, H.bad_denom(omega_n))))
-            x, r, p, v, rho, alpha, omega, res = self._guard_commit(
-                ok, (x_n, r_n, p_n, v_n, rho_new, alpha_n, omega_n, res_n),
-                (x, r, p, v, rho, alpha, omega, res))
+            x, r, p, v, rho, rho_c, alpha, omega, res = \
+                self._guard_commit(
+                    ok, (x_n, r_n, p_n, v_n, rho_new, rho_next, alpha_n,
+                         omega_n, res_n),
+                    (x, r, p, v, rho, rho_c, alpha, omega, res))
             hist = self._hist_put(hist, it, res_n / scale, keep=ok)
-            return (x, r, p, v, rho, alpha, omega,
+            return (x, r, p, v, rho, rho_c, alpha, omega,
                     it + ok.astype(jnp.int32), res, hist, hs)
 
-        res0 = jnp.sqrt(jnp.abs(dot(r, r)))
+        res0 = jnp.sqrt(jnp.abs(rr0))
+        # rhat = r, so the first iteration's rho = <rhat, r> = <r, r>
         st = (x, r, jnp.zeros_like(r), jnp.zeros_like(r),
-              one, one, one, jnp.zeros((), jnp.int32), res0,
+              one, jnp.asarray(rr0, rhs.dtype), one, one,
+              jnp.zeros((), jnp.int32), res0,
               self._hist_init(rhs.real.dtype),
               self._guard_init(res0 / scale))
-        (x, r, p, v, rho, alpha, omega, it, res, hist, hs) = \
+        (x, r, p, v, rho, rho_c, alpha, omega, it, res, hist, hs) = \
             lax.while_loop(cond, body, st)
         x = jnp.where(norm_rhs > 0, x, jnp.zeros_like(x))
         return self._hist_result(x, it, res / scale, hist, health=hs)

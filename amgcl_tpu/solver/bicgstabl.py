@@ -19,6 +19,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry.history import HistoryMixin
 
 
@@ -61,7 +62,8 @@ class BiCGStabL(HistoryMixin):
                 return y, jnp.conj(yr)
 
             b_p = rhs
-            r0 = dev.residual(rhs, A, x_init)
+            # fused residual + <r,r> — zeta0 rides the operator pass
+            r0, zz0 = fv.residual_dot(rhs, A, x_init, ip=dot)
             x = jnp.zeros_like(rhs)
         else:
             def op(v):
@@ -73,6 +75,7 @@ class BiCGStabL(HistoryMixin):
 
             b_p = precond(rhs)
             r0 = b_p - op(x_init)
+            zz0 = dot(r0, r0)
             x = x_init
         norm_rhs = jnp.sqrt(jnp.abs(dot(b_p, b_p)))
         scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
@@ -81,7 +84,7 @@ class BiCGStabL(HistoryMixin):
         n = rhs.shape[0]
         dtype = rhs.dtype
         use_delta = self.delta > 0
-        zeta0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
+        zeta0 = jnp.sqrt(jnp.abs(zz0))
         if use_delta and not right:
             # reliable updates need the correction form on BOTH sides:
             # run from Xc = 0 against B = r0, flush into xbase
@@ -133,12 +136,17 @@ class BiCGStabL(HistoryMixin):
                 ujp1, gamma = op_dot_rhat(Uc[j], rhat)
                 Uc = Uc.at[j + 1].set(ujp1)
                 alpha_c = rho1 / jnp.where(gamma == 0, 1.0, gamma)
-                Rc = R
-                for i in range(j + 1):
+                # R[0]'s update carries the zeta reduction in the same
+                # pass (ops/fused_vec.py); the remaining rows are plain
+                # axpys with no dependent dot
+                r0c, zz = fv.axpby_dot(-alpha_c, Uc[1], 1.0, R[0],
+                                       ip=dot)
+                Rc = R.at[0].set(r0c)
+                for i in range(1, j + 1):
                     Rc = Rc.at[i].set(Rc[i] - alpha_c * Uc[i + 1])
                 Rc = Rc.at[j + 1].set(op(Rc[j]))
                 xc = x + alpha_c * Uc[0]
-                zeta = jnp.sqrt(jnp.abs(dot(Rc[0], Rc[0])))
+                zeta = jnp.sqrt(jnp.abs(zz))
                 if guard_on:
                     trip_rho = trip_rho | (live & He.bad_denom(rho1))
                     trip_gamma = trip_gamma | (live & He.bad_denom(gamma))
@@ -157,12 +165,17 @@ class BiCGStabL(HistoryMixin):
                     rnt = jnp.where(step_ok, jnp.maximum(rnt, zeta), rnt)
                 live = live & (zeta > eps) & finite_or_pass(zeta)
             # -- MR part: minimize ||R[0] - sum_j g_j R[j]|| over j=1..L --
-            # Gram products go through the inner-product seam (vmapped) so
-            # they stay globally reduced inside shard_map; a raw conj(Z)@Z.T
-            # would be shard-local and silently wrong distributed.
+            # Gram products through the seam-aware batched dot
+            # (ops/fused_vec.py block_dots): ONE read of the stacked
+            # basis — and inside shard_map ONE psum of the (L, L+1)
+            # partial matrix instead of L(L+1) scalar collectives; a raw
+            # conj(Z)@Z.T would be shard-local and silently wrong
+            # distributed, which is exactly what block_dots' psum seam
+            # handling prevents.
             Z = R[1:]                       # (L, n)
-            G = jax.vmap(lambda zi: jax.vmap(lambda zj: dot(zi, zj))(Z))(Z)
-            rhs_g = jax.vmap(lambda zi: dot(zi, R[0]))(Z)
+            gram = fv.block_dots(Z, R, ip=dot)       # (L, L+1)
+            G = gram[:, 1:]
+            rhs_g = gram[:, 0]
             gam = jnp.linalg.solve(
                 G + 1e-300 * jnp.eye(Lp, dtype=dtype), rhs_g)
             xc = x + jnp.tensordot(gam, R[:Lp], axes=1)

@@ -13,6 +13,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry.history import HistoryMixin
 
 
@@ -35,7 +36,9 @@ class CG(HistoryMixin):
         exactly at the global target)."""
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
-        r = dev.residual(rhs, A, x)
+        # fused residual + <r,r> (ops/fused_vec.py): one operator pass
+        # yields both the initial residual and res0 below
+        r, rr0 = fv.residual_dot(rhs, A, x, ip=dot)
         norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
         # if ||rhs|| == 0 the solution is x = 0 (reference cg.hpp:144-149)
         norm_scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
@@ -66,9 +69,11 @@ class CG(HistoryMixin):
             # historical failure signal guard=False callers rely on
             alpha = rho / (jnp.where(qp == 0, 1.0, qp) if guard_trips
                            else qp)
-            x_n = dev.axpby(alpha, p_n, 1.0, x)
-            r_n = dev.axpby(-alpha, q, 1.0, r)
-            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # fused tail (ops/fused_vec.py): x += alpha p, r -= alpha q
+            # and <r,r> from ONE read of {p,q,x,r} — the residual
+            # reduction rides the update instead of re-streaming r
+            x_n, r_n, rr = fv.xr_update(alpha, p_n, q, x, r, ip=dot)
+            res_n = jnp.sqrt(jnp.abs(rr))
             if guard_trips:
                 # rho: residual orthogonal to the preconditioned residual;
                 # qp ≈ 0: singular direction; qp < 0: not positive
@@ -100,7 +105,7 @@ class CG(HistoryMixin):
                     lambda: None)
             return (x, r, p, rho, it + ok.astype(jnp.int32), res, hist, hs)
 
-        res0 = jnp.sqrt(jnp.abs(dot(r, r)))
+        res0 = jnp.sqrt(jnp.abs(rr0))
         hist0 = self._hist_init(rhs.real.dtype)
         state = (x, r, jnp.zeros_like(r), jnp.zeros((), rhs.dtype),
                  jnp.zeros((), jnp.int32), res0, hist0,

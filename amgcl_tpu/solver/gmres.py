@@ -24,6 +24,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry.history import HistoryMixin
 
 
@@ -88,13 +89,14 @@ def _arnoldi_cycle(apply_op, r0, m, eps, dot, direction=None, n_steps=None,
         v = V[j] if direction is None else direction(j, V)
         w, z = apply_op(v)
         # CGS2: h = V w; w -= V^T h; second pass for stability. The basis
-        # dots go through the inner-product seam (vmapped) so the same code
-        # is correct inside shard_map, where a raw V @ w would silently
-        # compute shard-local (unreduced) products.
-        vdots = jax.vmap(lambda vv: dot(vv, w))
-        h1 = vdots(V)
+        # dots go through the seam-aware batched dot (ops/fused_vec.py
+        # stack_dots): one read of V per pass, and inside shard_map the
+        # m+1 per-column psums merge into ONE collective of the stacked
+        # partials — a raw V @ w would silently compute shard-local
+        # (unreduced) products.
+        h1 = fv.stack_dots(V, w, ip=dot)
         w = w - V.T @ h1
-        h2 = vdots(V)
+        h2 = fv.stack_dots(V, w, ip=dot)
         w = w - V.T @ h2
         h = h1 + h2
         hn = jnp.sqrt(jnp.abs(dot(w, w)))

@@ -23,6 +23,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry.history import HistoryMixin
 
 
@@ -64,15 +65,18 @@ class IDRs(HistoryMixin):
 
         idx = jnp.arange(n) if row_index is None else row_index
         P = _shadow_block(s, idx, n_valid, dtype, dot)
-        # all shadow-space products below go through the dot seam (vmapped)
-        # so they stay globally reduced inside shard_map
-        pdots = jax.vmap(lambda p, v: dot(p, v), in_axes=(0, None))
+        # all shadow-space products below go through the seam-aware
+        # batched dot (ops/fused_vec.py): one read of P per block, and
+        # inside shard_map the s per-column psums merge into ONE
+        # collective of the stacked partials
+        def pdots(Pm, v):
+            return fv.stack_dots(Pm, v, ip=dot)
 
         norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))
         scale = jnp.where(norm_rhs > 0, norm_rhs, 1.0)
         eps = self.tol * scale
 
-        r0 = dev.residual(rhs, A, x)
+        r0, rr0 = fv.residual_dot(rhs, A, x, ip=dot)
 
         from amgcl_tpu.telemetry import health as He
         guard_on = bool(self.guard)
@@ -113,14 +117,20 @@ class IDRs(HistoryMixin):
                 U = U.at[k].set(u)
                 M = M.at[:, k].set(pdots(P, g))
                 beta = f[k] / jnp.where(M[k, k] == 0, 1.0, M[k, k])
-                r_n = r - beta * G[k]
-                x_n = x + beta * U[k]
+                if guard_on or self.record_history:
+                    # fused sub-step tail: x += beta U[k], r -= beta G[k]
+                    # and the <r,r> the guard/history needs, in one pass
+                    x_n, r_n, rr_k = fv.xr_update(beta, U[k], G[k], x, r,
+                                                  ip=dot)
+                else:
+                    r_n = r - beta * G[k]
+                    x_n = x + beta * U[k]
                 f_n = f - beta * M[:, k]
                 if guard_on:
                     # M[k,k] = <P_k, g> ≈ 0: the residual left the shadow
                     # space — the IDR(s) analogue of a rho-breakdown
                     bad = He.bad_denom(M[k, k])
-                    res_k = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+                    res_k = jnp.sqrt(jnp.abs(rr_k))
                     trip_rho = trip_rho | (alive & bad)
                     nan_seen = nan_seen | (alive & ~jnp.isfinite(res_k))
                     step_ok = alive & ~bad & jnp.isfinite(res_k)
@@ -136,20 +146,20 @@ class IDRs(HistoryMixin):
                     r, x, f = r_n, x_n, f_n
                     took = took + 1
                     if self.record_history:
-                        # the extra dot per sub-step only exists when
-                        # history is requested — the default path is
-                        # untouched
+                        # the extra reduction per sub-step only exists
+                        # when history is requested — the default path
+                        # is untouched (and fused, it rides the update)
                         hist = self._hist_put(
                             hist, it + k,
-                            jnp.sqrt(jnp.abs(dot(r, r))) / scale)
+                            jnp.sqrt(jnp.abs(rr_k)) / scale)
             # dimension-reduction step into the next Sonneveld space
             # (fused spmv + <t,t>/<t,r> on the DIA path — one HBM pass)
             v = precond(r)
             t, tt, _, tr = dev.spmv_dots(A, v, r, dot)
             om_n = tr / jnp.where(tt == 0, 1.0, tt)
-            x_n = x + om_n * v
-            r_n = r - om_n * t
-            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # fused tail: x += om v, r -= om t and <r,r> in one pass
+            x_n, r_n, rr_n = fv.xr_update(om_n, v, t, x, r, ip=dot)
+            res_n = jnp.sqrt(jnp.abs(rr_n))
             if guard_on:
                 bad = He.bad_denom(tt)
                 trip_om = trip_om | (alive & bad)
@@ -171,7 +181,7 @@ class IDRs(HistoryMixin):
                 took = took + 1
             return (x, r, G, U, M, om, it + took, res, hist, hs)
 
-        res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
+        res0 = jnp.sqrt(jnp.abs(rr0))
         st = (x, r0, jnp.zeros((s, n), dtype), jnp.zeros((s, n), dtype),
               jnp.eye(s, dtype=dtype), jnp.ones((), dtype),
               jnp.zeros((), jnp.int32), res0,

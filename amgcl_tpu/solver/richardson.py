@@ -9,6 +9,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry.history import HistoryMixin
 
 
@@ -34,8 +35,10 @@ class Richardson(HistoryMixin):
         def body(st):
             x, r, it, res, hist, hs = st
             x_n = x + self.damping * precond(r)
-            r_n = dev.residual(rhs, A, x_n)
-            res_n = jnp.sqrt(jnp.abs(dot(r_n, r_n)))
+            # fused residual + <r,r> (ops/fused_vec.py): the whole body's
+            # vector work after the preconditioner is ONE operator pass
+            r_n, rr = fv.residual_dot(rhs, A, x_n, ip=dot)
+            res_n = jnp.sqrt(jnp.abs(rr))
             # no breakdown denominators in a stationary iteration — the
             # guards watch for NaN, stagnation and divergence only
             ok, hs = self._guard_step(hs, it, res_n / scale)
@@ -44,8 +47,8 @@ class Richardson(HistoryMixin):
             hist = self._hist_put(hist, it, res_n / scale, keep=ok)
             return (x, r, it + ok.astype(jnp.int32), res, hist, hs)
 
-        r0 = dev.residual(rhs, A, x)
-        res0 = jnp.sqrt(jnp.abs(dot(r0, r0)))
+        r0, rr0 = fv.residual_dot(rhs, A, x, ip=dot)
+        res0 = jnp.sqrt(jnp.abs(rr0))
         st = (x, r0, jnp.zeros((), jnp.int32), res0,
               self._hist_init(rhs.real.dtype),
               self._guard_init(res0 / scale))

@@ -11,9 +11,12 @@ of XLA compile per iteration. Nothing in jax surfaces that per function —
 this module does:
 
 * :func:`watched_jit` — drop-in ``jax.jit`` replacement used by our jitted
-  entry points (``models/make_solver.py``, ``ops/pallas_spmv.py``,
-  ``ops/densewin.py``, ``ops/unstructured.py``,
-  ``parallel/dist_solver.py``): counts **calls** per function and
+  entry points (``models/make_solver.py``, ``ops/pallas_spmv.py`` —
+  including ``ops.dia_residual_dot``, ``ops/fused_vec.py`` (the fused
+  vector-algebra kernels, one ``ops.fused_vec`` bucket across its
+  modes), ``ops/densewin.py``, ``ops/unstructured.py``,
+  ``parallel/dist_solver.py`` — both the classical and pipelined CG
+  bodies): counts **calls** per function and
   **traces** per function + abstract-signature (a trace observed for an
   already-seen function with a NEW signature after warmup is recorded
   as a **retrace** event — the "same function, new shape" smell), with

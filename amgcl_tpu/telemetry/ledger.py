@@ -199,13 +199,29 @@ def _zero_sweep_cost(relax, n: int, vec: int) -> Optional[Dict[str, int]]:
 
 def cycle_cost_model(hier) -> Dict[str, Any]:
     """Per-stage FLOPs/HBM-bytes of ONE multigrid cycle of ``hier``
-    (models/amg.Hierarchy or compatible). Stage model per level: a
-    smoother sweep streams the operator and its own state once plus ~3
-    vector passes (f, x in, x out) — except the FIRST pre-sweep, which
-    runs from a zero guess and for the scaled-residual family is just
-    ``scale ∘ f`` (see :func:`_zero_sweep_cost`); the residual the
-    operator plus two vectors; transfers stream themselves plus their
-    two vectors. W-cycles visit level i ``ncycle**i`` times."""
+    (models/amg.Hierarchy or compatible). Stage model per level is the
+    STREAMING FLOOR — what a perfect single-pass kernel moves, which is
+    what the fused sweep/residual kernels run on TPU and what XLA's
+    elementwise fusion approaches elsewhere: a smoother sweep streams
+    the operator and its own state once plus {x in, f in, x out}
+    (the Ax intermediate is never materialized) — except the FIRST
+    pre-sweep, which runs from a zero guess and for the scaled-residual
+    family is just ``scale ∘ f`` (see :func:`_zero_sweep_cost`); the
+    residual the operator plus {x, f in, r out}; transfers stream
+    themselves plus their vectors. W-cycles visit level i ``ncycle**i``
+    times.
+
+    Levels carrying the whole-leg fused kernels (ops/pallas_vcycle.py,
+    ``lv.down``/``lv.up``) are priced as the SINGLE passes the cycle
+    actually runs — no double counting of the intermediate vectors the
+    composed stages would re-stream: a ``down_fused`` row replaces
+    pre_smooth + restrict when the zero-guess leg engages (npre == 1,
+    scalar scaled-residual smoother), the ``restrict`` row becomes the
+    one-pass residual+restrict kernel whenever ``lv.down`` exists, and
+    an ``up_fused`` row absorbs prolong + the first post-sweep (the
+    ``post_smooth`` row keeps the full-npost model for the roofline
+    join, which rescales it — the level total charges only the
+    remaining npost−1 sweeps)."""
     levels = getattr(hier, "levels", [])
     npre = getattr(hier, "npre", 1)
     npost = getattr(hier, "npost", 1)
@@ -237,8 +253,15 @@ def cycle_cost_model(hier) -> Dict[str, Any]:
             level_total = row["coarse_solve"]
         else:
             rx_b = _leaf_bytes(getattr(lv, "relax", None))
-            sweep = _add(a_cost, {"flops": 3 * n, "bytes": 3 * vec + rx_b})
-            resid = _add(a_cost, {"flops": n, "bytes": 2 * vec})
+            # streaming floors (what a perfect single-pass kernel moves
+            # — and what the fused dia/windowed-ELL sweep kernels and
+            # XLA's elementwise fusion actually run): a sweep reads
+            # {x, f, smoother state}, streams A and writes x' — the Ax
+            # intermediate is never materialized, so it is not charged
+            # (a_cost already carries the x read + one vector write);
+            # same for the residual's r and the prolong's correction add
+            sweep = _add(a_cost, {"flops": 3 * n, "bytes": vec + rx_b})
+            resid = _add(a_cost, {"flops": n, "bytes": vec})
             zero = _zero_sweep_cost(getattr(lv, "relax", None), n, vec)
             if npre > 0 and zero is not None:
                 row["pre_smooth"] = _add(zero, _scale(sweep, npre - 1))
@@ -246,11 +269,49 @@ def cycle_cost_model(hier) -> Dict[str, Any]:
                 row["pre_smooth"] = _scale(sweep, npre)
             row["restrict"] = _add(resid, mv_cost(lv.R))
             row["prolong"] = _add(mv_cost(lv.P),
-                                  {"flops": n, "bytes": 2 * vec})
+                                  {"flops": n, "bytes": vec})
             row["post_smooth"] = _scale(sweep, npost)
+            down = getattr(lv, "down", None)
+            up = getattr(lv, "up", None)
+            vec_c = _vec_dims(lv.R)[0] * itemsize   # coarse-vector bytes
+            fused_zero = npre == 1 and down is not None \
+                and getattr(down, "w", None) is not None
+            if down is not None:
+                # the one-pass kernel streams ITS operand copy once plus
+                # {f, u} in and fc out — this is what the cycle runs for
+                # its residual+restrict whenever the leg exists
+                down_pass = {"flops": row["restrict"]["flops"],
+                             "bytes": _leaf_bytes(down) + 2 * vec + vec_c}
+                row["restrict"] = down_pass
+                if fused_zero:
+                    # zero-guess whole leg: same pass also emits the
+                    # pre-smoothed iterate (writes u instead of reading
+                    # it) — byte count identical, flops add the sweep's
+                    row["down_fused"] = {
+                        "flops": row["pre_smooth"]["flops"]
+                        + down_pass["flops"],
+                        "bytes": down_pass["bytes"]}
+            fused_up = up is not None and npost >= 1
+            if fused_up:
+                row["up_fused"] = {
+                    "flops": row["prolong"]["flops"]
+                    + (row["post_smooth"]["flops"] / npost
+                       if npost else 0),
+                    "bytes": _leaf_bytes(up) + 3 * vec + vec_c}
             level_total = {"flops": 0, "bytes": 0}
-            for key in ("pre_smooth", "restrict", "prolong", "post_smooth"):
-                level_total = _add(level_total, row[key])
+            if fused_zero:
+                level_total = _add(level_total, row["down_fused"])
+            else:
+                level_total = _add(level_total, row["pre_smooth"])
+                level_total = _add(level_total, row["restrict"])
+            if fused_up:
+                level_total = _add(level_total, row["up_fused"])
+                if npost > 1:
+                    level_total = _add(level_total, _scale(
+                        row["post_smooth"], (npost - 1) / npost))
+            else:
+                level_total = _add(level_total, row["prolong"])
+                level_total = _add(level_total, row["post_smooth"])
         total = _add(total, _scale(level_total, visits))
         stages.append(row)
     out = {"stages": stages, "total": dict(total)}
@@ -275,26 +336,70 @@ KRYLOV_OPS = {
     "PreOnly":    (0, 1, 0, 0),
 }
 
+#: n-vector HBM streams per iteration (reads + writes at working dtype)
+#: of the FUSED iteration bodies (ops/fused_vec.py): every dot that
+#: rides an update or an spmv pass costs zero extra streams, so the
+#: vector traffic is just the distinct operand reads + result writes.
+#: The unfused composition pays 2·dots + 3·axpys streams instead (each
+#: dot re-reads its two operands, each axpby reads two and writes one).
+#: CG: rho(2: r,s) + p-update(3) + fused xr tail(4r+2w) = 11.
+#: BiCGStab: p-update(4) + s-update(3) + fused tail(6r+2w) = 15 (rho,
+#: <rhat,v>, <t,t>, <t,s>, ‖r‖² all ride spmv/update passes).
+#: Others estimated the same way from their rewritten bodies.
+KRYLOV_VEC_STREAMS_FUSED = {
+    "CG":         11,
+    "BiCGStab":   15,
+    "BiCGStabL":  24,
+    "GMRES":      16,
+    "FGMRES":     16,
+    "LGMRES":     20,
+    "IDRs":       30,
+    "Richardson": 4,
+    "PreOnly":    0,
+}
+
+
+def fused_vec_modeled() -> bool:
+    """Whether the iteration model should charge the fused vector-tier
+    byte counts — mirrors ops.fused_vec.fused_vec_enabled without
+    importing jax (this module stays stdlib+numpy-only)."""
+    return os.environ.get("AMGCL_TPU_FUSED_VEC", "1") != "0"
+
 
 def krylov_iteration_model(solver_name: str, A_dev,
                            cycle_total: Optional[Dict[str, int]] = None,
-                           pre_cycles: int = 1) -> Dict[str, Any]:
+                           pre_cycles: int = 1,
+                           fused: Optional[bool] = None) -> Dict[str, Any]:
     """FLOPs/HBM-bytes of one outer Krylov iteration: the solver's SpMVs
     and vector work plus ``pre_cycles`` multigrid cycles per
-    preconditioner application (``cycle_total`` from cycle_cost_model)."""
+    preconditioner application (``cycle_total`` from cycle_cost_model).
+
+    ``fused`` selects the vector-traffic model: the fused tier
+    (ops/fused_vec.py, default when ``AMGCL_TPU_FUSED_VEC`` is on)
+    streams each iteration vector once per compound primitive
+    (:data:`KRYLOV_VEC_STREAMS_FUSED`), so the dots are byte-free; the
+    composed model charges every dot and axpby its own passes. FLOPs are
+    identical either way — fusion moves bytes, not arithmetic."""
     spmv, papp, dots, axpys = KRYLOV_OPS.get(solver_name, (1, 1, 4, 4))
+    if fused is None:
+        fused = fused_vec_modeled()
     n, _ = _vec_dims(A_dev) if A_dev is not None else (0, 0)
     itemsize = _itemsize(A_dev) if A_dev is not None else 4
     vec = n * itemsize
     cost = _scale(mv_cost(A_dev), spmv)
+    streams = KRYLOV_VEC_STREAMS_FUSED.get(solver_name) if fused else None
+    if streams is None:
+        fused = False
+        streams = 2 * dots + 3 * axpys
     cost = _add(cost, {"flops": (2 * dots + 2 * axpys) * n,
-                       "bytes": (2 * dots + 3 * axpys) * vec})
+                       "bytes": streams * vec})
     if cycle_total:
         cost = _add(cost, _scale(
             {"flops": cycle_total["flops"], "bytes": cycle_total["bytes"]},
             papp * max(int(pre_cycles), 1)))
     out = {"solver": solver_name, "spmvs": spmv, "precond_applies": papp,
-           "dots": dots, "axpys": axpys, **cost}
+           "dots": dots, "axpys": axpys, "vec_streams": streams,
+           "fused_vec": bool(fused), **cost}
     if cost["bytes"]:
         out["flop_per_byte"] = round(cost["flops"] / cost["bytes"], 4)
     return out
@@ -454,17 +559,27 @@ def allreduce_model(nd: int, count: int, itemsize: int) -> Dict[str, int]:
 
 def krylov_comm_model(spmv_comm: Optional[Dict[str, Any]], nd: int,
                       itemsize: int, spmvs: int = 1,
-                      dots: int = 3) -> Dict[str, Any]:
+                      dots: int = 3,
+                      elems_per_dot: int = 1) -> Dict[str, Any]:
     """Per-iteration comm of a distributed Krylov loop: the SpMV halo
-    exchanges plus one scalar allreduce per inner product."""
+    exchanges plus one allreduce per inner-product GROUP.
+
+    ``dots`` counts the collectives (the latency-bearing quantity);
+    ``elems_per_dot`` the scalars each one carries — a merged-reduction
+    body like the pipelined CG psums ONE stacked 3-vector per iteration
+    (``dots=1, elems_per_dot=3``) where the classical body pays three
+    separate scalar collectives."""
     base = {"msgs": 0, "bytes": 0}
     if spmv_comm:
         base = {"msgs": spmv_comm["msgs"] * spmvs,
                 "bytes": spmv_comm["bytes"] * spmvs}
-    red = allreduce_model(nd, 1, itemsize)
-    return {"msgs": base["msgs"] + dots * red["msgs"],
-            "bytes": base["bytes"] + dots * red["bytes"],
-            "spmvs": spmvs, "dots": dots}
+    red = allreduce_model(nd, max(int(elems_per_dot), 1), itemsize)
+    out = {"msgs": base["msgs"] + dots * red["msgs"],
+           "bytes": base["bytes"] + dots * red["bytes"],
+           "spmvs": spmvs, "dots": dots}
+    if elems_per_dot != 1:
+        out["elems_per_dot"] = int(elems_per_dot)
+    return out
 
 
 # ---------------------------------------------------------------------------

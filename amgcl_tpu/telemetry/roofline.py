@@ -30,7 +30,12 @@ the device's peaks:
   scaled-residual stages agree to ~1%.
 * :func:`solve_roofline` — the per-Krylov-iteration variant from one
   solve's wall time and the ledger's iteration model
-  (``SolveReport.resources["roofline"]``).
+  (``SolveReport.resources["roofline"]``). The iteration model prices
+  the fused tiers at their single-stream cost (fused V-cycle legs via
+  ``cycle_cost_model``'s ``down_fused``/``up_fused`` rows, fused vector
+  algebra via ``KRYLOV_VEC_STREAMS_FUSED``) — no double counting of
+  intermediates the fused kernels never write, so achieved-GB/s numbers
+  stay honest as kernels merge.
 * :func:`counter_map` — the achieved-GB/s counter track for
   ``Profiler.to_chrome_trace(counters=...)``.
 
@@ -455,7 +460,9 @@ def findings(rf: Dict[str, Any], hier=None,
         if sugg is None:
             sugg = "memory-bound stage far off the roofline: check the " \
                    "storage format (ledger by_format), gather overhead, " \
-                   "and per-dispatch latency at this level's size" \
+                   "per-dispatch latency at this level's size, and that " \
+                   "the fused vector tier is engaged " \
+                   "(AMGCL_TPU_FUSED_VEC)" \
                 if r["bound"] == "memory" else \
                 "compute-bound stage off peak: dense coarse levels this " \
                 "small are dispatch-latency dominated"

@@ -38,6 +38,11 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # optionally rolling up a JSONL sink
                                     # file too; --prom PATH additionally
                                     # writes Prometheus exposition text
+    python bench.py --vecbench [n ...]
+                                    # microbenchmark: fused vector kernels
+                                    # (ops/fused_vec.py) vs the composed
+                                    # axpby+dot per vector size, emitted
+                                    # as a bench_vecbench JSONL record
 
 All JSON emission routes through the telemetry sink
 (amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
@@ -984,9 +989,16 @@ def main_worker():
     except Exception as e:
         _PARTIAL["ledger"] = {"error": repr(e)[:200]}
 
-    # bandwidth observability: documented traffic model / measured time
-    per_iter_bytes = _traffic_model(solver, prm.npre, prm.npost,
-                                    prm.pre_cycles)
+    # bandwidth observability: documented traffic model / measured time.
+    # The ledger's per-iteration model is the primary source — it prices
+    # the fused tiers (single-pass V-cycle legs, fused vector algebra)
+    # at their actual single-stream cost instead of double counting the
+    # composed stages; the legacy composed formula stays as the fallback
+    per_iter_bytes = ((info.resources or {}).get("per_iteration")
+                      or {}).get("bytes")
+    if not per_iter_bytes:
+        per_iter_bytes = _traffic_model(solver, prm.npre, prm.npost,
+                                        prm.pre_cycles)
     iters = max(int(info.iters), 1)
     achieved = per_iter_bytes * iters / t_solve / 1e9
     _PARTIAL["model_bytes_per_iter"] = int(per_iter_bytes)
@@ -1177,6 +1189,15 @@ def _record_ledger_bytes(rec):
     return v
 
 
+def _record_platform(rec):
+    """Device platform of a bench record; a record marked as a CPU
+    fallback counts as 'cpu' even if the field predates the split."""
+    p = rec.get("device_platform")
+    if p is None and rec.get("fallback"):
+        return "cpu"
+    return p
+
+
 def run_gate(candidate, last_good, tol=None):
     """Compare ``candidate`` against ``last_good`` under the tolerances.
 
@@ -1185,11 +1206,24 @@ def run_gate(candidate, last_good, tol=None):
     check (tripped-guard count must not exceed the baseline's; env
     AMGCL_TPU_GATE_HEALTH=0 opts out). A metric missing on either side
     is 'skipped', not a regression (pre-ledger records carry no byte
-    accounting, pre-health records no guard decode)."""
+    accounting, pre-health records no guard decode).
+
+    The time/bytes ratios only compare records from the SAME
+    ``device_platform``: a CPU-fallback candidate scored against a TPU
+    last-good (or vice versa) is a platform change, not a perf
+    regression — those checks report 'skipped' with the mismatch
+    (BENCH_r05 compared a CPU 2.10 s run against a TPU 0.069 s baseline
+    and the ratio meant nothing). Iteration count and health flags stay
+    compared — the math is platform-independent."""
     tol = tol or gate_tolerances()
     checks = []
 
-    def check(name, cand, base, limit):
+    def check(name, cand, base, limit, skip_reason=None):
+        if skip_reason is not None:
+            checks.append({"check": name, "status": "skipped",
+                           "reason": skip_reason,
+                           "candidate": cand, "last_good": base})
+            return
         if cand is None or base is None:
             checks.append({"check": name, "status": "skipped",
                            "candidate": cand, "last_good": base})
@@ -1198,15 +1232,22 @@ def run_gate(candidate, last_good, tol=None):
                        "last_good": base, "limit": round(limit, 6),
                        "status": "ok" if cand <= limit else "regression"})
 
+    plat_c, plat_b = _record_platform(candidate), _record_platform(last_good)
+    plat_skip = None
+    if plat_c is not None and plat_b is not None and plat_c != plat_b:
+        plat_skip = "platform_mismatch: candidate=%s last_good=%s" \
+            % (plat_c, plat_b)
     it0 = last_good.get("iters")
     check("iters", candidate.get("iters"), it0,
           it0 + tol["iters"] if it0 is not None else 0)
     t0 = last_good.get("value")
     check("solve_time", candidate.get("value"), t0,
-          t0 * tol["time"] if t0 is not None else 0)
+          t0 * tol["time"] if t0 is not None else 0,
+          skip_reason=plat_skip)
     b0 = _record_ledger_bytes(last_good)
     check("ledger_bytes", _record_ledger_bytes(candidate), b0,
-          b0 * tol["bytes"] if b0 is not None else 0)
+          b0 * tol["bytes"] if b0 is not None else 0,
+          skip_reason=plat_skip)
     if os.environ.get("AMGCL_TPU_GATE_HEALTH", "1") != "0":
         # flag IDENTITIES, not counts: any guard the baseline did not
         # trip is a regression (a candidate swapping a warning-level
@@ -1320,6 +1361,124 @@ def main_trend(args=None):
         with open(prom_path, "w") as f:
             f.write(m.prometheus_text(rollups))
         print("\nprometheus text written to %s" % prom_path)
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    return 0
+
+
+# ===========================================================================
+# vecbench: fused vector kernels vs their composed counterparts
+# ===========================================================================
+
+def main_vecbench(args=None):
+    """``bench.py --vecbench [n ...]``: time the fused vector-algebra
+    primitives (ops/fused_vec.py) against the composed axpby+dot
+    reference per vector size and emit ONE ``bench_vecbench`` JSONL
+    record — so the fusion win is tracked round-over-round like the
+    solve metric. Each arm chains ``reps`` data-dependent applications
+    inside one jitted scan (both carries thread every output, so
+    neither arm can dead-code its updates) and reports median
+    per-application microseconds."""
+    from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested
+    apply_if_cpu_requested()
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from amgcl_tpu.ops import fused_vec as fv
+
+    sizes = [int(a) for a in (args or []) if a.isdigit()]
+    on_tpu = jax.default_backend() == "tpu"
+    if not sizes:
+        sizes = [1 << k for k in ((16, 18, 20, 22) if on_tpu
+                                  else (14, 16, 18))]
+    reps = 32 if on_tpu else 8
+    repeats = 5
+
+    def timeit(step, init, ops):
+        # the carry AND the operand vectors ride as jit ARGUMENTS: a
+        # closed-over init would let XLA constant-fold the whole chain
+        # (measuring nothing), and closure operands embed megabytes of
+        # MLIR constants (see _timed_chain's tunnel note)
+        def many(st, ops):
+            out, _ = lax.scan(lambda c, _: (step(c, ops), None),
+                              step(st, ops), None, length=reps - 1)
+            return out[-1]
+        f = jax.jit(many)
+        float(f(init, ops))             # compile + warm
+        ts = []
+        for _ in range(repeats):
+            t0 = time.perf_counter()
+            float(f(init, ops))
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)) / reps
+
+    rows = []
+    for n in sizes:
+        rng = np.random.RandomState(7)
+        p, q, x, r = (jnp.asarray(rng.standard_normal(n), jnp.float32)
+                      for _ in range(4))
+        alpha = jnp.float32(0.37)
+        mode = fv._pallas_mode(x)
+        path = "xla" if mode is None else (
+            "pallas-interpret" if mode else "pallas")
+
+        # -- xr_update: the CG tail -------------------------------------
+        def xr_fused(st, ops):
+            xc, rc, rr = st
+            pp, qq = ops
+            a = alpha * (1 + 0 * rr)    # data-depend on the prior dot
+            return fv.xr_update(a, pp, qq, xc, rc)
+
+        def xr_composed(st, ops):
+            xc, rc, rr = st
+            pp, qq = ops
+            a = alpha * (1 + 0 * rr)
+            xn = xc + a * pp
+            rn = rc - a * qq
+            return xn, rn, jnp.vdot(rn, rn)
+
+        init_xr = (x, r, jnp.float32(0))
+        t_f = timeit(xr_fused, init_xr, (p, q))
+        t_c = timeit(xr_composed, init_xr, (p, q))
+
+        # -- axpby_dot --------------------------------------------------
+        def ax_fused(st, ops):
+            z, zz = st
+            (pp,) = ops
+            a = alpha * (1 + 0 * zz)
+            return fv.axpby_dot(a, pp, 0.5, z)
+
+        def ax_composed(st, ops):
+            z, zz = st
+            (pp,) = ops
+            a = alpha * (1 + 0 * zz)
+            zn = a * pp + 0.5 * z
+            return zn, jnp.vdot(zn, zn)
+
+        init_ax = (x, jnp.float32(0))
+        a_f = timeit(ax_fused, init_ax, (p,))
+        a_c = timeit(ax_composed, init_ax, (p,))
+        rows.append({
+            "n": n, "path": path,
+            "xr_update_us": round(t_f * 1e6, 3),
+            "xr_composed_us": round(t_c * 1e6, 3),
+            "xr_speedup": round(t_c / max(t_f, 1e-12), 3),
+            "axpby_dot_us": round(a_f * 1e6, 3),
+            "axpby_composed_us": round(a_c * 1e6, 3),
+            "axpby_speedup": round(a_c / max(a_f, 1e-12), 3)})
+        print("n=%-9d %-17s xr %8.2f vs %8.2f us (%.2fx)   axpby_dot "
+              "%8.2f vs %8.2f us (%.2fx)"
+              % (n, path, rows[-1]["xr_update_us"],
+                 rows[-1]["xr_composed_us"], rows[-1]["xr_speedup"],
+                 rows[-1]["axpby_dot_us"], rows[-1]["axpby_composed_us"],
+                 rows[-1]["axpby_speedup"]))
+    dev0 = jax.devices()[0]
+    rec = {"event": "bench_vecbench", "rows": rows,
+           "fused_enabled": fv.fused_vec_enabled(),
+           "device": str(dev0), "device_platform": dev0.platform,
+           "device_kind": getattr(dev0, "device_kind", None),
+           "commit": _git_head()}
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0
@@ -1442,5 +1601,8 @@ if __name__ == "__main__":
     elif "--trend" in sys.argv:
         extra = sys.argv[sys.argv.index("--trend") + 1:]
         sys.exit(main_trend(extra))
+    elif "--vecbench" in sys.argv:
+        extra = sys.argv[sys.argv.index("--vecbench") + 1:]
+        sys.exit(main_vecbench(extra))
     else:
         main_supervisor()

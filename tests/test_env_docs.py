@@ -49,5 +49,6 @@ def test_table_covers_new_knobs():
         documented = set(_ROW.findall(f.read()))
     for var in ("AMGCL_TPU_TELEMETRY_MAX_BYTES", "AMGCL_TPU_PEAK_GBPS",
                 "AMGCL_TPU_PEAK_FLOPS", "AMGCL_TPU_COMPILE_WATCH",
-                "AMGCL_TPU_ROOFLINE_REPS"):
+                "AMGCL_TPU_ROOFLINE_REPS", "AMGCL_TPU_FUSED_VEC",
+                "AMGCL_TPU_PIPELINED_CG"):
         assert var in documented, var
