@@ -1,0 +1,79 @@
+"""Static analysis — the before-execution leg of the telemetry stack.
+
+Two passes over two representations of the same programs:
+
+* :mod:`amgcl_tpu.analysis.lint` — stdlib-``ast`` JAX-hazard linter over
+  the source (bare ``jax.jit`` bypassing the compile watch, host syncs
+  in traced loop bodies, ``np.*`` on tracers, undocumented
+  ``AMGCL_TPU_*`` knobs, mutable defaults, Pallas calls without the
+  ``interpret=`` CI seam). Importable without jax.
+* :mod:`amgcl_tpu.analysis.jaxpr_audit` — abstract-traces the solver /
+  distributed / ``make_solver`` entry points (``jax.make_jaxpr``, no
+  execution) and verifies the declared contracts: collective census vs
+  ``ledger.DIST_CG_COLLECTIVES``, fused-tier engagement + vector-stream
+  recount vs ``ledger.KRYLOV_VEC_STREAMS_FUSED``, dtype discipline,
+  host callbacks in iteration bodies, buffer-donation state vs
+  ``ledger.DONATION_CONTRACTS``, and the compile-watch entry-point
+  drift check.
+
+``python -m amgcl_tpu.analysis`` runs both against the committed
+findings budget (``ANALYSIS_BASELINE.json``): new findings exit
+nonzero, like the bench gate. ``bench.py --check`` embeds the same run
+in its CI record.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, Optional
+
+from amgcl_tpu.analysis.lint import (  # noqa: F401  (public surface)
+    RULES, apply_baseline, finding_key, format_findings, run_lint,
+    undocumented_knobs, watched_entry_points,
+)
+
+#: committed findings budget at the repo root
+BASELINE_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)))), "ANALYSIS_BASELINE.json")
+
+
+def load_baseline(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    path = path or BASELINE_PATH
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except OSError:
+        return None
+
+
+def run_all(baseline: Optional[Dict[str, Any]] = None,
+            with_audit: bool = True) -> Dict[str, Any]:
+    """Lint (+ jaxpr audit) against the baseline. Returns a JSON-clean
+    record with ``ok`` false on any new lint finding or audit error."""
+    if baseline is None:
+        baseline = load_baseline()
+    findings = run_lint()
+    split = apply_baseline(findings, baseline)
+    out: Dict[str, Any] = {
+        "lint": {
+            "total": len(findings),
+            "new": split["new"],
+            "suppressed": len(split["suppressed"]),
+            "stale_suppressions": split["stale"],
+            "rules": list(RULES),
+        },
+        "ok": not split["new"],
+    }
+    if with_audit:
+        from amgcl_tpu.analysis import jaxpr_audit
+        audit = jaxpr_audit.run_audit()
+        out["audit"] = {
+            "records": audit["records"],
+            "findings": audit["findings"],
+            "errors": audit["errors"],
+            "ok": audit["ok"],
+        }
+        out["ok"] = out["ok"] and audit["ok"]
+    return out

@@ -1,0 +1,538 @@
+"""JAX-hazard AST linter — repo-wide static checks, stdlib ``ast`` only.
+
+The runtime telemetry stack (PRs 1-4) observes what a solve DID; this
+module checks what the source CAN do, before anything executes. Every
+rule encodes a hazard this codebase has actually paid for (or a
+discipline the jaxpr auditor depends on):
+
+``bare-jit``
+    ``jax.jit`` used directly instead of ``watched_jit``
+    (telemetry/compile_watch.py). A bare-jit entry point compiles
+    invisibly: its traces, retraces and compile seconds land in the
+    ``<unwatched>`` bucket, so the PR-4 compile accounting undercounts
+    exactly when it matters. Probe compiles (``.lower().compile()`` with
+    the result thrown away) and one-shot setup programs are legitimate —
+    they carry suppressions with reasons in ANALYSIS_BASELINE.json.
+``host-sync-in-loop``
+    ``.item()`` / ``float()`` / ``int()`` / ``bool()`` / ``np.asarray``
+    / ``jax.device_get`` inside a ``lax.while_loop``/``scan``/
+    ``fori_loop`` body function. Loop bodies are traced: these either
+    fail at trace time or, worse, silently freeze a traced value into a
+    Python constant.
+``np-in-jit``
+    ``np.*`` computation applied inside a traced loop body. NumPy calls
+    on tracers raise ``TracerArrayConversionError`` at best; at worst a
+    constant-folding call bakes trace-time values into the compiled
+    program. Shape/dtype helpers (``np.dtype``, ``np.int32(3)`` style
+    constants) are allowlisted.
+``undocumented-knob``
+    an ``AMGCL_TPU_*`` environment variable referenced under
+    ``amgcl_tpu/`` with no row in README's environment-variable table —
+    a knob nobody can discover is a knob that does not exist.
+    (Generalizes tests/test_env_docs.py's grep; that test now asserts
+    through this rule so there is ONE implementation.)
+``mutable-default``
+    a mutable literal (list/dict/set) as a default argument — the
+    classic shared-state bug, and in solver parameter dataclasses a
+    cross-instance parameter leak.
+``pallas-no-interpret``
+    a ``pl.pallas_call(...)`` without an ``interpret=`` argument. The CI
+    story for every kernel in this repo is the interpret seam
+    (AMGCL_TPU_PALLAS_INTERPRET routes the production dispatch through
+    the kernels on CPU); a pallas_call that cannot be interpreted is a
+    kernel CI cannot exercise.
+
+Findings are plain dicts keyed for the baseline by ``(rule, file,
+symbol)`` — line numbers are carried for display but excluded from the
+key so unrelated edits above a finding do not churn the baseline.
+
+The module also exposes :func:`watched_entry_points` — the statically
+discovered ``watched_jit(..., name=...)`` call sites — which the jaxpr
+auditor cross-checks against ``compile_watch.DECLARED_ENTRY_POINTS``
+(the drift check between the PR-4 docstring list and reality).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+#: repo root (two levels above this file: amgcl_tpu/analysis/lint.py)
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+#: the rules this module implements, in report order
+RULES = ("bare-jit", "host-sync-in-loop", "np-in-jit",
+         "undocumented-knob", "mutable-default", "pallas-no-interpret")
+
+_ENV_VAR = re.compile(r"AMGCL_TPU_[A-Z0-9_]+")
+#: a documented row in README: a table cell holding the backticked
+#: knob name (no example name in this comment — the reference scan
+#: over amgcl_tpu/ would count it as an undocumented knob)
+_ENV_ROW = re.compile(r"\|\s*`(AMGCL_TPU_[A-Z0-9_]+)`")
+
+#: np.* attributes that are safe inside traced code (constants, dtype
+#: and metadata helpers — they never touch a tracer's VALUES)
+_NP_SAFE = frozenset({
+    "dtype", "int8", "int16", "int32", "int64", "uint8", "uint16",
+    "uint32", "uint64", "float16", "float32", "float64", "complex64",
+    "complex128", "bool_", "intp", "pi", "e", "inf", "nan", "newaxis",
+    "finfo", "iinfo", "ndim", "shape", "size", "promote_types",
+    "result_type", "issubdtype", "floating", "complexfloating",
+    "integer", "prod",
+})
+
+#: builtin calls that force a device sync / python conversion on a tracer
+#: (``len`` is fine: shapes are static at trace time)
+_HOST_SYNC_BUILTINS = frozenset({"float", "int", "bool", "complex"})
+
+
+def finding(rule: str, file: str, line: int, symbol: str,
+            message: str) -> Dict[str, Any]:
+    return {"rule": rule, "file": file, "line": int(line),
+            "symbol": symbol, "message": message}
+
+
+def finding_key(f: Dict[str, Any]) -> Tuple[str, str, str]:
+    """Baseline identity of a finding: (rule, file, symbol) — stable
+    across unrelated edits that only move line numbers."""
+    return (f["rule"], f["file"], f["symbol"])
+
+
+# ---------------------------------------------------------------------------
+# per-file AST analysis
+# ---------------------------------------------------------------------------
+
+class _Module:
+    """One parsed file: alias maps, module-level string constants,
+    function table with qualnames, loop-body function set."""
+
+    def __init__(self, path: str, rel: str, tree: ast.Module):
+        self.path = path
+        self.rel = rel
+        self.tree = tree
+        #: local alias -> canonical module path ('numpy', 'jax',
+        #: 'jax.lax', 'jax.experimental.pallas', ...)
+        self.aliases: Dict[str, str] = {}
+        #: names bound by `from M import n [as a]` -> 'M.n'
+        self.from_imports: Dict[str, str] = {}
+        #: module-level `NAME = "literal"` constants (watched_jit name=)
+        self.str_consts: Dict[str, str] = {}
+        #: every FunctionDef/AsyncFunctionDef/Lambda -> qualname
+        self.qualnames: Dict[ast.AST, str] = {}
+        #: function name -> [nodes] (for loop-body resolution by name)
+        self.by_name: Dict[str, List[ast.AST]] = {}
+        #: nodes that are lax.while_loop/scan/fori_loop body/cond fns
+        self.loop_bodies: Set[ast.AST] = set()
+        self._index()
+
+    # -- indexing -----------------------------------------------------------
+
+    def _index(self) -> None:
+        # imports anywhere in the file (function-local `import jax` is
+        # the norm in the lazy-import modules — capi, pyamgcl_compat)
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    self.aliases[al.asname or al.name.split(".")[0]] = \
+                        al.name
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for al in node.names:
+                    self.from_imports[al.asname or al.name] = \
+                        node.module + "." + al.name
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name) \
+                    and isinstance(node.value, ast.Constant) \
+                    and isinstance(node.value.value, str):
+                self.str_consts[node.targets[0].id] = node.value.value
+        # qualnames via a parent-tracking walk
+        stack: List[str] = []
+
+        def visit(node):
+            is_scope = isinstance(node, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef,
+                                         ast.ClassDef))
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = ".".join(stack + [node.name])
+                self.qualnames[node] = qn
+                self.by_name.setdefault(node.name, []).append(node)
+            if is_scope:
+                stack.append(node.name)
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+            if is_scope:
+                stack.pop()
+
+        visit(self.tree)
+        # loop-body discovery: names passed to lax loop combinators
+        body_names: Set[str] = set()
+        for call in self._calls():
+            tail = _attr_tail(call.func)
+            if tail in ("while_loop", "scan", "fori_loop") \
+                    and self._is_laxish(call.func):
+                for arg in call.args:
+                    if isinstance(arg, ast.Name):
+                        body_names.add(arg.id)
+        for name in body_names:
+            for node in self.by_name.get(name, ()):
+                self.loop_bodies.add(node)
+
+    def _calls(self) -> Iterable[ast.Call]:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Call):
+                yield node
+
+    def _is_laxish(self, func: ast.AST) -> bool:
+        """True when `func` is <x>.while_loop/... with <x> resolving to
+        jax.lax (import jax; jax.lax.X / from jax import lax; lax.X /
+        aliased _lax)."""
+        if not isinstance(func, ast.Attribute):
+            return False
+        base = func.value
+        if isinstance(base, ast.Name):
+            target = self.from_imports.get(base.id) \
+                or self.aliases.get(base.id)
+            return target in ("jax.lax", "lax") or base.id in ("lax",
+                                                               "_lax")
+        if isinstance(base, ast.Attribute) and base.attr == "lax":
+            return True
+        return False
+
+    # -- alias resolution ---------------------------------------------------
+
+    def resolves_to(self, node: ast.AST, module: str,
+                    attr: str) -> bool:
+        """Does `node` (a Call.func) denote ``module.attr``? Handles
+        `import module [as m]` + `m.attr`, and
+        `from module import attr [as a]` + `a(...)`."""
+        if isinstance(node, ast.Attribute) and node.attr == attr:
+            base = node.value
+            if isinstance(base, ast.Name):
+                return self.aliases.get(base.id) == module \
+                    or self.from_imports.get(base.id) == module
+            return False
+        if isinstance(node, ast.Name):
+            return self.from_imports.get(node.id) == module + "." + attr
+        return False
+
+    def np_alias(self) -> Optional[str]:
+        for alias, mod in self.aliases.items():
+            if mod == "numpy":
+                return alias
+        return None
+
+
+def _attr_tail(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    if isinstance(func, ast.Name):
+        return func.id
+    return None
+
+
+def _enclosing_symbol(mod: _Module, node: ast.AST) -> str:
+    """Qualname of the innermost FunctionDef containing `node` (by line
+    span), or '<module>'."""
+    best, best_span = "<module>", None
+    for fn, qn in mod.qualnames.items():
+        lo = fn.lineno
+        hi = getattr(fn, "end_lineno", fn.lineno)
+        line = getattr(node, "lineno", None)
+        if line is None or not (lo <= line <= hi):
+            continue
+        span = hi - lo
+        if best_span is None or span < best_span:
+            best, best_span = qn, span
+    return best
+
+
+# ---------------------------------------------------------------------------
+# rules
+# ---------------------------------------------------------------------------
+
+def _rule_bare_jit(mod: _Module) -> List[Dict[str, Any]]:
+    out = []
+    if mod.rel.endswith("telemetry/compile_watch.py"):
+        return out          # the watcher wraps jax.jit by definition
+    msg = ("jax.jit bypasses watched_jit: traces/retraces/compile "
+           "seconds land in the <unwatched> bucket "
+           "(telemetry/compile_watch.py)")
+    for call in mod._calls():
+        if mod.resolves_to(call.func, "jax", "jit"):
+            out.append(finding("bare-jit", mod.rel, call.lineno,
+                               _enclosing_symbol(mod, call), msg))
+    # bare `@jax.jit` decorators are Attribute nodes, not Calls
+    for fn, qn in mod.qualnames.items():
+        for dec in getattr(fn, "decorator_list", ()):
+            if not isinstance(dec, ast.Call) \
+                    and mod.resolves_to(dec, "jax", "jit"):
+                out.append(finding("bare-jit", mod.rel, dec.lineno, qn,
+                                   msg))
+    out.sort(key=lambda f: f["line"])
+    return out
+
+
+def _is_self_attr(node: ast.AST) -> bool:
+    """``self.x`` (or ``self.x.y``) — solver config attributes are
+    trace-time Python constants, not traced values."""
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+def _rule_loop_hazards(mod: _Module) -> List[Dict[str, Any]]:
+    """host-sync-in-loop + np-in-jit over the discovered loop bodies."""
+    out = []
+    np_alias = mod.np_alias()
+    for body in mod.loop_bodies:
+        qn = mod.qualnames.get(body, "<module>")
+        for node in ast.walk(body):
+            if not isinstance(node, ast.Call):
+                continue
+            tail = _attr_tail(node.func)
+            if isinstance(node.func, ast.Attribute) \
+                    and node.func.attr == "item" and not node.args:
+                out.append(finding(
+                    "host-sync-in-loop", mod.rel, node.lineno, qn,
+                    ".item() inside a traced loop body forces a device "
+                    "sync / fails on a tracer"))
+            elif isinstance(node.func, ast.Name) \
+                    and node.func.id in _HOST_SYNC_BUILTINS \
+                    and node.args \
+                    and not isinstance(node.args[0], ast.Constant) \
+                    and not _is_self_attr(node.args[0]):
+                out.append(finding(
+                    "host-sync-in-loop", mod.rel, node.lineno, qn,
+                    "%s() on a traced value inside a loop body is a "
+                    "host sync (or a trace-time constant-fold)"
+                    % node.func.id))
+            elif mod.resolves_to(node.func, "jax", "device_get"):
+                out.append(finding(
+                    "host-sync-in-loop", mod.rel, node.lineno, qn,
+                    "jax.device_get inside a traced loop body"))
+            elif np_alias is not None \
+                    and isinstance(node.func, ast.Attribute):
+                # walk np.linalg.norm-style chains down to the base name
+                chain = []
+                base = node.func
+                while isinstance(base, ast.Attribute):
+                    chain.append(base.attr)
+                    base = base.value
+                if isinstance(base, ast.Name) and base.id == np_alias \
+                        and chain[-1] not in _NP_SAFE:
+                    out.append(finding(
+                        "np-in-jit", mod.rel, node.lineno, qn,
+                        "np.%s(...) inside a traced loop body operates "
+                        "on tracers (use jnp or hoist to trace time)"
+                        % ".".join(reversed(chain))))
+            del tail
+    return out
+
+
+def _rule_mutable_default(mod: _Module) -> List[Dict[str, Any]]:
+    out = []
+    for fn, qn in mod.qualnames.items():
+        args = fn.args
+        for default in list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]:
+            bad = isinstance(default, (ast.List, ast.Dict, ast.Set))
+            if not bad and isinstance(default, ast.Call):
+                t = _attr_tail(default.func)
+                bad = t in ("list", "dict", "set") and not default.args \
+                    and not default.keywords
+            if bad:
+                out.append(finding(
+                    "mutable-default", mod.rel, default.lineno, qn,
+                    "mutable default argument is shared across calls"))
+    return out
+
+
+def _rule_pallas_interpret(mod: _Module) -> List[Dict[str, Any]]:
+    out = []
+    for call in mod._calls():
+        if _attr_tail(call.func) != "pallas_call":
+            continue
+        kwargs = {kw.arg for kw in call.keywords}
+        if "interpret" not in kwargs and None not in kwargs:
+            out.append(finding(
+                "pallas-no-interpret", mod.rel, call.lineno,
+                _enclosing_symbol(mod, call),
+                "pallas_call without an interpret= seam cannot be "
+                "exercised by CPU CI (AMGCL_TPU_PALLAS_INTERPRET)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# env-knob documentation rule (the test_env_docs implementation)
+# ---------------------------------------------------------------------------
+
+def referenced_env_vars(root: Optional[str] = None) -> Set[str]:
+    """Every AMGCL_TPU_* name referenced under ``amgcl_tpu/`` (prose
+    stems like ``AMGCL_TPU_PEAK_{GBPS,FLOPS}`` keep their stem with the
+    trailing underscore stripped)."""
+    root = root or os.path.join(REPO, "amgcl_tpu")
+    refs: Set[str] = set()
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            with open(os.path.join(dirpath, fn)) as f:
+                for match in _ENV_VAR.findall(f.read()):
+                    refs.add(match.rstrip("_"))
+    return refs
+
+
+def documented_env_vars(readme: Optional[str] = None) -> Set[str]:
+    readme = readme or os.path.join(REPO, "README.md")
+    try:
+        with open(readme) as f:
+            return set(_ENV_ROW.findall(f.read()))
+    except OSError:
+        return set()
+
+
+def undocumented_knobs(root: Optional[str] = None,
+                       readme: Optional[str] = None) -> List[str]:
+    """Referenced-but-undocumented knob names (the rule's payload; a
+    stem is covered when a longer documented name extends it)."""
+    refs = referenced_env_vars(root)
+    documented = documented_env_vars(readme)
+    return sorted(v for v in refs - documented
+                  if not any(d.startswith(v + "_") for d in documented))
+
+
+def _rule_undocumented_knob(root: Optional[str],
+                            readme: Optional[str]) -> List[Dict[str, Any]]:
+    return [finding(
+        "undocumented-knob", "README.md", 0, var,
+        "%s is referenced under amgcl_tpu/ but has no row in README's "
+        "environment-variable table" % var)
+        for var in undocumented_knobs(root, readme)]
+
+
+# ---------------------------------------------------------------------------
+# watched_jit discovery (consumed by the jaxpr auditor's drift check)
+# ---------------------------------------------------------------------------
+
+def watched_entry_points(root: Optional[str] = None) -> Dict[str, List[str]]:
+    """Statically discovered ``watched_jit(...)`` call sites:
+    ``{watch name: [file:line, ...]}``. The ``name=`` argument is
+    resolved from a string literal or a module-level string constant;
+    call sites with a dynamic name map under ``<dynamic>``."""
+    out: Dict[str, List[str]] = {}
+    for mod in _modules(root):
+        if mod.rel.endswith("telemetry/compile_watch.py"):
+            continue        # the definition site, not a registration
+        for call in mod._calls():
+            tail = _attr_tail(call.func)
+            if tail not in ("watched_jit", "_watched_jit"):
+                # decorator form: functools.partial(watched_jit, name=...)
+                if not (tail == "partial" and call.args
+                        and _attr_tail(call.args[0])
+                        in ("watched_jit", "_watched_jit")):
+                    continue
+            name = "<dynamic>"
+            for kw in call.keywords:
+                if kw.arg != "name":
+                    continue
+                if isinstance(kw.value, ast.Constant) \
+                        and isinstance(kw.value.value, str):
+                    name = kw.value.value
+                elif isinstance(kw.value, ast.Name):
+                    name = mod.str_consts.get(kw.value.id, "<dynamic>")
+            out.setdefault(name, []).append(
+                "%s:%d" % (mod.rel, call.lineno))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _modules(root: Optional[str] = None) -> List[_Module]:
+    root = root or os.path.join(REPO, "amgcl_tpu")
+    base = os.path.dirname(root.rstrip(os.sep)) or REPO
+    mods = []
+    for dirpath, dirs, files in os.walk(root):
+        dirs[:] = [d for d in dirs if d != "__pycache__"]
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, base).replace(os.sep, "/")
+            with open(path) as f:
+                src = f.read()
+            # a SyntaxError propagates: a file the linter cannot parse
+            # cannot be audited, and python itself will not import it —
+            # fail loudly rather than silently skipping the file
+            tree = ast.parse(src, filename=path)
+            mods.append(_Module(path, rel, tree))
+    return mods
+
+
+def run_lint(root: Optional[str] = None,
+             readme: Optional[str] = None,
+             rules: Optional[Iterable[str]] = None) -> List[Dict[str, Any]]:
+    """Run the AST rules over ``root`` (default: the installed
+    ``amgcl_tpu`` package) and the knob-doc rule against ``readme``.
+    Returns findings in (file, line) order."""
+    want = set(rules) if rules is not None else set(RULES)
+    out: List[Dict[str, Any]] = []
+    ast_rules = want & {"bare-jit", "host-sync-in-loop", "np-in-jit",
+                        "mutable-default", "pallas-no-interpret"}
+    for mod in (_modules(root) if ast_rules else []):
+        if "bare-jit" in want:
+            out += _rule_bare_jit(mod)
+        if want & {"host-sync-in-loop", "np-in-jit"}:
+            out += [f for f in _rule_loop_hazards(mod)
+                    if f["rule"] in want]
+        if "mutable-default" in want:
+            out += _rule_mutable_default(mod)
+        if "pallas-no-interpret" in want:
+            out += _rule_pallas_interpret(mod)
+    if "undocumented-knob" in want:
+        out += _rule_undocumented_knob(root, readme)
+    out.sort(key=lambda f: (f["file"], f["line"], f["rule"]))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# baseline: accepted findings with reasons (the findings budget)
+# ---------------------------------------------------------------------------
+
+def apply_baseline(findings: List[Dict[str, Any]],
+                   baseline: Optional[Dict[str, Any]]) -> Dict[str, Any]:
+    """Split findings against a baseline's suppression list.
+
+    ``baseline["suppressions"]`` entries carry {rule, file, symbol,
+    reason}; a finding whose :func:`finding_key` matches is accepted.
+    Returns {"new": [...], "suppressed": [...], "stale": [...]} — new
+    findings fail the gate (like the bench gate's regressions), stale
+    suppressions are reported so the baseline can shrink."""
+    sup = {(s["rule"], s["file"], s["symbol"]): s
+           for s in (baseline or {}).get("suppressions", [])}
+    new, suppressed = [], []
+    seen = set()
+    for f in findings:
+        key = finding_key(f)
+        seen.add(key)
+        if key in sup:
+            suppressed.append(dict(f, reason=sup[key].get("reason", "")))
+        else:
+            new.append(f)
+    stale = [dict(zip(("rule", "file", "symbol"), key),
+                  reason=s.get("reason", ""))
+             for key, s in sup.items() if key not in seen]
+    return {"new": new, "suppressed": suppressed, "stale": stale}
+
+
+def format_findings(findings: List[Dict[str, Any]]) -> str:
+    if not findings:
+        return "(no findings)"
+    return "\n".join("%s:%s: [%s] %s (%s)" % (
+        f["file"], f.get("line", "?"), f["rule"], f["message"],
+        f["symbol"]) for f in findings)

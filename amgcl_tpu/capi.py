@@ -109,10 +109,14 @@ class _PrecondApply:
         self._compiled = None
 
     def __call__(self, r):
-        import jax
         import jax.numpy as jnp
         if self._compiled is None:
-            self._compiled = jax.jit(lambda hier, v: hier.apply(v))
+            # observed jit (telemetry/compile_watch.py): C-API precond
+            # applications are repeat-call entry points — their compiles
+            # must not land in the <unwatched> bucket
+            from amgcl_tpu.telemetry.compile_watch import watched_jit
+            self._compiled = watched_jit(lambda hier, v: hier.apply(v),
+                                         name="capi.precond_apply")
         dtype = getattr(self.precond, "dtype", jnp.float64)
         z = self._compiled(self.precond.hierarchy,
                            jnp.asarray(r, dtype=dtype))

@@ -75,6 +75,16 @@ def main(argv=None):
                          "Chrome/Perfetto trace-event JSON — open in "
                          "ui.perfetto.dev (includes the hierarchy "
                          "setup-phase profile as its own track)")
+    ap.add_argument("--audit", action="store_true",
+                    help="static jaxpr audit of the solver in use "
+                         "(analysis/jaxpr_audit.py): abstractly re-trace "
+                         "its iteration body with the fused tier on and "
+                         "off and report fused-kernel engagement, the "
+                         "per-iteration vector-stream count vs the "
+                         "ledger's KRYLOV_VEC_STREAMS_FUSED model, dtype "
+                         "casts and host callbacks — plus the contract "
+                         "findings; with --telemetry also emits an "
+                         "'audit' event")
     args = ap.parse_args(argv)
 
     # honor 64-bit dtype requests before any jax array is created
@@ -260,6 +270,43 @@ def main(argv=None):
         print(format_findings(findings))
         telemetry.emit(event="doctor", findings=findings,
                        **({"probe": probe} if probe is not None else {}))
+
+    if args.audit:
+        # per-solver contract report: re-trace the iteration body of
+        # the solver CLASS in use (tiny probe operator — the contracts
+        # are structural, not size-dependent) and check it against the
+        # declared ledger contracts
+        from amgcl_tpu.analysis import jaxpr_audit as _ja
+        solver_obj = getattr(inner, "solver", None)
+        sname = type(solver_obj).__name__ if solver_obj is not None \
+            else "CG"
+        audit_recs, audit_findings = [], []
+        if sname in _ja.solver_registry():
+            for fused in (True, False):
+                rec = _ja.audit_solver(sname, fused=fused)
+                audit_recs.append(rec)
+                audit_findings += _ja.check_solver(rec)
+        else:
+            audit_recs.append({"entry": "solver." + sname,
+                               "skipped": "no audit contract declared "
+                               "for this solver class"})
+        if args.mesh:
+            # audit the body dist_cg would actually dispatch to under
+            # the current env (AMGCL_TPU_PIPELINED_CG)
+            from amgcl_tpu.parallel.dist_solver import \
+                pipelined_cg_enabled
+            rec = _ja.audit_dist_cg(pipelined=pipelined_cg_enabled())
+            audit_recs.append(rec)
+            audit_findings += _ja.check_dist(rec)
+        result = {"records": audit_recs, "findings": audit_findings,
+                  "errors": sum(1 for f in audit_findings
+                                if f["severity"] == "error"),
+                  "ok": not any(f["severity"] == "error"
+                                for f in audit_findings)}
+        print()
+        print(_ja.format_report(result))
+        telemetry.emit(event="audit", ok=result["ok"],
+                       records=audit_recs, findings=audit_findings)
 
     if args.telemetry:
         # structured duplicates of the text report, one JSONL record each

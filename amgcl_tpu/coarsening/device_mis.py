@@ -89,8 +89,13 @@ def device_aggregates(cols, valid, prio, rounds: int = 40):
     return key, key > 0
 
 
-_jitted_device_aggregates = jax.jit(device_aggregates,
-                                    static_argnames="rounds")
+# observed jit (telemetry/compile_watch.py): the device-MIS rounds are
+# a setup-phase entry point headed for default status (ROADMAP item 2)
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
+
+_jitted_device_aggregates = _watched_jit(
+    device_aggregates, name="coarsening.device_aggregates",
+    static_argnames="rounds")
 
 
 def aggregates_on_device(A: CSR, eps_strong: float = 0.08,

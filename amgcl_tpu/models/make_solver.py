@@ -398,12 +398,7 @@ class make_solver:
         t0 = time.perf_counter()
         first_call = self._compiled is None
         if first_call:
-            # observed jit (telemetry/compile_watch.py): traces, backend
-            # compiles and compile seconds of the solve program land in
-            # SolveReport.compile; a retrace on a new shape after warmup
-            # is flagged for the doctor
-            self._compiled = _cwatch.watched_jit(
-                self._solve_fn, name=_SOLVE_FN)
+            self._wrapped_solve_fn()
         cw0 = _cwatch.snapshot(_SOLVE_FN) if _cwatch.enabled() else None
         got = self._compiled(self.A_dev, self.A_dev64,
                              self.precond.hierarchy, rhs, x0)
@@ -489,6 +484,19 @@ class make_solver:
                                iters=int(iters), resid=float(resid),
                                **health)
         return x, report
+
+    def _wrapped_solve_fn(self):
+        """THE jit wrap of the solve program — observed jit
+        (telemetry/compile_watch.py): traces, backend compiles and
+        compile seconds land in SolveReport.compile; a retrace on a new
+        shape after warmup is flagged for the doctor. One method so the
+        static donation audit (analysis/jaxpr_audit.audit_make_solver)
+        lowers the SAME wrap the solve runs — when ROADMAP item 1 adds
+        donated buffers here, the audit sees them."""
+        if self._compiled is None:
+            self._compiled = _cwatch.watched_jit(
+                self._solve_fn, name=_SOLVE_FN)
+        return self._compiled
 
     def _hierarchy_stats(self):
         # invariant per built hierarchy — cached; rebuild() invalidates

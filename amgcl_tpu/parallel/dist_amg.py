@@ -746,7 +746,11 @@ class DistAMGSolver:
             in_specs=(hier_specs, P(ROWS_AXIS), P(ROWS_AXIS)),
             out_specs=(P(ROWS_AXIS), P(), P()),
             check_vma=False)
-        return jax.jit(fn)
+        # observed jit (telemetry/compile_watch.py): THE distributed
+        # AMG solve program — a retrace per call here is the worst
+        # silent-latency case on a pod
+        from amgcl_tpu.telemetry.compile_watch import watched_jit
+        return watched_jit(fn, name="parallel.dist_amg_solve")
 
     def __call__(self, rhs, x0=None):
         dtype = self.prm.dtype

@@ -108,7 +108,11 @@ def _compiled_mis(mesh, shape, nloc, ncloc, rounds):
 
     fn = shard_map(run, mesh=mesh, in_specs=(dS_spec, P(ROWS_AXIS, None)),
                    out_specs=P(ROWS_AXIS), check_vma=False)
-    return jax.jit(fn)
+    # observed jit (telemetry/compile_watch.py): runs once per strip
+    # setup, but the lru_cache above makes it a process-lived entry
+    # point — keep its compiles attributable
+    from amgcl_tpu.telemetry.compile_watch import watched_jit
+    return watched_jit(fn, name="parallel.dist_mis")
 
 
 def sharded_aggregates(A: CSR, eps_strong: float, mesh, rounds: int = 40):

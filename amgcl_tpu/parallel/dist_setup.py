@@ -419,7 +419,10 @@ def _compiled_alltoall(mesh, C, kind):
                    in_specs=(P(ROWS_AXIS), P(ROWS_AXIS)),
                    out_specs=(P(ROWS_AXIS), P(ROWS_AXIS)),
                    check_vma=False)
-    return jax.jit(fn)
+    # observed jit (telemetry/compile_watch.py): every strip-setup
+    # triple product funnels its exchanges through this cached program
+    from amgcl_tpu.telemetry.compile_watch import watched_jit
+    return watched_jit(fn, name="parallel.dist_exchange")
 
 
 # ===========================================================================

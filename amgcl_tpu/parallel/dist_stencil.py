@@ -672,7 +672,12 @@ class DistStencilSolver:
                 in_specs=(hier_specs, P(ROWS_AXIS), P(ROWS_AXIS)),
                 out_specs=(P(ROWS_AXIS), P(), P()),
                 check_vma=False)
-            self._compiled = jax.jit(fn)
+            # observed jit (telemetry/compile_watch.py): the stencil
+            # solver's whole-mesh CG program is a repeat-solve entry
+            # point like dist_cg
+            from amgcl_tpu.telemetry.compile_watch import watched_jit
+            self._compiled = watched_jit(fn,
+                                         name="parallel.dist_stencil_cg")
         x, it, res = self._compiled(self.hier, f, x0p)
         x = np.asarray(x)[: self.n]
         from amgcl_tpu.telemetry import emit as _tel_emit

@@ -33,8 +33,11 @@ class amgcl:
         A0 = self._amg.host_levels[0][0]
         n = A0.nrows * A0.block_size[0]
         self.shape = (n, n)
-        import jax
-        self._apply = jax.jit(lambda h, r: h.apply(r))
+        # observed jit (telemetry/compile_watch.py): scipy callers apply
+        # this preconditioner once per Krylov iteration
+        from amgcl_tpu.telemetry.compile_watch import watched_jit
+        self._apply = watched_jit(lambda h, r: h.apply(r),
+                                  name="pyamgcl_compat.precond_apply")
 
     def __call__(self, rhs):
         import jax.numpy as jnp

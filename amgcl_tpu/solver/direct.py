@@ -16,6 +16,7 @@ host float64 path)."""
 
 from __future__ import annotations
 
+import functools
 import os
 import warnings
 
@@ -96,7 +97,10 @@ class DenseDirectSolver:
         return cls(jnp.asarray(inv, dtype=dtype), block)
 
 
-@jax.jit
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
+
+
+@functools.partial(_watched_jit, name="solver.direct.device_inv")
 def _device_inv(Ad):
     """f32 inverse + two Newton-Schulz polish steps (X <- X(2I - A X)):
     quadratic residual contraction, all MXU matmuls. Returns

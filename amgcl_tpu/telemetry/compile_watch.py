@@ -11,12 +11,12 @@ of XLA compile per iteration. Nothing in jax surfaces that per function —
 this module does:
 
 * :func:`watched_jit` — drop-in ``jax.jit`` replacement used by our jitted
-  entry points (``models/make_solver.py``, ``ops/pallas_spmv.py`` —
-  including ``ops.dia_residual_dot``, ``ops/fused_vec.py`` (the fused
-  vector-algebra kernels, one ``ops.fused_vec`` bucket across its
-  modes), ``ops/densewin.py``, ``ops/unstructured.py``,
-  ``parallel/dist_solver.py`` — both the classical and pipelined CG
-  bodies): counts **calls** per function and
+  entry points. The authoritative registration list is
+  :data:`DECLARED_ENTRY_POINTS` below — kept equal to the
+  ``watched_jit(name=...)`` call sites in the source by the static
+  auditor (analysis/jaxpr_audit.check_entry_points), so this docstring
+  can no longer silently drift from reality. It counts **calls** per
+  function and
   **traces** per function + abstract-signature (a trace observed for an
   already-seen function with a NEW signature after warmup is recorded
   as a **retrace** event — the "same function, new shape" smell), with
@@ -47,6 +47,43 @@ _LOCK = threading.Lock()
 
 #: attribution bucket for compiles observed while no watched function runs
 UNWATCHED = "<unwatched>"
+
+#: every watched_jit registration name in the package — the docstring
+#: list above, as code. The static auditor
+#: (analysis/jaxpr_audit.check_entry_points) asserts this tuple is
+#: EXACTLY the set of ``watched_jit(name=...)`` call sites the linter
+#: discovers in the source, so the list can no longer drift from
+#: reality: adding or renaming a watched entry point without updating
+#: it fails `python -m amgcl_tpu.analysis`.
+DECLARED_ENTRY_POINTS = (
+    "capi.precond_apply",
+    "coarsening.device_aggregates",
+    "make_solver._solve_fn",
+    "ops.dense_window_fused",
+    "ops.dense_window_spmv",
+    "ops.dia_fused",
+    "ops.dia_residual_dot",
+    "ops.dia_spmv",
+    "ops.dia_spmv_dots",
+    "ops.fused_down_sweep",
+    "ops.fused_up_sweep",
+    "ops.fused_vec",
+    "ops.level_setup",
+    "ops.windowed_ell_block_fused",
+    "ops.windowed_ell_block_spmv",
+    "ops.windowed_ell_block_spmv_dots",
+    "ops.windowed_ell_fused",
+    "ops.windowed_ell_spmv",
+    "ops.windowed_ell_spmv_dots",
+    "parallel.dist_amg_solve",
+    "parallel.dist_cg",
+    "parallel.dist_cg_pipelined",
+    "parallel.dist_exchange",
+    "parallel.dist_mis",
+    "parallel.dist_stencil_cg",
+    "pyamgcl_compat.precond_apply",
+    "solver.direct.device_inv",
+)
 
 
 def enabled() -> bool:

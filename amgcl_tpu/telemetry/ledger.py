@@ -359,6 +359,56 @@ KRYLOV_VEC_STREAMS_FUSED = {
 }
 
 
+#: fused-engagement CONTRACT per solver (audited statically by
+#: analysis/jaxpr_audit.py): (fused `_fused_pass` call sites per
+#: iteration body with the tier on, whether the per-iteration
+#: vector-stream recount from the jaxpr must EXACTLY equal
+#: KRYLOV_VEC_STREAMS_FUSED). Declared next to the byte model it
+#: protects: if an iteration body loses its fused kernels (a silently
+#: dead Pallas path, an accidental decomposition), the audit fails
+#: before any benchmark runs. Solvers whose stream-table entry is per
+#: INNER step or an estimate (the restarted/recycling methods carry
+#: whole basis matrices through the outer body, which the audit weighs
+#: as k streams each) pin only the fused-pass count; the GMRES family's
+#: merged reductions are matvec ``stack_dots``, not ``_fused_pass``
+#: kernels, hence 0 there.
+KRYLOV_FUSED_PASSES = {
+    "CG":         (1, True),
+    "BiCGStab":   (1, True),
+    "BiCGStabL":  (2, False),
+    "GMRES":      (0, False),
+    "FGMRES":     (0, False),
+    "LGMRES":     (0, False),
+    "IDRs":       (5, False),
+    "Richardson": (0, False),
+    "PreOnly":    (0, False),
+}
+
+
+#: collective CONTRACT of the distributed Krylov bodies (audited
+#: statically): psums per iteration, elements the stacked psum carries,
+#: halo SpMVs per iteration. parallel/dist_solver.py prices its
+#: SolveReport comm model FROM this table (dots=psums,
+#: elems_per_dot=elems_per_psum), so the model and the traced program
+#: are checked against one declaration — a third psum sneaking back
+#: into dist_cg_pipelined fails the audit, not a chip session.
+DIST_CG_COLLECTIVES = {
+    "dist_cg":           {"psums": 3, "elems_per_psum": 1, "spmvs": 1},
+    "dist_cg_pipelined": {"psums": 1, "elems_per_psum": 3, "spmvs": 1},
+}
+
+
+#: donation CONTRACT per jitted entry point: how many argument buffers
+#: the lowered program is expected to alias into outputs. All zero
+#: today — the audit's informational finding is the standing reminder
+#: that ROADMAP item 1's resident solve loop wants donated x/r buffers;
+#: when that lands, this table changes in the same commit (or the audit
+#: fails CI).
+DONATION_CONTRACTS = {
+    "make_solver._solve_fn": 0,
+}
+
+
 def fused_vec_modeled() -> bool:
     """Whether the iteration model should charge the fused vector-tier
     byte counts — mirrors ops.fused_vec.fused_vec_enabled without

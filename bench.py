@@ -1578,13 +1578,50 @@ def main_check(targets=None):
                         k: src.get(k) for k in
                         ("gbps", "gflops", "frac_hbm_peak", "bound")
                         if src.get(k) is not None}
+    analysis_ok = True
+    if os.environ.get("AMGCL_TPU_ANALYSIS_IN_CHECK", "1") != "0":
+        # static-analysis gate (amgcl_tpu/analysis): AST lint vs the
+        # committed ANALYSIS_BASELINE.json findings budget + the jaxpr
+        # contract audit (collective census, fused-tier engagement,
+        # dtype/donation discipline). A subprocess, like the pytest
+        # run: the audit forces its own 8-virtual-device CPU topology.
+        a_timeout = float(os.environ.get("AMGCL_TPU_ANALYSIS_TIMEOUT",
+                                         "600"))
+        try:
+            ar = subprocess.run(
+                [sys.executable, "-m", "amgcl_tpu.analysis", "--json"],
+                capture_output=True, text=True, timeout=a_timeout,
+                cwd=_REPO, env=dict(os.environ))
+            arec = json.loads(ar.stdout.strip().splitlines()[-1])
+            audit = arec.get("audit", {})
+            analysis_ok = bool(arec.get("ok")) and ar.returncode == 0
+            rec["analysis"] = {
+                "ok": analysis_ok,
+                "lint_total": arec["lint"]["total"],
+                "lint_new": len(arec["lint"]["new"]),
+                "lint_suppressed": arec["lint"]["suppressed"],
+                "stale_suppressions":
+                    len(arec["lint"]["stale_suppressions"]),
+                "rules": arec["lint"]["rules"],
+                "audit_records": len(audit.get("records", [])),
+                "audit_errors": audit.get("errors", 0),
+            }
+            if not analysis_ok:
+                # the actionable payload rides the CI record
+                rec["analysis"]["new_findings"] = arec["lint"]["new"]
+                rec["analysis"]["audit_findings"] = [
+                    f for f in audit.get("findings", [])
+                    if f.get("severity") == "error"]
+        except Exception as e:
+            analysis_ok = False
+            rec["analysis"] = {"ok": False, "error": repr(e)[:300]}
     try:
         rec["trend"] = trend_summary()["rollups"]
     except Exception as e:
         rec["trend"] = {"error": repr(e)[:200]}
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
-    return 0 if (rc == 0 and gate_ok) else 1
+    return 0 if (rc == 0 and gate_ok and analysis_ok) else 1
 
 
 if __name__ == "__main__":

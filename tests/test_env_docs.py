@@ -1,54 +1,36 @@
-"""Env-knob lint (ISSUE 4 satellite): every ``AMGCL_TPU_*`` variable
-referenced under ``amgcl_tpu/`` must have a row in README's environment
-variable table — a knob nobody can discover is a knob that does not
-exist. Fails listing the missing names."""
+"""Env-knob documentation lint, asserted through the ONE implementation
+(ISSUE 6 satellite): ``analysis.lint``'s ``undocumented-knob`` rule owns
+the scan — every ``AMGCL_TPU_*`` variable referenced under ``amgcl_tpu/``
+must have a row in README's environment-variable table. A knob nobody
+can discover is a knob that does not exist."""
 
-import os
-import re
-
-_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-_VAR = re.compile(r"AMGCL_TPU_[A-Z0-9_]+")
-#: a documented row looks like ``| `AMGCL_TPU_X` | meaning |``
-_ROW = re.compile(r"\|\s*`(AMGCL_TPU_[A-Z0-9_]+)`")
-
-
-def _referenced_vars():
-    refs = set()
-    for root, dirs, files in os.walk(os.path.join(_REPO, "amgcl_tpu")):
-        dirs[:] = [d for d in dirs if d != "__pycache__"]
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(root, fn)) as f:
-                for match in _VAR.findall(f.read()):
-                    # prose like "AMGCL_TPU_PEAK_{GBPS,FLOPS}" leaves a
-                    # trailing-underscore stem — not a variable
-                    refs.add(match.rstrip("_"))
-    return refs
+from amgcl_tpu.analysis import lint
 
 
 def test_every_env_var_documented():
-    refs = _referenced_vars()
+    refs = lint.referenced_env_vars()
     assert refs, "lint is broken: no AMGCL_TPU_* references found"
-    with open(os.path.join(_REPO, "README.md")) as f:
-        documented = set(_ROW.findall(f.read()))
-    # a stem like AMGCL_TPU_PEAK (from "AMGCL_TPU_PEAK_{GBPS,FLOPS}"
-    # prose) is covered when longer documented names extend it
-    missing = sorted(v for v in refs - documented
-                     if not any(d.startswith(v + "_")
-                                for d in documented))
+    missing = lint.undocumented_knobs()
     assert not missing, (
         "env vars referenced under amgcl_tpu/ but missing from README's "
         "environment-variable table: %s" % ", ".join(missing))
 
 
+def test_rule_rides_run_lint():
+    """The same check fires as an `undocumented-knob` finding through
+    run_lint, so `python -m amgcl_tpu.analysis` and this test can never
+    disagree about what counts as documented."""
+    findings = lint.run_lint(rules=["undocumented-knob"])
+    assert [f["symbol"] for f in findings] == lint.undocumented_knobs()
+
+
 def test_table_covers_new_knobs():
-    """The knobs this PR added are in the table (guards against the
-    table regressing while the lint above is green only by accident)."""
-    with open(os.path.join(_REPO, "README.md")) as f:
-        documented = set(_ROW.findall(f.read()))
+    """Knobs recent PRs added are in the table (guards against the table
+    regressing while the lint above is green only by accident)."""
+    documented = lint.documented_env_vars()
     for var in ("AMGCL_TPU_TELEMETRY_MAX_BYTES", "AMGCL_TPU_PEAK_GBPS",
                 "AMGCL_TPU_PEAK_FLOPS", "AMGCL_TPU_COMPILE_WATCH",
                 "AMGCL_TPU_ROOFLINE_REPS", "AMGCL_TPU_FUSED_VEC",
-                "AMGCL_TPU_PIPELINED_CG"):
+                "AMGCL_TPU_PIPELINED_CG", "AMGCL_TPU_ANALYSIS_IN_CHECK",
+                "AMGCL_TPU_ANALYSIS_TIMEOUT"):
         assert var in documented, var

@@ -360,6 +360,11 @@ def test_gate_rides_check_record(monkeypatch, tmp_path):
     cand.write_text(json.dumps(dict(lg, iters=50)))
     monkeypatch.setenv("AMGCL_TPU_GATE_LAST_GOOD", str(lg_path))
     monkeypatch.setenv("AMGCL_TPU_GATE_CANDIDATE", str(cand))
+    # this test fakes subprocess.run for the pytest leg, which would
+    # also feed garbage to the static-analysis subprocess (ISSUE 6) —
+    # opt that gate out here; test_telemetry's bench-check test covers
+    # the analysis record end to end
+    monkeypatch.setenv("AMGCL_TPU_ANALYSIS_IN_CHECK", "0")
     recs = []
     monkeypatch.setattr(bench._stdout_sink, "emit",
                         lambda rec=None, **kw: recs.append(dict(rec or {})))

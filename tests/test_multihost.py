@@ -3,7 +3,13 @@ Gloo CPU collectives, one global mesh — the jax.distributed rendition of
 the reference's MPI scale-out (SURVEY.md §5.8). Worker scripts build
 distributed solvers over the global mesh and solve the Poisson fixture;
 the tests assert convergence AND iteration parity with a single-process
-mesh of the same size (multi-controller must not change the math)."""
+mesh of the same size (multi-controller must not change the math).
+
+Both tests are ``@pytest.mark.serial``: they spawn controller
+subprocesses that bind ports and race the Gloo init timeout, which is
+known to fail under concurrent host load. A failure here during a full
+suite run is NOT a regression signal until reproduced alone
+(``pytest tests/test_multihost.py -m serial``) — see README."""
 
 import os
 import socket
@@ -93,6 +99,7 @@ import jax.numpy as jnp, numpy as np
     return int(probe.stdout.split("ITERS")[1].split()[0])
 
 
+@pytest.mark.serial
 def test_two_process_dist_amg():
     outs, iters = _run_workers(r"""
 from amgcl_tpu.utils.sample_problem import poisson3d
@@ -128,6 +135,7 @@ print("ITERS", info.iters)
     assert iters[0] == single
 
 
+@pytest.mark.serial
 def test_two_process_strip_ingestion():
     """VERDICT r3 item 3: each controller holds only its row strips; the
     hierarchy is built with real cross-process exchanges (strip-parallel

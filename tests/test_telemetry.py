@@ -275,6 +275,7 @@ def test_pyamgcl_compat_report_shape():
     assert (it, err) == (solve.iterations, solve.error)
 
 
+@pytest.mark.serial
 @pytest.mark.parametrize("mesh", [0, 4], ids=["serial", "mesh4"])
 def test_cli_telemetry_smoke(tmp_path, mesh):
     """`python -m amgcl_tpu.cli --telemetry out.jsonl` end to end on CPU
@@ -318,6 +319,13 @@ def test_bench_check_emits_dots():
     assert rec["metric"] == "tier1_dots_passed"
     assert rec["value"] == 2, rec
     assert rec["rc"] == 0 and r.returncode == 0
+    # ISSUE 6: --check embeds the static-analysis gate as an `analysis`
+    # record (new lint findings or audit contract errors fail the check)
+    an = rec["analysis"]
+    assert an["ok"] is True, an
+    assert an["lint_new"] == 0 and an["audit_errors"] == 0
+    assert an["audit_records"] > 0
+    assert "bare-jit" in an["rules"]
 
 
 def test_bench_count_dots():
