@@ -35,6 +35,8 @@ from amgcl_tpu.models.runtime import make_solver_from_config
 from amgcl_tpu.models.preconditioner import AsPreconditioner, \
     DummyPreconditioner
 
+from amgcl_tpu.serve import SolverService
+
 __all__ = ["CSR", "AMG", "AMGParams", "make_solver", "make_block_solver",
            "deflated_solver", "make_solver_from_config", "AsPreconditioner",
-           "DummyPreconditioner", "__version__"]
+           "DummyPreconditioner", "SolverService", "__version__"]

@@ -65,7 +65,7 @@ import numpy as np
 __all__ = [
     "iter_eqns", "find_while_bodies", "collective_census",
     "vector_streams", "dtype_casts", "host_callbacks", "donation_audit",
-    "audit_solver", "audit_dist_cg", "audit_make_solver",
+    "audit_solver", "audit_dist_cg", "audit_make_solver", "audit_serve",
     "audit_entry_points", "run_audit", "format_report",
 ]
 
@@ -653,6 +653,56 @@ def audit_make_solver(mixed: bool = False, m: int = 8) -> Dict[str, Any]:
             "donation": don}
 
 
+def audit_serve(m: int = 8, batch: int = 2) -> Dict[str, Any]:
+    """Lower the resident serve loop's ACTUAL jit wrap
+    (serve/service.py: ``SolverService._entry``, iterate buffer donated
+    via ``donate_argnums``) over a stacked (n, B) probe and read the
+    input→output buffer aliasing out of the lowered program — the
+    static proof that the resident loop reuses its workspace instead of
+    allocating per batch (ROADMAP item 1's donation contract)."""
+    import jax.numpy as jnp
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.serve.service import SolverService
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.utils.sample_problem import poisson3d
+
+    A, rhs = poisson3d(m)
+    ms = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=50),
+                     solver=CG(maxiter=10))
+    svc = SolverService(ms, batch=batch)
+    rhs2 = jnp.tile(jnp.asarray(rhs, jnp.float32)[:, None], (1, batch))
+    x0 = jnp.zeros_like(rhs2)
+    don = donation_audit(svc._entry, ms.A_dev, ms.A_dev64,
+                         ms.precond.hierarchy, rhs2, x0)
+    return {"entry": "serve.solve_step", "n": len(rhs),
+            "batch": int(batch), "donation": don}
+
+
+def check_serve(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Donation contract of the resident loop: the lowered program must
+    alias exactly ``DONATION_CONTRACTS['serve.solve_step']`` argument
+    buffers (1 — the donated iterate). Zero means every batch allocates
+    fresh result storage; more means an undeclared donation landed."""
+    from amgcl_tpu.telemetry.ledger import DONATION_CONTRACTS
+    out = []
+    if rec.get("skipped"):
+        out.append({"severity": "info", "pass": "donation",
+                    "entry": rec["entry"], "message": rec["skipped"]})
+        return out
+    want = DONATION_CONTRACTS.get(rec["entry"], 0)
+    got = rec["donation"]["donated_args"]
+    if got != want:
+        out.append({
+            "severity": "error", "pass": "donation",
+            "entry": rec["entry"],
+            "message": "resident serve loop aliases %d arg buffer(s), "
+            "contract declares %d — the donated iterate buffer was "
+            "lost (or a new donation is undeclared); update "
+            "ledger.DONATION_CONTRACTS in the same commit" % (got, want)})
+    return out
+
+
 # ---------------------------------------------------------------------------
 # contract checks
 # ---------------------------------------------------------------------------
@@ -865,6 +915,9 @@ def run_audit(solvers: Optional[Sequence[str]] = None,
         rec = audit_make_solver(mixed=mixed)
         records.append(rec)
         findings += check_make_solver(rec)
+    rec = audit_serve()
+    records.append(rec)
+    findings += check_serve(rec)
     findings += check_entry_points()
     errors = [f for f in findings if f["severity"] == "error"]
     return {"records": records, "findings": findings,
@@ -895,6 +948,10 @@ def format_report(result: Dict[str, Any]) -> str:
         if "downcasts" in rec:
             bits.append("casts %dv/%d^ donated=%d" % (
                 rec["downcasts"], rec["upcasts"],
+                rec["donation"]["donated_args"]))
+        elif "donation" in rec:
+            bits.append("batch=%s donated=%d" % (
+                rec.get("batch", "-"),
                 rec["donation"]["donated_args"]))
         lines.append("  %-34s %s" % (rec["entry"], "  ".join(bits)))
     for f in result["findings"]:

@@ -58,7 +58,9 @@ def params_read_json(h: int, fname: str) -> None:
 
 
 def handle_destroy(h: int) -> None:
-    _handles.pop(h, None)
+    obj = _handles.pop(h, None)
+    if hasattr(obj, "close"):
+        obj.close()            # serve handles own a worker thread
 
 
 def _csr_from_addrs(n, ptr_addr, col_addr, val_addr, one_based):
@@ -142,10 +144,68 @@ def solver_solve(h, rhs_addr, x_addr, n):
     return int(info.iters), float(info.resid)
 
 
+def solver_solve_batch(h, rhs_addr, x_addr, n, nrhs):
+    """Stacked multi-RHS solve (serve/batched.py): ``rhs``/``x`` are
+    ``nrhs`` contiguous length-``n`` vectors (C layout: vector-major).
+    One compiled dispatch retires every right-hand side; per-request
+    convergence is masked per column on device. ``x`` holds the initial
+    guesses on entry (all-zero = cold start) and the solutions on exit.
+    Returns (max_iters, max_resid) across the batch — the latency-SLO
+    numbers; per-request detail is on the Python-side report."""
+    s = _handles[h]
+    rhs = np.asarray(_view(rhs_addr, n * nrhs, ctypes.c_double))
+    x = _view(x_addr, n * nrhs, ctypes.c_double)
+    rhs2 = rhs.reshape(nrhs, n).T                     # -> (n, B) columns
+    x2 = np.asarray(x).reshape(nrhs, n).T
+    got, info = s(rhs2, x0=x2 if np.any(x2) else None)
+    x[:] = np.asarray(got, dtype=np.float64).T.ravel()
+    return int(info.iters), float(info.resid)
+
+
+def serve_create(solver_h, batch=0) -> int:
+    """Resident solve loop over an existing solver handle
+    (serve/service.py): compiled once per (shape, B) bucket, iterate
+    buffers donated, device sync at batch boundaries. Returns a service
+    handle; destroy with ``handle_destroy`` (drains + stops the
+    worker)."""
+    from amgcl_tpu.serve import SolverService
+    s = _handles[solver_h]
+    if hasattr(s, "inner"):            # make_block_solver wraps
+        s = s.inner
+    return _register(SolverService(s, batch=int(batch) or None).start())
+
+
+def serve_solve(h, rhs_addr, x_addr, n, nrhs):
+    """Push ``nrhs`` requests (layout as ``solver_solve_batch``) through
+    the service queue and wait for all of them — the batching/flush
+    behavior is the service's. Returns (max_iters, max_resid)."""
+    svc = _handles[h]
+    rhs = np.asarray(_view(rhs_addr, n * nrhs, ctypes.c_double))
+    x = _view(x_addr, n * nrhs, ctypes.c_double).reshape(nrhs, n)
+    futs = [svc.submit(rhs[k * n:(k + 1) * n], block=True)
+            for k in range(nrhs)]
+    worst_it, worst_res = 0, 0.0
+    for k, fut in enumerate(futs):
+        xk, rep = fut.result(timeout=svc.timeout_s + 120)
+        x[k, :] = np.asarray(xk, np.float64)
+        worst_it = max(worst_it, int(rep.iters))
+        worst_res = max(worst_res, float(rep.resid))
+    return worst_it, worst_res
+
+
+def serve_stats(h) -> str:
+    """JSON text of the service's lifetime stats (requests, batches,
+    solves/sec, latency percentiles)."""
+    return json.dumps(_handles[h].stats())
+
+
 def handle_n(h) -> int:
     """Scalar system size of the solver/preconditioner behind a handle."""
     obj = _handles[h]
     if isinstance(obj, _PrecondApply):
+        return obj.n
+    from amgcl_tpu.serve.service import SolverService
+    if isinstance(obj, SolverService):
         return obj.n
     if hasattr(obj, "inner"):          # make_block_solver wraps make_solver
         obj = obj.inner

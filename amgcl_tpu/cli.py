@@ -37,6 +37,18 @@ def main(argv=None):
                     help="with --mesh: build the hierarchy strip-parallel "
                          "(distributed transpose/SpGEMM, no global "
                          "assembly — precond.class=strip_amg)")
+    ap.add_argument("--serve", type=int, default=0, metavar="N",
+                    help="solve-as-a-service smoke run: feed N requests "
+                         "(the rhs, rescaled per request) through a "
+                         "resident SolverService (batched multi-RHS, "
+                         "donated buffers, async bounded queue) and "
+                         "print the per-request iterations plus the "
+                         "service throughput/latency stats; with "
+                         "--telemetry the per-batch 'serve' events ride "
+                         "the same sink")
+    ap.add_argument("--serve-batch", type=int, default=0, metavar="B",
+                    help="batch bucket for --serve (default: the "
+                         "AMGCL_TPU_SERVE_BATCH env knob, then 8)")
     ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
     ap.add_argument("-x", "--x0", help="initial guess file")
     ap.add_argument("--telemetry", metavar="PATH",
@@ -164,8 +176,39 @@ def main(argv=None):
         x0 = np.asarray(aio.read_binary(args.x0)
                         if args.x0.endswith(".bin")
                         else aio.mm_read(args.x0)).ravel()
-    with prof.scope("solve"):
-        x, info = solve(rhs, x0)
+    if args.serve:
+        if args.mesh or args.reorder:
+            ap.error("--serve supports the plain serial bundle only "
+                     "(no --mesh / --reorder yet)")
+        from amgcl_tpu.models.make_solver import make_solver as _ms
+        if not isinstance(solve, _ms):
+            ap.error("--serve needs a make_solver bundle; the current "
+                     "configuration built %r" % type(solve).__name__)
+        from amgcl_tpu.serve import SolverService
+        with prof.scope("serve"):
+            with SolverService(solve, batch=args.serve_batch
+                               or None) as svc:
+                # rescale per request: distinct solves, same hierarchy
+                futs = [svc.submit(rhs * (1.0 + 0.25 * k), x0=x0,
+                                   block=True)
+                        for k in range(args.serve)]
+                results = [f.result(timeout=svc.timeout_s + 120)
+                           for f in futs]
+                stats = svc.stats()
+        x, info = results[0]
+        print("serve: %d request(s), batch bucket %d"
+              % (args.serve, svc.batch))
+        print("  iters per request: %s"
+              % " ".join(str(r[1].iters) for r in results))
+        if stats.get("solves_per_sec") is not None:
+            print("  throughput: %.2f solves/s" % stats["solves_per_sec"])
+        lat = stats.get("latency_s") or {}
+        if lat:
+            print("  latency: p50 %.4fs  p99 %.4fs  max %.4fs"
+                  % (lat["p50"], lat["p99"], lat["max"]))
+    else:
+        with prof.scope("solve"):
+            x, info = solve(rhs, x0)
 
     inner = getattr(solve, "solve", solve)
     precond_obj = getattr(inner, "precond", None) \

@@ -102,8 +102,13 @@ class Hierarchy:
                     return self.coarse.solve(f)
                 u = lv.relax.apply(lv.A, f)
                 return u
+        # prebuilt fused-sweep kernels carry exact 1-D shapes and call
+        # pallas_call without re-checking the gates — a stacked/vmapped
+        # trace (pallas_locally_disabled) must take the composed path
+        from amgcl_tpu.ops.pallas_spmv import pallas_locally_disabled
+        fused_ok = not pallas_locally_disabled()
         fc = None
-        if self.npre == 1 and lv.down is not None \
+        if self.npre == 1 and fused_ok and lv.down is not None \
                 and lv.down.w is not None:
             # whole down-sweep in one pass: pre-smooth from zero,
             # residual, filtered tentative restriction
@@ -117,7 +122,7 @@ class Hierarchy:
                         u = lv.relax.apply_pre(lv.A, f, u)
                 else:
                     u = dev.clear(f)
-            if lv.down is not None:
+            if fused_ok and lv.down is not None:
                 # one-pass residual + filtered tentative restriction
                 with phase("level%d/restrict" % i):
                     fc = lv.down(f, u)
@@ -129,7 +134,7 @@ class Hierarchy:
         for _ in range(self.ncycle - 1):      # W-cycle: extra coarse visits
             rc = dev.residual(fc, self.levels[i + 1].A, uc)
             uc = uc + self.cycle(i + 1, rc)
-        if lv.up is not None and self.npost >= 1:
+        if fused_ok and lv.up is not None and self.npost >= 1:
             # one-pass prolong + correct + first post-smoothing sweep
             with phase("level%d/up_fused" % i):
                 u = lv.up(f, u, uc)
@@ -145,7 +150,22 @@ class Hierarchy:
         return u
 
     def apply(self, r):
-        """Preconditioner application (amg.hpp:288-297): pre_cycles cycles."""
+        """Preconditioner application (amg.hpp:288-297): pre_cycles cycles.
+
+        Accepts a stacked ``(n, B)`` residual block (serve/batched.py):
+        the cycle is vmapped over the trailing batch axis, so ONE XLA
+        program runs the whole V-cycle for B right-hand sides — every
+        level operator is read once per sweep regardless of B once XLA
+        batches the level matvecs."""
+        if getattr(r, "ndim", 1) == 2:
+            import jax
+            from amgcl_tpu.ops.pallas_spmv import pallas_disabled
+            # the 1-D hand kernels (incl. the prebuilt fused sweeps) do
+            # not carry a batch axis — the stacked trace takes the XLA
+            # lowerings, which batch natively under vmap; thread-local,
+            # so concurrent single-rhs traces keep their kernels
+            with pallas_disabled():
+                return jax.vmap(self.apply, in_axes=1, out_axes=1)(r)
         x = self.cycle(0, r)
         for _ in range(self.pre_cycles - 1):
             rr = dev.residual(r, self.levels[0].A, x)

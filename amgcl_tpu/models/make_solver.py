@@ -43,7 +43,12 @@ class make_solver:
 
     def __init__(self, A, precond: Any = None, solver: Any = None,
                  solver_dtype=None, matrix_format: str = "auto",
-                 refine: int = 0, refine_dtype: str = "auto"):
+                 refine: int = 0, refine_dtype: str = "auto",
+                 batch: Any = None):
+        # ``batch``: declared multi-RHS bucket size (serve/): ``__call__``
+        # accepts a stacked (n, B) rhs regardless; the declared value is
+        # the default bucket a SolverService built on this bundle uses
+        self.batch = int(batch) if batch else None
         if not isinstance(A, CSR):
             A = CSR.from_scipy(A)
         self.A_host = A
@@ -382,16 +387,22 @@ class make_solver:
 
     def __call__(self, rhs, x0=None):
         n = self.A_host.nrows * self.A_host.block_size[0]
-        if np.shape(rhs) != (n,):
+        shp = np.shape(rhs)
+        batched = len(shp) == 2
+        if not (shp == (n,) or (batched and shp[0] == n and shp[1] >= 1)):
             raise ValueError(
-                "rhs has shape %s but the system has %d unknowns"
-                % (np.shape(rhs), n))
+                "rhs has shape %s but the system has %d unknowns "
+                "(stacked multi-RHS must be (n, B))" % (shp, n))
+        if batched and self.refine > 0:
+            raise ValueError(
+                "stacked multi-RHS solves do not support iterative "
+                "refinement yet; build the bundle with refine=0")
         rhs = jnp.asarray(rhs, dtype=self.solver_dtype)
         if x0 is not None:
-            if np.shape(x0) != (n,):
+            if np.shape(x0) != shp:
                 raise ValueError(
-                    "x0 has shape %s but the system has %d unknowns"
-                    % (np.shape(x0), n))
+                    "x0 has shape %s but rhs has shape %s"
+                    % (np.shape(x0), shp))
             x0 = jnp.asarray(x0, dtype=self.solver_dtype)
         else:
             x0 = jnp.zeros_like(rhs)
@@ -410,16 +421,44 @@ class make_solver:
         # for hist/health pass through device_get as empty pytree nodes)
         iters, resid, hist_buf, hist_n, hstate = jax.device_get(got[1:6])
         hist = None
-        if hist_buf is not None:
+        per_rhs = None
+        if batched:
+            # per-column convergence record; the headline iters/resid
+            # are the batch maxima (the numbers a latency SLO cares
+            # about), per-column detail rides ``extra["per_rhs"]``
+            per_rhs = {"iters": [int(v) for v in np.atleast_1d(iters)],
+                       "resid": [float(v) for v in np.atleast_1d(resid)]}
+            if hist_buf is not None:
+                # (B, maxiter) with per-column recorded counts (== the
+                # per-column iters; refine is gated off when batched):
+                # slice each column by its own count, headline history =
+                # the slowest column's (matches the headline iters)
+                hb = np.asarray(hist_buf)
+                hn = per_rhs["iters"]
+                per_rhs["history"] = [hb[b, :hn[b]].tolist()
+                                      for b in range(hb.shape[0])]
+                hist = hb[int(np.argmax(hn)), :max(hn)]
+            iters = max(per_rhs["iters"])
+            resid = max(per_rhs["resid"])
+        elif hist_buf is not None:
             # slice by the recorded count — NaN filtering would also drop
             # genuine NaN residuals from a breakdown
             hist = np.asarray(hist_buf)[:int(hist_n)]
         health = None
         if hstate is not None:
             from amgcl_tpu.telemetry import health as _health
-            health = _health.decode(hstate.flags, hstate.first_it)
+            if batched:
+                from amgcl_tpu.serve.batched import decode_batched_health
+                health = decode_batched_health(
+                    np.atleast_1d(np.asarray(hstate.flags)),
+                    np.atleast_2d(np.asarray(hstate.first_it)))
+            else:
+                health = _health.decode(hstate.flags, hstate.first_it)
         wall = time.perf_counter() - t0
         extra = {"first_call": True} if first_call else {}
+        if batched:
+            extra["batch"] = int(shp[1])
+            extra["per_rhs"] = per_rhs
         if first_call and self.refine_mode == "df32":
             # satellite of _df32_selfcheck: the standalone-jit check ran
             # the residual kernel ALONE — the full _solve_fn program fuses
@@ -443,6 +482,23 @@ class make_solver:
                            "totals": {"traces": cw1["traces"],
                                       "compile_s": cw1["compile_s"]}}
         resources = self._resources()
+        if batched and resources and "error" not in resources:
+            # per-iteration model with the batch axis: operator reads
+            # amortize over B, vector streams and FLOPs scale with it
+            # (ledger.krylov_iteration_model) — a copy, so the cached
+            # single-rhs model keeps pricing unbatched calls
+            try:
+                from amgcl_tpu.telemetry import ledger as _ledger
+                resources = dict(resources)
+                resources["per_iteration"] = \
+                    _ledger.krylov_iteration_model(
+                        type(self.solver).__name__, self.A_dev,
+                        (resources.get("cycle") or {}).get("total"),
+                        getattr(getattr(self.precond, "prm", None),
+                                "pre_cycles", 1),
+                        batch=int(shp[1]))
+            except Exception:
+                pass
         try:
             # whole-solve roofline (telemetry/roofline.py): achieved
             # GB/s / GFLOP/s of this call from the ledger's per-iteration
@@ -460,6 +516,8 @@ class make_solver:
             pass                 # roofline must never fail a solve
         report = SolveReport(
             int(iters), float(resid), hist, wall_time_s=wall,
+            solves_per_sec=round(shp[1] / wall, 3)
+            if batched and wall > 0 else None,
             solver=type(self.solver).__name__,
             hierarchy=self._hierarchy_stats(),
             resources=resources,

@@ -45,10 +45,15 @@ from amgcl_tpu.models.make_solver import make_solver
 from amgcl_tpu.models.preconditioner import AsPreconditioner, \
     DummyPreconditioner
 
+from amgcl_tpu.serve.batched import BlockCG
+
 SOLVERS = {
     "cg": CG, "bicgstab": BiCGStab, "bicgstabl": BiCGStabL,
     "gmres": GMRES, "fgmres": FGMRES, "lgmres": LGMRES, "idrs": IDRs,
     "richardson": Richardson, "preonly": PreOnly,
+    # serve/batched.py: true block CG over one shared Krylov subspace
+    # (stacked multi-RHS native; a 1-D rhs runs as B=1)
+    "blockcg": BlockCG,
 }
 
 RELAXATION = {

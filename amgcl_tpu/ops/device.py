@@ -79,6 +79,22 @@ class DiaMatrix:
 
     def mv(self, x):
         n, m = self.shape
+        if x.ndim == 2:
+            # stacked (m, B) operand (serve/batched.py): same shifted
+            # multiply-add sequence, each diagonal broadcast across the B
+            # columns — ONE read of the matrix data retires B right-hand
+            # sides (the batched-bytes amortization the ledger models)
+            lo = min(self.offsets + (0,))
+            base = -lo if lo < 0 else 0
+            hi = max(max(self.offsets + (0,)) + n - m, 0)
+            xp = jnp.pad(x, ((base, hi), (0, 0)))
+            y = jnp.zeros((n, x.shape[1]),
+                          dtype=jnp.result_type(self.dtype, x.dtype))
+            for k, d in enumerate(self.offsets):
+                seg = lax.dynamic_slice(xp, (base + d, 0),
+                                        (n, x.shape[1]))
+                y = y + self.data[k][:, None] * seg
+            return y
         from amgcl_tpu.ops.pallas_spmv import dia_spmv
         ip = self._pallas_mode(x)
         if ip is not None:
@@ -128,10 +144,22 @@ class EllMatrix:
     def mv(self, x):
         br, bc = self.block
         if (br, bc) == (1, 1):
+            if x.ndim == 2:
+                # stacked (m, B): one gather of the column table serves
+                # every right-hand side
+                xg = jnp.take(x, self.cols, axis=0)      # (n, K, B)
+                return jnp.einsum("nk,nkb->nb", self.vals, xg,
+                                  preferred_element_type=jnp.result_type(
+                                      self.dtype, x.dtype))
             xg = jnp.take(x, self.cols, axis=0)          # (n, K)
             return jnp.einsum("nk,nk->n", self.vals, xg,
                               preferred_element_type=jnp.result_type(
                                   self.dtype, x.dtype))
+        if x.ndim == 2:
+            # block values with stacked operands: per-column fallback —
+            # the block gather/einsum is written against the logical
+            # (mcols, bc) layout of ONE rhs
+            return jax.vmap(self.mv, in_axes=1, out_axes=1)(x)
         xb = x.reshape(self.shape[1], bc)
         xg = jnp.take(xb, self.cols, axis=0)             # (n, K, bc)
         y = jnp.einsum("nkij,nkj->ni", self.vals, xg,
@@ -415,9 +443,25 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
 # the operator's device format, so a jax.profiler trace attributes device
 # time to "spmv/DiaMatrix", "residual/EllMatrix", ... — zero runtime cost.
 
+#: formats whose ``mv`` accepts stacked (m, B) operands natively; any
+#: other format goes through a vmap at the :func:`spmv` seam so the whole
+#: backend is stacked-capable without every kernel learning a batch axis
+_STACKED_MV = (DiaMatrix, EllMatrix, DenseMatrix)
+
+
 def spmv(A, x):
-    """y = A x."""
+    """y = A x. Accepts a stacked ``(m, B)`` operand: formats with a
+    native batched ``mv`` (DIA/ELL/Dense) amortize the matrix read over
+    the B columns; others fall back to a vmap over columns."""
     with _phase("spmv/" + type(A).__name__):
+        if getattr(x, "ndim", 1) == 2 \
+                and not isinstance(A, _STACKED_MV):
+            # the vmapped 1-D mv must trace its XLA lowering — the hand
+            # kernels carry exact 1-D shapes (same rule as vmap_solve /
+            # Hierarchy.apply's stacked branch)
+            from amgcl_tpu.ops.pallas_spmv import pallas_disabled
+            with pallas_disabled():
+                return jax.vmap(A.mv, in_axes=1, out_axes=1)(x)
         return A.mv(x)
 
 
@@ -434,6 +478,10 @@ def residual(f, A, x):
 
 
 def _residual(f, A, x):
+    if getattr(x, "ndim", 1) == 2:
+        # stacked operands: the fused single-rhs kernels do not apply —
+        # compose through the (batched) spmv seam
+        return f - spmv(A, x)
     if isinstance(A, DiaMatrix):
         ip = A._pallas_mode(x, f)
         if ip is not None:

@@ -119,6 +119,54 @@ def _acc_dtype(*vecs):
 
 
 # ---------------------------------------------------------------------------
+# stacked (n, B) tier — batched multi-RHS operands (serve/batched.py)
+# ---------------------------------------------------------------------------
+#
+# Every primitive also accepts stacked (n, B) operands with per-column
+# scalars of shape (B,) (or broadcastable scalars) and returns per-column
+# dot VECTORS of shape (B,) in the scalar slots. The stacked tier is a
+# plain-XLA composition: the elementwise update is one fused pass over
+# the (n, B) block either way, and the per-column reductions read the
+# freshly produced block once — the per-dispatch win batching is after
+# comes from retiring B right-hand sides per XLA program, not from a
+# hand kernel. (A Pallas batched kernel is a follow-up; the single-rhs
+# kernels keep their exact shapes.)
+
+def is_stacked(*vecs) -> bool:
+    """True when any operand carries a trailing batch axis (n, B)."""
+    return any(getattr(v, "ndim", 1) == 2 for v in vecs)
+
+
+def _colscal(a):
+    """Broadcast a per-column scalar vector (B,) against (n, B) blocks;
+    plain scalars pass through untouched."""
+    a = jnp.asarray(a)
+    return a[None, :] if a.ndim == 1 else a
+
+
+def col_dots(x, y):
+    """Per-column conjugated inner products of stacked operands:
+    ``(B,)`` vector of ``⟨x[:, b], y[:, b]⟩`` from one read of each."""
+    xc = jnp.conj(x) if jnp.issubdtype(x.dtype, jnp.complexfloating) \
+        else x
+    return jnp.einsum("nb,nb->b", xc, y)
+
+
+def _seam_col_dot(kind, axis, ip, x, y):
+    """One per-column dot vector through the inner-product seam: plain
+    fuses to a single einsum, psum globalizes the (B,) partial vector in
+    ONE collective, opaque composes ``ip`` column by column."""
+    if kind == "opaque":
+        return jax.vmap(lambda xc, yc: ip(xc, yc),
+                        in_axes=1, out_axes=0)(x, y)
+    d = col_dots(x, y)
+    if kind == "psum":
+        from jax import lax
+        d = lax.psum(d, axis)
+    return d
+
+
+# ---------------------------------------------------------------------------
 # the shared elementwise-update + in-register-reduction kernel
 # ---------------------------------------------------------------------------
 #
@@ -230,9 +278,14 @@ def _zero_dot(*vecs):
 # ---------------------------------------------------------------------------
 
 def axpby_dot(a, x, b, y, ip=None):
-    """``(z, ⟨z, z⟩)`` with ``z = a·x + b·y`` in one pass."""
+    """``(z, ⟨z, z⟩)`` with ``z = a·x + b·y`` in one pass. Stacked
+    (n, B) operands (per-column ``a``/``b`` of shape (B,) allowed)
+    return a (B,) per-column dot vector."""
     from amgcl_tpu.ops import device as dev
     kind, axis = _seam(ip)
+    if is_stacked(x, y):
+        z = _colscal(a) * x + _colscal(b) * y
+        return z, _seam_col_dot(kind, axis, ip, z, z)
     if x.shape[0] == 0:
         return x, _zero_dot(x, y)
     m = _pallas_mode(x, y) if kind != "opaque" else None
@@ -250,9 +303,16 @@ def axpby_dot(a, x, b, y, ip=None):
 def xr_update(alpha, p, q, x, r, ip=None):
     """The CG/IDR(s) iteration tail in one pass:
     ``(x + α·p, r − α·q, ⟨r_new, r_new⟩)`` — one read of {p, q, x, r},
-    one write of {x, r}, residual reduction in-register."""
+    one write of {x, r}, residual reduction in-register. Stacked (n, B)
+    operands with per-column ``alpha`` (B,) return a (B,) residual-dot
+    vector."""
     from amgcl_tpu.ops import device as dev
     kind, axis = _seam(ip)
+    if is_stacked(p, q, x, r):
+        a = _colscal(alpha)
+        xn = x + a * p
+        rn = r - a * q
+        return xn, rn, _seam_col_dot(kind, axis, ip, rn, rn)
     if x.shape[0] == 0:
         return x, r, _zero_dot(x, r)
     m = _pallas_mode(p, q, x, r) if kind != "opaque" else None
@@ -274,9 +334,17 @@ def bicgstab_tail(alpha, phat, omega, shat, s, t, x, rhat, ip=None):
     ``x_n = x + α·phat + ω·shat``, ``r_n = s − ω·t``, returning
     ``(x_n, r_n, ⟨r_n, r_n⟩, ⟨rhat, r_n⟩)``. The second dot is the NEXT
     iteration's ``rho`` — fusing it here removes a whole reduction pass
-    (and, distributed, a whole collective) per iteration."""
+    (and, distributed, a whole collective) per iteration. Stacked (n, B)
+    operands with per-column ``alpha``/``omega`` return (B,) dot
+    vectors."""
     from amgcl_tpu.ops import device as dev
     kind, axis = _seam(ip)
+    if is_stacked(phat, shat, s, t, x, rhat):
+        a, w = _colscal(alpha), _colscal(omega)
+        xn = x + a * phat + w * shat
+        rn = s - w * t
+        return (xn, rn, _seam_col_dot(kind, axis, ip, rn, rn),
+                _seam_col_dot(kind, axis, ip, rhat, rn))
     if x.shape[0] == 0:
         z = _zero_dot(x, s)
         return x, s, z, z
@@ -306,6 +374,8 @@ def multi_dot(x, ys, ip=None):
     from amgcl_tpu.ops import device as dev
     ys = tuple(ys)
     kind, axis = _seam(ip)
+    if is_stacked(x, *ys):
+        return tuple(_seam_col_dot(kind, axis, ip, x, y) for y in ys)
     if kind == "opaque":
         return tuple(ip(x, y) for y in ys)
     if x.shape[0] == 0:
@@ -354,9 +424,13 @@ def residual_dot(f, A, x, ip=None):
     reduction in ONE operator pass on the DIA Pallas path (the composed
     form re-reads r from HBM just to reduce it). Other formats compose
     ``ops.device.residual`` (itself fused where a kernel exists) with
-    the seam dot."""
+    the seam dot. Stacked (f, x) of shape (n, B) return ``r`` (n, B)
+    and a (B,) per-column dot vector."""
     from amgcl_tpu.ops import device as dev
     kind, axis = _seam(ip)
+    if is_stacked(f, x):
+        r = dev.residual(f, A, x)
+        return r, _seam_col_dot(kind, axis, ip, r, r)
     if kind != "opaque" and isinstance(A, dev.DiaMatrix) \
             and A.shape[0] == A.shape[1] and fused_vec_enabled():
         m = A._pallas_mode(x, f)

@@ -37,6 +37,38 @@ def pallas_enabled() -> bool:
     return os.environ.get("AMGCL_TPU_PALLAS", "1") != "0"
 
 
+# -- thread-local Pallas opt-out (stacked/vmapped traces) -------------------
+#
+# The batched multi-RHS traces (serve/batched.py, Hierarchy.apply's 2-D
+# branch) vmap over bodies whose hand kernels carry exact 1-D shapes, so
+# they must trace the XLA lowerings instead. A process-env override
+# would RACE concurrent traces on other threads (the serve worker thread
+# compiles batched buckets while the main thread may be tracing a
+# single-rhs program); this thread-local is exact: it scopes to the
+# tracing thread for the duration of the context.
+
+import contextlib
+import threading
+
+_TLS = threading.local()
+
+
+@contextlib.contextmanager
+def pallas_disabled():
+    """Disable every Pallas gate on THIS thread for the duration of a
+    trace (re-entrant)."""
+    prev = getattr(_TLS, "disabled", 0)
+    _TLS.disabled = prev + 1
+    try:
+        yield
+    finally:
+        _TLS.disabled = prev
+
+
+def pallas_locally_disabled() -> bool:
+    return getattr(_TLS, "disabled", 0) > 0
+
+
 def pallas_interpret_forced() -> bool:
     """AMGCL_TPU_PALLAS_INTERPRET=1 routes the DIA dispatch seams through
     the Pallas kernels in interpret mode on NON-TPU backends — a test hook
@@ -103,7 +135,7 @@ def pallas_mode(*dtypes):
     participating dtypes must be <= 32-bit (Mosaic's f64 vector support
     is partial)."""
     import jax
-    if not pallas_enabled():
+    if not pallas_enabled() or pallas_locally_disabled():
         return None
     if any(jnp.dtype(d).itemsize > 4 for d in dtypes):
         return None

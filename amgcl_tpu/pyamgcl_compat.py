@@ -59,7 +59,12 @@ class solver:
     preconditioner and ``prm`` flat solver params ({"type", "tol",
     "maxiter", ...}); callable as ``solve(rhs)`` or ``solve(A_new, rhs)``
     (new matrix, same preconditioner — the reference's non-steady-state
-    workflow)."""
+    workflow).
+
+    A stacked ``(n, B)`` rhs solves every column in ONE dispatch
+    (serve/batched.py — JAX-AMG's stacked-operand API shape):
+    ``iterations``/``error`` then report the batch maxima and
+    ``last_report.extra["per_rhs"]`` the per-column detail."""
 
     def __init__(self, P: amgcl, prm=None):
         self.P = P

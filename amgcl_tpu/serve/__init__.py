@@ -1,0 +1,21 @@
+"""Solve-as-a-service: batched multi-RHS Krylov + the resident solver
+loop (ROADMAP item 1).
+
+Two legs:
+
+* ``serve.batched`` — stacked ``(n, B)`` operands through every Krylov
+  solver (the ``rhs.ndim == 2`` entry seam in each solver body routes
+  here), with per-RHS convergence masking, per-RHS health guards, and
+  a true block-CG sharing one Krylov subspace.
+* ``serve.service`` — :class:`SolverService`: one resident compiled
+  program per (shape, B) bucket with donated iterate buffers, a
+  bounded async request queue, and a device sync only at batch
+  boundaries.
+"""
+
+from amgcl_tpu.serve.batched import (BlockCG, decode_batched_health,
+                                     vmap_solve)
+from amgcl_tpu.serve.service import SolverService
+
+__all__ = ["BlockCG", "SolverService", "decode_batched_health",
+           "vmap_solve"]

@@ -30,6 +30,10 @@ class BiCGStab(HistoryMixin):
         if self.precond_side not in ("left", "right"):
             raise ValueError("precond_side must be 'left' or 'right', got %r"
                              % self.precond_side)
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py)
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product)
         left = self.precond_side == "left"
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0

@@ -41,6 +41,10 @@ class BiCGStabL(HistoryMixin):
     guard: bool = True    # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py)
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product)
         dot = inner_product
         Lp = self.L
         if self.pside not in ("left", "right"):

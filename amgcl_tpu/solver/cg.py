@@ -34,6 +34,12 @@ class CG(HistoryMixin):
         function r -> approximate solution of A z = r. ``abstol`` may be a
         traced value (used by iterative refinement to stop correction solves
         exactly at the global target)."""
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py): one program
+            # retires every column, per-RHS convergence masking + guards
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product,
+                              abstol=abstol)
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
         # fused residual + <r,r> (ops/fused_vec.py): one operator pass

@@ -183,6 +183,10 @@ class GMRES(HistoryMixin):
     flexible = False
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py)
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product)
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
         if self.pside not in ("left", "right"):

@@ -57,6 +57,12 @@ class IDRs(HistoryMixin):
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product,
               row_index=None, n_valid=None):
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py); the shadow-
+            # space row index plumbing stays per-column-identical
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product,
+                              row_index=row_index, n_valid=n_valid)
         dot = inner_product
         s = self.s
         n = rhs.shape[0]

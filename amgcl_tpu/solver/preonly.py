@@ -19,6 +19,10 @@ class PreOnly(HistoryMixin):
     guard: bool = True      # NaN detection only (no loop to guard)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py)
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product)
         from amgcl_tpu.telemetry import health as H
         x = precond(rhs)
         r = dev.residual(rhs, A, x)

@@ -22,6 +22,10 @@ class Richardson(HistoryMixin):
     guard: bool = True      # in-loop health guards (telemetry/health.py)
 
     def solve(self, A, precond, rhs, x0=None, inner_product=dev.inner_product):
+        if rhs.ndim == 2:
+            # stacked multi-RHS entry (serve/batched.py)
+            from amgcl_tpu.serve.batched import vmap_solve
+            return vmap_solve(self, A, precond, rhs, x0, inner_product)
         dot = inner_product
         x = jnp.zeros_like(rhs) if x0 is None else x0
         norm_rhs = jnp.sqrt(jnp.abs(dot(rhs, rhs)))

@@ -82,6 +82,7 @@ DECLARED_ENTRY_POINTS = (
     "parallel.dist_mis",
     "parallel.dist_stencil_cg",
     "pyamgcl_compat.precond_apply",
+    "serve.solve_step",
     "solver.direct.device_inv",
 )
 

@@ -62,6 +62,14 @@ class SolveReport:
     #: program, cumulative signature count, and whether this call was a
     #: compile-cache hit. None with AMGCL_TPU_COMPILE_WATCH=0
     compile: Optional[Dict[str, Any]] = None
+    #: serving throughput: right-hand sides retired per second by this
+    #: call (batched solves: B / wall) or by the service window it
+    #: summarizes (serve/service.py). None for plain single solves
+    solves_per_sec: Optional[float] = None
+    #: per-request latency percentiles of the serve window this report
+    #: summarizes ({"p50": s, "p99": s, ...} — telemetry/metrics.py
+    #: interpolated percentiles). None outside the serving path
+    latency: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -107,6 +115,10 @@ class SolveReport:
             out["health"] = self.health
         if self.compile is not None:
             out["compile"] = self.compile
+        if self.solves_per_sec is not None:
+            out["solves_per_sec"] = self.solves_per_sec
+        if self.latency is not None:
+            out["latency"] = self.latency
         if self.extra:
             out.update(self.extra)
         return out
@@ -122,6 +134,8 @@ class SolveReport:
             lines.append("Rate:       %.3g /iter" % self.convergence_rate)
         if self.wall_time_s is not None:
             lines.append("Wall time:  %.4f s" % self.wall_time_s)
+        if self.solves_per_sec is not None:
+            lines.append("Throughput: %.2f solves/s" % self.solves_per_sec)
         if self.health is not None and not self.health.get("ok", True):
             lines.append("Health:     %s"
                          % ", ".join(self.health.get("flags", [])))

@@ -41,8 +41,17 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
     python bench.py --vecbench [n ...]
                                     # microbenchmark: fused vector kernels
                                     # (ops/fused_vec.py) vs the composed
-                                    # axpby+dot per vector size, emitted
+                                    # axpby+dot per vector size (including
+                                    # the stacked (n, B) tier), emitted
                                     # as a bench_vecbench JSONL record
+    python bench.py --throughput [B ...]
+                                    # serving throughput: solves/sec of the
+                                    # stacked multi-RHS path at B in
+                                    # {1, 8, 32} (or the given list) vs the
+                                    # honest un-chained single-solve rate;
+                                    # emitted as a bench_throughput JSONL
+                                    # record and gated round-over-round via
+                                    # AMGCL_TPU_GATE_THROUGHPUT
 
 All JSON emission routes through the telemetry sink
 (amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
@@ -1109,6 +1118,18 @@ def main_worker():
                 "speedup_vs_f32": round(t_solve / t16, 3)}
         except Exception as e:
             _PARTIAL["bf16"] = {"error": repr(e)}
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_THROUGHPUT") == "1") \
+            and _enough("throughput", 200):
+        # serving throughput (serve/): stacked multi-RHS solves/sec at
+        # B in {1, 8, 32} vs the honest un-chained single rate — the
+        # gate's AMGCL_TPU_GATE_THROUGHPUT metric (ROADMAP item 1's
+        # acceptance: b32 >= 4x the un-chained single-solve rate)
+        _stage("throughput")
+        try:
+            _PARTIAL["throughput"] = _bench_throughput(solver, rhs_dev,
+                                                       on_tpu)
+        except Exception as e:
+            _PARTIAL["throughput"] = {"error": repr(e)[:200]}
     if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_UNSTRUCT") == "1") \
             and _enough("unstructured", 320):
         _stage("unstructured spmv")
@@ -1135,6 +1156,96 @@ def main_worker():
     _sink.emit(dict(out), event="bench_worker")
 
 
+def _bench_throughput(solver, rhs_dev, on_tpu, bs=(1, 8, 32)):
+    """Solves/sec of the stacked multi-RHS path at each batch size in
+    ``bs``, against the honest UN-CHAINED single-solve rate (every
+    per-call overhead included — that is the number batching amortizes).
+    ``solver`` is the headline bundle; the measurement builds a
+    refine-free CG bundle SHARING its hierarchy (stacked solves gate
+    out refinement), so no second setup cost is paid."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.solver.cg import CG
+    slv = make_solver(solver.A_host, solver.precond,
+                      CG(maxiter=100, tol=1e-6))
+    rhs1 = jnp.asarray(rhs_dev, jnp.float32)
+
+    def timed(call, warm=1, reps=3):
+        for _ in range(warm):
+            x, _ = call()
+            jax.block_until_ready(x)
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            x, info = call()
+            jax.block_until_ready(x)
+            ts.append(time.perf_counter() - t0)
+        return float(np.median(ts)), info
+
+    t1, info1 = timed(lambda: slv(rhs1))
+    out = {"single_unchained_s": round(t1, 4),
+           "single_unchained_sps": round(1.0 / t1, 3),
+           "iters_b1": int(info1.iters), "rows": []}
+    for B in bs:
+        cols = np.stack([np.asarray(rhs_dev) * (1.0 + 0.1 * k)
+                         for k in range(B)], axis=1)
+        Rh = jnp.asarray(cols, jnp.float32)
+        reps = 2 if B >= 8 and not on_tpu else 3
+        tB, infoB = timed(lambda: slv(Rh), reps=reps)
+        sps = B / tB
+        row = {"B": int(B), "batch_s": round(tB, 4),
+               "solves_per_sec": round(sps, 3),
+               "iters_max": int(infoB.iters),
+               "speedup_vs_single": round(sps * t1, 3)}
+        out["rows"].append(row)
+        out["b%d_sps" % B] = row["solves_per_sec"]
+    if "b32_sps" in out:
+        out["speedup_b32_vs_single"] = round(out["b32_sps"] * t1, 3)
+    return out
+
+
+def main_throughput(args=None):
+    """``bench.py --throughput [B ...]``: measure the serving throughput
+    curve (stacked multi-RHS solves/sec per batch size vs the un-chained
+    single-solve rate) and emit ONE ``bench_throughput`` JSONL record.
+    Problem size: AMGCL_TPU_THROUGHPUT_N, defaulting to the headline
+    bench size on TPU and a small CPU-friendly size elsewhere."""
+    from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested
+    apply_if_cpu_requested()
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.solver.cg import CG
+
+    bs = tuple(int(a) for a in (args or []) if a.isdigit()) or (1, 8, 32)
+    on_tpu = jax.default_backend() == "tpu"
+    n = int(os.environ.get("AMGCL_TPU_THROUGHPUT_N", "0")) \
+        or (_N if on_tpu else 24)
+    A, rhs = poisson3d(n)
+    solver = make_solver(A, AMGParams(dtype=jnp.float32),
+                         CG(maxiter=100, tol=1e-6))
+    rec = _bench_throughput(solver, jnp.asarray(rhs, jnp.float32),
+                            on_tpu, bs)
+    dev0 = jax.devices()[0]
+    print("throughput (n=%d^3, %s): single un-chained %.2f solves/s"
+          % (n, dev0.platform, rec["single_unchained_sps"]))
+    for row in rec["rows"]:
+        print("  B=%-3d  %8.4f s/batch  %8.2f solves/s  (%.2fx single)"
+              % (row["B"], row["batch_s"], row["solves_per_sec"],
+                 row["speedup_vs_single"]))
+    out = {"event": "bench_throughput", "n": n, **rec,
+           "device": str(dev0), "device_platform": dev0.platform,
+           "device_kind": getattr(dev0, "device_kind", None),
+           "commit": _git_head()}
+    _stdout_sink.emit(out)
+    _sink.emit(dict(out))
+    return 0
+
+
 # ===========================================================================
 # regression gate: compare a candidate bench record against the last-good
 # ===========================================================================
@@ -1148,6 +1259,13 @@ def gate_tolerances():
                               chained timings still jitter ~10-15% across
                               chip sessions, see BENCH_r0*.json)
       AMGCL_TPU_GATE_BYTES  — allowed peak-ledger-bytes ratio (def 1.10)
+      AMGCL_TPU_GATE_THROUGHPUT — minimum allowed fraction of the
+                              baseline's B=32 serving throughput
+                              (default 0.75: the candidate regresses
+                              when its b32 solves/sec drop below 75% of
+                              last-good); skipped across
+                              device_platform mismatches like the time
+                              ratio
       AMGCL_TPU_GATE_HEALTH — 1 (default): fail when a previously-clean
                               record's candidate trips any health guard
                               (breakdown/NaN/stagnation/divergence);
@@ -1161,7 +1279,8 @@ def gate_tolerances():
 
     return {"iters": _f("AMGCL_TPU_GATE_ITERS", 2),
             "time": _f("AMGCL_TPU_GATE_TIME", 1.25),
-            "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10)}
+            "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10),
+            "throughput": _f("AMGCL_TPU_GATE_THROUGHPUT", 0.75)}
 
 
 def _record_health_flags(rec):
@@ -1248,6 +1367,28 @@ def run_gate(candidate, last_good, tol=None):
     check("ledger_bytes", _record_ledger_bytes(candidate), b0,
           b0 * tol["bytes"] if b0 is not None else 0,
           skip_reason=plat_skip)
+    # serving throughput (bench_throughput / the worker's throughput
+    # stage): HIGHER is better, so the check inverts — regression when
+    # the candidate's B=32 solves/sec fall below the tolerance fraction
+    # of the baseline's. Skipped across platforms and for records that
+    # predate the metric.
+    tp_c = (candidate.get("throughput") or {}).get("b32_sps")
+    tp_b = (last_good.get("throughput") or {}).get("b32_sps")
+    if tp_c is None and tp_b is None:
+        pass          # neither record carries the metric: no check row
+    elif plat_skip is not None:
+        checks.append({"check": "throughput_b32", "status": "skipped",
+                       "reason": plat_skip,
+                       "candidate": tp_c, "last_good": tp_b})
+    elif tp_c is None or tp_b is None:
+        checks.append({"check": "throughput_b32", "status": "skipped",
+                       "candidate": tp_c, "last_good": tp_b})
+    else:
+        floor = tp_b * tol["throughput"]
+        checks.append({"check": "throughput_b32", "candidate": tp_c,
+                       "last_good": tp_b, "limit": round(floor, 6),
+                       "status": "ok" if tp_c >= floor
+                       else "regression"})
     if os.environ.get("AMGCL_TPU_GATE_HEALTH", "1") != "0":
         # flag IDENTITIES, not counts: any guard the baseline did not
         # trip is a regression (a candidate swapping a warning-level
@@ -1405,11 +1546,11 @@ def main_vecbench(args=None):
                               step(st, ops), None, length=reps - 1)
             return out[-1]
         f = jax.jit(many)
-        float(f(init, ops))             # compile + warm
+        jax.block_until_ready(f(init, ops))     # compile + warm
         ts = []
         for _ in range(repeats):
             t0 = time.perf_counter()
-            float(f(init, ops))
+            jax.block_until_ready(f(init, ops))
             ts.append(time.perf_counter() - t0)
         return float(np.median(ts)) / reps
 
@@ -1459,8 +1600,26 @@ def main_vecbench(args=None):
         init_ax = (x, jnp.float32(0))
         a_f = timeit(ax_fused, init_ax, (p,))
         a_c = timeit(ax_composed, init_ax, (p,))
+
+        # -- stacked (n, B) tier: one fused pass retires B columns ------
+        Bb = 8
+        Pb, Qb, Xb, Rb = (jnp.asarray(
+            rng.standard_normal((n, Bb)), jnp.float32) for _ in range(4))
+
+        def xr_batched(st, ops):
+            xc, rc, rr = st
+            pp, qq = ops
+            a = alpha * (1 + 0 * rr)    # (Bb,) per-column scalars
+            return fv.xr_update(a, pp, qq, xc, rc)
+
+        init_b = (Xb, Rb, jnp.zeros(Bb, jnp.float32))
+        t_b = timeit(xr_batched, init_b, (Pb, Qb))
         rows.append({
             "n": n, "path": path,
+            "xr_b8_us": round(t_b * 1e6, 3),
+            "xr_b8_per_rhs_us": round(t_b / Bb * 1e6, 3),
+            # per-rhs win of the stacked pass vs B single fused passes
+            "xr_b8_vs_single": round(t_f / max(t_b / Bb, 1e-12), 3),
             "xr_update_us": round(t_f * 1e6, 3),
             "xr_composed_us": round(t_c * 1e6, 3),
             "xr_speedup": round(t_c / max(t_f, 1e-12), 3),
@@ -1641,5 +1800,8 @@ if __name__ == "__main__":
     elif "--vecbench" in sys.argv:
         extra = sys.argv[sys.argv.index("--vecbench") + 1:]
         sys.exit(main_vecbench(extra))
+    elif "--throughput" in sys.argv:
+        extra = sys.argv[sys.argv.index("--throughput") + 1:]
+        sys.exit(main_throughput(extra))
     else:
         main_supervisor()

@@ -1,0 +1,351 @@
+"""Solve-as-a-service (ISSUE 7): batched multi-RHS parity, per-RHS
+guard independence, the resident SolverService, the donation contract,
+and the serving throughput gate.
+
+The parity contract: a B=1 stacked solve matches the unbatched solver
+per method (same iteration count, same solution to float tolerance),
+and B>1 columns match B independent solves — per-column convergence
+masking means a converged column's iterate is frozen while the loop
+serves the stragglers, so iteration counts are per-column exact.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import amgcl_tpu.solver as S
+from amgcl_tpu.ops import device as dev
+from amgcl_tpu.ops import fused_vec as fv
+from amgcl_tpu.serve import BlockCG, SolverService
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_B = 3
+
+
+def _problem(m=6, dtype=jnp.float64):
+    A, rhs = poisson3d(m)
+    Ad = dev.to_device(A, "dia", dtype)
+    dinv = jnp.asarray(1.0 / A.diagonal(), dtype)
+
+    def precond(r):
+        return dinv[:, None] * r if r.ndim == 2 else dinv * r
+
+    rng = np.random.RandomState(7)
+    Rh = jnp.asarray(rng.rand(A.nrows, _B), dtype)
+    return A, Ad, precond, Rh
+
+
+_SOLVERS = [
+    ("CG", dict(maxiter=200, tol=1e-8)),
+    ("BiCGStab", dict(maxiter=200, tol=1e-8)),
+    ("BiCGStabL", dict(maxiter=200, tol=1e-8)),
+    ("GMRES", dict(maxiter=200, tol=1e-8)),
+    ("FGMRES", dict(maxiter=200, tol=1e-8)),
+    ("LGMRES", dict(maxiter=200, tol=1e-8)),
+    ("IDRs", dict(maxiter=200, tol=1e-8)),
+    ("Richardson", dict(maxiter=500, tol=1e-8)),
+    ("PreOnly", dict()),
+]
+
+
+@pytest.mark.parametrize("name,kw", _SOLVERS,
+                         ids=[n for n, _ in _SOLVERS])
+def test_batched_parity_per_method(name, kw):
+    """B=1 matches the unbatched solver; B>1 columns match independent
+    solves (solution AND per-column iteration count)."""
+    A, Ad, precond, Rh = _problem()
+    sl = getattr(S, name)(**kw)
+    got = sl.solve(Ad, precond, Rh)
+    x, iters, resid = got[:3]
+    assert x.shape == Rh.shape
+    assert iters.shape == (_B,) and resid.shape == (_B,)
+    # B=1 stacked vs plain 1-D entry
+    g1 = sl.solve(Ad, precond, Rh[:, :1])
+    g0 = sl.solve(Ad, precond, Rh[:, 0])
+    assert int(g1[1][0]) == int(g0[1])
+    np.testing.assert_allclose(np.asarray(g1[0][:, 0]),
+                               np.asarray(g0[0]),
+                               rtol=1e-9, atol=1e-12)
+    # B>1 columns vs independent solves
+    for b in range(_B):
+        gb = sl.solve(Ad, precond, Rh[:, b])
+        assert int(iters[b]) == int(gb[1]), \
+            "per-column iteration count drifted (column %d)" % b
+        np.testing.assert_allclose(np.asarray(x[:, b]),
+                                   np.asarray(gb[0]),
+                                   rtol=1e-7, atol=1e-10)
+    # per-column guard states ride along, all clean here
+    hs = got[-1]
+    assert np.asarray(hs.flags).shape == (_B,)
+    assert not np.asarray(hs.flags).any()
+
+
+def test_batched_guard_trips_are_independent():
+    """A poisoned column (an x0 so large its first iteration overflows
+    to NaN) trips ITS guard and freezes ITS iterate at iteration 0; the
+    healthy columns converge untouched."""
+    A, Ad, precond, Rh = _problem()
+    x0 = np.zeros(Rh.shape)
+    x0[:, 1] = 1e200          # first body step overflows -> NaN guard
+    sl = S.CG(maxiter=100, tol=1e-8)
+    x, iters, resid, hs = sl.solve(Ad, precond, Rh, jnp.asarray(x0))
+    flags = np.asarray(hs.flags)
+    from amgcl_tpu.telemetry import health as H
+    assert flags[1] & H.NAN
+    assert flags[0] == 0 and flags[2] == 0
+    assert int(iters[1]) == 0     # no committed iteration on the trip
+    for b in (0, 2):
+        gb = sl.solve(Ad, precond, Rh[:, b])
+        assert int(iters[b]) == int(gb[1])
+        np.testing.assert_allclose(np.asarray(x[:, b]),
+                                   np.asarray(gb[0]),
+                                   rtol=1e-7, atol=1e-10)
+    # decode: headline reflects the union, per_rhs isolates the column
+    from amgcl_tpu.serve import decode_batched_health
+    dec = decode_batched_health(flags, np.asarray(hs.first_it))
+    assert not dec["ok"] and dec["nan"]
+    assert dec["unhealthy_rhs"] == [1]
+    assert dec["per_rhs"][0]["ok"] and not dec["per_rhs"][1]["ok"]
+
+
+def test_blockcg_shared_subspace():
+    """Block CG converges every column and needs no more iterations
+    than the worst independent CG column (the shared subspace can only
+    add information)."""
+    A, Ad, precond, Rh = _problem()
+    bcg = BlockCG(maxiter=200, tol=1e-8)
+    x, iters, resid = bcg.solve(Ad, precond, Rh)[:3]
+    cg_iters = []
+    for b in range(_B):
+        g = S.CG(maxiter=200, tol=1e-8).solve(Ad, precond, Rh[:, b])
+        cg_iters.append(int(g[1]))
+        rb = np.asarray(Rh[:, b], np.float64)
+        xr = np.asarray(x[:, b], np.float64)
+        rel = np.linalg.norm(rb - A.spmv(xr)) / np.linalg.norm(rb)
+        assert rel < 1e-7, rel
+    assert int(np.max(np.asarray(iters))) <= max(cg_iters)
+    # 1-D rhs runs as B=1 and returns the plain shapes
+    g1 = bcg.solve(Ad, precond, Rh[:, 0])
+    assert g1[0].ndim == 1 and np.ndim(g1[1]) == 0
+    # registered in the runtime registry as solver.type=blockcg
+    from amgcl_tpu.models.runtime import SOLVERS
+    assert SOLVERS["blockcg"] is BlockCG
+
+
+def test_fused_vec_stacked_primitives():
+    """The (n, B) tier of ops/fused_vec.py matches the per-column
+    composition exactly (same XLA arithmetic, one pass)."""
+    rng = np.random.RandomState(11)
+    p, q, x, r = (jnp.asarray(rng.rand(64, 4)) for _ in range(4))
+    al = jnp.asarray(rng.rand(4))
+    xn, rn, rr = fv.xr_update(al, p, q, x, r)
+    for b in range(4):
+        xb, rb, rrb = fv.xr_update(al[b], p[:, b], q[:, b],
+                                   x[:, b], r[:, b])
+        np.testing.assert_allclose(np.asarray(xn[:, b]), np.asarray(xb),
+                                   rtol=1e-12)
+        np.testing.assert_allclose(float(rr[b]), float(rrb), rtol=1e-12)
+    z, zz = fv.axpby_dot(al, p, 0.5, x)
+    xn2, rn2, rr2, rhr2 = fv.bicgstab_tail(al, p, 0.3, q, x, r,
+                                           p * 0, q)
+    assert z.shape == (64, 4) and zz.shape == (4,)
+    assert rr2.shape == (4,) and rhr2.shape == (4,)
+    A, rhs = poisson3d(5)
+    Ad = dev.to_device(A, "dia", jnp.float64)
+    F = jnp.asarray(rng.rand(A.nrows, 4))
+    X = jnp.asarray(rng.rand(A.nrows, 4))
+    rres, rrv = fv.residual_dot(F, Ad, X)
+    ref = np.asarray(F) - np.stack(
+        [A.spmv(np.asarray(X[:, b])) for b in range(4)], axis=1)
+    np.testing.assert_allclose(np.asarray(rres), ref, rtol=1e-10,
+                               atol=1e-12)
+    assert rrv.shape == (4,)
+
+
+def test_make_solver_batched_end_to_end():
+    """make_solver(batch=B) + AMG V-cycle accept stacked vectors end to
+    end; the report carries per-RHS detail, solves_per_sec and the
+    batched per-iteration byte model."""
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.make_solver import make_solver
+    A, rhs = poisson3d(8)
+    ms = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=50),
+                     solver=S.CG(maxiter=50, tol=1e-6), batch=4)
+    assert ms.batch == 4
+    x1, info1 = ms(rhs)
+    R = np.stack([rhs, 2 * rhs, 0.5 * rhs, -rhs], axis=1)
+    xb, infob = ms(R)
+    assert xb.shape == (len(rhs), 4)
+    per = infob.extra["per_rhs"]
+    assert len(per["iters"]) == 4 and infob.extra["batch"] == 4
+    assert infob.iters == max(per["iters"]) == info1.iters
+    assert infob.solves_per_sec and infob.solves_per_sec > 0
+    assert "solves_per_sec" in infob.to_dict()
+    # scaled rhs: same system, scaled solution
+    np.testing.assert_allclose(np.asarray(xb[:, 1]),
+                               2 * np.asarray(x1), rtol=1e-4,
+                               atol=1e-5)
+    assert infob.health is not None and infob.health["ok"]
+    assert len(infob.health["per_rhs"]) == 4
+    pi = (infob.resources or {}).get("per_iteration") or {}
+    assert pi.get("batch") == 4
+    # x0 must match the stacked shape
+    with pytest.raises(ValueError):
+        ms(R, x0=rhs)
+    # refinement is gated off for stacked solves
+    ms_ref = make_solver(A, AMGParams(dtype=jnp.float32,
+                                      coarse_enough=50),
+                         solver=S.CG(maxiter=50, tol=1e-6), refine=2)
+    with pytest.raises(ValueError):
+        ms_ref(R)
+
+
+def test_krylov_iteration_model_batch_amortizes_operator():
+    """Satellite: the batch axis scales FLOPs by B but amortizes the
+    operator's stored bytes — bytes(B) < B * bytes(1)."""
+    from amgcl_tpu.telemetry.ledger import krylov_iteration_model
+    A, _ = poisson3d(8)
+    Ad = dev.to_device(A, "dia", jnp.float32)
+    m1 = krylov_iteration_model("CG", Ad)
+    m8 = krylov_iteration_model("CG", Ad, batch=8)
+    assert m8["batch"] == 8
+    assert m8["flops"] == 8 * m1["flops"]
+    assert m8["bytes"] < 8 * m1["bytes"]
+    assert m8["bytes"] > m1["bytes"]
+
+
+def test_service_queue_and_stats(tmp_path):
+    """SolverService: async submits resolve to per-request results that
+    match direct solves; stats carry solves/sec + p50/p99 latency; the
+    per-batch 'serve' JSONL events land in the sink."""
+    from amgcl_tpu import telemetry
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.make_solver import make_solver
+    out = tmp_path / "serve.jsonl"
+    telemetry.set_default_sink(telemetry.JsonlSink(str(out)))
+    try:
+        A, rhs = poisson3d(8)
+        ms = make_solver(A, AMGParams(dtype=jnp.float32,
+                                      coarse_enough=50),
+                         solver=S.CG(maxiter=50, tol=1e-6))
+        x_direct, _ = ms(rhs)
+        with SolverService(ms, batch=4, flush_ms=25) as svc:
+            futs = [svc.submit(rhs * (1.0 + k)) for k in range(6)]
+            results = [f.result(timeout=120) for f in futs]
+            stats = svc.stats()
+        for k, (xk, rep) in enumerate(results):
+            np.testing.assert_allclose(
+                xk, (1.0 + k) * np.asarray(x_direct),
+                rtol=1e-4, atol=1e-5)
+            assert rep.iters > 0 and rep.extra["batch"] >= 1
+        assert stats["requests"] == 6
+        assert stats["batches"] >= 2          # bucket 4 forces a split
+        assert stats["latency_s"]["p50"] <= stats["latency_s"]["p99"]
+        assert stats["solves_per_sec"] > 0
+    finally:
+        telemetry.set_default_sink(telemetry.NullSink())
+    recs = [json.loads(ln) for ln in open(out)]
+    serve = [r for r in recs if r.get("event") == "serve"]
+    assert serve, "no 'serve' events emitted"
+    assert any(r.get("final") for r in serve)
+    assert any(r.get("solves_per_sec") for r in serve)
+
+
+def test_service_request_timeout_and_refine_gate():
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.make_solver import make_solver
+    A, rhs = poisson3d(6)
+    ms = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=50),
+                     solver=S.CG(maxiter=50, tol=1e-6))
+    with SolverService(ms, batch=2, flush_ms=5) as svc:
+        fut = svc.submit(rhs, timeout_s=-1.0)    # already expired
+        with pytest.raises(TimeoutError):
+            fut.result(timeout=60)
+    ms_ref = make_solver(A, AMGParams(dtype=jnp.float32,
+                                      coarse_enough=50),
+                         solver=S.CG(maxiter=50, tol=1e-6), refine=1)
+    with pytest.raises(ValueError):
+        SolverService(ms_ref)
+
+
+def test_serve_donation_contract():
+    """The resident loop's lowered program aliases exactly the donated
+    iterate buffer — the static contract the analysis gate enforces."""
+    from amgcl_tpu.analysis import jaxpr_audit as ja
+    from amgcl_tpu.telemetry.ledger import DONATION_CONTRACTS
+    assert DONATION_CONTRACTS["serve.solve_step"] == 1
+    rec = ja.audit_serve()
+    assert rec["donation"]["aliasing_present"]
+    assert rec["donation"]["donated_args"] == 1
+    assert ja.check_serve(rec) == []
+    # a drifted contract is an error finding, not a silent pass
+    bad = dict(rec, donation={"donated_args": 0,
+                              "aliasing_present": False})
+    finds = ja.check_serve(bad)
+    assert finds and finds[0]["severity"] == "error"
+
+
+def test_gate_throughput_check():
+    """bench.py --gate: the B=32 solves/sec floor trips on a drop below
+    the tolerance fraction and skips across device platforms."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    base = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+            "throughput": {"b32_sps": 100.0}}
+    good = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+            "throughput": {"b32_sps": 90.0}}
+    bad = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+           "throughput": {"b32_sps": 50.0}}
+    other = {"iters": 10, "value": 1.0, "device_platform": "tpu",
+             "throughput": {"b32_sps": 1.0}}
+    ok, checks = bench.run_gate(good, base)
+    row = [c for c in checks if c["check"] == "throughput_b32"][0]
+    assert ok and row["status"] == "ok"
+    ok, checks = bench.run_gate(bad, base)
+    row = [c for c in checks if c["check"] == "throughput_b32"][0]
+    assert not ok and row["status"] == "regression"
+    ok, checks = bench.run_gate(other, base)
+    row = [c for c in checks if c["check"] == "throughput_b32"][0]
+    assert row["status"] == "skipped" and "platform_mismatch" \
+        in row["reason"]
+    # records predating the metric skip, never regress
+    ok, checks = bench.run_gate({"iters": 10, "value": 1.0,
+                                 "device_platform": "cpu"}, base)
+    row = [c for c in checks if c["check"] == "throughput_b32"][0]
+    assert ok and row["status"] == "skipped"
+
+
+@pytest.mark.serial
+def test_cli_serve_smoke(tmp_path):
+    """`python -m amgcl_tpu.cli --serve N` end to end on the 8-virtual-
+    device CPU topology: resident service, per-request iterations,
+    throughput/latency lines, 'serve' events in the telemetry sink."""
+    out = tmp_path / "serve_cli.jsonl"
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8")
+               .strip())
+    r = subprocess.run(
+        [sys.executable, "-m", "amgcl_tpu.cli", "-n", "8",
+         "-p", "solver.type=cg", "--serve", "5", "--serve-batch", "2",
+         "--telemetry", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "serve: 5 request(s), batch bucket 2" in r.stdout
+    assert "iters per request:" in r.stdout
+    assert "throughput:" in r.stdout
+    recs = [json.loads(ln) for ln in open(out)]
+    serve = [x for x in recs if x.get("event") == "serve"]
+    assert serve and any(x.get("final") for x in serve)
