@@ -29,8 +29,8 @@ import os
 from typing import Any, Dict, Optional
 
 from amgcl_tpu.analysis.lint import (  # noqa: F401  (public surface)
-    RULES, apply_baseline, finding_key, format_findings, run_lint,
-    undocumented_knobs, watched_entry_points,
+    RULES, apply_baseline, declared_metric_names, finding_key,
+    format_findings, run_lint, undocumented_knobs, watched_entry_points,
 )
 
 #: committed findings budget at the repo root

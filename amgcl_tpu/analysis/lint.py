@@ -41,6 +41,16 @@ discipline the jaxpr auditor depends on):
     (AMGCL_TPU_PALLAS_INTERPRET routes the production dispatch through
     the kernels on CPU); a pallas_call that cannot be interpreted is a
     kernel CI cannot exercise.
+``metric-name-literal``
+    a live-registry update (``.inc(...)`` / ``.set_gauge(...)`` /
+    ``.observe(...)``) whose metric name is not a string literal from
+    the declared ``telemetry/live.py`` ``METRICS`` table — the one
+    table the ``/metrics`` endpoint serves and the runtime registry
+    validates against. An ad-hoc name would raise at serve time (or,
+    with a private registry spec, scrape as a metric no dashboard
+    knows); the rule makes both impossible to merge. The registry
+    implementation itself (telemetry/live.py) is exempt — it passes
+    names through variables by construction.
 
 Findings are plain dicts keyed for the baseline by ``(rule, file,
 symbol)`` — line numbers are carried for display but excluded from the
@@ -65,7 +75,12 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 
 #: the rules this module implements, in report order
 RULES = ("bare-jit", "host-sync-in-loop", "np-in-jit",
-         "undocumented-knob", "mutable-default", "pallas-no-interpret")
+         "undocumented-knob", "mutable-default", "pallas-no-interpret",
+         "metric-name-literal")
+
+#: live-registry update methods the metric-name rule inspects (the
+#: LiveRegistry public write surface, telemetry/live.py)
+_METRIC_METHODS = frozenset({"inc", "set_gauge", "observe"})
 
 _ENV_VAR = re.compile(r"AMGCL_TPU_[A-Z0-9_]+")
 #: a documented row in README: a table cell holding the backticked
@@ -366,6 +381,72 @@ def _rule_pallas_interpret(mod: _Module) -> List[Dict[str, Any]]:
 
 
 # ---------------------------------------------------------------------------
+# live-metric declaration rule (the /metrics contract)
+# ---------------------------------------------------------------------------
+
+def declared_metric_names(root: Optional[str] = None) -> Set[str]:
+    """The keys of the ``METRICS`` dict literal in
+    ``telemetry/live.py`` under ``root`` — parsed statically, so this
+    is exactly the table the runtime registry (and therefore the
+    ``/metrics`` endpoint) validates against. Empty when the file or
+    the table is absent."""
+    root = root or os.path.join(REPO, "amgcl_tpu")
+    path = os.path.join(root, "telemetry", "live.py")
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return set()
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "METRICS"
+                   for t in targets) \
+                    and isinstance(node.value, ast.Dict):
+                return {k.value for k in node.value.keys
+                        if isinstance(k, ast.Constant)
+                        and isinstance(k.value, str)}
+    return set()
+
+
+def _rule_metric_name_literal(mod: _Module,
+                              declared: Set[str]) -> List[Dict[str, Any]]:
+    if mod.rel.endswith("telemetry/live.py"):
+        return []       # the registry implementation: names arrive in
+        #                 variables, validated at runtime against METRICS
+    out = []
+    for call in mod._calls():
+        if not isinstance(call.func, ast.Attribute) \
+                or call.func.attr not in _METRIC_METHODS:
+            continue
+        # the metric name may ride positionally or as name= (the
+        # registry methods accept both) — resolve either form
+        arg = call.args[0] if call.args else next(
+            (kw.value for kw in call.keywords if kw.arg == "name"),
+            None)
+        if arg is None:
+            continue
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            if arg.value not in declared:
+                out.append(finding(
+                    "metric-name-literal", mod.rel, call.lineno,
+                    arg.value,
+                    "live metric %r is not declared in telemetry/live"
+                    ".py METRICS — the /metrics endpoint serves only "
+                    "the declared table, and the registry raises on "
+                    "unknown names" % arg.value))
+        else:
+            out.append(finding(
+                "metric-name-literal", mod.rel, call.lineno,
+                _enclosing_symbol(mod, call),
+                "live metric name must be a string literal from the "
+                "declared telemetry/live.py METRICS table (no ad-hoc "
+                "or computed metric names)"))
+    return out
+
+
+# ---------------------------------------------------------------------------
 # env-knob documentation rule (the test_env_docs implementation)
 # ---------------------------------------------------------------------------
 
@@ -483,7 +564,10 @@ def run_lint(root: Optional[str] = None,
     want = set(rules) if rules is not None else set(RULES)
     out: List[Dict[str, Any]] = []
     ast_rules = want & {"bare-jit", "host-sync-in-loop", "np-in-jit",
-                        "mutable-default", "pallas-no-interpret"}
+                        "mutable-default", "pallas-no-interpret",
+                        "metric-name-literal"}
+    declared = declared_metric_names(root) \
+        if "metric-name-literal" in want else set()
     for mod in (_modules(root) if ast_rules else []):
         if "bare-jit" in want:
             out += _rule_bare_jit(mod)
@@ -494,6 +578,8 @@ def run_lint(root: Optional[str] = None,
             out += _rule_mutable_default(mod)
         if "pallas-no-interpret" in want:
             out += _rule_pallas_interpret(mod)
+        if "metric-name-literal" in want:
+            out += _rule_metric_name_literal(mod, declared)
     if "undocumented-knob" in want:
         out += _rule_undocumented_knob(root, readme)
     out.sort(key=lambda f: (f["file"], f["line"], f["rule"]))

@@ -162,17 +162,32 @@ def solver_solve_batch(h, rhs_addr, x_addr, n, nrhs):
     return int(info.iters), float(info.resid)
 
 
-def serve_create(solver_h, batch=0) -> int:
+def serve_create(solver_h, batch=0, metrics_port=-1) -> int:
     """Resident solve loop over an existing solver handle
     (serve/service.py): compiled once per (shape, B) bucket, iterate
     buffers donated, device sync at batch boundaries. Returns a service
     handle; destroy with ``handle_destroy`` (drains + stops the
-    worker)."""
+    worker and the scrape server).
+
+    ``metrics_port >= 0`` serves live Prometheus metrics + /healthz on
+    that port while the service runs (0 = ephemeral — read the bound
+    port from the ``metrics_port`` field of ``serve_stats``); -1 falls
+    back to the AMGCL_TPU_SERVE_METRICS_PORT env knob; any other
+    negative forces the scrape server OFF for this service even when
+    the env knob is set. The SLO watchdog thresholds ride the
+    AMGCL_TPU_SLO_* env knobs."""
     from amgcl_tpu.serve import SolverService
     s = _handles[solver_h]
     if hasattr(s, "inner"):            # make_block_solver wraps
         s = s.inner
-    return _register(SolverService(s, batch=int(batch) or None).start())
+    mp = int(metrics_port)
+    # C convention: -1 = fall back to the env knob; any other negative
+    # = force the scrape server OFF for this service (the service's
+    # negative sentinel — the opt-out when the env knob is fleet-wide);
+    # >= 0 = bind this port (0 = ephemeral)
+    return _register(SolverService(
+        s, batch=int(batch) or None,
+        metrics_port=None if mp == -1 else mp).start())
 
 
 def serve_solve(h, rhs_addr, x_addr, n, nrhs):
@@ -194,8 +209,12 @@ def serve_solve(h, rhs_addr, x_addr, n, nrhs):
 
 
 def serve_stats(h) -> str:
-    """JSON text of the service's lifetime stats (requests, batches,
-    solves/sec, latency percentiles)."""
+    """JSON text of the service's lifetime stats: requests/batches,
+    solves/sec, latency percentiles, plus the serving-observability
+    fields (timeouts, unhealthy count, mean span breakdown ``spans_ms``,
+    ``batch_fill`` occupancy, ``padding_waste``, the compile-cache join,
+    SLO watchdog state, and ``metrics_port`` when the scrape server
+    runs)."""
     return json.dumps(_handles[h].stats())
 
 

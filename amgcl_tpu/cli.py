@@ -49,6 +49,17 @@ def main(argv=None):
     ap.add_argument("--serve-batch", type=int, default=0, metavar="B",
                     help="batch bucket for --serve (default: the "
                          "AMGCL_TPU_SERVE_BATCH env knob, then 8)")
+    ap.add_argument("--metrics-port", type=int, default=None,
+                    metavar="PORT",
+                    help="with --serve: serve live Prometheus metrics "
+                         "on http://127.0.0.1:PORT/metrics (+ /healthz) "
+                         "while the service runs — queue depth, batch "
+                         "occupancy, latency percentiles, compile-cache "
+                         "join (telemetry/live.py). 0 binds an "
+                         "ephemeral port (printed); default: the "
+                         "AMGCL_TPU_SERVE_METRICS_PORT env knob, else "
+                         "no server. The SLO watchdog thresholds ride "
+                         "the AMGCL_TPU_SLO_* knobs")
     ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
     ap.add_argument("-x", "--x0", help="initial guess file")
     ap.add_argument("--telemetry", metavar="PATH",
@@ -186,8 +197,11 @@ def main(argv=None):
                      "configuration built %r" % type(solve).__name__)
         from amgcl_tpu.serve import SolverService
         with prof.scope("serve"):
-            with SolverService(solve, batch=args.serve_batch
-                               or None) as svc:
+            with SolverService(solve, batch=args.serve_batch or None,
+                               metrics_port=args.metrics_port) as svc:
+                if svc.metrics_url:
+                    print("serve: metrics at %s (and /healthz)"
+                          % svc.metrics_url)
                 # rescale per request: distinct solves, same hierarchy
                 futs = [svc.submit(rhs * (1.0 + 0.25 * k), x0=x0,
                                    block=True)
@@ -195,6 +209,7 @@ def main(argv=None):
                 results = [f.result(timeout=svc.timeout_s + 120)
                            for f in futs]
                 stats = svc.stats()
+        serve_svc = svc
         x, info = results[0]
         print("serve: %d request(s), batch bucket %d"
               % (args.serve, svc.batch))
@@ -206,7 +221,23 @@ def main(argv=None):
         if lat:
             print("  latency: p50 %.4fs  p99 %.4fs  max %.4fs"
                   % (lat["p50"], lat["p99"], lat["max"]))
+        spans = {k: v for k, v in (stats.get("spans_ms") or {}).items()
+                 if v is not None}
+        if spans:
+            print("  spans (ms, mean): %s"
+                  % "  ".join("%s %.2f" % (k, spans[k])
+                              for k in ("queue", "pad", "compile",
+                                        "solve", "sync") if k in spans))
+        slo = stats.get("slo") or {}
+        if slo.get("trips"):
+            from amgcl_tpu.telemetry.health import (format_findings,
+                                                    serve_findings)
+            print()
+            print("SLO watchdog tripped (%s):"
+                  % ", ".join(slo["trips"]))
+            print(format_findings(serve_findings(svc.slo_summary())))
     else:
+        serve_svc = None
         with prof.scope("solve"):
             x, info = solve(rhs, x0)
 
@@ -308,7 +339,11 @@ def main(argv=None):
                             # into the same findings list
                             roofline=roofline_rec,
                             compile_stats=_cwatch.snapshot()
-                            if _cwatch.enabled() else None)
+                            if _cwatch.enabled() else None,
+                            # serving leg: the SLO watchdog's window
+                            # summary becomes serve-side findings
+                            serve=serve_svc.slo_summary()
+                            if serve_svc is not None else None)
         print()
         print(format_findings(findings))
         telemetry.emit(event="doctor", findings=findings,
@@ -388,6 +423,13 @@ def main(argv=None):
             trace["traceEvents"] += roofline_rec["_prof"].to_chrome_trace(
                 tid=2, tid_name="roofline stages", epoch=prof._t0,
                 counters=counter_map(roofline_rec))["traceEvents"]
+        if serve_svc is not None:
+            # per-request serving spans (queue/pad/compile/solve/sync)
+            # as their own track — same epoch, so a request's queue
+            # wait lines up under the CLI's 'serve' span
+            trace["traceEvents"] += serve_svc.to_chrome_trace(
+                tid=3, tid_name="serve requests",
+                epoch=prof._t0)["traceEvents"]
         with open(args.trace, "w") as f:
             _json.dump(trace, f)
         print("trace written to %s (open in ui.perfetto.dev)" % args.trace)

@@ -470,17 +470,49 @@ class make_solver:
             # set by _check_df32_runtime on harmful drift — sticky so the
             # doctor sees it on every later report from this bundle
             extra["df32_drift"] = self._df32_drift
+        # which lowering this dispatch took: stacked traces run with the
+        # Pallas gates off ("xla-batched"), single-rhs dispatches take
+        # the hand kernels where the gates allow ("pallas") and XLA
+        # otherwise — recorded so CPU-fallback vs kernel runs are
+        # distinguishable in rollups (the PR-5 platform-mismatch lesson).
+        # The tag is captured when a trace happens and stickied on the
+        # bundle: warm dispatches reuse jit's cached executable, so the
+        # gate state that governed the TRACE is the truth, not the live
+        # gate state at report time (which env flips can change between
+        # calls)
         compile_rec = None
+        delta = None
         if cw0 is not None:
             # per-call compile delta: 0 new traces on a warm repeat, 1 on
             # a fresh shape — the recompile counter the roofline tests
             # pin down
             cw1 = _cwatch.snapshot(_SOLVE_FN)
+            delta = _cwatch.delta(cw0, cw1)
+        tags = getattr(self, "_lowering_tags", None)
+        if tags is None:
+            tags = self._lowering_tags = {}
+        # keyed by the abstract shape: the first call per shape IS the
+        # trace, so the tag is captured at trace time with or without
+        # the compile watch. Deliberately NOT refreshed on the watch's
+        # new_traces delta — the _SOLVE_FN counter is process-global,
+        # so a concurrent trace by a DIFFERENT bundle would relabel
+        # this bundle's warm calls from post-flip gate state
+        key = shp
+        if key not in tags:
+            from amgcl_tpu.serve.batched import lowering_kind
+            tags[key] = lowering_kind(batched, self.solver_dtype)
+        lowering = tags[key]
+        if delta is not None:
             compile_rec = {"function": _SOLVE_FN,
-                           **_cwatch.delta(cw0, cw1),
+                           **delta,
                            "signatures": cw1["signatures"],
+                           "lowering": lowering,
                            "totals": {"traces": cw1["traces"],
                                       "compile_s": cw1["compile_s"]}}
+        else:
+            # the tag must survive AMGCL_TPU_COMPILE_WATCH=0 — it is a
+            # lowering fact, not a compile statistic
+            extra["lowering"] = lowering
         resources = self._resources()
         if batched and resources and "error" not in resources:
             # per-iteration model with the batch axis: operator reads

@@ -13,9 +13,10 @@ Two legs:
   boundaries.
 """
 
-from amgcl_tpu.serve.batched import (BlockCG, decode_batched_health,
-                                     vmap_solve)
+from amgcl_tpu.serve.batched import (BlockCG, STACKED_LOWERING,
+                                     decode_batched_health,
+                                     lowering_kind, vmap_solve)
 from amgcl_tpu.serve.service import SolverService
 
-__all__ = ["BlockCG", "SolverService", "decode_batched_health",
-           "vmap_solve"]
+__all__ = ["BlockCG", "STACKED_LOWERING", "SolverService",
+           "decode_batched_health", "lowering_kind", "vmap_solve"]

@@ -50,6 +50,26 @@ from amgcl_tpu.ops import fused_vec as fv
 from amgcl_tpu.telemetry import health as _health
 from amgcl_tpu.telemetry.history import HistoryMixin
 
+#: lowering tag of every stacked trace: the Pallas gates are off for the
+#: (n, B) programs (see the note below), so the XLA lowerings batch the
+#: body. Recorded in ``SolveReport.compile["lowering"]`` and the serve
+#: events so CPU-fallback vs hand-kernel runs are distinguishable in
+#: rollups (the PR-5 gate-skip-on-platform-mismatch lesson: a silent
+#: fallback looks like a regression three rounds later).
+STACKED_LOWERING = "xla-batched"
+
+
+def lowering_kind(batched: bool, *dtypes) -> str:
+    """The lowering tag a dispatch will take: ``"xla-batched"`` for any
+    stacked (n, B) trace (Pallas thread-locally gated off),
+    ``"pallas"`` when the DIA/ELL hand kernels would engage for these
+    dtypes on this backend, ``"xla"`` otherwise. Trace-time gate state,
+    not a post-hoc measurement — the same gates the dispatch reads."""
+    if batched:
+        return STACKED_LOWERING
+    from amgcl_tpu.ops.pallas_spmv import pallas_mode
+    return "xla" if pallas_mode(*dtypes) is None else "pallas"
+
 
 def vmap_solve(solver, A, precond, rhs, x0=None,
                inner_product=dev.inner_product, **kw):

@@ -24,26 +24,73 @@ buffers, syncs the device, and round-trips the report. The
   latency under overload. Partial batches zero-pad up to a power-of-two
   bucket ≤ B — compile count stays O(log B) per shape.
 
+Serving observability (ISSUE 8) rides every request:
+
+* **per-request spans** — ``submit()`` assigns a ``request_id``; the
+  worker records queue wait → padding → (cold) compile → device solve
+  → sync/decode wall times into the request's
+  ``SolveReport.serve = {request_id, queue_ms, pad_ms, compile_ms,
+  solve_ms, sync_ms, bucket_B, batch_fill, latency_ms, lowering}``
+  (the phases sum to the end-to-end latency by construction), emits
+  them as ``serve_request`` JSONL events, and keeps them in a
+  :class:`~amgcl_tpu.telemetry.tracing.RequestSpans` recorder —
+  ``to_chrome_trace(epoch=...)`` exports the request track onto the
+  CLI profiler's Perfetto timeline (``cli.py --serve --trace``).
+* **live metrics** — a :class:`~amgcl_tpu.telemetry.live.LiveRegistry`
+  updated in-line by the worker (queue depth, in-flight, batch
+  occupancy, per-bucket solves, timeout/health counters, compile-cache
+  join from the compile watch), scrapeable while the service runs via
+  ``/metrics`` (Prometheus exposition) and ``/healthz`` on
+  ``AMGCL_TPU_SERVE_METRICS_PORT`` / ``cli.py --serve
+  --metrics-port`` (port 0 = ephemeral; the bound port is
+  ``metrics_url``/``metrics_server.port``).
+* **SLO watchdog** — rolling-window p99-latency / timeout-rate /
+  unhealthy-solve-rate thresholds evaluated per batch; a trip emits an
+  ``slo`` JSONL event carrying
+  :func:`~amgcl_tpu.telemetry.health.serve_findings` (the same
+  findings ``telemetry.diagnose(serve=...)`` folds into the doctor),
+  e.g. "p99 dominated by queue_ms → raise B or the flush deadline".
+* **padding-waste ledger** — zero-padded bucket columns are booked as
+  wasted FLOPs/bytes via
+  ``ledger.krylov_iteration_model(effective_batch=...)`` so the
+  roofline separates effective from padded work (``stats()
+  ["padding_waste"]``).
+
 Env knobs (read at construction; constructor args win):
 
-  AMGCL_TPU_SERVE_BATCH      default batch bucket B (default 8)
-  AMGCL_TPU_SERVE_QUEUE_MAX  bounded queue depth (default 1024)
-  AMGCL_TPU_SERVE_FLUSH_MS   flush-on-partial-batch deadline (def 50)
-  AMGCL_TPU_SERVE_TIMEOUT_S  per-request queue timeout (default 30)
+  AMGCL_TPU_SERVE_BATCH         default batch bucket B (default 8)
+  AMGCL_TPU_SERVE_QUEUE_MAX     bounded queue depth (default 1024)
+  AMGCL_TPU_SERVE_FLUSH_MS      flush-on-partial-batch deadline (def 50)
+  AMGCL_TPU_SERVE_TIMEOUT_S     per-request queue timeout (default 30)
+  AMGCL_TPU_SERVE_METRICS_PORT  /metrics + /healthz scrape port
+                                (unset = no server; 0 = ephemeral)
+  AMGCL_TPU_SLO_P99_MS          rolling-window p99 latency target in ms
+                                (0/unset = p99 watchdog off)
+  AMGCL_TPU_SLO_TIMEOUT_RATE    tolerated queue-timeout fraction
+                                (default 0.01)
+  AMGCL_TPU_SLO_UNHEALTHY_RATE  tolerated unhealthy-solve fraction
+                                (default 0.05)
+  AMGCL_TPU_SLO_WINDOW          rolling window size in requests
+                                (default 256)
 """
 
 from __future__ import annotations
 
+import itertools
 import os
 import queue
 import threading
 import time
+from collections import deque
 from concurrent.futures import Future
 from typing import Any, Dict, List, Optional
 
 import numpy as np
 
 from amgcl_tpu.telemetry import compile_watch as _cwatch
+from amgcl_tpu.telemetry.live import (LiveRegistry, MetricsServer,
+                                      metrics_port_from_env)
+from amgcl_tpu.telemetry.tracing import RequestSpans
 
 #: watched-jit name of the resident solve step — registered in
 #: ``compile_watch.DECLARED_ENTRY_POINTS`` and keyed in
@@ -66,17 +113,27 @@ def _env_float(name: str, default: float) -> float:
 
 
 class _Request:
-    __slots__ = ("rhs", "x0", "future", "t_submit", "timeout_s")
+    __slots__ = ("rhs", "x0", "future", "t_submit", "timeout_s", "rid")
 
-    def __init__(self, rhs, timeout_s, x0=None):
+    def __init__(self, rhs, timeout_s, x0=None, rid=0):
         self.rhs = rhs
         self.x0 = x0
         self.future: Future = Future()
-        self.t_submit = time.monotonic()
+        # perf_counter, not monotonic: the span timestamps must share a
+        # clock with Profiler._t0 so the Perfetto tracks epoch-merge
+        self.t_submit = time.perf_counter()
         self.timeout_s = timeout_s
+        self.rid = rid
 
 
 _SENTINEL = object()
+
+
+def _sink_attached() -> bool:
+    """True when a real telemetry sink is configured — the one gate all
+    emit paths in this module share."""
+    from amgcl_tpu.telemetry.sink import NullSink, get_default_sink
+    return not isinstance(get_default_sink(), NullSink)
 
 
 class SolverService:
@@ -94,7 +151,12 @@ class SolverService:
     def __init__(self, solver, batch: Optional[int] = None,
                  queue_max: Optional[int] = None,
                  flush_ms: Optional[float] = None,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 metrics_port: Optional[int] = None,
+                 slo_p99_ms: Optional[float] = None,
+                 slo_timeout_rate: Optional[float] = None,
+                 slo_unhealthy_rate: Optional[float] = None,
+                 slo_window: Optional[int] = None):
         if not hasattr(solver, "_solve_fn"):
             raise TypeError(
                 "SolverService needs a make_solver bundle (got %r)"
@@ -123,11 +185,50 @@ class SolverService:
         self._n_requests = 0
         self._n_batches = 0
         self._n_padded = 0
+        self._n_timeouts = 0
+        self._n_unhealthy = 0
         self._t_first: Optional[float] = None
         self._t_last: Optional[float] = None
         self._lock = threading.Lock()
         self._stop = False
+        self._closed = False
         self._thread: Optional[threading.Thread] = None
+        # -- serving observability ------------------------------------------
+        self._rid = itertools.count(1)   # request_id source (submit())
+        self.live = LiveRegistry()       # /metrics registry
+        self.spans = RequestSpans()      # Perfetto request track
+        port = metrics_port if metrics_port is not None \
+            else metrics_port_from_env()
+        # a negative port means OFF even when the env knob is set
+        # fleet-wide — without it a second service in one process (or
+        # a second process on the host) could never opt out of the
+        # taken port (0 stays "bind ephemeral")
+        self.metrics_port = None if (port is not None and port < 0) \
+            else port
+        self.metrics_server: Optional[MetricsServer] = None
+        # SLO watchdog thresholds: rates are fractions of the rolling
+        # window; p99 target 0 disables the latency leg
+        self.slo = {
+            "p99_ms": slo_p99_ms if slo_p99_ms is not None
+            else _env_float("AMGCL_TPU_SLO_P99_MS", 0.0),
+            "timeout_rate": slo_timeout_rate
+            if slo_timeout_rate is not None
+            else _env_float("AMGCL_TPU_SLO_TIMEOUT_RATE", 0.01),
+            "unhealthy_rate": slo_unhealthy_rate
+            if slo_unhealthy_rate is not None
+            else _env_float("AMGCL_TPU_SLO_UNHEALTHY_RATE", 0.05),
+        }
+        self.slo_window = slo_window if slo_window is not None \
+            else _env_int("AMGCL_TPU_SLO_WINDOW", 256)
+        #: rolling per-request window the watchdog evaluates: dicts of
+        #: {lat_ms, queue_ms, pad_ms, compile_ms, solve_ms, sync_ms,
+        #: fill, timeout, unhealthy}
+        self._win: deque = deque(maxlen=max(int(self.slo_window), 8))
+        self._slo_trips = 0
+        self._slo_active: set = set()   # trip kinds currently firing
+        self._last_slo: Optional[Dict[str, Any]] = None
+        self._waste = {"flops": 0, "bytes": 0, "padded_col_iters": 0}
+        self._bucket_models: Dict[int, Dict[str, Any]] = {}
 
     # -- sizing ---------------------------------------------------------------
 
@@ -165,25 +266,47 @@ class SolverService:
             x0 = jnp.array(x0, self.solver.solver_dtype, copy=True)
             if x0.ndim == 1:
                 x0 = x0[:, None]
-        x, iters, resid, hstate, wall = self._dispatch(rhs, x0)
-        report = self._batch_report(iters, resid, hstate, wall)
+        x, iters, resid, hstate, timing = self._dispatch(rhs, x0)
+        report = self._batch_report(iters, resid, hstate,
+                                    timing["wall_s"])
         return x, report
 
     def _dispatch(self, rhs, x0):
         """ONE resident-program dispatch: solve, sync at the batch
         boundary, fetch every per-column stat in a single host round
         trip. The got[1:6] slicing mirrors _solve_fn's return contract
-        (make_solver.py) — this is the only place the service reads it."""
+        (make_solver.py) — this is the only place the service reads it.
+
+        The returned ``timing`` dict carries the span boundaries the
+        request tracer needs: ``t0`` (dispatch start) -> ``t_solved``
+        (block_until_ready: the device finished) -> ``t_fetched``
+        (stats on host), plus the compile-watch delta of this call
+        (``compile_s`` > 0 exactly on a cold (shape, B) bucket)."""
         import jax
+        cw0 = _cwatch.snapshot(_SERVE_STEP) if _cwatch.enabled() else None
         t0 = time.perf_counter()
         got = self._entry(self.solver.A_dev, self.solver.A_dev64,
                           self.solver.precond.hierarchy, rhs, x0)
         x = got[0]
         jax.block_until_ready(x)         # the ONLY device sync
+        t_solved = time.perf_counter()
         iters, resid, _hist, _hn, hstate = jax.device_get(got[1:6])
-        wall = time.perf_counter() - t0
+        t_fetched = time.perf_counter()
+        compile_s = 0.0
+        if cw0 is not None:
+            # clamped to THIS dispatch's interval: the compile watch
+            # attributes by the shared _SERVE_STEP name process-wide,
+            # so a concurrent solve_batch()/second service compiling
+            # during our window could otherwise inflate the carve-out
+            # past t_solved − t0 (negative solve span, broken
+            # phase-partition invariant)
+            compile_s = min(max(_cwatch.delta(
+                cw0, _cwatch.snapshot(_SERVE_STEP))["new_compile_s"],
+                0.0), max(t_solved - t0, 0.0))
+        timing = {"t0": t0, "t_solved": t_solved, "t_fetched": t_fetched,
+                  "compile_s": compile_s, "wall_s": t_fetched - t0}
         return (x, np.atleast_1d(np.asarray(iters)),
-                np.atleast_1d(np.asarray(resid)), hstate, wall)
+                np.atleast_1d(np.asarray(resid)), hstate, timing)
 
     def _batch_report(self, iters, resid, hstate, wall):
         from amgcl_tpu.telemetry import SolveReport
@@ -208,13 +331,60 @@ class SolverService:
     # -- async queue ----------------------------------------------------------
 
     def start(self) -> "SolverService":
-        if self._thread is None:
-            self._stop = False
-            self._thread = threading.Thread(target=self._loop,
-                                            daemon=True,
-                                            name="amgcl-tpu-serve")
-            self._thread.start()
+        # double-checked: submit() calls start() per request, so the
+        # steady state (worker up, metrics server up-or-disabled) must
+        # not take the service-wide lock — but two FIRST submits racing
+        # here must not double-start the worker or double-bind the
+        # metrics port, hence the locked re-check
+        if not self._closed and self._thread is not None and (
+                self.metrics_port is None
+                or self.metrics_server is not None):
+            return self
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("SolverService is closed")
+            if self.metrics_server is None and self.metrics_port is not None:
+                # scrape endpoint up for the service's lifetime; port 0
+                # binds ephemeral — the real port is metrics_server.port.
+                # Bound BEFORE the worker thread starts: a bind failure
+                # (port taken) then raises out of the first start()/
+                # __enter__ with nothing leaked. Gauges are seeded so
+                # the very first scrape (before any traffic) already
+                # exposes the serving surface
+                self.live.set_gauge("serve_queue_depth", self.queue.qsize())
+                self.live.set_gauge("serve_inflight", 0)
+                self.metrics_server = MetricsServer(
+                    self.metrics_port, self.live.prometheus,
+                    self._health_json)
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(target=self._loop,
+                                                daemon=True,
+                                                name="amgcl-tpu-serve")
+                self._thread.start()
         return self
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        return self.metrics_server.url if self.metrics_server else None
+
+    def _health_json(self) -> Dict[str, Any]:
+        """/healthz payload: liveness + the cheap lifetime counters (the
+        scrape thread must not touch the device, so this is lock-and-
+        copy only)."""
+        alive = self._thread is not None and self._thread.is_alive()
+        with self._lock:
+            out = {
+                "ok": bool(alive or (self._thread is None
+                                     and not self._stop)),
+                "requests": self._n_requests,
+                "batches": self._n_batches,
+                "timeouts": self._n_timeouts,
+                "unhealthy": self._n_unhealthy,
+                "queue_depth": self.queue.qsize(),
+                "slo_trips": self._slo_trips,
+            }
+        return out
 
     def submit(self, rhs, timeout_s: Optional[float] = None,
                x0=None, block: bool = False) -> Future:
@@ -236,9 +406,22 @@ class SolverService:
                                  "unknowns" % (x0.shape, self.n))
         self.start()
         timeout = timeout_s if timeout_s is not None else self.timeout_s
-        req = _Request(rhs, timeout, x0=x0)
+        req = _Request(rhs, timeout, x0=x0, rid=next(self._rid))
         self.queue.put(req, block=block,
                        timeout=timeout if block else None)
+        if self._closed:
+            # raced close() past start()'s fast path: the worker may
+            # already be gone, leaving this entry unserviced forever.
+            # Once the worker IS gone the queue is dead — fail whatever
+            # is stranded on it (ours included; entries the final drain
+            # already served have resolved futures and are skipped)
+            with self._lock:
+                gone = self._thread is None
+            if gone:
+                self._fail_stragglers()
+            if req.future.done() and req.future.exception() is not None:
+                raise RuntimeError("SolverService is closed")
+        self.live.set_gauge("serve_queue_depth", self.queue.qsize())
         return req.future
 
     def _loop(self):
@@ -270,34 +453,63 @@ class SolverService:
             try:
                 self._run_batch(batch)
             except Exception as e:       # noqa: BLE001 — a failed batch
-                delivered = False
+                failed = 0
                 for req in batch:        # must fail ITS futures, not
                     if not req.future.done():   # kill the service loop
                         req.future.set_exception(e)
-                        delivered = True
-                if not delivered:
+                        failed += 1
+                if not failed:
                     # every future already resolved: nothing to attach
                     # the error to — print it or it vanishes entirely
                     import traceback
                     traceback.print_exc()
+                else:
+                    # the error must stay visible to the observability
+                    # surface too: the batch is over (in-flight back to
+                    # 0), and error-failed requests count as unhealthy
+                    # in the lifetime stats and the SLO window
+                    self.live.set_gauge("serve_inflight", 0)
+                    self.live.set_gauge("serve_queue_depth",
+                                        self.queue.qsize())
+                    self.live.inc("serve_unhealthy_total", failed)
+                    with self._lock:
+                        self._n_unhealthy += failed
+                        self._win.extend(
+                            {"timeout": False, "unhealthy": True,
+                             "error": True} for _ in range(failed))
+                    self._check_slo()
             if self._stop and self.queue.empty():
                 return
 
     def _run_batch(self, batch):
         import jax.numpy as jnp
-        now = time.monotonic()
+        from amgcl_tpu.serve.batched import STACKED_LOWERING
+        t_start = time.perf_counter()
         live = []
+        timeouts = 0
         for req in batch:
-            if now - req.t_submit > req.timeout_s:
+            if t_start - req.t_submit > req.timeout_s:
                 req.future.set_exception(TimeoutError(
                     "request waited %.2fs in the serve queue "
-                    "(timeout %.2fs)" % (now - req.t_submit,
+                    "(timeout %.2fs)" % (t_start - req.t_submit,
                                          req.timeout_s)))
+                timeouts += 1
             elif req.future.set_running_or_notify_cancel():
                 live.append(req)
+        if timeouts:
+            self.live.inc("serve_timeouts_total", timeouts)
+            with self._lock:
+                self._n_timeouts += timeouts
+                self._win.extend({"timeout": True, "unhealthy": False}
+                                 for _ in range(timeouts))
+        self.live.set_gauge("serve_queue_depth", self.queue.qsize())
         if not live:
+            if timeouts:
+                self._check_slo()
             return
+        self.live.set_gauge("serve_inflight", len(live))
         bucket = self._bucket(len(live))
+        fill = len(live) / bucket
         cols = [req.rhs for req in live]
         pad = bucket - len(cols)
         if pad:
@@ -313,9 +525,8 @@ class SolverService:
             x0cols += [np.zeros(self.n, cols[0].dtype)] * pad
         x0 = jnp.asarray(np.stack(x0cols, axis=1),
                          self.solver.solver_dtype)
-        x, iters, resid, hstate, wall = self._dispatch(rhs, x0)
+        x, iters, resid, hstate, timing = self._dispatch(rhs, x0)
         xs = np.asarray(x)
-        t_done = time.monotonic()
         from amgcl_tpu.telemetry import SolveReport
         per_health = None
         if hstate is not None:
@@ -327,17 +538,107 @@ class SolverService:
             # batch-union shape with per_rhs belongs to solve_batch)
             per_health = [_health.decode(int(flags[b]), first[b])
                           for b in range(len(live))]
-        lats = []
+        t_done = time.perf_counter()
+        wall = timing["wall_s"]
+        # batch-shared span legs; the compile leg is carved out of the
+        # dispatch->sync interval so a cold (shape, B) bucket shows up
+        # as compile_ms, not as a mysteriously slow solve_ms
+        pad_ms = (timing["t0"] - t_start) * 1e3
+        compile_ms = timing["compile_s"] * 1e3
+        solve_ms = max(
+            (timing["t_solved"] - timing["t0"]) * 1e3 - compile_ms, 0.0)
+        sync_ms = (t_done - timing["t_solved"]) * 1e3
+        emitting = _sink_attached()
+        if emitting:
+            from amgcl_tpu import telemetry
+        lats: List[float] = []
+        win_rows: List[Dict[str, Any]] = []
+        req_events: List[Dict[str, Any]] = []
+        resolved = []      # (req, x column, report) — futures resolve
+        #                    LAST, after every stat is committed, so a
+        #                    caller who saw its future done reads stats
+        #                    that already include this batch
+        n_unhealthy = 0
         for i, req in enumerate(live):
             lat = t_done - req.t_submit
             lats.append(lat)
+            queue_ms = (t_start - req.t_submit) * 1e3
+            serve = {"request_id": req.rid,
+                     "queue_ms": round(queue_ms, 3),
+                     "pad_ms": round(pad_ms, 3),
+                     "compile_ms": round(compile_ms, 3),
+                     "solve_ms": round(solve_ms, 3),
+                     "sync_ms": round(sync_ms, 3),
+                     "bucket_B": bucket,
+                     "batch_fill": round(fill, 4),
+                     "latency_ms": round(lat * 1e3, 3),
+                     "lowering": STACKED_LOWERING}
+            healthy = per_health[i]["ok"] if per_health else True
+            if not healthy:
+                n_unhealthy += 1
+                for flag in per_health[i]["flags"]:
+                    self.live.inc("serve_health_flags_total", flag=flag)
             rep = SolveReport(
                 int(iters[i]), float(resid[i]), wall_time_s=wall,
                 solver=type(self.solver.solver).__name__,
                 health=per_health[i] if per_health else None,
+                serve=serve,
                 extra={"batch": bucket, "batch_index": i,
                        "latency_s": round(lat, 6)})
-            req.future.set_result((xs[:, i], rep))
+            resolved.append((req, xs[:, i], rep))
+            # per-request track: the queue wait is the only phase that
+            # differs per request — the shared device phases are added
+            # ONCE per batch below (B identical copies would burn the
+            # span cap B× faster and stack as noise in Perfetto)
+            self.spans.add(req.rid, [("queue", req.t_submit, t_start)])
+            self.live.observe("serve_latency_ms", lat * 1e3)
+            self.live.observe("serve_queue_ms", queue_ms)
+            win_rows.append({
+                "lat_ms": lat * 1e3, "queue_ms": queue_ms,
+                "pad_ms": pad_ms, "compile_ms": compile_ms,
+                "solve_ms": solve_ms, "sync_ms": sync_ms,
+                "fill": fill, "timeout": False,
+                "unhealthy": not healthy})
+            if emitting:
+                # deferred: a sink failure must not fail the futures of
+                # an otherwise-successful batch (same discipline as
+                # _emit_batch — sink errors only after futures resolve)
+                req_events.append(dict(event="serve_request",
+                                       iters=int(iters[i]),
+                                       resid=float(resid[i]),
+                                       healthy=healthy, **serve))
+        # batch-shared span legs, once per batch (worker-serial, so
+        # _n_batches is stable here; +1 = this batch's ordinal)
+        batch_phases = [("pad", t_start, timing["t0"])]
+        if timing["compile_s"] > 0:
+            batch_phases.append(("compile", timing["t0"],
+                                 timing["t0"] + timing["compile_s"]))
+        batch_phases += [("solve", timing["t0"] + timing["compile_s"],
+                          timing["t_solved"]),
+                         ("sync", timing["t_solved"], t_done)]
+        self.spans.add(self._n_batches + 1, batch_phases, label="batch")
+        # live registry, per batch
+        self.live.inc("serve_requests_total", len(live))
+        self.live.inc("serve_batches_total")
+        if pad:
+            self.live.inc("serve_padded_slots_total", pad)
+        if n_unhealthy:
+            self.live.inc("serve_unhealthy_total", n_unhealthy)
+        self.live.inc("serve_bucket_solves_total", len(live),
+                      bucket=str(bucket))
+        self.live.observe("serve_batch_fill", fill)
+        self.live.observe("serve_solve_ms", solve_ms)
+        self.live.set_gauge("serve_inflight", 0)
+        if _cwatch.enabled():
+            # compile-cache join: cache hits vs traces of the resident
+            # program, live on /metrics (a bucket retrace under traffic
+            # shows as traces climbing while hits stall)
+            snap = _cwatch.snapshot(_SERVE_STEP)
+            self.live.set_gauge("serve_compile_traces", snap["traces"])
+            self.live.set_gauge("serve_compile_cache_hits",
+                                snap["cache_hits"])
+            self.live.set_gauge("serve_compile_s", snap["compile_s"])
+        self._account_padding(bucket, len(live), int(np.max(iters)))
         with self._lock:
             self._lat.extend(lats)
             if len(self._lat) > 4096:
@@ -345,38 +646,182 @@ class SolverService:
             self._n_requests += len(live)
             self._n_batches += 1
             self._n_padded += pad
+            self._n_unhealthy += n_unhealthy
+            self._win.extend(win_rows)
             t_now = time.perf_counter()
             if self._t_first is None:
                 self._t_first = t_now - wall   # dispatch start
             self._t_last = t_now
-        self._emit_batch(len(live), bucket, wall, iters, resid)
+        # SLO state is a stat too: commit it BEFORE the futures resolve
+        # so a caller who saw its future done reads stats()/slo state
+        # that already include this batch (pure host dict math; the slo
+        # event ride-along never raises — sink.emit swallows)
+        summary = self._check_slo()
+        for req, xcol, rep in resolved:
+            req.future.set_result((xcol, rep))
+        for ev in req_events:
+            telemetry.emit(**ev)
+        self._emit_batch(len(live), bucket, fill, wall, iters, resid,
+                         slo_summary=summary,
+                         spans_ms={"queue": round(
+                             sum((t_start - r.t_submit) for r in live)
+                             * 1e3 / len(live), 3),
+                             "pad": round(pad_ms, 3),
+                             "compile": round(compile_ms, 3),
+                             "solve": round(solve_ms, 3),
+                             "sync": round(sync_ms, 3)})
 
-    def _emit_batch(self, n_live, bucket, wall, iters, resid):
+    def _account_padding(self, bucket, n_live, iters_max):
+        """Book the zero-padded columns' device work against the ledger
+        model (padding_waste bytes/FLOPs per iteration x the batch's
+        iteration count) so the roofline can separate effective from
+        padded throughput. Best-effort: a model failure must never fail
+        a batch."""
+        if bucket <= n_live:
+            return
+        try:
+            model = self._bucket_models.get(bucket)
+            if model is None:
+                from amgcl_tpu.telemetry import ledger as _ledger
+                # effective_batch=0 prices a fully padded bucket: the
+                # per-slot waste below scales it linearly
+                model = _ledger.krylov_iteration_model(
+                    type(self.solver.solver).__name__,
+                    self.solver.A_dev, batch=bucket, effective_batch=0)
+                self._bucket_models[bucket] = model
+            frac = (bucket - n_live) / bucket
+            with self._lock:
+                self._waste["flops"] += int(
+                    model["padding_waste_flops"] * frac * iters_max)
+                self._waste["bytes"] += int(
+                    model["padding_waste_bytes"] * frac * iters_max)
+                self._waste["padded_col_iters"] += \
+                    (bucket - n_live) * iters_max
+        except Exception:
+            pass
+
+    # -- SLO watchdog ---------------------------------------------------------
+
+    def slo_summary(self) -> Dict[str, Any]:
+        """Rolling-window summary the watchdog evaluates (and
+        ``telemetry.diagnose(serve=...)`` consumes): window latency
+        percentiles, timeout/unhealthy rates, mean span breakdown and
+        occupancy, plus the configured thresholds."""
+        from amgcl_tpu.telemetry import metrics as _metrics
+        with self._lock:
+            rows = list(self._win)
+        lat = [r["lat_ms"] for r in rows if r.get("lat_ms") is not None]
+        n = len(rows)
+
+        def mean(key):
+            vals = [r[key] for r in rows if r.get(key) is not None]
+            return round(sum(vals) / len(vals), 3) if vals else None
+
+        out: Dict[str, Any] = {
+            "window": n,
+            "p50_ms": round(_metrics.percentile(lat, 50), 3)
+            if lat else None,
+            "p99_ms": round(_metrics.percentile(lat, 99), 3)
+            if lat else None,
+            "timeout_rate": round(sum(
+                1 for r in rows if r.get("timeout")) / n, 4) if n else 0,
+            "unhealthy_rate": round(sum(
+                1 for r in rows if r.get("unhealthy")) / n, 4)
+            if n else 0,
+            "batch_fill": mean("fill"),
+            "bucket": self.batch,
+            "spans_ms": {k: mean(k + "_ms") for k in
+                         ("queue", "pad", "compile", "solve", "sync")},
+            "slo": dict(self.slo, window=self.slo_window),
+        }
+        trips = []
+        if self.slo["p99_ms"] and out["p99_ms"] is not None \
+                and out["p99_ms"] > self.slo["p99_ms"]:
+            trips.append("p99")
+        if out["timeout_rate"] > self.slo["timeout_rate"]:
+            trips.append("timeout_rate")
+        if out["unhealthy_rate"] > self.slo["unhealthy_rate"]:
+            trips.append("unhealthy_rate")
+        out["trips"] = trips
+        return out
+
+    def _check_slo(self):
+        """Evaluate the rolling window against the thresholds. EDGE-
+        triggered: a trip kind fires (one ``slo`` JSONL event carrying
+        the serve-side findings, one counter bump) when it ENTERS the
+        tripped state, stays silent while the window remains over
+        threshold, and re-arms when the window clears — so the trip
+        counter counts incidents, not batches-while-tripped, and a
+        sustained episode cannot flood the sink. Runs on the worker
+        after every batch — pure host dict math. Returns the window
+        summary so the caller can reuse it (stats() recomputes it
+        otherwise — two O(window) copies per batch for one number)."""
+        summary = self.slo_summary()
+        if not summary["window"]:
+            return summary
+        trips = summary["trips"]
+        self._last_slo = summary
+        new = [t for t in trips if t not in self._slo_active]
+        self._slo_active = set(trips)
+        if not new:
+            return summary
+        self.live.inc("serve_slo_trips_total", len(new))
+        with self._lock:
+            self._slo_trips += len(new)
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            from amgcl_tpu.telemetry.health import serve_findings
+            telemetry.emit(event="slo", new_trips=new,
+                           findings=serve_findings(summary), **summary)
+        return summary
+
+    def to_chrome_trace(self, tid: int = 0,
+                        tid_name: Optional[str] = None,
+                        epoch: Optional[float] = None) -> Dict[str, Any]:
+        """The per-request span track as Chrome/Perfetto trace-event
+        JSON — merge with ``Profiler.to_chrome_trace`` exports on a
+        shared ``epoch`` (``cli.py --serve --trace``)."""
+        return self.spans.to_chrome_trace(tid=tid, tid_name=tid_name,
+                                          epoch=epoch)
+
+    def _emit_batch(self, n_live, bucket, fill, wall, iters, resid,
+                    spans_ms=None, slo_summary=None):
         # one 'serve' JSONL event per batch — free when no sink is set
-        from amgcl_tpu.telemetry.sink import NullSink, get_default_sink
-        if isinstance(get_default_sink(), NullSink):
+        if not _sink_attached():
             return
         from amgcl_tpu import telemetry
+        from amgcl_tpu.serve.batched import STACKED_LOWERING
         # lifetime rollup rides NESTED (it shares key names with the
         # per-batch fields — requests, solves_per_sec — and a kwarg
         # collision here would raise AFTER the futures resolved, i.e.
         # vanish into _loop's already-done exception sink)
         telemetry.emit(event="serve", requests=n_live, bucket=bucket,
+                       batch_fill=round(fill, 4),
                        wall_s=round(wall, 6),
                        solves_per_sec=round(n_live / wall, 3)
                        if wall > 0 else None,
                        iters_max=int(np.max(iters)),
                        resid_max=float(np.max(resid)),
-                       totals=self.stats())
+                       lowering=STACKED_LOWERING,
+                       spans_ms=spans_ms or {},
+                       totals=self.stats(_summary=slo_summary))
 
     # -- stats / lifecycle ----------------------------------------------------
 
-    def stats(self) -> Dict[str, Any]:
+    def stats(self, _summary: Optional[Dict[str, Any]] = None
+              ) -> Dict[str, Any]:
         """Service-lifetime rollup: request/batch counts, solves/sec
-        over the busy window, and the per-request latency percentiles
-        (the same interpolated percentiles the fleet metrics use —
-        telemetry/metrics.py)."""
+        over the busy window, per-request latency percentiles (the same
+        interpolated percentiles the fleet metrics use —
+        telemetry/metrics.py), plus the serving-observability totals:
+        timeout/unhealthy counts, mean span breakdown and occupancy of
+        the rolling window, the padding-waste ledger, the compile-cache
+        join, the SLO watchdog state, and the scrape port when the
+        /metrics server runs (the ``capi.serve_stats`` payload).
+        ``_summary`` lets the worker pass the window summary its
+        watchdog pass just computed instead of recomputing it."""
         from amgcl_tpu.telemetry import metrics as _metrics
+        from amgcl_tpu.serve.batched import STACKED_LOWERING
         with self._lock:
             lat = list(self._lat)
             out: Dict[str, Any] = {
@@ -384,9 +829,13 @@ class SolverService:
                 "batches": self._n_batches,
                 "padded_slots": self._n_padded,
                 "batch_bucket": self.batch,
+                "timeouts": self._n_timeouts,
+                "unhealthy": self._n_unhealthy,
+                "slo_trips": self._slo_trips,
             }
             span = (self._t_last - self._t_first) \
                 if self._t_first is not None and self._t_last else None
+            waste = dict(self._waste)
         if span and span > 0:
             out["solves_per_sec"] = round(out["requests"] / span, 3)
         if lat:
@@ -394,23 +843,91 @@ class SolverService:
                 "p50": round(_metrics.percentile(lat, 50), 6),
                 "p99": round(_metrics.percentile(lat, 99), 6),
                 "max": round(max(lat), 6)}
+        summary = _summary if _summary is not None else self.slo_summary()
+        out["lowering"] = STACKED_LOWERING
+        out["spans_ms"] = summary["spans_ms"]
+        if summary["batch_fill"] is not None:
+            out["batch_fill"] = summary["batch_fill"]
+        if any(waste.values()):
+            out["padding_waste"] = waste
+        if self._last_slo is not None:
+            # sourced from the SAME summary as spans_ms/batch_fill above
+            # so one stats() snapshot is internally consistent (the
+            # _last_slo gate only says "the watchdog has run")
+            out["slo"] = {"trips": summary.get("trips", []),
+                          "p99_ms": summary.get("p99_ms"),
+                          "timeout_rate": summary.get("timeout_rate"),
+                          "unhealthy_rate":
+                              summary.get("unhealthy_rate"),
+                          "targets": dict(self.slo,
+                                          window=self.slo_window)}
+        if _cwatch.enabled():
+            snap = _cwatch.snapshot(_SERVE_STEP)
+            out["compile"] = {"traces": snap["traces"],
+                              "cache_hits": snap["cache_hits"],
+                              "compile_s": snap["compile_s"]}
+        if self.metrics_server is not None:
+            out["metrics_port"] = self.metrics_server.port
         return out
 
+    def _fail_stragglers(self):
+        """Fail every request still sitting on a queue no worker will
+        drain again (close() after join, or a submit() that raced
+        close()). Entries the worker already served carry resolved
+        futures and are skipped."""
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                return
+            if item is not _SENTINEL and not item.future.done():
+                item.future.set_exception(
+                    RuntimeError("SolverService is closed"))
+
     def close(self, timeout: float = 10.0):
-        """Drain the queue, stop the worker, emit a final ``serve``
-        summary event."""
-        if self._thread is not None:
+        """Drain the queue, stop the worker (and the /metrics server),
+        emit a final ``serve`` summary event. TERMINAL: a submit()
+        racing (or following) close() raises instead of silently
+        resurrecting a worker + metrics port nothing would ever stop —
+        the state handoff rides the same lock start() takes, the join
+        happens outside it (the worker takes the lock per batch). If
+        the join exceeds ``timeout`` the worker keeps draining and the
+        teardown (straggler-fail, final event, scrape endpoint) is
+        deferred to a later close()."""
+        with self._lock:
+            self._closed = True
             self._stop = True
+            thread = self._thread
+        if thread is not None:
             try:
                 self.queue.put(_SENTINEL, block=False)
             except queue.Full:
                 pass
-            self._thread.join(timeout)
+            thread.join(timeout)
+            if thread.is_alive():
+                # join TIMED OUT: the worker is still draining and owns
+                # the queue — leave the thread reference, the queued
+                # requests, the final event and the scrape endpoint to
+                # a later close() (or process exit) rather than failing
+                # solvable requests and going dark mid-drain
+                return
+        with self._lock:
+            # nulled only AFTER a completed join: submit()'s raced-
+            # close check treats `_thread is None` as "the graceful
+            # drain is over", and must not steal entries the worker
+            # would still serve
             self._thread = None
-        from amgcl_tpu.telemetry.sink import NullSink, get_default_sink
-        if not isinstance(get_default_sink(), NullSink):
+        # entries stuck behind the sentinel (or raced in while the
+        # worker exited) would never resolve — fail them now
+        self._fail_stragglers()
+        if _sink_attached():
             from amgcl_tpu import telemetry
             telemetry.emit(event="serve", final=True, **self.stats())
+        with self._lock:
+            server, self.metrics_server = self.metrics_server, None
+        if server is not None:
+            # after the final event so a last scrape can still land
+            server.close()
 
     def __enter__(self) -> "SolverService":
         return self.start()

@@ -38,12 +38,14 @@ plus the efficiency leg (PR 4):
 
 from amgcl_tpu.telemetry.report import SolveReport
 from amgcl_tpu.telemetry.history import HistoryMixin
-from amgcl_tpu.telemetry.tracing import phase, annotate, setup_scope
+from amgcl_tpu.telemetry.tracing import (phase, annotate, setup_scope,
+                                         RequestSpans)
 from amgcl_tpu.telemetry.sink import (JsonlSink, NullSink, emit,
                                       get_default_sink, set_default_sink)
 from amgcl_tpu.telemetry.health import (HealthState, decode as decode_health,
                                         diagnose, format_findings,
-                                        probe_hierarchy, two_grid_factor)
+                                        probe_hierarchy, serve_findings,
+                                        two_grid_factor)
 from amgcl_tpu.telemetry.ledger import (DeviceMemoryBudget,
                                         dense_window_budget,
                                         hierarchy_ledger, summarize_ledger,
@@ -62,16 +64,23 @@ from amgcl_tpu.telemetry.compile_watch import (watched_jit,
                                                compile_snapshot,
                                                global_watch)
 from amgcl_tpu.telemetry import metrics
+# live registry + scrape endpoint (serve observability) — module-named
+# like ``metrics``; the classes ride along for direct construction
+from amgcl_tpu.telemetry import live
+from amgcl_tpu.telemetry.live import LiveRegistry, MetricsServer
 
 __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
-           "setup_scope", "JsonlSink", "NullSink", "emit",
+           "setup_scope", "RequestSpans", "JsonlSink", "NullSink",
+           "emit",
            "get_default_sink", "set_default_sink", "DeviceMemoryBudget",
            "dense_window_budget", "hierarchy_ledger", "summarize_ledger",
            "format_ledger", "mv_cost", "cycle_cost_model",
            "krylov_iteration_model", "comm_model", "allreduce_model",
            "krylov_comm_model", "xla_cost_analysis", "HealthState",
            "decode_health", "diagnose", "format_findings",
-           "probe_hierarchy", "two_grid_factor", "device_peaks",
+           "probe_hierarchy", "serve_findings", "two_grid_factor",
+           "device_peaks",
            "measure_stages", "format_roofline",
            "solve_roofline", "counter_map", "xla_stage_check",
-           "watched_jit", "compile_snapshot", "global_watch", "metrics"]
+           "watched_jit", "compile_snapshot", "global_watch", "metrics",
+           "live", "LiveRegistry", "MetricsServer"]

@@ -359,20 +359,104 @@ def _finding(sev, code, message, suggestion=None):
     return f
 
 
+def serve_findings(serve: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Serve-side findings from an SLO-watchdog window summary
+    (``SolverService.slo_summary()``): which thresholds tripped, WHERE
+    the latency went (the span breakdown names the dominant phase), and
+    whether the batching itself wastes work (padding). Pure dict
+    crunching, same {severity, code, message, suggestion} shape as the
+    solve-side findings — :func:`diagnose` folds these in via its
+    ``serve=`` argument, and the watchdog emits them on ``slo``
+    events."""
+    out: List[Dict[str, Any]] = []
+    trips = serve.get("trips") or []
+    slo = serve.get("slo") or {}
+    spans = serve.get("spans_ms") or {}
+    window = serve.get("window")
+    if "p99" in trips:
+        # attribute the latency to the dominant phase: the fix for a
+        # queue-bound p99 (batch shape) is the opposite of the fix for
+        # a solve-bound one (make the solve itself faster)
+        parts = {k: spans.get(k) or 0.0
+                 for k in ("queue", "pad", "compile", "solve", "sync")}
+        total = sum(parts.values()) or 1.0
+        dom = max(parts, key=parts.get)
+        msg = ("serving p99 latency %.1f ms exceeds the %.1f ms SLO "
+               "over the last %s request(s) — dominated by %s_ms "
+               "(%.0f%% of the span breakdown)"
+               % (serve.get("p99_ms", float("nan")),
+                  slo.get("p99_ms", float("nan")), window, dom,
+                  100.0 * parts[dom] / total))
+        sug = {
+            "queue": "raise the batch bucket B or shorten the flush "
+                     "deadline (AMGCL_TPU_SERVE_FLUSH_MS) so requests "
+                     "spend less time queued; add worker capacity if "
+                     "the queue depth keeps growing",
+            "pad": "host packing dominates — submit contiguous "
+                   "float buffers of the solver dtype to avoid "
+                   "per-request conversion copies",
+            "compile": "cold XLA compiles dominate — warm every "
+                       "(shape, B) bucket at startup (submit one dummy "
+                       "request per bucket) so traffic never pays them",
+            "solve": "the device solve itself dominates — batching "
+                     "cannot help; cut iterations (stronger "
+                     "preconditioner) or move to a faster device",
+            "sync": "result fetch/decode dominates — keep results on "
+                    "device or batch the host round trips",
+        }[dom]
+        out.append(_finding("critical", "slo_p99", msg, sug))
+    if "timeout_rate" in trips:
+        out.append(_finding(
+            "critical", "slo_timeout_rate",
+            "%.1f%% of the last %s request(s) timed out in the serve "
+            "queue (SLO %.1f%%)"
+            % (100 * serve.get("timeout_rate", 0), window,
+               100 * slo.get("timeout_rate", 0)),
+            "the service is overloaded: raise AMGCL_TPU_SERVE_TIMEOUT_S "
+            "only if callers tolerate the latency — otherwise add "
+            "capacity or shed load (submit(block=False) backpressure)"))
+    if "unhealthy_rate" in trips:
+        out.append(_finding(
+            "critical", "slo_unhealthy_rate",
+            "%.1f%% of the last %s request(s) finished with tripped "
+            "health guards (SLO %.1f%%)"
+            % (100 * serve.get("unhealthy_rate", 0), window,
+               100 * slo.get("unhealthy_rate", 0)),
+            "inspect the per-request health decodes (serve_request "
+            "events / SolveReport.health) and run cli.py --doctor on a "
+            "failing rhs — a systematic breakdown is an operator/"
+            "preconditioner problem, not a serving problem"))
+    fill = serve.get("batch_fill")
+    if fill is not None and fill < 0.5:
+        out.append(_finding(
+            "warning", "serve_padding_waste",
+            "mean batch_fill %.2f < 0.5 — over half the padded bucket "
+            "columns are zero-padding, wasted device work the ledger "
+            "books as padding_waste bytes/FLOPs" % fill,
+            "shrink the bucket (batch B) toward the real arrival rate, "
+            "or raise the flush deadline (AMGCL_TPU_SERVE_FLUSH_MS) so "
+            "batches fill before dispatch"))
+    return out
+
+
 def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              probe: Optional[List[Dict[str, Any]]] = None,
              tol: Optional[float] = None,
              maxiter: Optional[int] = None,
              roofline: Optional[Dict[str, Any]] = None,
-             compile_stats: Optional[Dict[str, Any]] = None
+             compile_stats: Optional[Dict[str, Any]] = None,
+             serve: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
     the efficiency leg — a roofline join (``AMG.roofline()``: its ranked
     bottleneck stages ride along) and compile-watch stats (retraces
     after warmup become findings; so does compile time dominating the
-    solve). Each finding: {severity, code, message, suggestion}. Pure
-    host-side dict-crunching — never raises on missing pieces."""
+    solve). ``serve`` takes an SLO-watchdog window summary
+    (``SolverService.slo_summary()``) and folds in the serve-side
+    findings (:func:`serve_findings`). Each finding: {severity, code,
+    message, suggestion}. Pure host-side dict-crunching — never raises
+    on missing pieces."""
     out: List[Dict[str, Any]] = []
     health = getattr(report, "health", None) or {}
     resid = getattr(report, "resid", None)
@@ -522,6 +606,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     if isinstance(roofline, dict):
         out.extend(f for f in roofline.get("bottlenecks", [])
                    if isinstance(f, dict) and "severity" in f)
+    if isinstance(serve, dict):
+        out.extend(serve_findings(serve))
     if isinstance(compile_stats, dict):
         from amgcl_tpu.telemetry import compile_watch as _cw
         out.extend(_cw.findings(compile_stats))

@@ -426,7 +426,9 @@ def krylov_iteration_model(solver_name: str, A_dev,
                            cycle_total: Optional[Dict[str, int]] = None,
                            pre_cycles: int = 1,
                            fused: Optional[bool] = None,
-                           batch: int = 1) -> Dict[str, Any]:
+                           batch: int = 1,
+                           effective_batch: Optional[int] = None
+                           ) -> Dict[str, Any]:
     """FLOPs/HBM-bytes of one outer Krylov iteration: the solver's SpMVs
     and vector work plus ``pre_cycles`` multigrid cycles per
     preconditioner application (``cycle_total`` from cycle_cost_model).
@@ -444,7 +446,16 @@ def krylov_iteration_model(solver_name: str, A_dev,
     amortization that makes one stacked dispatch beat B single solves
     even before dispatch overhead. The multigrid-cycle bytes are scaled
     by B conservatively (the cycle total has no stored/vector split
-    here), so the modeled amortization is a floor, not the full win."""
+    here), so the modeled amortization is a floor, not the full win.
+
+    ``effective_batch`` prices padding: the serve path zero-pads
+    partial batches up to a power-of-two bucket (serve/service.py), so
+    only ``effective_batch`` of the ``batch`` columns are real work.
+    The model then also reports ``batch_fill`` plus the effective and
+    padding-waste splits of flops/bytes — wasted FLOPs scale with the
+    padded columns, wasted bytes with their per-column vector traffic
+    only (the stored operator is read once regardless), so the roofline
+    can separate effective from padded throughput."""
     spmv, papp, dots, axpys = KRYLOV_OPS.get(solver_name, (1, 1, 4, 4))
     if fused is None:
         fused = fused_vec_modeled()
@@ -453,10 +464,12 @@ def krylov_iteration_model(solver_name: str, A_dev,
     itemsize = _itemsize(A_dev) if A_dev is not None else 4
     vec = n * itemsize
     mv = mv_cost(A_dev)
+    stored_once = 0
     if batch > 1 and A_dev is not None:
         stored = _leaf_bytes(A_dev)
         mv = {"flops": mv["flops"] * batch,
               "bytes": stored + batch * max(mv["bytes"] - stored, 0)}
+        stored_once = stored * spmv
     cost = _scale(mv, spmv)
     streams = KRYLOV_VEC_STREAMS_FUSED.get(solver_name) if fused else None
     if streams is None:
@@ -473,6 +486,20 @@ def krylov_iteration_model(solver_name: str, A_dev,
            "fused_vec": bool(fused), **cost}
     if batch > 1:
         out["batch"] = batch
+    if effective_batch is not None:
+        eff = min(max(int(effective_batch), 0), batch)
+        fill = eff / batch
+        # wasted bytes: the per-column-scaled traffic only — the stored
+        # operator read (stored_once) is paid once whatever the fill
+        per_col_bytes = max(cost["bytes"] - stored_once, 0)
+        waste_f = int(round(cost["flops"] * (1 - fill)))
+        waste_b = int(round(per_col_bytes * (1 - fill)))
+        out["effective_batch"] = eff
+        out["batch_fill"] = round(fill, 4)
+        out["padding_waste_flops"] = waste_f
+        out["padding_waste_bytes"] = waste_b
+        out["effective_flops"] = cost["flops"] - waste_f
+        out["effective_bytes"] = cost["bytes"] - waste_b
     if cost["bytes"]:
         out["flop_per_byte"] = round(cost["flops"] / cost["bytes"], 4)
     return out

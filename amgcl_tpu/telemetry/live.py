@@ -1,0 +1,295 @@
+"""Live metrics registry + scrape endpoint — the while-it-runs leg of
+the serving observability stack.
+
+Everything before this module reports AFTER the fact: ``SolveReport``
+describes a finished solve, ``SolverService.stats()`` summarizes a
+finished window, ``metrics.rollup_events`` aggregates a closed JSONL
+file. A resident service under live traffic needs the numbers WHILE it
+runs — queue depth, in-flight requests, batch occupancy, compile-cache
+behavior, latency percentiles — scrapeable by Prometheus without
+touching the worker thread. This module provides:
+
+* :data:`METRICS` — THE declared metric-name table. Every live metric
+  the registry accepts is a row here; the ``metric-name-literal`` lint
+  rule (analysis/lint.py) statically asserts every ``inc``/
+  ``set_gauge``/``observe`` call site under ``amgcl_tpu/`` uses a string
+  literal from this table, and the registry enforces the same contract
+  at runtime (unknown names raise). One table, no ad-hoc strings.
+* :class:`LiveRegistry` — thread-safe counters (monotonic, optional
+  labels), gauges (last-value), and bounded histograms (a deque of the
+  last N observations, summarized with the same interpolated
+  percentiles the fleet rollups use). All updates are a dict write
+  under one lock — cheap enough for the serve worker's per-batch path.
+* :class:`MetricsServer` — a daemon ``http.server`` thread serving
+  ``/metrics`` (Prometheus exposition text, reusing
+  :func:`metrics.prometheus_text` for the histogram summaries) and
+  ``/healthz`` (JSON liveness). Bound to 127.0.0.1; port 0 binds an
+  ephemeral port (the bound port is on ``.port``).
+
+Enabled for the serving path by ``AMGCL_TPU_SERVE_METRICS_PORT`` or
+``cli.py --serve --metrics-port`` (serve/service.py wires it).
+
+The module body is stdlib + the sibling ``metrics.py`` only (jax never
+appears here, and a file-path load falls back to loading metrics.py by
+file path too, the sink.py discipline) — the scrape path must stay
+responsive while the worker thread holds the device.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from typing import Any, Callable, Dict, Optional, Tuple
+
+try:
+    from amgcl_tpu.telemetry import metrics as _metrics
+except ImportError:          # loaded by file path (sink.py discipline):
+    import importlib.util as _ilu    # pull the sibling the same way
+    _spec = _ilu.spec_from_file_location(
+        "_amgcl_tpu_metrics", os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "metrics.py"))
+    _metrics = _ilu.module_from_spec(_spec)
+    _spec.loader.exec_module(_metrics)
+
+#: THE declared metric table: name -> (kind, help). ``kind`` is one of
+#: "counter" | "gauge" | "histogram". The lint rule and the runtime
+#: registry both validate against exactly this dict — adding a metric
+#: means adding a row here first.
+METRICS: Dict[str, Tuple[str, str]] = {
+    "serve_queue_depth": (
+        "gauge", "requests waiting in the serve queue right now"),
+    "serve_inflight": (
+        "gauge", "requests inside the current device batch"),
+    "serve_requests_total": (
+        "counter", "requests completed by the service"),
+    "serve_batches_total": (
+        "counter", "device batches dispatched"),
+    "serve_timeouts_total": (
+        "counter", "requests expired in the queue before dispatch"),
+    "serve_unhealthy_total": (
+        "counter", "requests whose health guards tripped or whose "
+                   "batch dispatch raised"),
+    "serve_health_flags_total": (
+        "counter", "guard-flag trips by flag name (label: flag)"),
+    "serve_padded_slots_total": (
+        "counter", "zero-padded bucket columns dispatched (wasted)"),
+    "serve_bucket_solves_total": (
+        "counter", "requests retired by bucket size (label: bucket)"),
+    "serve_slo_trips_total": (
+        "counter", "SLO watchdog threshold trips"),
+    "serve_batch_fill": (
+        "histogram", "live columns / padded bucket B per batch"),
+    "serve_latency_ms": (
+        "histogram", "end-to-end per-request latency (submit->result)"),
+    "serve_queue_ms": (
+        "histogram", "per-request queue wait before batch assembly"),
+    "serve_solve_ms": (
+        "histogram", "per-batch device solve wall (compile excluded)"),
+    "serve_compile_traces": (
+        "gauge", "compile-watch traces of serve.solve_step"),
+    "serve_compile_cache_hits": (
+        "gauge", "compile-watch cache hits of serve.solve_step"),
+    "serve_compile_s": (
+        "gauge", "cumulative XLA compile seconds of serve.solve_step"),
+}
+
+# the ONE name-mangling rule, shared with the rollup exposition so the
+# two halves of a /metrics payload can never disagree on base names
+_prom_name = _metrics.prom_name
+
+
+def _prom_labels(labels: Tuple[Tuple[str, str], ...]) -> str:
+    if not labels:
+        return ""
+    return "{%s}" % ",".join('%s="%s"' % (k, str(v).replace('"', "'"))
+                             for k, v in labels)
+
+
+class LiveRegistry:
+    """Thread-safe in-process metrics, validated against a declared
+    table (:data:`METRICS` by default — an unknown name raises KeyError,
+    a kind mismatch raises TypeError; the same contract the
+    ``metric-name-literal`` lint rule enforces statically)."""
+
+    def __init__(self, spec: Optional[Dict[str, Tuple[str, str]]] = None,
+                 hist_cap: int = 2048):
+        self.spec = dict(METRICS if spec is None else spec)
+        self.hist_cap = int(hist_cap)
+        self._lock = threading.Lock()
+        #: (name, labels-tuple) -> float, labels sorted for identity
+        self._counters: Dict[Tuple[str, Tuple], float] = {}
+        self._gauges: Dict[str, float] = {}
+        self._hists: Dict[str, deque] = {}
+
+    def _check(self, name: str, kind: str) -> None:
+        row = self.spec.get(name)
+        if row is None:
+            raise KeyError(
+                "undeclared live metric %r — add it to telemetry/live.py"
+                " METRICS (the metric-name-literal rule enforces the "
+                "same table statically)" % name)
+        if row[0] != kind:
+            raise TypeError("metric %r is declared %r, not %r"
+                            % (name, row[0], kind))
+
+    # -- updates (the worker's hot path: one lock, one dict write) ----------
+
+    def inc(self, name: str, by: float = 1, **labels) -> None:
+        self._check(name, "counter")
+        key = (name, tuple(sorted(labels.items())))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0) + by
+
+    def set_gauge(self, name: str, value: float) -> None:
+        self._check(name, "gauge")
+        with self._lock:
+            self._gauges[name] = float(value)
+
+    def observe(self, name: str, value: float) -> None:
+        self._check(name, "histogram")
+        with self._lock:
+            h = self._hists.get(name)
+            if h is None:
+                h = self._hists[name] = deque(maxlen=self.hist_cap)
+            h.append(float(value))
+
+    # -- reads ---------------------------------------------------------------
+
+    def get(self, name: str, **labels) -> Optional[float]:
+        """Current value: counter (with exact labels) or gauge; the last
+        observation for a histogram. None when never touched."""
+        kind = self.spec.get(name, (None,))[0]
+        with self._lock:
+            if kind == "counter":
+                return self._counters.get(
+                    (name, tuple(sorted(labels.items()))))
+            if kind == "gauge":
+                return self._gauges.get(name)
+            if kind == "histogram":
+                h = self._hists.get(name)
+                return h[-1] if h else None
+        return None
+
+    def snapshot(self) -> Dict[str, Any]:
+        """JSON-clean copy: counters (labels flattened into the key),
+        gauges, and histogram rollups ({count, min, p50, p90, p99, max,
+        mean, last} via the fleet percentile helpers)."""
+        with self._lock:
+            counters = {name + _prom_labels(labels): v
+                        for (name, labels), v in self._counters.items()}
+            gauges = dict(self._gauges)
+            hists = {name: list(h) for name, h in self._hists.items()}
+        return {"counters": counters, "gauges": gauges,
+                "histograms": {name: _metrics.rollup(vals)
+                               for name, vals in hists.items()
+                               if vals}}
+
+    def prometheus(self, prefix: str = "amgcl_tpu") -> str:
+        """Prometheus exposition text of everything live: counters and
+        gauges as typed scalar lines, histograms as the summary-style
+        quantile gauges :func:`metrics.prometheus_text` renders."""
+        with self._lock:
+            counters = sorted(self._counters.items())
+            gauges = sorted(self._gauges.items())
+            hists = {name: list(h) for name, h in self._hists.items()}
+        lines = []
+        seen_type = set()
+        for (name, labels), v in counters:
+            metric = _prom_name(prefix, name)
+            if metric not in seen_type:
+                seen_type.add(metric)
+                lines.append("# HELP %s %s"
+                             % (metric, self.spec[name][1]))
+                lines.append("# TYPE %s counter" % metric)
+            lines.append("%s%s %s" % (metric, _prom_labels(labels), v))
+        for name, v in gauges:
+            metric = _prom_name(prefix, name)
+            lines.append("# HELP %s %s" % (metric, self.spec[name][1]))
+            lines.append("# TYPE %s gauge" % metric)
+            lines.append("%s %s" % (metric, v))
+        rollups = {name: r for name, r in
+                   ((name, _metrics.rollup(vals))
+                    for name, vals in sorted(hists.items()))
+                   if r is not None}
+        text = "\n".join(lines) + ("\n" if lines else "")
+        if rollups:
+            text += _metrics.prometheus_text(rollups, prefix=prefix)
+        return text
+
+
+def metrics_port_from_env() -> Optional[int]:
+    """``AMGCL_TPU_SERVE_METRICS_PORT``: unset/unparseable = no scrape
+    server; an integer (0 = ephemeral port) enables it."""
+    raw = os.environ.get("AMGCL_TPU_SERVE_METRICS_PORT")
+    if raw is None or raw == "":
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+class MetricsServer:
+    """Daemon HTTP thread serving ``/metrics`` (Prometheus text) and
+    ``/healthz`` (JSON) on 127.0.0.1. ``metrics_cb`` returns the
+    exposition text, ``health_cb`` a JSON-able dict; both run on the
+    scrape thread, so they must not block on the device (the registry's
+    lock-and-copy reads never do). Port 0 binds an ephemeral port —
+    read the real one from ``.port``."""
+
+    def __init__(self, port: int,
+                 metrics_cb: Callable[[], str],
+                 health_cb: Optional[Callable[[], Dict[str, Any]]] = None,
+                 host: str = "127.0.0.1"):
+        from http.server import (BaseHTTPRequestHandler,
+                                 ThreadingHTTPServer)
+        server = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                try:
+                    if self.path.split("?")[0] == "/metrics":
+                        body = server.metrics_cb().encode()
+                        ctype = ("text/plain; version=0.0.4; "
+                                 "charset=utf-8")
+                    elif self.path.split("?")[0] == "/healthz":
+                        payload = (server.health_cb()
+                                   if server.health_cb else {"ok": True})
+                        body = json.dumps(payload).encode()
+                        ctype = "application/json"
+                    else:
+                        self.send_error(404)
+                        return
+                except Exception as e:   # noqa: BLE001 — a scrape must
+                    self.send_error(500, repr(e)[:120])   # never crash
+                    return                                # the server
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):    # scrapes are not log lines
+                pass
+
+        self.metrics_cb = metrics_cb
+        self.health_cb = health_cb
+        self._httpd = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self.host = host
+        self.port = int(self._httpd.server_address[1])
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="amgcl-tpu-metrics")
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return "http://%s:%d/metrics" % (self.host, self.port)
+
+    def close(self, timeout: float = 5.0) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout)

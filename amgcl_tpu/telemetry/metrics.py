@@ -66,6 +66,16 @@ EVENT_FIELDS = {
               ("achieved_gbps", "achieved_gbps")],
     "bench_worker": [("solve_time_s", "value"), ("iters", "iters"),
                      ("achieved_gbps", "achieved_gbps")],
+    # serving path (serve/service.py): per-batch dispatch records and
+    # the per-request span events — latency/occupancy trends scrape
+    # from the same sink files as everything else
+    "serve": [("requests", "requests"), ("wall_s", "wall_s"),
+              ("solves_per_sec", "solves_per_sec"),
+              ("batch_fill", "batch_fill"),
+              ("iters_max", "iters_max")],
+    "serve_request": [("latency_ms", "latency_ms"),
+                      ("queue_ms", "queue_ms"),
+                      ("solve_ms", "solve_ms"), ("iters", "iters")],
 }
 
 
@@ -230,7 +240,10 @@ def rollup_events(records: List[Dict[str, Any]],
     groups: Dict[str, List[Dict[str, Any]]] = {}
     for rec in records:
         ev = rec.get("event")
-        if ev in spec:
+        # final=True marks a lifetime-summary row (e.g. the serve
+        # close() event) whose fields aggregate the whole run — mixing
+        # it with the per-sample rows would skew every rollup
+        if ev in spec and not rec.get("final"):
             groups.setdefault(ev, []).append(rec)
     out = {}
     for ev, recs in groups.items():
@@ -241,6 +254,14 @@ def rollup_events(records: List[Dict[str, Any]],
     return out
 
 
+def prom_name(prefix: str, name: str) -> str:
+    """THE Prometheus metric-name mangling rule — prefix join +
+    sanitize to [a-zA-Z0-9_]. One implementation shared by the rollup
+    exposition below and the live registry (telemetry/live.py), so the
+    two halves of one /metrics payload can never disagree on names."""
+    return "%s_%s" % (prefix, re.sub(r"[^a-zA-Z0-9_]", "_", name))
+
+
 def prometheus_text(rollups: Dict[str, Dict[str, Any]],
                     prefix: str = "amgcl_tpu") -> str:
     """Prometheus exposition format of a rollup table: summary-style
@@ -249,7 +270,7 @@ def prometheus_text(rollups: Dict[str, Dict[str, Any]],
     lines = []
     for name in sorted(rollups):
         r = rollups[name]
-        metric = "%s_%s" % (prefix, re.sub(r"[^a-zA-Z0-9_]", "_", name))
+        metric = prom_name(prefix, name)
         lines.append("# TYPE %s summary" % metric)
         for q, key in (("0.5", "p50"), ("0.9", "p90"), ("0.99", "p99")):
             if r.get(key) is not None:

@@ -70,6 +70,12 @@ class SolveReport:
     #: summarizes ({"p50": s, "p99": s, ...} — telemetry/metrics.py
     #: interpolated percentiles). None outside the serving path
     latency: Optional[Dict[str, Any]] = None
+    #: per-request serving-phase breakdown (serve/service.py):
+    #: ``{request_id, queue_ms, pad_ms, compile_ms, solve_ms, sync_ms,
+    #: bucket_B, batch_fill, latency_ms, lowering}`` — the phase wall
+    #: times sum to the end-to-end latency by construction. None for
+    #: reports born outside the SolverService queue
+    serve: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -119,6 +125,8 @@ class SolveReport:
             out["solves_per_sec"] = self.solves_per_sec
         if self.latency is not None:
             out["latency"] = self.latency
+        if self.serve is not None:
+            out["serve"] = self.serve
         if self.extra:
             out.update(self.extra)
         return out

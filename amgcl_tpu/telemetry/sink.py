@@ -24,6 +24,7 @@ import json
 import math
 import os
 import subprocess
+import threading
 import time
 from typing import Any, Dict, Optional
 
@@ -119,7 +120,16 @@ class JsonlSink:
     ``.1``) and starts fresh — a long-running service holds at most
     ~2x the cap on disk instead of growing without bound. Rotation is
     checked before the write, so a single record never splits across
-    the two files."""
+    the two files.
+
+    The write path is serialized by a per-instance lock: the serve
+    worker thread and foreground callers share the process-global sink,
+    and an unlocked rotate-then-append pair can interleave — thread A
+    rotates, thread B (who sized the file before the rename) rotates
+    again, and A's freshly written records vanish into a replaced
+    ``.1``. The lock makes size-check + rename + append one atomic
+    step; stream writes take it too so two threads' lines cannot
+    interleave mid-record on buffered streams."""
 
     def __init__(self, path: Optional[str] = None, stream=None,
                  stamp_records: bool = True, clean_records: bool = True,
@@ -132,6 +142,7 @@ class JsonlSink:
         self.clean_records = clean_records
         self.max_bytes = max_sink_bytes() if max_bytes is None \
             else int(max_bytes)
+        self._lock = threading.Lock()
 
     def _maybe_rotate(self):
         if not self.max_bytes or self.max_bytes <= 0:
@@ -150,13 +161,14 @@ class JsonlSink:
             rec = stamp(rec)
         line = json.dumps(_clean(rec) if self.clean_records else rec,
                           default=_jsonable)
-        if self.stream is not None:
-            self.stream.write(line + "\n")
-            self.stream.flush()
-        else:
-            self._maybe_rotate()
-            with open(self.path, "a") as f:
-                f.write(line + "\n")
+        with self._lock:
+            if self.stream is not None:
+                self.stream.write(line + "\n")
+                self.stream.flush()
+            else:
+                self._maybe_rotate()
+                with open(self.path, "a") as f:
+                    f.write(line + "\n")
         return rec
 
     def close(self):

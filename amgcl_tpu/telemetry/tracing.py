@@ -13,11 +13,21 @@ metadata at trace time.
 
 Both degrade to no-ops when the underlying jax API is unavailable, so
 telemetry never becomes a hard dependency of the numerics.
+
+:class:`RequestSpans` is the serving-path recorder: per-request phase
+spans (queue wait, padding, compile, device solve, sync) measured on
+the serve worker and exported as a Chrome/Perfetto track compatible
+with ``utils.profiler.Profiler.to_chrome_trace``'s epoch-merge — pass
+the same ``epoch`` and the request track lands on the CLI profiler's
+timeline (``cli.py --serve --trace``).
 """
 
 from __future__ import annotations
 
+import threading
+import time
 from contextlib import contextmanager, nullcontext
+from typing import Dict, List, Optional, Sequence, Tuple
 
 PREFIX = "amgcl/"
 
@@ -39,6 +49,72 @@ def annotate(name: str):
         return TraceAnnotation(PREFIX + name)
     except Exception:
         return nullcontext()
+
+
+class RequestSpans:
+    """Bounded thread-safe recorder of per-request serve phases.
+
+    ``add(request_id, phases)`` takes ``[(phase, start_s, end_s), ...]``
+    in ``time.perf_counter()`` seconds; the export renders one
+    ``reqNNNNN/phase`` complete event per span, same trace-event shape
+    as ``Profiler.to_chrome_trace`` so the tracks merge on a shared
+    epoch. Past ``max_events`` spans further requests are dropped (the
+    count is carried in the export), mirroring the Profiler cap — a
+    long-running service must not grow without bound."""
+
+    def __init__(self, max_events: int = 100_000):
+        self.max_events = int(max_events)
+        self._lock = threading.Lock()
+        #: (path, start_s, end_s) — the Profiler.events triple
+        self.events: List[Tuple[str, float, float]] = []
+        self.dropped = 0
+        self._t0 = time.perf_counter()
+
+    def add(self, request_id: int,
+            phases: Sequence[Tuple[str, float, float]],
+            label: str = "req") -> None:
+        """``label`` prefixes the span path: per-request spans ride
+        ``req<id>/...``, batch-shared phases (pad/compile/solve/sync are
+        one device dispatch for the whole bucket) ride ``batch<id>/...``
+        ONCE instead of B identical copies."""
+        with self._lock:
+            if len(self.events) + len(phases) > self.max_events:
+                self.dropped += len(phases)
+                return
+            for name, start, end in phases:
+                self.events.append(
+                    ("%s%05d/%s" % (label, int(request_id), name),
+                     float(start), float(end)))
+
+    def to_chrome_trace(self, tid: int = 0,
+                        tid_name: Optional[str] = None, pid: int = 0,
+                        epoch: Optional[float] = None) -> Dict:
+        """Chrome/Perfetto trace-event dict of the recorded spans —
+        concatenate ``traceEvents`` with other tracks sharing the same
+        ``epoch`` (see ``Profiler.to_chrome_trace``)."""
+        t0 = self._t0 if epoch is None else epoch
+        with self._lock:
+            spans = list(self.events)
+            dropped = self.dropped
+        events = []
+        if tid_name:
+            events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                           "tid": tid, "args": {"name": tid_name}})
+        for path, start, end in spans:
+            events.append({
+                "name": path.rsplit("/", 1)[-1], "cat": "amgcl/serve",
+                "ph": "X", "ts": round((start - t0) * 1e6, 3),
+                "dur": round((end - start) * 1e6, 3),
+                "pid": pid, "tid": tid, "args": {"path": path}})
+        if dropped:
+            last_end = spans[-1][2] if spans else t0
+            events.append({
+                "name": "spans_dropped", "cat": "amgcl/serve",
+                "ph": "i", "s": "g",
+                "ts": round((last_end - t0) * 1e6, 3),
+                "pid": pid, "tid": tid,
+                "args": {"dropped": dropped, "cap": self.max_events}})
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
 @contextmanager

@@ -1162,7 +1162,13 @@ def _bench_throughput(solver, rhs_dev, on_tpu, bs=(1, 8, 32)):
     per-call overhead included — that is the number batching amortizes).
     ``solver`` is the headline bundle; the measurement builds a
     refine-free CG bundle SHARING its hierarchy (stacked solves gate
-    out refinement), so no second setup cost is paid."""
+    out refinement), so no second setup cost is paid.
+
+    Each row also carries SERVICE-measured per-request latency
+    percentiles (``latency_ms`` p50/p99 + ``service_sps``): 2B requests
+    pushed through a real ``SolverService`` at that bucket, so the
+    BENCH_r* trend tracks serving latency — queue, padding and sync
+    included — not just raw stacked solves/sec."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -1199,11 +1205,59 @@ def _bench_throughput(solver, rhs_dev, on_tpu, bs=(1, 8, 32)):
                "solves_per_sec": round(sps, 3),
                "iters_max": int(infoB.iters),
                "speedup_vs_single": round(sps * t1, 3)}
+        row.update(_serve_latency(slv, rhs_dev, B))
         out["rows"].append(row)
         out["b%d_sps" % B] = row["solves_per_sec"]
+        if row.get("latency_ms"):
+            out["b%d_p99_ms" % B] = row["latency_ms"]["p99"]
     if "b32_sps" in out:
         out["speedup_b32_vs_single"] = round(out["b32_sps"] * t1, 3)
     return out
+
+
+def _serve_latency(slv, rhs_dev, B, factor=2):
+    """Per-request latency p50/p99 through a resident SolverService at
+    bucket ``B`` — the serving numbers (queue wait + padding + solve +
+    sync), not the bare stacked-dispatch rate. ``factor * B`` requests
+    give the bucket at least two full batches. Never fails the bench:
+    errors come back as ``latency_error``."""
+    import numpy as np
+    try:
+        from amgcl_tpu.serve import SolverService
+        reqs = max(factor * B, 4)
+        # ONE device_get; per-submit np.asarray(rhs_dev) would pay a
+        # full device->host transfer per request and compete with the
+        # service worker for the device mid-measurement
+        rhs_host = np.asarray(rhs_dev)
+        import time as _time
+        from amgcl_tpu.telemetry import metrics as _metrics
+        with SolverService(slv, batch=B, flush_ms=5.0) as svc:
+            # warm the (shape, B) bucket OUTSIDE the measured window:
+            # the service's jitted entry has its own compile cache, so
+            # without this the percentiles track cold XLA compiles
+            # (and early partial-bucket compiles), not serving latency
+            warm = [svc.submit(rhs_host, block=True)
+                    for _ in range(max(B, 1))]
+            for f in warm:
+                f.result(timeout=600)
+            t0 = _time.perf_counter()
+            futs = [svc.submit(rhs_host * (1.0 + 0.1 * (k % max(B, 1))),
+                               block=True) for k in range(reqs)]
+            lats = [f.result(timeout=600)[1].serve["latency_ms"]
+                    for f in futs]
+            wall = _time.perf_counter() - t0
+        out = {}
+        if lats:
+            out["latency_ms"] = {
+                "p50": round(_metrics.percentile(lats, 50), 3),
+                "p99": round(_metrics.percentile(lats, 99), 3),
+                "max": round(max(lats), 3)}
+        if wall > 0:
+            out["service_sps"] = round(reqs / wall, 3)
+        return out
+    except Exception as e:            # noqa: BLE001 — latency detail is
+        return {"latency_error": repr(e)[:120]}   # optional, the gate
+        #                                           metric is b32_sps
 
 
 def main_throughput(args=None):
@@ -1234,9 +1288,12 @@ def main_throughput(args=None):
     print("throughput (n=%d^3, %s): single un-chained %.2f solves/s"
           % (n, dev0.platform, rec["single_unchained_sps"]))
     for row in rec["rows"]:
-        print("  B=%-3d  %8.4f s/batch  %8.2f solves/s  (%.2fx single)"
+        lat = row.get("latency_ms") or {}
+        print("  B=%-3d  %8.4f s/batch  %8.2f solves/s  (%.2fx single)%s"
               % (row["B"], row["batch_s"], row["solves_per_sec"],
-                 row["speedup_vs_single"]))
+                 row["speedup_vs_single"],
+                 "  serve p50 %.1fms p99 %.1fms"
+                 % (lat["p50"], lat["p99"]) if lat else ""))
     out = {"event": "bench_throughput", "n": n, **rec,
            "device": str(dev0), "device_platform": dev0.platform,
            "device_kind": getattr(dev0, "device_kind", None),

@@ -32,5 +32,8 @@ def test_table_covers_new_knobs():
                 "AMGCL_TPU_PEAK_FLOPS", "AMGCL_TPU_COMPILE_WATCH",
                 "AMGCL_TPU_ROOFLINE_REPS", "AMGCL_TPU_FUSED_VEC",
                 "AMGCL_TPU_PIPELINED_CG", "AMGCL_TPU_ANALYSIS_IN_CHECK",
-                "AMGCL_TPU_ANALYSIS_TIMEOUT"):
+                "AMGCL_TPU_ANALYSIS_TIMEOUT",
+                "AMGCL_TPU_SERVE_METRICS_PORT", "AMGCL_TPU_SLO_P99_MS",
+                "AMGCL_TPU_SLO_TIMEOUT_RATE",
+                "AMGCL_TPU_SLO_UNHEALTHY_RATE", "AMGCL_TPU_SLO_WINDOW"):
         assert var in documented, var

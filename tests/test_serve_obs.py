@@ -1,0 +1,568 @@
+"""Serving observability (ISSUE 8): per-request span partition, live
+registry + occupancy math, the /metrics scrape endpoint, the SLO
+watchdog + serve-side findings, the serve/slo JSONL event schemas, the
+lowering tag, the padding-waste ledger, the JsonlSink write-path
+thread-safety fix, and the metric-name-literal lint rule.
+
+The acceptance invariant: every completed request's report carries a
+serve-phase breakdown whose phase sum is within 10% of its measured
+end-to-end latency (by construction the phases PARTITION the
+submit->result interval, so the slack only absorbs rounding).
+"""
+
+import json
+import os
+import textwrap
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from amgcl_tpu import telemetry
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.serve import STACKED_LOWERING, SolverService, lowering_kind
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.telemetry import live as tlive
+from amgcl_tpu.telemetry.health import diagnose, serve_findings
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bundle(m=8):
+    A, rhs = poisson3d(m)
+    ms = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=50),
+                     solver=CG(maxiter=50, tol=1e-6))
+    return A, rhs, ms
+
+
+# ===========================================================================
+# per-request spans
+# ===========================================================================
+
+def test_request_spans_partition_latency():
+    """report.serve carries the full phase breakdown; the phase sum is
+    within 10% of the end-to-end latency; request ids are unique and
+    the lowering tag marks the stacked trace."""
+    _, rhs, ms = _bundle()
+    with SolverService(ms, batch=4, flush_ms=25) as svc:
+        futs = [svc.submit(rhs * (1.0 + k)) for k in range(6)]
+        results = [f.result(timeout=120) for f in futs]
+    rids = set()
+    for _, rep in results:
+        s = rep.serve
+        assert s is not None
+        rids.add(s["request_id"])
+        total = (s["queue_ms"] + s["pad_ms"] + s["compile_ms"]
+                 + s["solve_ms"] + s["sync_ms"])
+        assert abs(total - s["latency_ms"]) \
+            <= 0.1 * s["latency_ms"] + 0.5, (total, s["latency_ms"])
+        assert s["bucket_B"] in (1, 2, 4)
+        assert 0 < s["batch_fill"] <= 1.0
+        assert s["lowering"] == STACKED_LOWERING == "xla-batched"
+        assert "serve" in rep.to_dict()
+    assert len(rids) == 6
+    # the span recorder kept a queue/solve span per request
+    paths = {p.split("/", 1)[1] for p, _, _ in svc.spans.events}
+    assert {"queue", "pad", "solve", "sync"} <= paths
+    trace = svc.to_chrome_trace(tid=3, tid_name="serve requests")
+    names = {e["name"] for e in trace["traceEvents"]
+             if e.get("ph") == "X"}
+    assert {"queue", "solve"} <= names
+
+
+def test_occupancy_math_on_partial_batch():
+    """3 requests land in a power-of-two bucket of 4: batch_fill = 0.75
+    everywhere (the reports, the live histogram, stats) and the
+    padding-waste ledger books the dead column. (The power-of-two
+    bucketing bounds per-dispatch fill to (0.5, 1] — 2 requests would
+    ride a bucket of 2 at fill 1.0.)"""
+    _, rhs, ms = _bundle()
+    # long flush: all three submits must join ONE batch
+    with SolverService(ms, batch=4, flush_ms=2000) as svc:
+        futs = [svc.submit(rhs * (1.0 + k)) for k in range(3)]
+        results = [f.result(timeout=120) for f in futs]
+        stats = svc.stats()
+    for _, rep in results:
+        assert rep.serve["bucket_B"] == 4
+        assert rep.serve["batch_fill"] == 0.75
+    assert svc.live.get("serve_batch_fill") == 0.75
+    assert svc.live.get("serve_padded_slots_total") == 1
+    assert svc.live.get("serve_requests_total") == 3
+    assert svc.live.get("serve_bucket_solves_total", bucket="4") == 3
+    assert stats["batch_fill"] == 0.75
+    waste = stats["padding_waste"]
+    assert waste["flops"] > 0 and waste["bytes"] > 0
+    iters_max = max(r[1].iters for r in results)
+    assert waste["padded_col_iters"] == 1 * iters_max
+
+
+def test_padding_waste_ledger_model():
+    """krylov_iteration_model(effective_batch=k): fill math, the
+    effective/waste split, and the amortization asymmetry (FLOPs scale
+    with padding, stored-operator bytes do not)."""
+    from amgcl_tpu.ops import device as dev
+    from amgcl_tpu.telemetry.ledger import krylov_iteration_model
+    A, _ = poisson3d(8)
+    Ad = dev.to_device(A, "dia", jnp.float32)
+    m = krylov_iteration_model("CG", Ad, batch=8, effective_batch=2)
+    assert m["batch"] == 8 and m["effective_batch"] == 2
+    assert m["batch_fill"] == 0.25
+    assert m["padding_waste_flops"] + m["effective_flops"] == m["flops"]
+    assert m["padding_waste_bytes"] + m["effective_bytes"] == m["bytes"]
+    assert m["padding_waste_flops"] == int(round(0.75 * m["flops"]))
+    # bytes waste only covers the per-column traffic, so its fraction
+    # sits strictly below the FLOP fraction
+    assert 0 < m["padding_waste_bytes"] < 0.75 * m["bytes"]
+    full = krylov_iteration_model("CG", Ad, batch=8, effective_batch=8)
+    assert full["padding_waste_flops"] == 0
+    assert full["padding_waste_bytes"] == 0
+
+
+# ===========================================================================
+# /metrics endpoint
+# ===========================================================================
+
+def test_metrics_endpoint_scrape_smoke():
+    """Port 0 = ephemeral; /metrics serves live gauges (queue depth,
+    batch_fill, latency p99) that change between scrapes; /healthz
+    reports liveness."""
+    _, rhs, ms = _bundle()
+    with SolverService(ms, batch=2, flush_ms=10, metrics_port=0) as svc:
+        port = svc.metrics_server.port
+        assert port > 0 and svc.metrics_url.endswith("/metrics")
+
+        def scrape():
+            return urllib.request.urlopen(
+                "http://127.0.0.1:%d/metrics" % port,
+                timeout=30).read().decode()
+
+        first = scrape()
+        assert "amgcl_tpu_serve_queue_depth" in first
+        futs = [svc.submit(rhs * (1.0 + k), block=True)
+                for k in range(4)]
+        [f.result(timeout=120) for f in futs]
+        second = scrape()
+        assert second != first
+        assert "amgcl_tpu_serve_batch_fill" in second
+        assert 'amgcl_tpu_serve_latency_ms{quantile="0.99"}' in second
+        assert "amgcl_tpu_serve_requests_total 4" in second
+        h = json.loads(urllib.request.urlopen(
+            "http://127.0.0.1:%d/healthz" % port, timeout=30).read())
+        assert h["ok"] and h["requests"] == 4
+        assert svc.stats()["metrics_port"] == port
+    # close() tore the server down
+    assert svc.metrics_server is None
+
+
+def test_registry_rejects_undeclared_names():
+    reg = tlive.LiveRegistry()
+    with pytest.raises(KeyError):
+        reg.inc("not_a_declared_metric")
+    with pytest.raises(TypeError):
+        reg.inc("serve_queue_depth")      # declared, but a gauge
+
+
+# ===========================================================================
+# SLO watchdog
+# ===========================================================================
+
+def test_slo_trip_emits_event_with_findings(tmp_path):
+    """An absurdly tight p99 target trips on the first batch: ONE slo
+    event lands in the sink with the serve-side findings (the watchdog
+    is edge-triggered — the second batch stays over threshold but emits
+    nothing new), the trip counter counts the incident once, and stats
+    carries the watchdog state."""
+    out = tmp_path / "slo.jsonl"
+    telemetry.set_default_sink(telemetry.JsonlSink(str(out)))
+    try:
+        _, rhs, ms = _bundle()
+        with SolverService(ms, batch=2, flush_ms=10,
+                           slo_p99_ms=1e-6) as svc:
+            futs = [svc.submit(rhs * (1.0 + k), block=True)
+                    for k in range(4)]
+            [f.result(timeout=120) for f in futs]
+            stats = svc.stats()
+    finally:
+        telemetry.set_default_sink(telemetry.NullSink())
+    assert stats["slo_trips"] == 1   # one incident, not one per batch
+    assert "p99" in stats["slo"]["trips"]
+    assert stats["slo"]["targets"]["p99_ms"] == 1e-6
+    recs = [json.loads(ln) for ln in open(out)]
+    slo = [r for r in recs if r.get("event") == "slo"]
+    assert slo, "no slo events emitted"
+    ev = slo[-1]
+    assert ev["trips"] == ["p99"]
+    finds = ev["findings"]
+    assert finds and finds[0]["code"] == "slo_p99"
+    assert "p99 latency" in finds[0]["message"]
+    assert "dominated by" in finds[0]["message"]
+
+
+def test_serve_findings_attribution_and_padding():
+    """The p99 finding names the dominant phase with the matching
+    suggestion; batch_fill < 0.5 yields the padding-waste warning; the
+    findings ride telemetry.diagnose(serve=...)."""
+    base = {"window": 100, "p50_ms": 5.0, "p99_ms": 50.0,
+            "timeout_rate": 0.0, "unhealthy_rate": 0.0,
+            "batch_fill": 0.9, "bucket": 8,
+            "slo": {"p99_ms": 10.0, "timeout_rate": 0.01,
+                    "unhealthy_rate": 0.05, "window": 256},
+            "trips": ["p99"]}
+    queue_bound = dict(base, spans_ms={"queue": 40.0, "pad": 1.0,
+                                       "compile": 0.0, "solve": 8.0,
+                                       "sync": 1.0})
+    f = serve_findings(queue_bound)
+    assert f[0]["code"] == "slo_p99"
+    assert "dominated by queue_ms" in f[0]["message"]
+    assert "flush deadline" in f[0]["suggestion"]
+    solve_bound = dict(base, spans_ms={"queue": 1.0, "pad": 1.0,
+                                       "compile": 0.0, "solve": 45.0,
+                                       "sync": 1.0})
+    f = serve_findings(solve_bound)
+    assert "dominated by solve_ms" in f[0]["message"]
+    assert "batching cannot help" in f[0]["suggestion"]
+    # padding waste is a standing warning, trip or no trip
+    sparse = dict(base, trips=[], batch_fill=0.3,
+                  spans_ms=queue_bound["spans_ms"])
+    f = serve_findings(sparse)
+    assert [x["code"] for x in f] == ["serve_padding_waste"]
+    assert "batch_fill 0.30" in f[0]["message"]
+    assert "shrink the bucket" in f[0]["suggestion"]
+    # rate trips
+    rates = dict(base, trips=["timeout_rate", "unhealthy_rate"],
+                 timeout_rate=0.5, unhealthy_rate=0.25,
+                 spans_ms=queue_bound["spans_ms"])
+    codes = [x["code"] for x in serve_findings(rates)]
+    assert "slo_timeout_rate" in codes and "slo_unhealthy_rate" in codes
+    # diagnose folds them in next to the solve-side findings
+    finds = diagnose(None, serve=queue_bound)
+    assert any(x["code"] == "slo_p99" for x in finds)
+
+
+# ===========================================================================
+# event schemas
+# ===========================================================================
+
+SERVE_FIELDS = {"event", "requests", "bucket", "batch_fill", "wall_s",
+                "solves_per_sec", "iters_max", "resid_max", "lowering",
+                "spans_ms", "totals", "ts", "ts_iso"}
+SERVE_REQUEST_FIELDS = {"event", "request_id", "iters", "resid",
+                        "healthy", "queue_ms", "pad_ms", "compile_ms",
+                        "solve_ms", "sync_ms", "bucket_B", "batch_fill",
+                        "latency_ms", "lowering", "ts", "ts_iso"}
+SLO_FIELDS = {"event", "window", "p50_ms", "p99_ms", "timeout_rate",
+              "unhealthy_rate", "batch_fill", "bucket", "spans_ms",
+              "slo", "trips", "new_trips", "findings", "ts", "ts_iso"}
+
+
+def test_serve_event_schemas(tmp_path):
+    """Pin the serve / serve_request / slo JSONL event fields — sink
+    consumers (dashboards, the fleet rollups) parse these by name."""
+    out = tmp_path / "schema.jsonl"
+    telemetry.set_default_sink(telemetry.JsonlSink(str(out)))
+    try:
+        _, rhs, ms = _bundle()
+        with SolverService(ms, batch=2, flush_ms=10,
+                           slo_p99_ms=1e-6) as svc:
+            futs = [svc.submit(rhs * (1.0 + k), block=True)
+                    for k in range(4)]
+            [f.result(timeout=120) for f in futs]
+    finally:
+        telemetry.set_default_sink(telemetry.NullSink())
+    recs = [json.loads(ln) for ln in open(out)]
+    per_batch = [r for r in recs if r.get("event") == "serve"
+                 and not r.get("final")]
+    assert per_batch
+    for r in per_batch:
+        assert set(r) == SERVE_FIELDS, set(r) ^ SERVE_FIELDS
+        assert set(r["spans_ms"]) == {"queue", "pad", "compile",
+                                      "solve", "sync"}
+    reqs = [r for r in recs if r.get("event") == "serve_request"]
+    assert len(reqs) == 4
+    for r in reqs:
+        assert set(r) == SERVE_REQUEST_FIELDS, \
+            set(r) ^ SERVE_REQUEST_FIELDS
+    slo = [r for r in recs if r.get("event") == "slo"]
+    assert slo
+    for r in slo:
+        assert set(r) == SLO_FIELDS, set(r) ^ SLO_FIELDS
+    # the final serve summary still rides the same sink
+    assert any(r.get("final") for r in recs if r.get("event") == "serve")
+    # and the fleet rollups aggregate the new events by name
+    from amgcl_tpu.telemetry import metrics as tmetrics
+    roll = tmetrics.rollup_events(recs)
+    assert roll["serve_request.latency_ms"]["count"] == 4
+    assert "serve.solves_per_sec" in roll
+    # the final=True lifetime summary must NOT ride the per-batch
+    # rollup: its top-level requests is the lifetime total (4), the
+    # per-batch rows carry at most the bucket size (2)
+    assert roll["serve.requests"]["max"] <= 2
+    assert roll["serve.requests"]["count"] == len(per_batch)
+
+
+# ===========================================================================
+# lowering tag (satellite: make the Pallas gate visible)
+# ===========================================================================
+
+def test_sink_failure_does_not_fail_futures(tmp_path):
+    """serve_request emission is deferred until after futures resolve.
+    The module-level telemetry.emit already swallows SINK errors, so to
+    pin the ordering itself this patches emit() to raise at the worker's
+    serve_request call site: if emission ever moves back before
+    ``set_result``, the raise propagates to _loop's handler and fails
+    the batch's futures — exactly what must not happen."""
+    _, rhs, ms = _bundle()
+    svc = SolverService(ms, batch=2, flush_ms=20)
+    orig = telemetry.emit
+
+    def boom(record=None, **fields):
+        if fields.get("event") == "serve_request":
+            raise OSError("disk full")
+        return orig(record, **fields)
+
+    telemetry.set_default_sink(
+        telemetry.JsonlSink(str(tmp_path / "boom.jsonl")))
+    telemetry.emit = boom
+    try:
+        futs = [svc.submit(rhs * (1.0 + k), block=True)
+                for k in range(2)]
+        for f in futs:
+            x, rep = f.result(timeout=120)   # must NOT raise
+            assert rep.serve["request_id"] > 0
+    finally:
+        telemetry.emit = orig
+        telemetry.set_default_sink(telemetry.NullSink())
+        svc.close()
+
+
+def test_metrics_port_bind_failure_leaks_nothing():
+    """A taken metrics port fails the first start() loudly, BEFORE the
+    worker thread launches — nothing to clean up, and the error names
+    the bind, not a half-started service."""
+    import socket
+    _, rhs, ms = _bundle()
+    blocker = socket.socket()
+    blocker.bind(("127.0.0.1", 0))
+    try:
+        port = blocker.getsockname()[1]
+        svc = SolverService(ms, batch=2, metrics_port=port)
+        with pytest.raises(OSError):
+            svc.start()
+        assert svc._thread is None and svc.metrics_server is None
+    finally:
+        blocker.close()
+
+
+def test_negative_metrics_port_disables_env_knob(monkeypatch):
+    """metrics_port=-1 means OFF even when AMGCL_TPU_SERVE_METRICS_PORT
+    is set fleet-wide — a second service on a host must be able to opt
+    out of the taken port."""
+    monkeypatch.setenv("AMGCL_TPU_SERVE_METRICS_PORT", "39999")
+    _, rhs, ms = _bundle()
+    with SolverService(ms, batch=2, metrics_port=-1) as svc:
+        svc.submit(rhs, block=True).result(timeout=120)
+        assert svc.metrics_port is None
+        assert svc.metrics_server is None and svc.metrics_url is None
+
+
+def test_submit_after_close_raises():
+    """close() is terminal: a submit() landing after (or racing) it
+    raises instead of silently resurrecting a worker thread and a
+    metrics port that nothing would ever stop."""
+    _, rhs, ms = _bundle()
+    svc = SolverService(ms, batch=2, flush_ms=10)
+    f = svc.submit(rhs, block=True)
+    f.result(timeout=120)
+    svc.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        svc.submit(rhs)
+    assert svc._thread is None and svc.metrics_server is None
+
+
+def test_failed_batch_stays_observable():
+    """A batch whose dispatch raises fails its futures AND stays
+    visible: in-flight gauge back to 0, the failed requests counted
+    unhealthy in the lifetime stats and the SLO rolling window."""
+    _, rhs, ms = _bundle()
+    with SolverService(ms, batch=2, flush_ms=50) as svc:
+        boom = RuntimeError("injected dispatch failure")
+
+        def _dispatch_fail(*a, **k):
+            raise boom
+
+        svc._dispatch = _dispatch_fail
+        futs = [svc.submit(rhs * (1.0 + k), block=True)
+                for k in range(2)]
+        for f in futs:
+            with pytest.raises(RuntimeError, match="injected"):
+                f.result(timeout=120)
+        # worker is asynchronous past future resolution: wait for the
+        # stats commit the failure path performs
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            if svc.stats()["unhealthy"] >= 2:
+                break
+            time.sleep(0.01)
+        stats = svc.stats()
+    assert stats["unhealthy"] == 2
+    assert svc.live.get("serve_inflight") == 0.0
+    assert svc.live.get("serve_unhealthy_total") == 2
+    assert stats["slo"]["unhealthy_rate"] == 1.0
+
+
+def test_lowering_tag_in_reports():
+    """Batched dispatches tag xla-batched; single-rhs dispatches tag
+    the live gate state (pallas/xla) in SolveReport.compile."""
+    A, rhs, _ = _bundle()
+    ms = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=50),
+                     solver=CG(maxiter=50, tol=1e-6), batch=2)
+    _, info1 = ms(rhs)
+    assert info1.compile["lowering"] == lowering_kind(
+        False, jnp.float32)
+    assert info1.compile["lowering"] in ("pallas", "xla")
+    R = np.stack([rhs, 2 * rhs], axis=1)
+    _, infob = ms(R)
+    assert infob.compile["lowering"] == "xla-batched"
+    # the tag is stickied at trace time: a warm repeat reuses jit's
+    # cached executable, so a gate-state change between calls must NOT
+    # relabel it (the tag describes the executable that ran) — but a
+    # fresh trace (new stacked shape) re-reads the gates
+    import amgcl_tpu.serve.batched as batched_mod
+    tag1 = info1.compile["lowering"]
+    orig = batched_mod.lowering_kind
+    batched_mod.lowering_kind = lambda *a, **k: "sentinel"
+    try:
+        _, info2 = ms(rhs)
+        assert info2.compile["new_traces"] == 0     # warm repeat
+        assert info2.compile["lowering"] == tag1    # sticky
+        R4 = np.stack([rhs, 2 * rhs, 3 * rhs, 4 * rhs], axis=1)
+        _, info4 = ms(R4)                           # fresh (n, 4) trace
+        assert info4.compile["new_traces"] >= 1
+        assert info4.compile["lowering"] == "sentinel"   # refreshed
+    finally:
+        batched_mod.lowering_kind = orig
+
+
+# ===========================================================================
+# JsonlSink write-path thread-safety (satellite)
+# ===========================================================================
+
+def test_jsonl_sink_two_writer_threads(tmp_path):
+    """Two threads hammering one size-capped (rotating) file sink: no
+    exceptions, every surviving line is intact JSON, and the live file
+    plus its .1 sibling stay within the rotation budget."""
+    path = tmp_path / "rot.jsonl"
+    sink = telemetry.JsonlSink(str(path), max_bytes=4096)
+    errors = []
+
+    def writer(tag, n=300):
+        try:
+            for i in range(n):
+                sink.emit(event="stress", tag=tag, i=i,
+                          pad="x" * 40)
+        except Exception as e:    # noqa: BLE001 — the assertion target
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in ("a", "b")]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(60)
+    assert not errors, errors
+    total = 0
+    for p in (str(path), str(path) + ".1"):
+        if not os.path.exists(p):
+            continue
+        with open(p) as f:
+            for line in f:
+                rec = json.loads(line)      # torn line would raise
+                assert rec["event"] == "stress"
+                total += 1
+    assert total > 0
+    # rotation kept the on-disk footprint bounded (~2x the cap + one
+    # record of slack per file)
+    for p in (str(path), str(path) + ".1"):
+        if os.path.exists(p):
+            assert os.path.getsize(p) < 4096 + 4096
+
+
+# ===========================================================================
+# metric-name-literal lint rule (satellite)
+# ===========================================================================
+
+def test_lint_metric_name_literal(tmp_path):
+    """Fixture package: a declared table in telemetry/live.py, one
+    clean call, one undeclared literal, one dynamic name — the rule
+    flags exactly the last two."""
+    from amgcl_tpu.analysis import lint
+    pkg = tmp_path / "pkg"
+    (pkg / "telemetry").mkdir(parents=True)
+    (pkg / "telemetry" / "live.py").write_text(textwrap.dedent("""
+        METRICS = {
+            "declared_total": ("counter", "x"),
+        }
+        class LiveRegistry:
+            def inc(self, name, by=1):
+                self._c[name] = by       # dynamic by design: exempt
+    """))
+    (pkg / "mod.py").write_text(textwrap.dedent("""
+        def work(reg, name):
+            reg.inc("declared_total")
+            reg.inc(name="declared_total")
+            reg.inc("rogue_total")
+            reg.inc(name="kw_rogue_total")
+            reg.observe(name, 1.0)
+    """))
+    (tmp_path / "README.md").write_text("")
+    fs = lint.run_lint(root=str(pkg), readme=str(tmp_path / "README.md"),
+                       rules=["metric-name-literal"])
+    assert [f["symbol"] for f in fs] == ["rogue_total", "kw_rogue_total",
+                                        "work"]
+    assert all(f["rule"] == "metric-name-literal" for f in fs)
+    assert "not declared" in fs[0]["message"]
+    assert "not declared" in fs[1]["message"]
+    assert "string literal" in fs[2]["message"]
+
+
+def test_lint_table_matches_runtime_registry():
+    """The statically parsed table IS the registry the /metrics
+    endpoint serves — the lint rule and the runtime can never disagree
+    about what is declared."""
+    from amgcl_tpu.analysis import lint
+    assert lint.declared_metric_names() == set(tlive.METRICS)
+    # and the repo itself is clean under the rule
+    fs = lint.run_lint(rules=["metric-name-literal"])
+    assert fs == [], fs
+
+
+# ===========================================================================
+# bench --throughput latency rows (satellite)
+# ===========================================================================
+
+def test_bench_throughput_service_latency():
+    """_bench_throughput rows carry service-measured latency_ms
+    p50/p99 and the b<N>_p99_ms rollup key the trend reads."""
+    import sys
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    A, rhs = poisson3d(6)
+    solver = make_solver(A, AMGParams(dtype=jnp.float32,
+                                      coarse_enough=50),
+                         CG(maxiter=50, tol=1e-6))
+    rec = bench._bench_throughput(solver, jnp.asarray(rhs, jnp.float32),
+                                  on_tpu=False, bs=(2,))
+    row = rec["rows"][0]
+    assert row["B"] == 2
+    lat = row["latency_ms"]
+    assert 0 < lat["p50"] <= lat["p99"] <= lat["max"]
+    assert rec["b2_p99_ms"] == lat["p99"]
+    assert row["service_sps"] > 0
