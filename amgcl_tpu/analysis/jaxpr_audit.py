@@ -66,6 +66,7 @@ __all__ = [
     "iter_eqns", "find_while_bodies", "collective_census",
     "vector_streams", "dtype_casts", "host_callbacks", "donation_audit",
     "audit_solver", "audit_dist_cg", "audit_make_solver", "audit_serve",
+    "audit_setup", "check_setup",
     "audit_entry_points", "run_audit", "format_report",
 ]
 
@@ -679,6 +680,117 @@ def audit_serve(m: int = 8, batch: int = 2) -> Dict[str, Any]:
             "batch": int(batch), "donation": don}
 
 
+def audit_setup(m: int = 6) -> List[Dict[str, Any]]:
+    """Abstractly trace every device-setup entry point (the traced
+    per-level hierarchy build: MIS rounds, segment-Galerkin, smoothing
+    SpGEMM, stencil pair-Galerkin) and record host callbacks,
+    collectives and float-width casts — checked by :func:`check_setup`
+    against ``ledger.SETUP_CONTRACTS``. ``jax.make_jaxpr`` only, no
+    execution."""
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.coarsening import device_mis
+    from amgcl_tpu.ops import segment_spgemm as seg
+
+    recs: List[Dict[str, Any]] = []
+
+    def record(entry, jx, n):
+        recs.append({
+            "entry": entry, "n": n,
+            "collectives": collective_census(jx.jaxpr),
+            "casts": [c for c in dtype_casts(jx.jaxpr, 1)
+                      if c["elements"] >= n],
+            "host_callbacks": host_callbacks(jx.jaxpr)})
+
+    # MIS rounds: (n, K) ELL strength adjacency, static round count
+    npad = 64
+    cols = jnp.zeros((npad, 8), jnp.int32)
+    valid = jnp.zeros((npad, 8), bool)
+    prio = jnp.arange(1, npad + 1, dtype=jnp.int32)
+    jx = jax.make_jaxpr(
+        lambda c, v, p: device_mis.device_aggregates(c, v, p, rounds=4))(
+        cols, valid, prio)
+    record("coarsening.device_aggregates", jx, npad)
+
+    nnz, nnz_c = 48, 16
+    vals = jnp.ones(nnz, jnp.float32)
+    take = jnp.arange(nnz, dtype=jnp.int32)
+    sidx = jnp.zeros(nnz, jnp.int32)
+    jx = jax.make_jaxpr(
+        lambda v, t, s: seg._galerkin_kernel(
+            v, t, s, jnp.float32(1.0), nnz_c))(vals, take, sidx)
+    record("ops.segment_galerkin", jx, nnz)
+
+    jx = jax.make_jaxpr(
+        lambda a, b, ia, ib, s: seg._spgemm_kernel(a, b, ia, ib, s,
+                                                   nnz_c))(
+        vals, vals, take, take, sidx)
+    record("ops.segment_spgemm", jx, nnz)
+
+    jx = jax.make_jaxpr(
+        lambda a, d, t, s: seg._smooth_kernel(
+            a, d, t, s, jnp.float32(0.5), 8, nnz_c))(
+        vals, vals, take, jnp.zeros(8 + nnz, jnp.int32))
+    record("ops.transfer_smooth", jx, nnz)
+
+    # stencil pair-Galerkin: a real small grid plan's generated device fn
+    from amgcl_tpu.ops.stencil import StencilGalerkinPlan, \
+        host_dia_from_csr
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, _ = poisson3d(m)
+    Ad = host_dia_from_csr(A, (m, m, m), np.float32)
+    plan = StencilGalerkinPlan(
+        Ad.offsets3, Ad.offsets3, Ad.dims, (2, 2, 2),
+        tuple(-(-d // 2) for d in (m, m, m)), np.float32)
+    fn = plan._build_device_fn()
+    a_dev = jnp.asarray(Ad.data)
+    jx = jax.make_jaxpr(fn._jitted if hasattr(fn, "_jitted") else fn)(
+        a_dev, a_dev)
+    record("ops.stencil_galerkin", jx, int(Ad.nrows))
+    return recs
+
+
+def check_setup(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Findings for one audit_setup record against
+    ``ledger.SETUP_CONTRACTS``: the traced per-level build must stay
+    free of host callbacks and collectives, and must not change float
+    width on matrix-sized values (the dtype seam is the host boundary,
+    not the kernels)."""
+    from amgcl_tpu.telemetry.ledger import SETUP_CONTRACTS
+    contract = SETUP_CONTRACTS.get(rec["entry"])
+    out: List[Dict[str, Any]] = []
+    if contract is None:
+        return out
+    if len(rec["host_callbacks"]) != contract["host_callbacks"]:
+        out.append({
+            "severity": "error", "pass": "host-sync",
+            "entry": rec["entry"],
+            "message": "host callback %r inside the traced setup "
+            "program — the per-level build must run device-side "
+            "without host round trips"
+            % rec["host_callbacks"][0]["primitive"]})
+    cen = rec["collectives"]
+    n_coll = sum(cen.get(k, 0) for k in ("psum", "ppermute",
+                                         "all_gather", "all_to_all"))
+    if n_coll != contract["collectives"]:
+        out.append({
+            "severity": "error", "pass": "collectives",
+            "entry": rec["entry"],
+            "message": "%d collective(s) in the serial setup program, "
+            "contract says %d (the sharded MIS path has its own "
+            "contract)" % (n_coll, contract["collectives"])})
+    narrowing = [c for c in rec["casts"] if c["kind"] == "downcast"]
+    if len(narrowing) != contract["narrowing_casts"]:
+        out.append({
+            "severity": "error", "pass": "dtype",
+            "entry": rec["entry"],
+            "message": "%d narrowing float cast(s) on matrix-sized "
+            "values inside the setup kernel (contract: %d) — numeric "
+            "rebuilds must stay bit-stable in the build dtype"
+            % (len(narrowing), contract["narrowing_casts"])})
+    return out
+
+
 def check_serve(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Donation contract of the resident loop: the lowered program must
     alias exactly ``DONATION_CONTRACTS['serve.solve_step']`` argument
@@ -918,6 +1030,9 @@ def run_audit(solvers: Optional[Sequence[str]] = None,
     rec = audit_serve()
     records.append(rec)
     findings += check_serve(rec)
+    for rec in audit_setup():
+        records.append(rec)
+        findings += check_setup(rec)
     findings += check_entry_points()
     errors = [f for f in findings if f["severity"] == "error"]
     return {"records": records, "findings": findings,

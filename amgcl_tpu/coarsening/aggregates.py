@@ -162,9 +162,20 @@ def plain_aggregates(A: CSR, eps_strong: float = 0.08):
     (reference: amgcl/coarsening/plain_aggregates.hpp:63-213, default
     eps_strong = 0.08).
 
-    Uses the native C++ greedy distance-2 pass when the extension is
-    available (linear-time, the serial fast path); otherwise the vectorized
-    MIS formulation — the same one the distributed layer shards."""
+    Default on accelerator backends (and under
+    ``AMGCL_TPU_DEVICE_SETUP=1``): the device (jit-traced) distance-2
+    MIS rounds of coarsening/device_mis.py — deterministic, one traced
+    program per shape bucket, and exactly the algorithm the
+    mesh-distributed layer shards, so serial and distributed coarsening
+    agree by construction. On the CPU backend the "device" is the host,
+    so the jit adds only compile latency — the host path stays default
+    there; ``AMGCL_TPU_HOST_SETUP=1`` forces it everywhere: the native
+    C++ greedy distance-2 pass when the extension is available
+    (linear-time), else the vectorized numpy MIS formulation."""
+    from amgcl_tpu.coarsening.device_mis import device_mis_default
+    if device_mis_default():
+        from amgcl_tpu.coarsening.device_mis import aggregates_on_device
+        return aggregates_on_device(A, eps_strong)
     from amgcl_tpu.native import native_aggregates
     got = native_aggregates(A, eps_strong)
     if got is not None:

@@ -16,6 +16,8 @@ paths remain the default for serial setup.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -98,25 +100,64 @@ _jitted_device_aggregates = _watched_jit(
     static_argnames="rounds")
 
 
+def device_mis_default() -> bool:
+    """Is the device MIS the default aggregation path here? Yes on
+    accelerator backends and under ``AMGCL_TPU_DEVICE_SETUP=1``;
+    ``AMGCL_TPU_HOST_SETUP=1`` wins and reverts to the host
+    (native-greedy / numpy-MIS) path everywhere. On a CPU backend the
+    "device" is the host itself, so tracing the MIS rounds buys nothing
+    and costs a compile — host stays the CPU default."""
+    from amgcl_tpu.ops.segment_spgemm import host_setup_forced
+    if host_setup_forced():
+        return False
+    if os.environ.get("AMGCL_TPU_DEVICE_SETUP") == "1":
+        return True
+    import jax
+    return jax.default_backend() != "cpu"
+
+
+def _bucket(v: int, lo: int = 256) -> int:
+    """Round up to the next power of two (>= ``lo``): padding the MIS
+    operands to shape buckets bounds the number of distinct jit
+    signatures the setup path can create across a hierarchy (or a test
+    suite) — padded rows/slots carry ``valid=False`` and never win,
+    capture, or get captured, so bucketing is semantically invisible."""
+    b = max(int(lo), 1)
+    while b < v:
+        b <<= 1
+    return b
+
+
 def aggregates_on_device(A: CSR, eps_strong: float = 0.08,
                          rounds: int = 40):
     """Convenience wrapper: host strength graph -> device MIS -> (agg, n_agg)
-    in the host convention (-1 for isolated rows)."""
+    in the host convention (-1 for isolated rows).
+
+    The real nodes keep EXACTLY the host ``_priority(n)`` values, so the
+    result is independent of the padding bucket (and matches the
+    mesh-sharded MIS, parallel/dist_mis.py, by construction)."""
     from amgcl_tpu.coarsening.aggregates import strength_graph, _priority
-    S = strength_graph(A, eps_strong)
+    from amgcl_tpu.telemetry.tracing import setup_substage
+    with setup_substage("strength_graph"):
+        S = strength_graph(A, eps_strong)
     n = S.shape[0]
     nnz_row = np.diff(S.indptr)
-    K = max(int(nnz_row.max()), 1)
-    cols = np.zeros((n, K), dtype=np.int32)
-    valid = np.zeros((n, K), dtype=bool)
-    rows = np.repeat(np.arange(n), nnz_row)
-    pos = np.arange(S.nnz) - S.indptr[rows]
-    cols[rows, pos] = S.indices
-    valid[rows, pos] = True
-    prio = jnp.asarray(_priority(n).astype(np.int32))
-    key, assigned = _jitted_device_aggregates(
-        jnp.asarray(cols), jnp.asarray(valid), prio, rounds=rounds)
-    key = np.asarray(key)
+    K = _bucket(max(int(nnz_row.max()), 1), lo=8)
+    n_pad = _bucket(n)
+    with setup_substage("mis_pack"):
+        cols = np.zeros((n_pad, K), dtype=np.int32)
+        valid = np.zeros((n_pad, K), dtype=bool)
+        rows = np.repeat(np.arange(n), nnz_row)
+        pos = np.arange(S.nnz) - S.indptr[rows]
+        cols[rows, pos] = S.indices
+        valid[rows, pos] = True
+        prio = np.empty(n_pad, dtype=np.int32)
+        prio[:n] = _priority(n).astype(np.int32)
+        prio[n:] = np.arange(n + 1, n_pad + 1, dtype=np.int32)
+    with setup_substage("device_mis"):
+        key, assigned = _jitted_device_aggregates(
+            jnp.asarray(cols), jnp.asarray(valid), prio, rounds=rounds)
+        key = np.asarray(key)[:n]
     agg = np.full(n, -1, dtype=np.int64)
     live = key > 0
     uniq, inv = np.unique(key[live], return_inverse=True)

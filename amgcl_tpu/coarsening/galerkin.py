@@ -1,6 +1,18 @@
-"""Galerkin coarse operator Ac = R A P via two SpGEMMs
+"""Galerkin coarse operator Ac = R A P
 (reference: amgcl/coarsening/detail/galerkin.hpp:53,
-amgcl/coarsening/detail/scaled_galerkin.hpp)."""
+amgcl/coarsening/detail/scaled_galerkin.hpp).
+
+Two routes:
+
+* **plan route** (default where it applies): a segment-sum plan
+  (ops/segment_spgemm.py) — selection-matrix P collapses the triple
+  product to ONE segment pass over A's entries; smoothed P runs two
+  planned numeric SpGEMMs. The plan caches on P, so ``AMG.rebuild``
+  re-enters here and pays only the numeric kernels.
+* **host route**: the reference's two scipy/native SpGEMMs —
+  ``AMGCL_TPU_HOST_SETUP=1``, block values, or a level past the plan
+  flop guard.
+"""
 
 from __future__ import annotations
 
@@ -8,10 +20,24 @@ from amgcl_tpu.ops.csr import CSR
 
 
 def galerkin(A: CSR, P: CSR, R: CSR) -> CSR:
+    from amgcl_tpu.ops import segment_spgemm as seg
+    plan = seg.ensure_plan(A, P, R)
+    if plan is not None:
+        from amgcl_tpu.telemetry.tracing import setup_substage
+        with setup_substage("galerkin_numeric"):
+            return plan.coarse(A)
     return R @ (A @ P)
 
 
 def scaled_galerkin(A: CSR, P: CSR, R: CSR, scale: float) -> CSR:
+    from amgcl_tpu.ops import segment_spgemm as seg
+    plan = seg.ensure_plan(A, P, R)
+    if plan is not None:
+        from amgcl_tpu.telemetry.tracing import setup_substage
+        with setup_substage("galerkin_numeric"):
+            return plan.coarse(A, scale)
     Ac = galerkin(A, P, R)
-    Ac.val = Ac.val * scale
-    return Ac
+    # scale into a FRESH value array: galerkin() may hand back plan-owned
+    # or otherwise shared storage, and the unscaled product must not be
+    # corrupted under the caller's feet
+    return CSR(Ac.ptr, Ac.col, Ac.val * Ac.val.dtype.type(scale), Ac.ncols)

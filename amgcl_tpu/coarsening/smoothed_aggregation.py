@@ -114,16 +114,30 @@ class SmoothedAggregation:
         if n_agg == 0:
             raise CoarseningStall("empty coarse level (all rows isolated)")
 
-        P_tent, Bc = tentative_prolongation(
-            n_pt, agg, n_agg, nullspace, bs)
-        Pt = P_tent.unblock() if P_tent.is_block else P_tent
-
         rho = spectral_radius(Af, self.power_iters, scale=True)
         omega = self.relax * (4.0 / 3.0) / max(rho, 1e-30)
 
         # P = (I - omega * Df^-1 * Af) * P_tent
-        DA = Af.scale_rows(Df_inv)
-        P = _p_smooth(Pt, DA, omega)
+        from amgcl_tpu.ops import segment_spgemm as seg
+        if (nullspace is None and bs == 1 and not A.is_block
+                and not seg.host_setup_forced()
+                and seg.device_numeric(Af.val.dtype)):
+            # device prolongation smoothing: the tentative P is a
+            # selection matrix over ``agg`` (never materialized on this
+            # branch — the plan works from the aggregate vector), so the
+            # smoothing SpGEMM is ONE segment pass over A_f keyed by
+            # (row, agg[col]) — same plan machinery as the Galerkin
+            from amgcl_tpu.telemetry.tracing import setup_substage
+            with setup_substage("transfer_smooth"):
+                P = seg.SmoothPlan(Af, agg, n_agg).prolongation(
+                    Af, Df_inv, omega)
+            Bc = None
+        else:
+            P_tent, Bc = tentative_prolongation(
+                n_pt, agg, n_agg, nullspace, bs)
+            Pt = P_tent.unblock() if P_tent.is_block else P_tent
+            DA = Af.scale_rows(Df_inv)
+            P = _p_smooth(Pt, DA, omega)
         R = P.transpose()
         if A.is_block:
             P = P.to_block(bs)
@@ -132,7 +146,9 @@ class SmoothedAggregation:
                 and nullspace is None):
             # device realization applies P/R matrix-free through this spec
             # instead of packing gather-heavy ELL matrices (ops/structured.py)
-            M = CSR(DA.ptr, DA.col, DA.val * omega, DA.ncols)
+            M = CSR(Af.ptr, Af.col,
+                    Af.val * (omega * Df_inv[Af.expanded_rows()]),
+                    Af.ncols)
             spec = {"M": M}
             if grid is not None:
                 spec.update(fine=grid, block=blocks, coarse=coarse_dims)

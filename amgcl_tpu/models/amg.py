@@ -10,6 +10,8 @@ static, so the cycle recursion unrolls into one fused graph.
 
 from __future__ import annotations
 
+import time
+
 from dataclasses import dataclass, field, replace
 from typing import Any, Optional
 
@@ -223,6 +225,7 @@ class AMG:
         # resource ledger
         from amgcl_tpu.utils.profiler import Profiler
         prof = self.setup_profile = Profiler.device()
+        self._setup_t0 = time.perf_counter()
         n_prefix = 0
         eps_override = None
         if self._device_filter is None:
@@ -246,6 +249,8 @@ class AMG:
                             got["levels"], got["coarse"], prm.npre,
                             prm.npost, prm.ncycle, prm.pre_cycles)
                         self.host_levels = meta_rows
+                        self._setup_wall_s = \
+                            time.perf_counter() - self._setup_t0
                         return
                     # hybrid: SA stencil growth moved past the
                     # diagonal-pair regime — continue with the classic
@@ -294,38 +299,99 @@ class AMG:
         self.host_levels = (self._meta_prefix + host) if n_prefix else host
         self._coarse_op = coarsening.coarse_operator
         self._to_device_levels()
+        # wall time of THIS build: the profiler's own total keeps ticking
+        # after construction, so attribution needs the frozen number
+        self._setup_wall_s = time.perf_counter() - self._setup_t0
 
-    def rebuild(self, A: CSR):
-        """Fast rebuild for time-dependent problems: the matrix VALUES
-        changed but the structure (and thus the transfer operators) are
-        reused — only the Galerkin products, smoother states, and device
-        transfers are redone (reference: amg::rebuild, amgcl/amg.hpp:229-269
-        with allow_rebuild)."""
-        if not isinstance(A, CSR):
-            A = CSR.from_scipy(A)
-        if A.shape != self.host_levels[0][0].shape:
-            raise ValueError("rebuild requires the same matrix dimensions")
-        if getattr(self, "_device_built", False):
-            # device-built hierarchies redo the whole (cheap, on-device)
-            # build; the transfer structure is re-derived identically
+    def rebuild(self, A):
+        """Numeric-only rebuild for time-dependent problems: the matrix
+        VALUES changed, the sparsity (and thus the aggregation, transfer
+        operators, Galerkin plans, and device-format structure) is reused
+        (reference: amg::rebuild, amgcl/amg.hpp:229-269 with
+        allow_rebuild).
+
+        Accepts a CSR with the SAME sparsity pattern — asserted, a
+        structural change needs a fresh ``AMG`` — or just the new value
+        array (``rebuild(new_vals)``), which skips the pattern comparison
+        entirely. Each level re-runs only the numeric Galerkin/smoothing
+        segment kernels against the plans cached on the transfer
+        operators (ops/segment_spgemm.py, ops/stencil.py), the smoother
+        states, and the device value refresh — no strength graphs, no
+        aggregation, no symbolic SpGEMM, and the device transfer
+        operators (frozen by the rebuild contract) are reused as-is."""
+        old0 = self.host_levels[0][0]
+        if isinstance(A, np.ndarray):
+            if A.shape != old0.val.shape:
+                raise ValueError(
+                    "rebuild(new_vals): value array shape %r does not "
+                    "match the operator's %r"
+                    % (A.shape, old0.val.shape))
+            A = CSR(old0.ptr, old0.col, np.asarray(A), old0.ncols)
+            same_pattern = True
+        else:
+            if not isinstance(A, CSR):
+                A = CSR.from_scipy(A)
+            if A.shape != old0.shape:
+                raise ValueError(
+                    "rebuild requires the same matrix dimensions")
+            same_pattern = A.nnz == old0.nnz and (
+                (A.ptr is old0.ptr and A.col is old0.col)
+                or (np.array_equal(A.ptr, old0.ptr)
+                    and np.array_equal(A.col, old0.col)))
+        if getattr(self, "_device_built", False) \
+                or getattr(self, "_dev_prefix", []):
+            # device-built (and hybrid device-prefix) hierarchies redo
+            # the whole (cheap, on-device) build; the transfer structure
+            # is re-derived identically. _device_built covers both today
+            # — the prefix check is belt-and-braces so meta rows with
+            # P=None can never reach the numeric loop below
             self._build(A)
             return
+        if not same_pattern:
+            raise ValueError(
+                "rebuild requires the same sparsity pattern (values-only "
+                "update); construct a new AMG for structural changes")
+        # structure-only caches carry over (the pattern is identical):
+        # the DIA scatter plan and row expansion are what make the
+        # device value refresh O(nnz) with no symbolic work
+        for attr in ("_rows_cache", "_dia_struct_cache",
+                     "_dia_offsets_cache", "_grid_dims"):
+            if not hasattr(A, attr) and hasattr(old0, attr):
+                setattr(A, attr, getattr(old0, attr))
         from amgcl_tpu.utils.profiler import Profiler
         prof = self.setup_profile = Profiler.device()
+        self._setup_t0 = time.perf_counter()
         self._ledger_cache = None
         self._probe_cache = None
         self._roofline_cache = None
+        # one-time on a first rebuild: when the numeric backend is the
+        # device, make sure every CSR level carries a Galerkin plan so
+        # this and every later rebuild is a pure numeric segment pass
+        # (on the CPU backend the native hash-SpGEMM outruns a host
+        # segment pass over the materialized multiply list, so general
+        # levels keep the host route there; selection levels always plan)
+        from amgcl_tpu.ops import segment_spgemm as seg
         host = []
         Acur = A
-        for i, (_, P, R) in enumerate(self.host_levels[:-1]):
+        for i, (Ai, P, R) in enumerate(self.host_levels[:-1]):
+            if isinstance(P, CSR) and not seg.host_setup_forced():
+                seg.ensure_plan(Ai, P, R,
+                                force=seg.device_numeric(Ai.val.dtype))
             host.append((Acur, P, R))
             with setup_scope(prof, "level%d/galerkin" % i):
                 Acur = self._coarse_op(Acur, P, R)
         host.append((Acur, None, None))
+        old_levels = self.hierarchy.levels
         self.host_levels = host
-        self._to_device_levels()
+        self._to_device_levels(reuse_transfers=old_levels)
+        self._setup_wall_s = time.perf_counter() - self._setup_t0
 
-    def _to_device_levels(self):
+    def _to_device_levels(self, reuse_transfers=None):
+        """``reuse_transfers``: the previous build's device levels during
+        a numeric rebuild — the transfer operators (P/R device matrices,
+        frozen under the rebuild contract) are carried over instead of
+        re-packed, and level operators with a cached conversion structure
+        refresh values only."""
         prm = self.prm
         host = self.host_levels
         dtype = prm.dtype
@@ -351,8 +417,21 @@ class AMG:
                 continue
             lvl = "level%d" % i
             spec = getattr(P, "_implicit_spec", None)
+            old = reuse_transfers[i] if reuse_transfers is not None \
+                and i < len(reuse_transfers) else None
             with setup_scope(prof, lvl + "/transfer"):
-                if spec is not None:
+                if old is not None and old.A is not None:
+                    # numeric rebuild: transfers are frozen — reuse the
+                    # device matrices; the level operator refreshes
+                    # values into the old structure where the format
+                    # supports it (full reconvert otherwise)
+                    P_dev, R_dev = old.P, old.R
+                    A_dev = dev.refresh_values(old.A, Ai, dtype)
+                    if A_dev is None:
+                        A_dev = dev.to_device(Ai, prm.matrix_format,
+                                              dtype,
+                                              budget=self._dwin_budget)
+                elif spec is not None:
                     # matrix-free smoothed transfers: no gather-heavy
                     # device P/R
                     from amgcl_tpu.ops.structured import \
@@ -369,8 +448,9 @@ class AMG:
                                           budget=self._dwin_budget)
                     R_dev = dev.to_device(R, "auto", dtype,
                                           budget=self._dwin_budget)
-                A_dev = dev.to_device(Ai, prm.matrix_format, dtype,
-                                      budget=self._dwin_budget)
+                if old is None or old.A is None:
+                    A_dev = dev.to_device(Ai, prm.matrix_format, dtype,
+                                          budget=self._dwin_budget)
             from amgcl_tpu.ops.pallas_vcycle import (build_fused_down,
                                                      build_fused_up)
             with setup_scope(prof, lvl + "/relax_setup"):
@@ -391,16 +471,23 @@ class AMG:
                 "cannot build a dense coarse solver this large — adjust "
                 "coarsening parameters or set direct_coarse=False"
                 % (n_last, prm.coarse_enough))
+        old_last = reuse_transfers[len(host) - 1] \
+            if reuse_transfers is not None \
+            and len(reuse_transfers) == len(host) else None
         with setup_scope(prof, "coarse_solver"):
+            A_last_dev = None
+            if old_last is not None and old_last.A is not None:
+                A_last_dev = dev.refresh_values(old_last.A, Alast, dtype)
+            if A_last_dev is None:
+                A_last_dev = dev.to_device(Alast, prm.matrix_format,
+                                           dtype,
+                                           budget=self._dwin_budget)
             if prm.direct_coarse:
                 coarse = DenseDirectSolver.build(Alast, dtype)
-                last = Level(dev.to_device(Alast, prm.matrix_format, dtype,
-                                           budget=self._dwin_budget), None)
+                last = Level(A_last_dev, None)
             else:
                 coarse = None
-                last = Level(dev.to_device(Alast, prm.matrix_format, dtype,
-                                           budget=self._dwin_budget),
-                             prm.relax.build(Alast, dtype))
+                last = Level(A_last_dev, prm.relax.build(Alast, dtype))
         dev_levels.append(last)
         self.hierarchy = Hierarchy(
             dev_levels, coarse, prm.npre, prm.npost, prm.ncycle,
@@ -426,6 +513,17 @@ class AMG:
                 setup_profile=getattr(self, "setup_profile", None))
             self._ledger_cache = cached
         return cached
+
+    def setup_report(self):
+        """Stage-by-stage attribution of the last build/rebuild
+        (telemetry/ledger.setup_attribution): measured per-stage seconds
+        joined to the setup traffic model, plus the named-stage coverage
+        fraction — the setup-phase counterpart of ``roofline()``."""
+        from amgcl_tpu.telemetry.ledger import setup_attribution
+        return setup_attribution(getattr(self, "setup_profile", None),
+                                 self.host_levels,
+                                 total_s=getattr(self, "_setup_wall_s",
+                                                 None))
 
     def roofline(self, reps: Optional[int] = None,
                  peaks: Optional[dict] = None):

@@ -437,6 +437,56 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
     return csr_to_ell(A, dtype)
 
 
+def refresh_values(M, A: CSR, dtype):
+    """Value-only refresh of a device matrix from a same-pattern host CSR
+    (the numeric-rebuild path, models/amg.py): repack A's values into the
+    SAME device format/structure as ``M`` — DIA rides the cached scatter
+    plan (or the stencil prepack), ELL/dense are O(nnz) repacks. Returns
+    None when the format has no value-only route (windowed/dense-window/
+    block formats fall back to a full ``to_device``), or when the derived
+    structure unexpectedly differs from ``M``'s (a same-sparsity-contract
+    violation the caller resolves with a full conversion)."""
+    if isinstance(M, DiaMatrix) and not A.is_block:
+        new = csr_to_dia(A, dtype)
+        if list(new.offsets) == list(M.offsets):
+            return new
+        return None
+    if isinstance(M, DenseMatrix) and not A.is_block:
+        return DenseMatrix(jnp.asarray(A.to_dense(), dtype=dtype))
+    if isinstance(M, EllMatrix):
+        new = csr_to_ell(A, dtype)
+        if new.cols.shape == M.cols.shape:
+            return new
+        return None
+    from amgcl_tpu.ops.unstructured import WindowedEllMatrix
+    if isinstance(M, WindowedEllMatrix):
+        # same-pattern value scatter into the cached tile/window
+        # structure — skips tile_windows (the ufunc.at window scan is
+        # the expensive part of the conversion)
+        n_tiles, tile, K = M.cols_local.shape[:3]
+        rows = A.expanded_rows()
+        flat = rows * K + (np.arange(A.nnz) - A.ptr[rows])
+        if A.nnz and (flat.max() >= n_tiles * tile * K
+                      or A.row_nnz().max() > K):
+            return None
+        vdt = np.dtype(dtype) if np.dtype(dtype).kind != "c" \
+            else A.val.dtype
+        if A.is_block:
+            br, bc = A.block_size
+            vals = np.zeros((n_tiles * tile * K, br, bc), dtype=vdt)
+            vals[flat] = A.val
+            vals = vals.reshape(n_tiles, tile, K, br, bc)
+        else:
+            vals = np.zeros(n_tiles * tile * K, dtype=vdt)
+            vals[flat] = A.val
+            vals = vals.reshape(n_tiles, tile, K)
+        return WindowedEllMatrix(
+            M.window_starts, M.cols_local,
+            jnp.asarray(vals, dtype=M.vals.dtype), A.shape, M.win,
+            M.block)
+    return None
+
+
 # -- backend primitives (reference: amgcl/backend/interface.hpp:253-443) ----
 #
 # The hot primitives carry a named scope (telemetry/tracing.py) tagged with

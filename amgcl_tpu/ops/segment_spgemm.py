@@ -1,0 +1,402 @@
+"""Plan-based setup sparse algebra: Galerkin triple products and transfer
+smoothing as segment sums.
+
+The reference builds every coarse operator with two host SpGEMMs
+(amgcl/coarsening/detail/galerkin.hpp:53) — the round-5 VERDICT measured
+that design at ~23x slower than the K80 baseline on TPU, where host
+SpGEMM and host<->device transfer serialize the whole setup. But the
+setup algebra has far more structure than a general SpGEMM:
+
+* aggregation-type tentative prolongations are *selection* matrices
+  (one unit entry per fine row), so ``R A P`` collapses to a single
+  segment sum over A's entries keyed by ``(agg[row], agg[col])``;
+* smoothed aggregation's ``P = (I - omega D^-1 A_f) T`` is a segment
+  sum over A_f's entries keyed by ``(row, agg[col])``;
+* the remaining general products (smoothed ``A P``, ``R (A P)``) have
+  value-independent sparsity, so ONE host symbolic pass yields a static
+  *plan* (gather indices + output segments) and the numeric product
+  becomes ``segment_sum(a[ia] * b[ib])`` — a gather/multiply/scatter-add
+  program XLA runs entirely on device with static shapes.
+
+Each plan is built once per hierarchy level (the "single host sync for
+the coarse sparsity plan") and cached on the transfer operator, so
+``AMG.rebuild`` with new matrix values re-runs ONLY the numeric segment
+kernels — no symbolic work, no aggregation, no strength graphs.
+
+Numeric backends: the jitted device kernels (``ops.segment_galerkin``,
+``ops.segment_spgemm``, ``ops.transfer_smooth`` — all watched_jit entry
+points) run when the default backend is an accelerator or
+``AMGCL_TPU_DEVICE_SETUP=1``; otherwise a numpy ``bincount`` pass runs
+the identical plan on the host (same summation order, so rebuild-vs-
+fresh-build results are bit-identical per backend).
+``AMGCL_TPU_HOST_SETUP=1`` disables plan routing entirely (the legacy
+scipy two-SpGEMM path).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+import numpy as np
+
+from amgcl_tpu.ops.csr import CSR
+
+#: largest multiply-list a general SpGEMM plan may materialize (three
+#: int32 index arrays of this length); past it the level falls back to
+#: the host SpGEMM and opts out of the numeric-rebuild fast path
+_PLAN_MAX_FLOPS_DEFAULT = 32_000_000
+
+
+def host_setup_forced() -> bool:
+    """``AMGCL_TPU_HOST_SETUP=1``: legacy host-only setup (numpy MIS,
+    scipy SpGEMM Galerkin, no plans)."""
+    return os.environ.get("AMGCL_TPU_HOST_SETUP") == "1"
+
+
+def _plan_max_flops() -> int:
+    try:
+        return int(os.environ.get("AMGCL_TPU_SPGEMM_PLAN_MAX",
+                                  _PLAN_MAX_FLOPS_DEFAULT))
+    except ValueError:
+        return _PLAN_MAX_FLOPS_DEFAULT
+
+
+def device_numeric(dtype) -> bool:
+    """Run the numeric segment kernels on the device? Accelerator
+    backends: yes. CPU backend: only when forced
+    (``AMGCL_TPU_DEVICE_SETUP=1`` — CI parity tests) — the host bincount
+    pass is compile-free and single-pass, the right default for a
+    1-core test host. ``AMGCL_TPU_DEVICE_SETUP=0`` forces the host pass
+    everywhere. A 64-bit dtype without x64 enabled stays on the host so
+    plan numerics never silently narrow."""
+    knob = os.environ.get("AMGCL_TPU_DEVICE_SETUP")
+    if knob == "0":
+        return False
+    import jax
+    if np.dtype(dtype).kind == "c":
+        return False
+    if np.dtype(dtype).itemsize == 8 and not jax.config.jax_enable_x64:
+        return False
+    if knob == "1":
+        return True
+    return jax.default_backend() != "cpu"
+
+
+# ---------------------------------------------------------------------------
+# numeric kernels (device): gather -> multiply -> segment sum
+# ---------------------------------------------------------------------------
+
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
+
+
+def _galerkin_kernel(vals, take, seg, scale, n_out: int):
+    import jax.numpy as jnp
+    v = jnp.take(vals, take, axis=0) * scale
+    return jnp.zeros(n_out, dtype=v.dtype).at[seg].add(v)
+
+
+def _spgemm_kernel(avals, bvals, ia, ib, seg, n_out: int):
+    import jax.numpy as jnp
+    prod = jnp.take(avals, ia, axis=0) * jnp.take(bvals, ib, axis=0)
+    return jnp.zeros(n_out, dtype=prod.dtype).at[seg].add(prod)
+
+
+def _smooth_kernel(af_vals, dinv_rows, take, seg, omega, n_iden: int,
+                   n_out: int):
+    import jax.numpy as jnp
+    contrib = -omega * dinv_rows * jnp.take(af_vals, take, axis=0)
+    v = jnp.concatenate([jnp.ones(n_iden, dtype=contrib.dtype), contrib])
+    return jnp.zeros(n_out, dtype=v.dtype).at[seg].add(v)
+
+
+_jit_galerkin = _watched_jit(_galerkin_kernel, name="ops.segment_galerkin",
+                             static_argnames="n_out")
+_jit_spgemm = _watched_jit(_spgemm_kernel, name="ops.segment_spgemm",
+                           static_argnames="n_out")
+_jit_smooth = _watched_jit(_smooth_kernel, name="ops.transfer_smooth",
+                           static_argnames=("n_iden", "n_out"))
+
+
+def _host_segment(vals, seg, n_out, dtype):
+    """bincount segment sum (the host numeric backend); complex values
+    take two passes. Accumulates in f64 — at least as accurate as the
+    scipy product it replaces."""
+    if np.iscomplexobj(vals):
+        re = np.bincount(seg, weights=vals.real, minlength=n_out)
+        im = np.bincount(seg, weights=vals.imag, minlength=n_out)
+        return (re + 1j * im).astype(dtype)
+    return np.bincount(seg, weights=vals, minlength=n_out).astype(dtype)
+
+
+def _pattern_tag(A: CSR):
+    """Cheap identity of a sparsity pattern for the same-sparsity
+    contract: (shape, nnz, first/last column checksum). The rebuild
+    entry point does the full ptr/col comparison once at the fine
+    level; per-level plans only need to catch being handed a matrix
+    from a different build."""
+    col = A.col
+    s = int(col[:: max(1, len(col) // 64)].sum()) if len(col) else 0
+    return (A.nrows, A.ncols, A.nnz, s)
+
+
+# ---------------------------------------------------------------------------
+# plans
+# ---------------------------------------------------------------------------
+
+class TripleProductPlan:
+    """``Ac = R A P`` for selection P (tentative prolongation): one
+    segment sum over A's entries keyed by ``(agg[row], agg[col])``."""
+
+    def __init__(self, A: CSR, agg_rows: np.ndarray, agg_cols: np.ndarray,
+                 n_agg_rows: int, n_agg_cols: int):
+        rows = A.expanded_rows()
+        ri = agg_rows[rows]
+        ci = agg_cols[A.col]
+        keep = (ri >= 0) & (ci >= 0)
+        self.take = np.flatnonzero(keep).astype(np.int32)
+        key = ri[keep].astype(np.int64) * n_agg_cols + ci[keep]
+        uniq, seg = np.unique(key, return_inverse=True)
+        self.seg = seg.astype(np.int32)
+        self.nnz_c = len(uniq)
+        crow = (uniq // n_agg_cols).astype(np.int64)
+        self.ptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(crow, minlength=n_agg_rows))]
+        ).astype(np.int64)
+        self.col = (uniq % n_agg_cols).astype(np.int32)
+        self.ncols = int(n_agg_cols)
+        self.tag = _pattern_tag(A)
+        self.flops = int(len(self.take))
+        self._dev = None
+
+    def coarse_values(self, avals: np.ndarray, scale: float = 1.0,
+                      device: Optional[bool] = None) -> np.ndarray:
+        dt = avals.dtype
+        use_dev = device_numeric(dt) if device is None else device
+        if use_dev:
+            if self._dev is None:
+                import jax.numpy as jnp
+                self._dev = (jnp.asarray(self.take), jnp.asarray(self.seg))
+            import jax.numpy as jnp
+            take, seg = self._dev
+            out = _jit_galerkin(jnp.asarray(avals), take, seg,
+                                jnp.asarray(scale, dtype=dt),
+                                n_out=self.nnz_c)
+            return np.asarray(out)
+        v = avals[self.take]
+        if scale != 1.0:
+            v = v * scale
+        return _host_segment(v, self.seg, self.nnz_c, dt)
+
+    def coarse_csr(self, A: CSR, scale: float = 1.0) -> CSR:
+        assert _pattern_tag(A) == self.tag, \
+            "Galerkin plan was built for a different sparsity pattern"
+        return CSR(self.ptr, self.col,
+                   self.coarse_values(A.val, scale), self.ncols)
+
+
+class SpGEMMPlan:
+    """Numeric ``C = A @ B`` against a host-computed multiply list:
+    ``C.val = segment_sum(A.val[ia] * B.val[ib])`` with static output
+    sparsity. Returns None from :func:`build` past the flop guard."""
+
+    def __init__(self, ia, ib, seg, ptr, col, ncols, tag_a, tag_b):
+        self.ia, self.ib, self.seg = ia, ib, seg
+        self.ptr, self.col, self.ncols = ptr, col, ncols
+        self.nnz_c = len(col)
+        self.tag_a, self.tag_b = tag_a, tag_b
+        self.flops = int(len(ia))
+        self._dev = None
+
+    @classmethod
+    def build(cls, A: CSR, B: CSR,
+              max_flops: Optional[int] = None) -> Optional["SpGEMMPlan"]:
+        cnt = B.row_nnz()[A.col]
+        nflop = int(cnt.sum())
+        limit = _plan_max_flops() if max_flops is None else max_flops
+        if nflop > limit:
+            return None
+        idt = np.int32 if max(A.nnz, B.nnz, nflop) < 2**31 else np.int64
+        ia = np.repeat(np.arange(A.nnz, dtype=idt), cnt)
+        start = np.cumsum(cnt) - cnt
+        pos = np.arange(nflop, dtype=np.int64) - np.repeat(start, cnt)
+        ib = (np.repeat(B.ptr[A.col], cnt) + pos).astype(idt)
+        out_row = A.expanded_rows()[ia].astype(np.int64)
+        key = out_row * B.ncols + B.col[ib]
+        uniq, seg = np.unique(key, return_inverse=True)
+        crow = (uniq // B.ncols).astype(np.int64)
+        ptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(crow, minlength=A.nrows))]
+        ).astype(np.int64)
+        return cls(ia, ib, seg.astype(np.int32), ptr,
+                   (uniq % B.ncols).astype(np.int32), B.ncols,
+                   _pattern_tag(A), _pattern_tag(B))
+
+    def values(self, avals, bvals,
+               device: Optional[bool] = None) -> np.ndarray:
+        dt = np.result_type(avals.dtype, bvals.dtype)
+        use_dev = device_numeric(dt) if device is None else device
+        if use_dev:
+            import jax.numpy as jnp
+            if self._dev is None:
+                self._dev = (jnp.asarray(self.ia), jnp.asarray(self.ib),
+                             jnp.asarray(self.seg))
+            ia, ib, seg = self._dev
+            out = _jit_spgemm(jnp.asarray(avals), jnp.asarray(bvals),
+                              ia, ib, seg, n_out=self.nnz_c)
+            return np.asarray(out)
+        prod = avals[self.ia] * bvals[self.ib]
+        return _host_segment(prod, self.seg, self.nnz_c, dt)
+
+
+class SmoothPlan:
+    """``P = (I - omega D_f^-1 A_f) T`` for selection T over ``agg``:
+    the prolongation-smoothing SpGEMM as one segment sum over A_f's
+    entries keyed by ``(row, agg[col])`` plus the identity injection."""
+
+    def __init__(self, Af: CSR, agg: np.ndarray, n_agg: int):
+        rows = Af.expanded_rows()
+        keep = agg[Af.col] >= 0
+        self.take = np.flatnonzero(keep).astype(np.int32)
+        self.rows_kept = rows[keep].astype(np.int32)
+        iden = np.flatnonzero(agg >= 0)
+        key_i = iden.astype(np.int64) * n_agg + agg[iden]
+        key_a = rows[keep].astype(np.int64) * n_agg + agg[Af.col[keep]]
+        uniq, seg = np.unique(np.concatenate([key_i, key_a]),
+                              return_inverse=True)
+        self.seg = seg.astype(np.int32)
+        self.n_iden = len(iden)
+        self.nnz_p = len(uniq)
+        prow = (uniq // n_agg).astype(np.int64)
+        self.ptr = np.concatenate(
+            [[0], np.cumsum(np.bincount(prow, minlength=Af.nrows))]
+        ).astype(np.int64)
+        self.col = (uniq % n_agg).astype(np.int32)
+        self.n_agg = int(n_agg)
+        self.tag = _pattern_tag(Af)
+        self.flops = int(len(self.take)) + self.n_iden
+        self._dev = None
+
+    def prolongation(self, Af: CSR, dinv: np.ndarray,
+                     omega: float, device: Optional[bool] = None) -> CSR:
+        assert _pattern_tag(Af) == self.tag, \
+            "smoothing plan was built for a different strength pattern"
+        dt = Af.val.dtype
+        use_dev = device_numeric(dt) if device is None else device
+        if use_dev:
+            import jax.numpy as jnp
+            if self._dev is None:
+                self._dev = (jnp.asarray(self.take),
+                             jnp.asarray(self.seg),
+                             jnp.asarray(dinv[self.rows_kept], dtype=dt))
+            take, seg, dinv_rows = self._dev
+            vals = np.asarray(_jit_smooth(
+                jnp.asarray(Af.val), dinv_rows, take, seg,
+                jnp.asarray(omega, dtype=dt),
+                n_iden=self.n_iden, n_out=self.nnz_p))
+        else:
+            contrib = -omega * dinv[self.rows_kept] * Af.val[self.take]
+            v = np.concatenate([np.ones(self.n_iden, dtype=contrib.dtype),
+                                contrib])
+            vals = _host_segment(v, self.seg, self.nnz_p, dt)
+        return CSR(self.ptr, self.col, vals, self.n_agg)
+
+
+class GalerkinPlan:
+    """Per-level coarse-operator plan: either the one-pass selection
+    triple product or the general two-stage ``R (A P)`` (both stages
+    numeric segment sums; P/R values are captured at build — the
+    rebuild contract freezes the transfer operators)."""
+
+    def __init__(self, A: CSR, P: CSR, R: CSR):
+        agg = selection_aggregates(P)
+        if agg is not None:
+            self.kind = "selection"
+            self.triple = TripleProductPlan(A, agg, agg, P.ncols, P.ncols)
+            self.flops = self.triple.flops
+            self.plan_ap = self.plan_r = None
+        else:
+            self.kind = "general"
+            self.triple = None
+            self.plan_ap = SpGEMMPlan.build(A, P)
+            if self.plan_ap is None:
+                raise _PlanTooLarge()
+            ap_pattern = CSR(self.plan_ap.ptr, self.plan_ap.col,
+                             np.empty(self.plan_ap.nnz_c, np.float64),
+                             self.plan_ap.ncols)
+            self.plan_r = SpGEMMPlan.build(R, ap_pattern)
+            if self.plan_r is None:
+                raise _PlanTooLarge()
+            self._pvals = P.val
+            self._rvals = R.val
+            self.flops = self.plan_ap.flops + self.plan_r.flops
+        self.tag = _pattern_tag(A)
+
+    def coarse(self, A: CSR, scale: float = 1.0) -> CSR:
+        assert _pattern_tag(A) == self.tag, \
+            "Galerkin plan was built for a different sparsity pattern"
+        if self.kind == "selection":
+            return self.triple.coarse_csr(A, scale)
+        y = self.plan_ap.values(A.val, self._pvals)
+        vals = self.plan_r.values(self._rvals, y)
+        if scale != 1.0:
+            vals = vals * vals.dtype.type(scale)
+        return CSR(self.plan_r.ptr, self.plan_r.col, vals,
+                   self.plan_r.ncols)
+
+
+class _PlanTooLarge(Exception):
+    pass
+
+
+def selection_aggregates(P: CSR) -> Optional[np.ndarray]:
+    """If P is a selection/partition matrix (at most one unit entry per
+    row — a tentative prolongation without nullspace), return its
+    aggregate vector (−1 on excluded rows); else None."""
+    if P.is_block or P.nnz == 0:
+        return None
+    nnz_row = P.row_nnz()
+    if nnz_row.max() > 1 or not np.all(P.val == 1.0):
+        return None
+    agg = np.full(P.nrows, -1, dtype=np.int64)
+    agg[nnz_row == 1] = P.col[np.cumsum(nnz_row)[nnz_row == 1] - 1]
+    return agg
+
+
+# ---------------------------------------------------------------------------
+# galerkin() integration: lazy plan cache on the prolongation operator
+# ---------------------------------------------------------------------------
+
+def cached_plan(P, A: CSR) -> Optional[GalerkinPlan]:
+    plan = getattr(P, "_seg_plan", None)
+    if plan is not None and plan.tag == _pattern_tag(A):
+        return plan
+    return None
+
+
+def ensure_plan(A: CSR, P, R, force: bool = False) -> Optional[GalerkinPlan]:
+    """Build (and cache on P) the Galerkin plan for this level, or
+    return None when the level opts out (host-setup forced, block
+    values, selection-free P on a pure-host build unless ``force``, or
+    plan past the flop guard). ``force=True`` is the rebuild entry:
+    pay the one-time symbolic pass now so every later rebuild is a pure
+    numeric segment pass."""
+    if host_setup_forced() or A.is_block or getattr(P, "is_block", False):
+        return None
+    plan = cached_plan(P, A)
+    if plan is not None:
+        return plan
+    if getattr(P, "_seg_plan_oversize", None) == _pattern_tag(A):
+        return None       # don't re-materialize a known-oversize plan
+    selection = selection_aggregates(P) is not None
+    if not (force or selection or device_numeric(A.val.dtype)):
+        return None            # first host build: scipy SpGEMM is fine
+    from amgcl_tpu.telemetry.tracing import setup_substage
+    try:
+        with setup_substage("galerkin_plan"):
+            plan = GalerkinPlan(A, P, R)
+    except _PlanTooLarge:
+        P._seg_plan_oversize = _pattern_tag(A)
+        return None
+    P._seg_plan = plan
+    return plan

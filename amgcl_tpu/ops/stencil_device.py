@@ -21,7 +21,7 @@ computes:
    multiply-add — the device analogue of native_dia_fnma_batch, reference
    Galerkin: amgcl/coarsening/detail/galerkin.hpp:53),
 5. the tentative collapse Ac = Tᵀ S T as a scan over S diagonals with
-   static parity slicing (mirrors ops/stencil._TCollapse),
+   static parity slicing (mirrors ops/stencil.StencilGalerkinPlan),
 6. the smoother diagonal (SPAI-0 / damped Jacobi — elementwise,
    reference: amgcl/relaxation/spai0.hpp:49-117),
 7. per-coarse-diagonal nonzero counts — the ONLY per-level device→host
@@ -128,7 +128,7 @@ def _product_plan(src_offs, dst_offs, dims):
 
 def _collapse_plan(s_offs, dims, blocks, coarse):
     """Coarse offsets + (ns, n_par) slot table for the Tᵀ·T parity
-    collapse (mirrors ops/stencil._TCollapse)."""
+    collapse (mirrors ops/stencil.StencilGalerkinPlan)."""
     b2, b1, b0 = blocks
     parities = [(pz, py, px) for pz in range(b2) for py in range(b1)
                 for px in range(b0)]

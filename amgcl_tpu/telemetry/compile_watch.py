@@ -69,6 +69,10 @@ DECLARED_ENTRY_POINTS = (
     "ops.fused_up_sweep",
     "ops.fused_vec",
     "ops.level_setup",
+    "ops.segment_galerkin",
+    "ops.segment_spgemm",
+    "ops.stencil_galerkin",
+    "ops.transfer_smooth",
     "ops.windowed_ell_block_fused",
     "ops.windowed_ell_block_spmv",
     "ops.windowed_ell_block_spmv_dots",

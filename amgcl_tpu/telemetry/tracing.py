@@ -117,6 +117,13 @@ class RequestSpans:
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+#: thread-local holder of the profiler the CURRENT hierarchy build is
+#: annotating into — lets deep setup stages (device MIS, Galerkin plan
+#: construction, segment kernels) attribute themselves without threading
+#: a profiler argument through every coarsening policy signature
+_setup_tls = threading.local()
+
+
 @contextmanager
 def setup_scope(prof, name: str):
     """Setup-phase instrumentation in one wrapper: a tic/toc scope on
@@ -124,11 +131,40 @@ def setup_scope(prof, name: str):
     synced) AND an ``amgcl/setup/<name>`` host annotation so a
     ``jax.profiler`` capture of the build shows the same tree. ``prof``
     may be None (annotation only) — the numerics never depend on a
-    profiler being attached."""
+    profiler being attached.
+
+    While the scope is open the profiler is published thread-locally so
+    :func:`setup_substage` can attach nested stages from code that never
+    sees the AMG builder (``<scope>/<substage>`` in the profile)."""
     ann = annotate("setup/" + name)
-    if prof is None:
+    prev = getattr(_setup_tls, "scope", None)
+    _setup_tls.scope = (prof, name)
+    try:
+        if prof is None:
+            with ann:
+                yield
+        else:
+            with ann, prof.scope(name):
+                yield
+    finally:
+        _setup_tls.scope = prev
+
+
+@contextmanager
+def setup_substage(name: str):
+    """Nested setup stage under whatever :func:`setup_scope` is active
+    on this thread (no-op profiler-wise outside a build): device-MIS
+    rounds, plan construction and the numeric segment kernels report
+    through this, so ``AMG.setup_profile`` attributes the device-setup
+    path stage by stage like the host path."""
+    cur = getattr(_setup_tls, "scope", None)
+    ann = annotate("setup/" + (cur[1] + "/" if cur else "") + name)
+    if cur is None or cur[0] is None:
         with ann:
             yield
         return
+    prof, _parent = cur
+    # Profiler scopes nest on a stack — the path renders as
+    # "<parent>/<name>" without re-prefixing here
     with ann, prof.scope(name):
         yield

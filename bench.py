@@ -836,6 +836,16 @@ def _bench_extra_configs(on_tpu):
     return out
 
 
+def _setup_attr_summary(report, top=12):
+    """Compact form of AMG.setup_report() for the bench record: the
+    named-stage coverage fraction plus the top (non-nested) stages."""
+    rows = [[r["stage"], r["seconds"]] for r in report.get("rows", [])
+            if not r.get("nested")][:top]
+    return {"coverage": report.get("coverage"),
+            "total_s": report.get("total_s"),
+            "named_s": report.get("named_s"), "stages": rows}
+
+
 def main_worker():
     _stage("device init")
     _worker_watchdog()
@@ -908,6 +918,14 @@ def main_worker():
         "gen_s": round(t_gen, 3),
         "device": str(dev0), "device_platform": dev0.platform,
         "device_kind": getattr(dev0, "device_kind", None)})
+    # stage-by-stage setup attribution (telemetry/ledger.
+    # setup_attribution): named-stage coverage + the top stages, captured
+    # NOW — the rebuild stage below replaces the profiler
+    try:
+        _PARTIAL["setup_attribution"] = _setup_attr_summary(
+            solver.precond.setup_report())
+    except Exception as e:
+        _PARTIAL["setup_attribution"] = {"error": repr(e)[:200]}
     # which levels carry the fused sweep kernels (empty on CPU fallback
     # where pallas_mode gates them off — documents engagement per run)
     _PARTIAL["fused_levels"] = " ".join(
@@ -1050,6 +1068,32 @@ def main_worker():
     except Exception as e:
         _PARTIAL["compile"] = {"error": repr(e)[:200]}
 
+    # same-sparsity numeric rebuild (ROADMAP item 2, time-stepping
+    # workloads): identical values, so every later stage still measures
+    # the same operator. Warm median-of-2 — the first rebuild pays the
+    # one-time plan construction/compiles, which a time-stepping loop
+    # amortizes away; that cost is recorded separately.
+    _stage("hierarchy rebuild")
+    try:
+        pre = solver.precond
+        if hasattr(pre, "rebuild"):
+            vals = A.val.copy()
+            t0 = time.perf_counter()
+            pre.rebuild(vals)
+            _PARTIAL["rebuild_first_s"] = round(
+                time.perf_counter() - t0, 3)
+            ts = []
+            for _ in range(2):
+                t0 = time.perf_counter()
+                pre.rebuild(vals)
+                ts.append(time.perf_counter() - t0)
+            rebuild_s = float(np.median(ts))
+            _PARTIAL["rebuild_s"] = round(rebuild_s, 4)
+            _PARTIAL["rebuild_vs_setup"] = round(
+                rebuild_s / max(t_setup, 1e-9), 4)
+    except Exception as e:
+        _PARTIAL["rebuild_error"] = repr(e)[:200]
+
     # Optional deep-dive stages, highest decision-leverage first, each
     # gated on the time left before the watchdog (the r5 chip run burned
     # half its budget in 'block + stokes configs' and got killed mid-
@@ -1083,11 +1127,15 @@ def main_worker():
             from amgcl_tpu.ops import stencil_device as _sdev
             os.environ["AMGCL_TPU_PROFILE_SETUP"] = "1"
             t0 = time.perf_counter()
-            make_solver(A, prm, headline_config["solver"](),
-                        refine=headline_config["refine"])
+            s_rep = make_solver(A, prm, headline_config["solver"](),
+                                refine=headline_config["refine"])
             _PARTIAL["setup_repeat_s"] = round(time.perf_counter() - t0, 3)
             _PARTIAL["setup_profile"] = [
                 [tag, dt] for tag, dt in _sdev.LAST_SETUP_PROFILE]
+            # per-stage attribution of the warm re-run (device-setup
+            # stages included), same shape as setup_attribution above
+            _PARTIAL["setup_repeat_attribution"] = _setup_attr_summary(
+                s_rep.precond.setup_report())
         except Exception as e:
             _PARTIAL["setup_profile"] = {"error": repr(e)}
         finally:
@@ -1327,6 +1375,15 @@ def gate_tolerances():
                               record's candidate trips any health guard
                               (breakdown/NaN/stagnation/divergence);
                               0 disables the health check
+      AMGCL_TPU_GATE_SETUP  — minimum allowed fraction of the baseline's
+                              setup_vs_baseline (default 0.7: higher is
+                              better, the candidate regresses when its
+                              setup speed ratio drops below 70% of
+                              last-good); rebuild_s is gated alongside
+                              at the AMGCL_TPU_GATE_TIME ratio (lower
+                              is better). 0 disables both setup checks;
+                              both skip across device_platform
+                              mismatches like the time ratio.
     """
     def _f(name, default):
         try:
@@ -1337,7 +1394,8 @@ def gate_tolerances():
     return {"iters": _f("AMGCL_TPU_GATE_ITERS", 2),
             "time": _f("AMGCL_TPU_GATE_TIME", 1.25),
             "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10),
-            "throughput": _f("AMGCL_TPU_GATE_THROUGHPUT", 0.75)}
+            "throughput": _f("AMGCL_TPU_GATE_THROUGHPUT", 0.75),
+            "setup": _f("AMGCL_TPU_GATE_SETUP", 0.7)}
 
 
 def _record_health_flags(rec):
@@ -1446,6 +1504,30 @@ def run_gate(candidate, last_good, tol=None):
                        "last_good": tp_b, "limit": round(floor, 6),
                        "status": "ok" if tp_c >= floor
                        else "regression"})
+    # setup speed + same-sparsity rebuild (ROADMAP item 2): both skip on
+    # platform mismatch and on records predating the metrics.
+    # setup_vs_baseline is higher-is-better (like throughput), the
+    # rebuild time lower-is-better (like solve time).
+    if tol.get("setup", 0) > 0:
+        sv_c, sv_b = candidate.get("setup_vs_baseline"), \
+            last_good.get("setup_vs_baseline")
+        if sv_c is not None or sv_b is not None:
+            if plat_skip is not None or sv_c is None or sv_b is None:
+                checks.append({"check": "setup_vs_baseline",
+                               "status": "skipped",
+                               "reason": plat_skip,
+                               "candidate": sv_c, "last_good": sv_b})
+            else:
+                floor = sv_b * tol["setup"]
+                checks.append({
+                    "check": "setup_vs_baseline", "candidate": sv_c,
+                    "last_good": sv_b, "limit": round(floor, 6),
+                    "status": "ok" if sv_c >= floor else "regression"})
+        rb_c, rb_b = candidate.get("rebuild_s"), last_good.get("rebuild_s")
+        if rb_c is not None or rb_b is not None:
+            check("rebuild_s", rb_c, rb_b,
+                  rb_b * max(tol["time"], 1.0) if rb_b is not None else 0,
+                  skip_reason=plat_skip)
     if os.environ.get("AMGCL_TPU_GATE_HEALTH", "1") != "0":
         # flag IDENTITIES, not counts: any guard the baseline did not
         # trip is a regression (a candidate swapping a warning-level

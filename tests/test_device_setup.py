@@ -1,0 +1,499 @@
+"""Device-side hierarchy setup: plan-based Galerkin/smoothing parity,
+default device MIS quality bounds, same-sparsity numeric rebuilds, setup
+attribution, and the setup gate/audit contracts (ISSUE 9 / ROADMAP 2)."""
+
+import os
+
+import numpy as np
+import scipy.sparse as sp
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.ops import segment_spgemm as seg
+from amgcl_tpu.coarsening.galerkin import galerkin, scaled_galerkin
+from amgcl_tpu.coarsening.aggregation import Aggregation
+from amgcl_tpu.coarsening.smoothed_aggregation import SmoothedAggregation
+from amgcl_tpu.coarsening.smoothed_aggr_emin import SmoothedAggrEMin
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.utils.sample_problem import poisson3d, poisson3d_block
+
+
+@pytest.fixture
+def env(monkeypatch):
+    """Knob setter that restores after the test."""
+    def set_(name, val):
+        if val is None:
+            monkeypatch.delenv(name, raising=False)
+        else:
+            monkeypatch.setenv(name, str(val))
+    return set_
+
+
+def _unstructured(n=500, density=0.015, seed=3, dtype=np.float64):
+    rng = np.random.RandomState(seed)
+    M = sp.random(n, n, density=density, random_state=rng).tocsr()
+    M = M + M.T + 10.0 * sp.identity(n)
+    A = CSR.from_scipy(sp.csr_matrix(M))
+    A.val = A.val.astype(dtype)
+    return A
+
+
+def _csr_transfer_policy(policy):
+    """Force the generic CSR route (no stencil/structured shortcuts)."""
+    for attr, val in (("stencil_setup", False), ("structured", False),
+                      ("implicit_transfers", False)):
+        if hasattr(policy, attr):
+            setattr(policy, attr, val)
+    return policy
+
+
+def _host_rap(A, P, R, scale=1.0):
+    ref = (R @ (A @ P)).to_scipy()
+    ref.sort_indices()
+    return ref * scale
+
+
+# ---------------------------------------------------------------------------
+# device-Galerkin parity: device plan numerics == host R @ (A @ P)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("policy_factory,scale", [
+    (lambda: _csr_transfer_policy(SmoothedAggregation()), 1.0),
+    (lambda: _csr_transfer_policy(Aggregation()), 1.0 / 1.5),
+    (lambda: _csr_transfer_policy(SmoothedAggrEMin()), 1.0),
+])
+def test_device_galerkin_parity_all_coarsenings(env, policy_factory,
+                                                scale):
+    """Plan-based (forced-device) Galerkin == host two-SpGEMM to f64
+    tolerance for all three aggregation coarsening types."""
+    A = _unstructured()
+    env("AMGCL_TPU_DEVICE_SETUP", 1)     # device numeric on CPU backend
+    P, R = policy_factory().transfer_operators(A)
+    plan = seg.ensure_plan(A, P, R, force=True)
+    assert plan is not None
+    got = plan.coarse(A, scale).to_scipy()
+    got.sort_indices()
+    ref = _host_rap(A, P, R, scale)
+    assert np.array_equal(ref.indptr, got.indptr)
+    assert np.array_equal(ref.indices, got.indices)
+    assert abs(ref - got).max() < 1e-11 * max(abs(ref).max(), 1.0)
+
+
+def test_selection_triple_product_one_pass(env):
+    """Tentative P (a selection matrix) takes the single segment-sum
+    route — plan flops equal nnz(A) kept entries, not a multiply list."""
+    A = _unstructured()
+    P, R = _csr_transfer_policy(Aggregation()).transfer_operators(A)
+    plan = seg.ensure_plan(A, P, R, force=True)
+    assert plan.kind == "selection"
+    assert plan.flops <= A.nnz
+    # host-numeric and device-numeric backends agree exactly in f64
+    host = plan.triple.coarse_values(A.val, device=False)
+    dev = plan.triple.coarse_values(A.val, device=True)
+    np.testing.assert_allclose(host, dev, rtol=0, atol=1e-13)
+
+
+def test_device_galerkin_f32_values(env):
+    """Scalar f32 values ride the same plans (the bench hierarchy dtype)."""
+    A = _unstructured(dtype=np.float32)
+    P, R = _csr_transfer_policy(SmoothedAggregation()).transfer_operators(A)
+    plan = seg.ensure_plan(A, P, R, force=True)
+    got = plan.coarse(A).to_scipy()
+    ref = _host_rap(A.copy(), P, R)
+    assert abs(ref - got).max() < 1e-4 * abs(ref).max()
+
+
+def test_block_values_keep_host_route_and_fresh_scale():
+    """Block (BCSR) values: plans opt out, the host SpGEMM route runs,
+    and scaled_galerkin no longer mutates a possibly-shared value
+    array."""
+    A, _ = poisson3d_block(6, 2)
+    P, R = Aggregation(block_size=2).transfer_operators(A)
+    assert seg.ensure_plan(A, P, R, force=True) is None
+    Ac = galerkin(A, P, R)
+    v0 = Ac.val.copy()
+    Acs = scaled_galerkin(A, P, R, 1.0 / 1.5)
+    assert np.array_equal(Ac.val, v0)          # unscaled product intact
+    assert Acs.val is not Ac.val
+    np.testing.assert_allclose(
+        np.asarray(Acs.to_scipy().todense()),
+        np.asarray(Ac.to_scipy().todense()) / 1.5, atol=1e-12)
+
+
+def test_smooth_plan_matches_host_p_smooth(env):
+    """Device prolongation smoothing (SmoothPlan) == host
+    P_tent + (-omega DA) @ P_tent, pattern and values."""
+    from amgcl_tpu.coarsening.smoothed_aggregation import (_filtered,
+                                                           _p_smooth)
+    from amgcl_tpu.coarsening.aggregates import plain_aggregates
+    from amgcl_tpu.coarsening.tentative import tentative_prolongation
+    A = _unstructured()
+    agg, n_agg = plain_aggregates(A, 0.08)
+    Pt, _ = tentative_prolongation(A.nrows, agg, n_agg)
+    Af, Dfi = _filtered(A, 0.08)
+    omega = 0.61
+    ref = _p_smooth(Pt, Af.scale_rows(Dfi), omega).to_scipy()
+    ref.sort_indices()
+    for device in (False, True):
+        got = seg.SmoothPlan(Af, agg, n_agg).prolongation(
+            Af, Dfi, omega, device=device).to_scipy()
+        got.sort_indices()
+        assert np.array_equal(ref.indices, got.indices)
+        assert abs(ref - got).max() < 1e-12
+
+
+def test_sa_transfer_operators_use_smooth_plan(env):
+    """With device numerics forced, SmoothedAggregation's CSR route
+    produces the SAME P as the host path (f64 tolerance). The
+    aggregation is pinned through the aggregator hook so both runs
+    smooth the identical tentative operator."""
+    from amgcl_tpu.coarsening.aggregates import mis_aggregates, \
+        strength_graph
+    A = _unstructured()
+
+    def agg_hook(M, eps):
+        return mis_aggregates(strength_graph(M, eps))
+
+    def pol():
+        p = _csr_transfer_policy(SmoothedAggregation())
+        p.aggregator = agg_hook
+        return p
+
+    env("AMGCL_TPU_DEVICE_SETUP", 1)
+    P_dev, _ = pol().transfer_operators(A)
+    env("AMGCL_TPU_DEVICE_SETUP", 0)
+    P_host, _ = pol().transfer_operators(A)
+    assert P_dev.shape == P_host.shape
+    d = abs(P_dev.to_scipy() - P_host.to_scipy())
+    assert (d.max() if d.nnz else 0.0) < 1e-12
+
+
+# ---------------------------------------------------------------------------
+# device MIS as the default aggregation path
+# ---------------------------------------------------------------------------
+
+def test_device_mis_default_gates():
+    from amgcl_tpu.coarsening.device_mis import device_mis_default
+    # CPU backend: host default, device under the force knob, host wins
+    # under AMGCL_TPU_HOST_SETUP
+    saved = {k: os.environ.get(k) for k in
+             ("AMGCL_TPU_DEVICE_SETUP", "AMGCL_TPU_HOST_SETUP")}
+    try:
+        os.environ.pop("AMGCL_TPU_DEVICE_SETUP", None)
+        os.environ.pop("AMGCL_TPU_HOST_SETUP", None)
+        assert device_mis_default() is False      # CPU test backend
+        os.environ["AMGCL_TPU_DEVICE_SETUP"] = "1"
+        assert device_mis_default() is True
+        os.environ["AMGCL_TPU_HOST_SETUP"] = "1"
+        assert device_mis_default() is False
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def test_device_mis_quality_within_10pct(env, monkeypatch):
+    """Device-MIS-default aggregates vs the host greedy path: operator
+    complexity within 10% (PDE-graph fixture — the paths run different
+    distance-2 heuristics), and the device-MIS hierarchy converges."""
+    from amgcl_tpu.ops import stencil_device as sdev
+    monkeypatch.setattr(sdev, "enabled", lambda: False)
+    A, rhs = poisson3d(12)
+
+    def complexity(force_host):
+        env("AMGCL_TPU_DEVICE_SETUP", None if force_host else 1)
+        env("AMGCL_TPU_HOST_SETUP", 1 if force_host else None)
+        amg = AMG(A, AMGParams(
+            coarsening=_csr_transfer_policy(SmoothedAggregation()),
+            dtype=jnp.float64, coarse_enough=80))
+        st = amg.hierarchy_stats()
+        return st["operator_complexity"], amg
+
+    oc_dev, amg_dev = complexity(force_host=False)
+    oc_host, _ = complexity(force_host=True)
+    assert abs(oc_dev - oc_host) / oc_host < 0.10
+    env("AMGCL_TPU_DEVICE_SETUP", 1)
+    env("AMGCL_TPU_HOST_SETUP", None)
+    solve = make_solver(A, AMGParams(
+        coarsening=_csr_transfer_policy(SmoothedAggregation()),
+        dtype=jnp.float64, coarse_enough=80), CG(maxiter=100, tol=1e-8))
+    x, info = solve(rhs)
+    assert info.resid < 1e-8
+    assert info.iters < 40
+
+
+def test_device_mis_bucketing_invisible(env):
+    """Padding to shape buckets must not change the aggregation: the
+    real nodes keep the host priorities."""
+    from amgcl_tpu.coarsening.device_mis import aggregates_on_device
+    A, _ = poisson3d(9)                  # n = 729, pads to 1024
+    a1, n1 = aggregates_on_device(A)
+    a2, n2 = aggregates_on_device(A)
+    assert n1 == n2 and np.array_equal(a1, a2)
+    assert (a1 >= 0).all() and n1 == a1.max() + 1
+
+
+# ---------------------------------------------------------------------------
+# same-sparsity numeric rebuilds
+# ---------------------------------------------------------------------------
+
+def _dev_arrays(amg):
+    import jax
+    return [np.asarray(leaf) for leaf in jax.tree.leaves(amg.hierarchy)
+            if hasattr(leaf, "dtype")]
+
+
+@pytest.mark.parametrize("policy_factory", [
+    lambda: None,                                        # stencil path
+    lambda: _csr_transfer_policy(SmoothedAggregation()),  # CSR path
+])
+def test_rebuild_bit_identical_to_fresh(policy_factory):
+    """rebuild(2A) == fresh AMG(2A), bit for bit, host AND device
+    arrays — both builds run the identical numeric route."""
+    pol = policy_factory()
+    prm = dict(dtype=jnp.float64, coarse_enough=80)
+    if pol is not None:
+        prm["coarsening"] = pol
+        A = _unstructured(n=900, density=0.01, seed=5)
+    else:
+        A, _ = poisson3d(12)
+    amg = AMG(A, AMGParams(**prm))
+    A2 = CSR(A.ptr, A.col, 2.0 * A.val, A.ncols)
+    amg.rebuild(A2)
+    if pol is not None:
+        prm["coarsening"] = policy_factory()
+    fresh = AMG(A2, AMGParams(**prm))
+    for (Ai, _, _), (Bi, _, _) in zip(amg.host_levels,
+                                      fresh.host_levels):
+        assert np.array_equal(Ai.val, Bi.val)
+        assert np.array_equal(Ai.col, Bi.col)
+    for a, b in zip(_dev_arrays(amg), _dev_arrays(fresh)):
+        assert a.shape == b.shape
+        np.testing.assert_array_equal(a, b)
+
+
+def test_rebuild_values_only_api():
+    """rebuild(new_vals) takes a bare value array and skips the pattern
+    comparison."""
+    A, rhs = poisson3d(10)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=80))
+    amg.rebuild(2.0 * A.val)
+    ref = AMG(CSR(A.ptr, A.col, 2.0 * A.val, A.ncols),
+              AMGParams(dtype=jnp.float64, coarse_enough=80))
+    assert np.array_equal(amg.host_levels[1][0].val,
+                          ref.host_levels[1][0].val)
+    with pytest.raises(ValueError, match="value array shape"):
+        amg.rebuild(np.ones(3))
+
+
+def test_rebuild_asserts_same_sparsity():
+    A, _ = poisson3d(10)
+    amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=80))
+    B = A.to_scipy().tolil()
+    B[0, A.nrows - 1] = 1e-3             # new structural entry
+    B = CSR.from_scipy(B.tocsr())
+    with pytest.raises(ValueError, match="same sparsity"):
+        amg.rebuild(B)
+
+
+def test_rebuild_reuses_transfer_devices_and_plans():
+    """The rebuild keeps the device transfer operators (frozen) and the
+    cached Galerkin plans — no re-pack, no re-plan."""
+    A = _unstructured(n=900, density=0.01, seed=5)
+    amg = AMG(A, AMGParams(
+        coarsening=_csr_transfer_policy(SmoothedAggregation()),
+        dtype=jnp.float64, coarse_enough=80))
+    lv0 = amg.hierarchy.levels[0]
+    P_dev0, R_dev0 = lv0.P, lv0.R
+    amg.rebuild(2.0 * A.val)
+    plans1 = [getattr(P, "_seg_plan", None)
+              for (_, P, _) in amg.host_levels[:-1]]
+    assert amg.hierarchy.levels[0].P is P_dev0
+    assert amg.hierarchy.levels[0].R is R_dev0
+    amg.rebuild(3.0 * A.val)
+    plans2 = [getattr(P, "_seg_plan", None)
+              for (_, P, _) in amg.host_levels[:-1]]
+    for p1, p2 in zip(plans1, plans2):
+        assert p1 is p2                   # plan objects survive rebuilds
+
+
+def test_windowed_ell_value_refresh():
+    from amgcl_tpu.ops import device as dev
+    from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
+    A = _unstructured(n=700, density=0.02, seed=9, dtype=np.float32)
+    W = csr_to_windowed_ell(A, jnp.float32)
+    if W is None:
+        pytest.skip("fixture has no banded locality")
+    A2 = CSR(A.ptr, A.col, 2.0 * A.val, A.ncols)
+    W2 = dev.refresh_values(W, A2, jnp.float32)
+    assert W2 is not None
+    assert W2.window_starts is W.window_starts
+    np.testing.assert_array_equal(np.asarray(W2.vals),
+                                  2.0 * np.asarray(W.vals))
+
+
+def test_stencil_csr_cache_drift_guard():
+    """The cached DIA→CSR rebuild map serves same-value-pattern
+    rebuilds and REFUSES (returns None → caller re-derives) when a
+    value that was exactly zero at the first build comes alive."""
+    from amgcl_tpu.ops.stencil import (HostDia, _build_dia_csr_cache,
+                                       _csr_from_dia_cache)
+    dims = (1, 1, 8)
+    offs = [(0, 0, -1), (0, 0, 0), (0, 0, 1)]
+    data = np.zeros((3, 8))
+    data[1] = 2.0
+    data[2, :7] = -1.0
+    data[2, 3] = 0.0                    # value-zero inside the window
+    kept = [1, 2]                       # lower band all-zero at build 1
+    Acd = HostDia([offs[k] for k in kept], data[kept], dims)
+    out = Acd.to_csr()
+    cache = _build_dia_csr_cache(kept, Acd, out)
+    got = _csr_from_dia_cache(HostDia(offs, 2.0 * data, dims), cache)
+    assert got is not None
+    np.testing.assert_array_equal(got.val, 2.0 * out.val)
+    # a dropped diagonal turns on
+    d2 = data.copy()
+    d2[0, 1:] = -1.0
+    assert _csr_from_dia_cache(HostDia(offs, d2, dims), cache) is None
+    # an eliminated in-window entry turns on
+    d3 = data.copy()
+    d3[2, 3] = -1.0
+    assert _csr_from_dia_cache(HostDia(offs, d3, dims), cache) is None
+
+
+def test_stencil_galerkin_device_kernel_parity():
+    """The generated jitted stencil-Galerkin program == the native/host
+    pair-fnma route on the same plan (pre-drop output, f64)."""
+    from amgcl_tpu.ops.stencil import (StencilGalerkinPlan,
+                                       host_dia_from_csr, filtered_dia,
+                                       scale_rows)
+    m = 8
+    A, _ = poisson3d(m)
+    Ad = host_dia_from_csr(A, (m, m, m), np.float64)
+    Af, Dinv = filtered_dia(Ad, 0.08)
+    M = scale_rows(Af, Dinv)
+    M.data = M.data * 0.57
+    M = M.drop_empty()
+    coarse = tuple(-(-d // 2) for d in (m, m, m))
+    plan = StencilGalerkinPlan(Ad.offsets3, M.offsets3, Ad.dims,
+                               (2, 2, 2), coarse, np.float64)
+    host = plan.apply(Ad.data, M.data, device=False)
+    dev = plan.apply(Ad.data, M.data, device=True)
+    assert host.offsets3 == dev.offsets3
+    np.testing.assert_allclose(dev.data, host.data, rtol=0, atol=1e-12)
+    # plain-aggregation degenerate case (M=None): parity collapse only
+    plan0 = StencilGalerkinPlan(Ad.offsets3, None, Ad.dims, (2, 2, 2),
+                                coarse, np.float64)
+    h0 = plan0.apply(Ad.data, None, device=False)
+    d0 = plan0.apply(Ad.data, None, device=True)
+    np.testing.assert_allclose(d0.data, h0.data, rtol=0, atol=1e-12)
+
+
+# ---------------------------------------------------------------------------
+# telemetry: setup attribution + substage threading
+# ---------------------------------------------------------------------------
+
+def test_setup_attribution_coverage():
+    A, _ = poisson3d(24)
+    amg = AMG(A, AMGParams(dtype=jnp.float32))
+    rep = amg.setup_report()
+    assert rep["total_s"] > 0
+    stages = {r["stage"] for r in rep["rows"]}
+    assert any(s.endswith("/galerkin") for s in stages)
+    # the acceptance criterion: named stages own (nearly) all setup time
+    assert rep["coverage"] > 0.8
+    g = [r for r in rep["rows"] if r["stage"] == "level0/galerkin"][0]
+    assert g.get("bytes", 0) > 0 and "frac" in g
+
+
+def test_setup_substage_nested_in_profile(env, monkeypatch):
+    """Plan construction/numeric substages appear nested under the
+    level's galerkin scope when the device path engages, and the
+    attribution marks them nested (no double counting)."""
+    from amgcl_tpu.ops import stencil_device as sdev
+    monkeypatch.setattr(sdev, "enabled", lambda: False)
+    env("AMGCL_TPU_DEVICE_SETUP", 1)
+    A = _unstructured(n=900, density=0.01, seed=5)
+    amg = AMG(A, AMGParams(
+        coarsening=_csr_transfer_policy(SmoothedAggregation()),
+        dtype=jnp.float64, coarse_enough=80))
+    scopes = amg.setup_profile.to_dict()["scopes"]
+    kids = scopes.get("level0/galerkin", {}).get("children", {})
+    assert "galerkin_numeric" in kids or "galerkin_plan" in kids, scopes
+    rep = amg.setup_report()
+    nested = [r for r in rep["rows"] if r["nested"]]
+    assert any(r["stage"].endswith("galerkin_numeric") or
+               r["stage"].endswith("galerkin_plan") for r in nested)
+    top_sum = sum(r["seconds"] for r in rep["rows"] if not r["nested"])
+    assert abs(rep["named_s"] - top_sum) < 1e-9
+
+
+# ---------------------------------------------------------------------------
+# bench gate: setup_vs_baseline + rebuild_s round-over-round
+# ---------------------------------------------------------------------------
+
+def _gate(candidate, last_good):
+    import importlib.util
+    spec = importlib.util.spec_from_file_location(
+        "_bench_for_setup_gate",
+        os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    return bench.run_gate(candidate, last_good)
+
+
+def test_gate_setup_and_rebuild_checks():
+    base = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+            "setup_vs_baseline": 0.2, "rebuild_s": 1.0}
+    ok, checks = _gate({**base}, base)
+    names = {c["check"]: c for c in checks}
+    assert names["setup_vs_baseline"]["status"] == "ok"
+    assert names["rebuild_s"]["status"] == "ok"
+    # setup speed collapse → regression
+    ok, checks = _gate({**base, "setup_vs_baseline": 0.05}, base)
+    assert not ok
+    assert {c["check"]: c for c in checks}[
+        "setup_vs_baseline"]["status"] == "regression"
+    # rebuild blow-up → regression
+    ok, checks = _gate({**base, "rebuild_s": 2.0}, base)
+    assert not ok
+    # platform mismatch → skipped, not compared
+    ok, checks = _gate({**base, "device_platform": "tpu",
+                        "setup_vs_baseline": 0.01}, base)
+    st = {c["check"]: c for c in checks}
+    assert st["setup_vs_baseline"]["status"] == "skipped"
+    assert ok
+
+
+# ---------------------------------------------------------------------------
+# static audit: setup contract
+# ---------------------------------------------------------------------------
+
+def test_audit_setup_contract_clean():
+    from amgcl_tpu.analysis import jaxpr_audit as ja
+    recs = ja.audit_setup(m=6)
+    entries = {r["entry"] for r in recs}
+    from amgcl_tpu.telemetry.ledger import SETUP_CONTRACTS
+    assert entries == set(SETUP_CONTRACTS)
+    for rec in recs:
+        assert ja.check_setup(rec) == [], rec["entry"]
+
+
+def test_audit_setup_catches_violations():
+    from amgcl_tpu.analysis import jaxpr_audit as ja
+    bad = {"entry": "ops.segment_galerkin",
+           "collectives": {"psum": 1, "ppermute": 0, "all_gather": 0,
+                           "all_to_all": 0, "psum_elems": [1]},
+           "casts": [{"kind": "downcast", "from": "float64",
+                      "to": "float32", "elements": 4096, "path": ""}],
+           "host_callbacks": [{"primitive": "pure_callback", "path": ""}]}
+    findings = ja.check_setup(bad)
+    passes = {f["pass"] for f in findings}
+    assert passes == {"host-sync", "collectives", "dtype"}
+    assert all(f["severity"] == "error" for f in findings)
