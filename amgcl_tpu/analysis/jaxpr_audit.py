@@ -604,6 +604,96 @@ def audit_dist_cg(pipelined: bool = False, m: int = 8,
     return rec
 
 
+def audit_comm_stages(mesh=None, m: int = 8) -> List[Dict[str, Any]]:
+    """Abstractly trace every comm-measurement stage pair
+    (telemetry/comm.py: halo / psum / representative iteration, measured
+    + comm-ablated) over the available mesh and take the collective
+    census of each — checked by :func:`check_comm_stages` against
+    ``ledger.COMM_STAGE_CONTRACTS``. The measured variants must issue
+    exactly the declared collectives; the ablated stand-ins must issue
+    NONE (a collective surviving ablation poisons the subtraction that
+    attributes comm wall time). ``jax.make_jaxpr`` only, no execution."""
+    import jax
+    from amgcl_tpu.parallel.mesh import make_mesh, ROWS_AXIS
+    if mesh is None:
+        mesh = make_mesh(len(jax.devices()))
+    nd = int(mesh.shape[ROWS_AXIS])
+    if nd < 2:
+        return [{"entry": "telemetry.comm_stages", "skipped":
+                 "collective census needs >= 2 devices (have %d); run "
+                 "via `python -m amgcl_tpu.analysis`, which forces a "
+                 "virtual 8-device mesh" % nd}]
+    from amgcl_tpu.telemetry import comm as C
+    from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+    from amgcl_tpu.parallel.dist_ell import build_dist_ell
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, _ = poisson3d(m)
+    ops = [DistDiaMatrix.from_csr(A, mesh), build_dist_ell(A, mesh)]
+    recs: List[Dict[str, Any]] = []
+    seen = set()
+    for op in ops:
+        for pipelined in (False, True):
+            for st in C.comm_stages(op, mesh, pipelined=pipelined):
+                for ablated in (False, True):
+                    key = (st["contract"], ablated)
+                    if key in seen:
+                        continue        # halo/psum repeat across bodies
+                    seen.add(key)
+                    fn = st["fn_ablated"] if ablated else st["fn"]
+                    jx = jax.make_jaxpr(getattr(fn, "_jitted", fn))(
+                        *st["args"])
+                    recs.append({
+                        "entry": getattr(
+                            fn, "_watched_name",
+                            "telemetry.comm_%s%s"
+                            % (st["key"],
+                               "_ablated" if ablated else "")),
+                        "stage": st["contract"], "ablated": ablated,
+                        "devices": nd,
+                        "collectives": collective_census(jx.jaxpr)})
+    return recs
+
+
+def check_comm_stages(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Findings for one audit_comm_stages record: measured stages must
+    match ``ledger.COMM_STAGE_CONTRACTS`` collective for collective;
+    ablated stand-ins must census to exactly 0."""
+    from amgcl_tpu.telemetry.ledger import COMM_STAGE_CONTRACTS
+    out: List[Dict[str, Any]] = []
+    if rec.get("skipped"):
+        out.append({"severity": "info", "pass": "collectives",
+                    "entry": rec["entry"], "message": rec["skipped"]})
+        return out
+    kinds = ("psum", "ppermute", "all_gather", "all_to_all")
+    got = {k: rec["collectives"].get(k, 0) for k in kinds}
+    if rec["ablated"]:
+        total = sum(got.values())
+        if total != 0:
+            out.append({
+                "severity": "error", "pass": "collectives",
+                "entry": rec["entry"],
+                "message": "comm-ablated stand-in issues %d "
+                "collective(s) (%s) — the ablation contract is a "
+                "census of EXACTLY 0; any surviving collective "
+                "poisons the measured-comm subtraction"
+                % (total, {k: v for k, v in got.items() if v})})
+        return out
+    contract = COMM_STAGE_CONTRACTS.get(rec["stage"])
+    if contract is None:
+        return out
+    want = {k: contract.get(k, 0) for k in kinds}
+    if got != want:
+        out.append({
+            "severity": "error", "pass": "collectives",
+            "entry": rec["entry"],
+            "message": "measured comm stage %r census %s, contract "
+            "says %s (ledger.COMM_STAGE_CONTRACTS) — the stage no "
+            "longer measures what the model prices"
+            % (rec["stage"], {k: v for k, v in got.items() if v},
+               {k: v for k, v in want.items() if v})})
+    return out
+
+
 def audit_make_solver(mixed: bool = False, m: int = 8) -> Dict[str, Any]:
     """Trace ``make_solver._solve_fn`` (the fused P+S program) and audit
     dtype discipline across the whole program: with ``mixed`` the
@@ -1023,6 +1113,9 @@ def run_audit(solvers: Optional[Sequence[str]] = None,
             rec = audit_dist_cg(pipelined=pipelined)
             records.append(rec)
             findings += check_dist(rec)
+        for rec in audit_comm_stages():
+            records.append(rec)
+            findings += check_comm_stages(rec)
     for mixed in (False, True):
         rec = audit_make_solver(mixed=mixed)
         records.append(rec)

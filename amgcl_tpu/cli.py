@@ -86,6 +86,24 @@ def main(argv=None):
                          "--telemetry also emits a 'roofline' event, and "
                          "with --trace adds the stage timeline with an "
                          "achieved-GB/s counter track")
+    ap.add_argument("--dist-report", action="store_true",
+                    help="with --mesh: print the distributed "
+                         "observability report — per-level per-shard "
+                         "rows/nnz and the load-imbalance factor from "
+                         "the resource ledger, measured comm "
+                         "attribution of the finest sharded operator "
+                         "(halo exchange / stacked psum / one Krylov "
+                         "iteration, each timed against its "
+                         "comm-ablated stand-in, AMGCL_TPU_COMM_REPS "
+                         "reps), achieved wire GB/s vs the comm model, "
+                         "and the measured per-shard SpMV spread; with "
+                         "--telemetry also emits a 'dist_report' "
+                         "event, with --doctor folds the divergence "
+                         "findings into the doctor, with --trace adds "
+                         "a per-device track group, and with "
+                         "--metrics-port publishes the mesh-size and "
+                         "comm-fraction gauges on /metrics and keeps "
+                         "the endpoint alive until Ctrl-C")
     ap.add_argument("--doctor", action="store_true",
                     help="run the convergence doctor: probe the measured "
                          "per-level convergence factors and smoother "
@@ -282,6 +300,106 @@ def main(argv=None):
         else:
             print("(no resource ledger: %r exposes none)" % type(inner))
 
+    dist_comm_rec = None
+    dist_spread = None
+    dist_metrics_srv = None
+    if args.dist_report:
+        if not args.mesh:
+            ap.error("--dist-report requires --mesh")
+        from amgcl_tpu.telemetry import comm as _comm
+        mesh_obj = getattr(inner, "mesh", None)
+        if mesh_obj is None:
+            from amgcl_tpu.parallel.mesh import make_mesh as _mk
+            mesh_obj = _mk(args.mesh)
+        # the EXECUTED mesh size: make_mesh truncates the request to the
+        # available devices, and every table below must describe the
+        # partition that actually ran
+        from amgcl_tpu.parallel.mesh import ROWS_AXIS as _RAX
+        nd_mesh = int(mesh_obj.shape[_RAX])
+        led = None
+        try:
+            led = ledger_fn() if callable(ledger_fn) else None
+        except Exception:
+            pass
+        dist_led = (led or {}).get("dist") \
+            if isinstance(led, dict) else None
+        print()
+        if dist_led and dist_led.get("levels"):
+            # per-level useful-work shard tables from the ledger: the
+            # EXECUTED partition's rows/nnz, not the padded buffers
+            print("Per-shard ledger (useful-work nnz per level):")
+            for row in dist_led["levels"]:
+                nz = [r["nnz"] for r in row["per_shard"]]
+                print("  level %s: halo slab %s, nnz/shard %s, "
+                      "imbalance %.3f"
+                      % (row["level"], row.get("halo_slab"), nz,
+                         row["imbalance"]["factor"]))
+            print("  worst-level imbalance factor: %.3f"
+                  % dist_led.get("imbalance_factor", 1.0))
+        hier = getattr(inner, "hier", None)
+        Aop = None
+        if hier is not None:
+            # the Krylov-loop operator, same precedence as
+            # DistHierarchy.system_A(): top_A first (under a narrowed
+            # precond_dtype it is the solver-precision copy the outer
+            # loop actually dispatches), finest sharded level otherwise
+            Aop = getattr(hier, "top_A", None)
+            if Aop is None and getattr(hier, "levels", None):
+                Aop = hier.levels[0].A
+        if Aop is not None:
+            # measured comm attribution + per-shard spread of the
+            # finest sharded operator (telemetry/comm.py ablation)
+            with prof.scope("dist_report"):
+                try:
+                    dist_comm_rec = _comm.comm_attribution(Aop,
+                                                           mesh_obj)
+                    dist_spread = _comm.measure_shard_spread(Aop,
+                                                             mesh_obj)
+                except Exception as e:    # noqa: BLE001 — report what
+                    print("(comm attribution failed: %r)" % e)  # exists
+        if dist_comm_rec is not None:
+            print()
+            print(_comm.format_comm(dist_comm_rec))
+        # the structural shard table and the telemetry event need no
+        # measurement — a failed comm attribution still reports them
+        shard_tab = _comm.dist_resources(Aop, nd_mesh) \
+            if Aop is not None else None
+        if shard_tab is not None:
+            print()
+            print(_comm.format_dist_report(shard_tab, dist_spread))
+        if Aop is None:
+            print("(no distributed operator exposed by %r — the "
+                  "comm measurement needs a DistDiaMatrix/"
+                  "DistEllMatrix finest level)" % type(inner).__name__)
+        telemetry.emit(
+            event="dist_report",
+            comm={k: v for k, v in (dist_comm_rec or {}).items()
+                  if not k.startswith("_")},
+            ledger_dist=dist_led, shard_table=shard_tab,
+            spread={k: v for k, v in (dist_spread or {}).items()
+                    if not k.startswith("_")})
+        if args.metrics_port is not None and args.metrics_port >= 0 \
+                and not args.serve:
+            # the serving tie-in: a resident distributed solver
+            # exposes mesh size + measured comm fraction live (a
+            # negative port = OFF, the SolverService convention; a
+            # bind failure must not abort a finished report)
+            try:
+                from amgcl_tpu.telemetry.live import (
+                    LiveRegistry, MetricsServer, publish_dist_gauges)
+                reg = LiveRegistry()
+                publish_dist_gauges(
+                    reg, devices=nd_mesh,
+                    comm_fraction=((dist_comm_rec or {}).get(
+                        "per_iteration") or {}).get("comm_fraction"))
+                dist_metrics_srv = MetricsServer(args.metrics_port,
+                                                 reg.prometheus)
+                print("dist-report: metrics at %s"
+                      % dist_metrics_srv.url)
+            except OSError as e:
+                print("dist-report: metrics server failed to bind "
+                      "port %s (%r)" % (args.metrics_port, e))
+
     roofline_rec = None
     if args.roofline:
         from amgcl_tpu.telemetry import roofline as _roofline
@@ -343,7 +461,10 @@ def main(argv=None):
                             # serving leg: the SLO watchdog's window
                             # summary becomes serve-side findings
                             serve=serve_svc.slo_summary()
-                            if serve_svc is not None else None)
+                            if serve_svc is not None else None,
+                            # distributed leg: --dist-report's measured
+                            # comm attribution — divergence findings
+                            comm=dist_comm_rec)
         print()
         print(format_findings(findings))
         telemetry.emit(event="doctor", findings=findings,
@@ -430,6 +551,18 @@ def main(argv=None):
             trace["traceEvents"] += serve_svc.to_chrome_trace(
                 tid=3, tid_name="serve requests",
                 epoch=prof._t0)["traceEvents"]
+        if dist_comm_rec is not None and dist_comm_rec.get("_prof"):
+            # the comm measurement (measured + ablated stage scopes)
+            trace["traceEvents"] += dist_comm_rec[
+                "_prof"].to_chrome_trace(
+                tid=4, tid_name="dist comm",
+                epoch=prof._t0)["traceEvents"]
+        if dist_spread is not None and dist_spread.get("_prof"):
+            # the per-device track group: shard<i>/spmv scopes
+            trace["traceEvents"] += dist_spread[
+                "_prof"].to_chrome_trace(
+                tid=5, tid_name="dist shards",
+                epoch=prof._t0)["traceEvents"]
         with open(args.trace, "w") as f:
             _json.dump(trace, f)
         print("trace written to %s (open in ui.perfetto.dev)" % args.trace)
@@ -440,6 +573,17 @@ def main(argv=None):
             aio.write_binary(args.output, xa)
         else:
             aio.mm_write(args.output, xa)
+    if dist_metrics_srv is not None:
+        # a one-shot CLI that closed its scrape endpoint on return would
+        # advertise gauges nobody can scrape — hold the report's
+        # /metrics open until the operator interrupts (opt-in: the user
+        # asked for the port)
+        print("dist-report: serving /metrics until Ctrl-C ...")
+        try:
+            dist_metrics_srv._thread.join()
+        except KeyboardInterrupt:
+            pass
+        dist_metrics_srv.close()
     return 0
 
 

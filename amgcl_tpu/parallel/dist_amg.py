@@ -652,6 +652,10 @@ class DistAMGSolver:
 
         nlocs = [lvl_nloc(h[0].nrows * h[0].block_size[0])
                  for h in host.host_levels[:t]]
+        # the EXECUTED per-level partition (min_per_shard concentration
+        # included) — the per-shard ledger derives its strip bounds from
+        # exactly this, so a skewed partition reports its real imbalance
+        self._nlocs = list(nlocs)
         self.repartition_report = []
         if repartition and t > 1:
             from amgcl_tpu.parallel.repartition import \
@@ -832,9 +836,40 @@ class DistAMGSolver:
                 "bytes": (spmvs * top_comm["bytes"]
                           + papps * pre_cycles * cyc["bytes"]
                           + dots * red1["bytes"])}
+            # per-shard imbalance (telemetry/comm.py): exact useful-work
+            # rows/nnz per shard from the host CSR at the EXECUTED
+            # partition — a min_per_shard concentration or a naturally
+            # skewed level shows its real load factor here, padding-
+            # uniform device buffers notwithstanding. Nested guard: a
+            # wrapper without host_levels (StripAMGSolver reuses this
+            # method) keeps its comm/memory ledger and just skips the
+            # shard tables.
+            from amgcl_tpu.telemetry import comm as _comm
+            dist = {"devices": nd,
+                    "provenance": _comm.hw_provenance(self.mesh)}
+            try:
+                dist_levels = []
+                worst = 1.0
+                nlocs = self._nlocs
+                for k, lv in enumerate(self.hier.levels):
+                    Ak = self.host_amg.host_levels[k][0]
+                    Ak_s = Ak.unblock() if Ak.is_block else Ak
+                    bounds = _comm.even_bounds(Ak_s.nrows, nd,
+                                               nloc=nlocs[k])
+                    row = _comm.level_shard_costs(Ak_s, bounds)
+                    row["level"] = k
+                    row["halo_slab"] = int(lv.A.send_idx.shape[-1]) \
+                        if lv.A.send_idx is not None else 0
+                    dist_levels.append(row)
+                    worst = max(worst, row["imbalance"]["factor"])
+                dist["levels"] = dist_levels
+                dist["imbalance_factor"] = round(worst, 4)
+            except Exception as e:
+                dist["levels_error"] = repr(e)[:120]
             cached = {
                 "comm": {"devices": nd, "levels": lv_rows,
                          "per_cycle": cyc, "per_iteration": per_iter},
+                "dist": dist,
                 "memory": {
                     # global logical bytes of the sharded arrays (each
                     # shard holds 1/nd of these)

@@ -83,11 +83,19 @@ class DistEllMatrix:
 
     # -- device kernel (inside shard_map) ----------------------------------
 
-    def shard_mv(self, x_local):
+    def shard_mv(self, x_local, exchange=None):
         """Overlapped halo SpMV for the shard-local slice of the pytree
-        (leading dims == 1). x_local: (ncloc,) owned input values."""
+        (leading dims == 1). x_local: (ncloc,) owned input values.
+
+        ``exchange`` overrides the all_to_all seam — telemetry/comm.py
+        passes an identity stand-in (same (nd, C) shape, zero
+        collectives) to measure the comm-ablated variant of exactly this
+        program; the default issues the real collective."""
         send = jnp.take(x_local, self.send_idx[0], axis=0)   # (nd, C)
-        recv = lax.all_to_all(send, ROWS_AXIS, 0, 0, tiled=False)
+        if exchange is None:
+            recv = lax.all_to_all(send, ROWS_AXIS, 0, 0, tiled=False)
+        else:
+            recv = exchange(send)
         halo = recv.reshape(-1)
         y_loc = _ell_mv(self.loc_cols[0], self.loc_vals[0], x_local)
         y_rem = _ell_mv(self.rem_cols[0], self.rem_vals[0], halo)

@@ -103,7 +103,38 @@ class DistDiaMatrix:
         return dia_halo_mv(data_local, self.offsets, x_local)
 
 
-def dia_halo_mv(data_l, flat_offs, x_l):
+def _ring_exchange(x_l, w, nd):
+    """The real edge exchange: one ppermute per direction between every
+    adjacent shard pair — (prev_tail, next_head), each ``w`` elements."""
+    fwd = [(i, i + 1) for i in range(nd - 1)]
+    bwd = [(i + 1, i) for i in range(nd - 1)]
+    return (lax.ppermute(x_l[-w:], ROWS_AXIS, fwd),
+            lax.ppermute(x_l[:w], ROWS_AXIS, bwd))
+
+
+def _local_exchange(x_l, w, nd):
+    """Comm-ablated stand-in for :func:`_ring_exchange`
+    (telemetry/comm.py): identical shapes, dtypes and downstream compute,
+    ZERO collectives — timing the two variants of the same SpMV isolates
+    the collective's wall share. Numerically wrong at the shard edges on
+    purpose; never dispatched by a solve (the ablation audit pins its
+    collective census to exactly 0)."""
+    return x_l[:w], x_l[-w:]
+
+
+def _gather_ring(x_l, nd):
+    """Whole-vector gather of the thin-slab fallback path."""
+    return lax.all_gather(x_l, ROWS_AXIS, tiled=True)
+
+
+def _gather_local(x_l, nd):
+    """Comm-ablated stand-in for :func:`_gather_ring`: same output shape
+    from a local tile, zero collectives (see _local_exchange)."""
+    return jnp.tile(x_l, nd)
+
+
+def dia_halo_mv(data_l, flat_offs, x_l, exchange=_ring_exchange,
+                gather=_gather_ring):
     """y = A x on one shard with comm/compute overlap.
 
     The reference overlaps explicitly: start_exchange → local SpMV →
@@ -116,7 +147,12 @@ def dia_halo_mv(data_l, flat_offs, x_l):
     a sliver) are recomputed from the halo and spliced in. A naive
     concat(halo, x, halo) formulation would make EVERY fused
     multiply-add a consumer of the collective and serialize the step
-    (structure asserted by tests/test_distributed overlap-HLO test)."""
+    (structure asserted by tests/test_distributed overlap-HLO test).
+
+    ``exchange``/``gather`` are the collective seams: the defaults issue
+    the real ppermute/all_gather; telemetry/comm.py passes the local
+    same-shape stand-ins to measure the comm-ablated variant of exactly
+    this program."""
     w = max(max(flat_offs), -min(flat_offs), 0) if flat_offs else 0
     nl = x_l.shape[0]
     acc_dt = jnp.result_type(data_l.dtype, x_l.dtype)
@@ -132,7 +168,7 @@ def dia_halo_mv(data_l, flat_offs, x_l):
         # reachable on very thin coarse slabs, so assembling the global
         # vector is cheap — gather it and slice at the shard's global
         # row offset.
-        xg = lax.all_gather(x_l, ROWS_AXIS, tiled=True)
+        xg = gather(x_l, nd)
         base = lax.axis_index(ROWS_AXIS) * nl
         xe = jnp.pad(xg, (w, w))
         y = jnp.zeros(nl, dtype=acc_dt)
@@ -146,20 +182,14 @@ def dia_halo_mv(data_l, flat_offs, x_l):
         if nd == 1:
             xe = jnp.pad(x_l, (w, w))
         else:
-            fwd = [(i, i + 1) for i in range(nd - 1)]
-            bwd = [(i + 1, i) for i in range(nd - 1)]
-            prev_tail = lax.ppermute(x_l[-w:], ROWS_AXIS, fwd)
-            next_head = lax.ppermute(x_l[:w], ROWS_AXIS, bwd)
+            prev_tail, next_head = exchange(x_l, w, nd)
             xe = jnp.concatenate([prev_tail, x_l, next_head])
         y = jnp.zeros(nl, dtype=acc_dt)
         for k, s in enumerate(flat_offs):
             y = y + data_l[k] * lax.dynamic_slice(xe, (w + s,), (nl,))
         return y
 
-    fwd = [(i, i + 1) for i in range(nd - 1)]
-    bwd = [(i + 1, i) for i in range(nd - 1)]
-    prev_tail = lax.ppermute(x_l[-w:], ROWS_AXIS, fwd)   # in flight ...
-    next_head = lax.ppermute(x_l[:w], ROWS_AXIS, bwd)
+    prev_tail, next_head = exchange(x_l, w, nd)          # in flight ...
 
     # ... while the interior streams: zero-filled local shifts, valid for
     # rows [w, nl-w).  On TPU the interior takes the Pallas DIA kernel —

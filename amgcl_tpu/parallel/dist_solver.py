@@ -260,11 +260,24 @@ def dist_cg(A: DistDiaMatrix, mesh, rhs, x0=None, dinv=None,
         "devices": nd,
         "per_spmv": spmv_comm,
         "per_iteration": per_iter}}
+    # per-shard ledger + hardware provenance (telemetry/comm.py): the
+    # distributed half of SolveReport.resources — per-shard rows/nnz/
+    # halo and the load-imbalance factor, plus the ICI-vs-CPU-fallback
+    # tag the gates key their platform-mismatch skip on
+    extra = {"devices": nd}
+    try:
+        from amgcl_tpu.telemetry import comm as _comm
+        dist_res = _comm.dist_resources(A, nd)
+        if dist_res is not None:
+            resources["dist"] = dist_res
+        extra["provenance"] = _comm.hw_provenance(mesh)
+    except Exception:
+        pass                     # observability must never fail a solve
     report = SolveReport(
         int(it), float(res), wall_time_s=_time.perf_counter() - t0,
         solver="dist_cg_pipelined" if pipelined else "dist_cg",
         resources=resources, health=health,
-        extra={"devices": nd})
+        extra=extra)
     _tel_emit(report.to_dict(), event="dist_solve", n=int(A.shape[0]))
     out = _DistResult((x, int(it), float(res)))
     out.report = report

@@ -88,6 +88,13 @@ DECLARED_ENTRY_POINTS = (
     "pyamgcl_compat.precond_apply",
     "serve.solve_step",
     "solver.direct.device_inv",
+    "telemetry.comm_halo",
+    "telemetry.comm_halo_ablated",
+    "telemetry.comm_iter",
+    "telemetry.comm_iter_ablated",
+    "telemetry.comm_psum",
+    "telemetry.comm_psum_ablated",
+    "telemetry.comm_shard_spmv",
 )
 
 

@@ -445,7 +445,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              maxiter: Optional[int] = None,
              roofline: Optional[Dict[str, Any]] = None,
              compile_stats: Optional[Dict[str, Any]] = None,
-             serve: Optional[Dict[str, Any]] = None
+             serve: Optional[Dict[str, Any]] = None,
+             comm: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
@@ -454,9 +455,12 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     after warmup become findings; so does compile time dominating the
     solve). ``serve`` takes an SLO-watchdog window summary
     (``SolverService.slo_summary()``) and folds in the serve-side
-    findings (:func:`serve_findings`). Each finding: {severity, code,
-    message, suggestion}. Pure host-side dict-crunching — never raises
-    on missing pieces."""
+    findings (:func:`serve_findings`). ``comm`` takes a measured comm
+    attribution (``telemetry.comm.comm_attribution()``) and folds in
+    the model-vs-measured divergence findings — comm-bound iterations,
+    wire rates far off the ICI peak, host-virtual-mesh caveats. Each
+    finding: {severity, code, message, suggestion}. Pure host-side
+    dict-crunching — never raises on missing pieces."""
     out: List[Dict[str, Any]] = []
     health = getattr(report, "health", None) or {}
     resid = getattr(report, "resid", None)
@@ -608,6 +612,16 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
                    if isinstance(f, dict) and "severity" in f)
     if isinstance(serve, dict):
         out.extend(serve_findings(serve))
+    if isinstance(comm, dict):
+        # distributed leg: measured comm attribution divergence
+        # (telemetry/comm.py — pre-shaped findings ride the record, or
+        # are derived fresh from a findings-free record)
+        fs = comm.get("findings")
+        if fs is None:
+            from amgcl_tpu.telemetry.comm import comm_findings
+            fs = comm_findings(comm)
+        out.extend(f for f in fs
+                   if isinstance(f, dict) and "severity" in f)
     if isinstance(compile_stats, dict):
         from amgcl_tpu.telemetry import compile_watch as _cw
         out.extend(_cw.findings(compile_stats))

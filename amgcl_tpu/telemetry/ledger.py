@@ -398,6 +398,28 @@ DIST_CG_COLLECTIVES = {
 }
 
 
+#: collective CONTRACT of the comm-measurement stage pairs
+#: (telemetry/comm.py, audited statically by
+#: analysis/jaxpr_audit.audit_comm_stages): each measured stage must
+#: contain EXACTLY the listed collectives (and zero of every other
+#: kind), and every ``*_ablated`` stand-in must have a collective
+#: census of EXACTLY 0 — the ablation subtraction
+#: ``comm_s = t(measured) − t(ablated)`` is only an attribution of
+#: collective wall time if the ablated program really dropped the
+#: collectives and nothing else. A psum sneaking into a stand-in (or a
+#: halo exchange falling out of a measured stage) fails the analysis
+#: gate, not a measurement session.
+COMM_STAGE_CONTRACTS = {
+    "halo_dia":           {"ppermute": 2},
+    "halo_ell":           {"all_to_all": 1},
+    "psum":               {"psum": 1},
+    "iter_classical_dia": {"psum": 3, "ppermute": 2},
+    "iter_pipelined_dia": {"psum": 1, "ppermute": 2},
+    "iter_classical_ell": {"psum": 3, "all_to_all": 1},
+    "iter_pipelined_ell": {"psum": 1, "all_to_all": 1},
+}
+
+
 #: donation CONTRACT per jitted entry point: how many argument buffers
 #: the lowered program is expected to alias into outputs. All zero
 #: today — the audit's informational finding is the standing reminder

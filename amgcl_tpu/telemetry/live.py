@@ -93,6 +93,11 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "compile-watch cache hits of serve.solve_step"),
     "serve_compile_s": (
         "gauge", "cumulative XLA compile seconds of serve.solve_step"),
+    "dist_mesh_devices": (
+        "gauge", "devices in the distributed solve mesh"),
+    "dist_comm_fraction": (
+        "gauge", "measured collective wall fraction of one distributed "
+                 "iteration (telemetry/comm.py ablation)"),
 }
 
 # the ONE name-mangling rule, shared with the rollup exposition so the
@@ -217,6 +222,22 @@ class LiveRegistry:
         if rollups:
             text += _metrics.prometheus_text(rollups, prefix=prefix)
         return text
+
+
+def publish_dist_gauges(registry: "LiveRegistry",
+                        devices: Optional[int] = None,
+                        comm_fraction: Optional[float] = None) -> None:
+    """Publish the distributed-solve gauges onto a live registry so a
+    served distributed solver exposes them on ``/metrics``: the mesh
+    size and the measured comm fraction of one iteration
+    (``telemetry.comm.comm_attribution()['per_iteration']
+    ['comm_fraction']``). Names are literals from :data:`METRICS` —
+    the metric-name-literal contract (this module is the declaring
+    site)."""
+    if devices is not None:
+        registry.set_gauge("dist_mesh_devices", float(devices))
+    if comm_fraction is not None:
+        registry.set_gauge("dist_comm_fraction", float(comm_fraction))
 
 
 def metrics_port_from_env() -> Optional[int]:

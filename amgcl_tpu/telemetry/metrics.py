@@ -55,6 +55,19 @@ TREND_FIELDS = [
     ("roofline_frac", "roofline.frac_hbm_peak"),
 ]
 
+#: multichip trend fields (the structured ``MULTICHIP_r*.json`` schema
+#: emitted by ``bench.py --scaling``; legacy dryrun-log rounds degrade
+#: to device-count-only rows with gaps)
+MULTICHIP_TREND_FIELDS = [
+    ("devices", "headline.devices"),
+    ("weak_eff", "headline.weak_efficiency"),
+    ("strong_eff", "headline.strong_efficiency"),
+    ("comm_frac", "headline.comm_fraction"),
+    ("imbalance", "headline.imbalance"),
+    ("wire_gbps", "headline.wire_gbps"),
+    ("iters", "headline.iters"),
+]
+
 #: sink-event rollup spec: {event: [(metric, dotted path)]}
 EVENT_FIELDS = {
     "solve": [("iters", "iters"), ("solve_time_s", "wall_time_s"),
@@ -169,6 +182,43 @@ def bench_history(repo: str) -> List[Dict[str, Any]]:
             continue
         parsed = rec.get("parsed") if isinstance(rec, dict) else None
         row = dict(parsed) if isinstance(parsed, dict) else {}
+        row["round"] = int(m.group(1))
+        row["path"] = os.path.basename(path)
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+_MC_ROUND_RE = re.compile(r"MULTICHIP_r(\d+)\.json$")
+
+
+def multichip_history(repo: str) -> List[Dict[str, Any]]:
+    """The committed per-round multichip records, sorted by round.
+    Structured records (``bench.py --scaling``, ``schema`` >= 2) are
+    returned whole; legacy rounds (pass/fail dryrun logs with an
+    ``n_devices`` + ``tail``) normalize to ``legacy_dryrun`` rows whose
+    only trend column is the device count — gaps, never errors, the
+    ``bench_history`` discipline."""
+    rows = []
+    for path in glob.glob(os.path.join(repo, "MULTICHIP_r*.json")):
+        m = _MC_ROUND_RE.search(path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        if rec.get("schema"):
+            row = dict(rec)
+        else:
+            # the one number a dryrun log carries is the mesh size it
+            # ran on — surface it under the same headline key the
+            # structured records use so the trend column joins
+            row = {"legacy_dryrun": True, "ok": rec.get("ok"),
+                   "headline": {"devices": rec.get("n_devices")}}
         row["round"] = int(m.group(1))
         row["path"] = os.path.basename(path)
         rows.append(row)

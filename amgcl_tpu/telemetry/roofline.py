@@ -167,6 +167,39 @@ def device_peaks(refresh: bool = False) -> Dict[str, Any]:
     return out
 
 
+#: (device_kind substring, aggregate per-chip ICI GB/s) — the public
+#: Cloud figures (total inter-chip interconnect bandwidth per chip), the
+#: wire ceiling for the comm attribution (telemetry/comm.py). Substring
+#: order matters (v5p before v5).
+TPU_ICI_GBPS = [
+    ("v6", 448.0),
+    ("v5p", 600.0),
+    ("v5 lite", 200.0),
+    ("v5e", 200.0),
+    ("v4", 300.0),
+]
+
+
+def ici_peak_gbps() -> Optional[float]:
+    """Aggregate per-chip ICI bandwidth ceiling: env override
+    (``AMGCL_TPU_PEAK_ICI_GBPS``) first, then the public-figure table by
+    ``device_kind``; None on CPU/unknown backends — a host-virtual mesh
+    moves collectives through shared memory and has no meaningful wire
+    peak (the comm attribution tags those runs via provenance instead
+    of comparing against a fictitious number)."""
+    env = _env_float("AMGCL_TPU_PEAK_ICI_GBPS")
+    if env is not None:
+        return env
+    pk = device_peaks()
+    if pk.get("platform") != "tpu":
+        return None
+    kind = (pk.get("device_kind") or "").lower()
+    for key, gbps in TPU_ICI_GBPS:
+        if key in kind:
+            return gbps
+    return None
+
+
 # ---------------------------------------------------------------------------
 # stage measurement
 # ---------------------------------------------------------------------------

@@ -15,25 +15,37 @@ import scipy.sparse as sp
 from amgcl_tpu.ops.csr import CSR
 
 
-def poisson3d(n: int, anisotropy: float = 1.0, dtype=np.float64):
+def poisson3d(n: int, anisotropy: float = 1.0, dtype=np.float64,
+              nx=None):
     """7-point finite-difference Laplacian on an n×n×n grid.
 
     Returns ``(A: CSR, rhs: np.ndarray)`` with Dirichlet boundaries folded
     into the operator. ``anisotropy`` scales the z-direction coupling the way
     the reference fixture does to stress semi-coarsening behavior.
 
+    ``nx`` stretches the SLOWEST dimension to nx points — an (nx, n, n)
+    grid whose rows scale linearly with nx while the ±n² band reach (the
+    strip-partition halo) stays constant; bench.py's weak-scaling ladder
+    uses it. Default (nx = n) is the cubic fixture, bit-identical to
+    before the parameter existed.
+
     Mirrors the behavior (not the code) of tests/sample_problem.hpp:11-84.
     """
+    nx = n if nx is None else int(nx)
     h2i = float(n - 1) ** 2 if n > 1 else 1.0
     ex = np.ones(n)
+    exx = np.ones(nx)
     T = sp.diags([-ex[:-1], 2 * ex, -ex[:-1]], [-1, 0, 1], format="csr")
+    Tx = sp.diags([-exx[:-1], 2 * exx, -exx[:-1]], [-1, 0, 1],
+                  format="csr")
     I = sp.identity(n, format="csr")
-    Axy = sp.kron(I, sp.kron(I, T)) + sp.kron(I, sp.kron(T, I))
-    Az = sp.kron(T, sp.kron(I, I))
+    Ix = sp.identity(nx, format="csr")
+    Axy = sp.kron(Ix, sp.kron(I, T)) + sp.kron(Ix, sp.kron(T, I))
+    Az = sp.kron(Tx, sp.kron(I, I))
     A = (Axy + anisotropy * Az) * h2i
     A = sp.csr_matrix(A.astype(dtype))
     A.sort_indices()
-    rhs = np.ones(n ** 3, dtype=dtype)
+    rhs = np.ones(nx * n * n, dtype=dtype)
     return CSR.from_scipy(A), rhs
 
 

@@ -44,6 +44,19 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # axpby+dot per vector size (including
                                     # the stacked (n, B) tier), emitted
                                     # as a bench_vecbench JSONL record
+    python bench.py --scaling       # distributed scaling harness: weak +
+                                    # strong sweeps over the mesh (8
+                                    # virtual CPU devices forced where no
+                                    # TPU is attached) for dist CG /
+                                    # pipelined CG / dist AMG, with
+                                    # measured comm attribution, per-shard
+                                    # imbalance and the collective-census
+                                    # cross-check; emits ONE structured
+                                    # multichip_scaling record and writes
+                                    # MULTICHIP_LATEST.json — the --gate /
+                                    # --check candidate scored against the
+                                    # previous round's MULTICHIP_r*.json
+                                    # (AMGCL_TPU_GATE_MULTICHIP)
     python bench.py --throughput [B ...]
                                     # serving throughput: solves/sec of the
                                     # stacked multi-RHS path at B in
@@ -918,6 +931,14 @@ def main_worker():
         "gen_s": round(t_gen, 3),
         "device": str(dev0), "device_platform": dev0.platform,
         "device_kind": getattr(dev0, "device_kind", None)})
+    # uniform hardware-provenance stamp (telemetry/comm.py): device
+    # kind, topology, and the ICI vs CPU-fallback tag every gate's
+    # platform-mismatch skip reads through _record_platform
+    try:
+        from amgcl_tpu.telemetry.comm import hw_provenance
+        _PARTIAL["provenance"] = hw_provenance()
+    except Exception:
+        pass
     # stage-by-stage setup attribution (telemetry/ledger.
     # setup_attribution): named-stage coverage + the top stages, captured
     # NOW — the rebuild stage below replaces the profiler
@@ -1342,13 +1363,395 @@ def main_throughput(args=None):
                  row["speedup_vs_single"],
                  "  serve p50 %.1fms p99 %.1fms"
                  % (lat["p50"], lat["p99"]) if lat else ""))
+    from amgcl_tpu.telemetry.comm import hw_provenance
     out = {"event": "bench_throughput", "n": n, **rec,
            "device": str(dev0), "device_platform": dev0.platform,
            "device_kind": getattr(dev0, "device_kind", None),
+           "provenance": hw_provenance(),
            "commit": _git_head()}
     _stdout_sink.emit(out)
     _sink.emit(dict(out))
     return 0
+
+
+# ===========================================================================
+# scaling harness: weak+strong sweeps over the mesh, gated round-over-round
+# ===========================================================================
+
+_MULTICHIP_LATEST = os.path.join(_REPO, "MULTICHIP_LATEST.json")
+
+
+def _scaling_problem(n, scale):
+    """3D Poisson on an (n*scale, n, n) grid, slow dim stretched: rows
+    scale linearly with ``scale`` while the strip-partition halo (the
+    +-n^2 band reach) stays constant — the weak-scaling ladder, built by
+    the SAME fixture the tests and audits use (poisson3d's ``nx``
+    parameter). Rows divide every mesh size that divides n^3."""
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    return poisson3d(n, nx=n * scale)
+
+
+def _scaling_measure(solver_key, A, rhs, mesh, maxiter, tol, reps):
+    """One (solver, mesh, problem) cell: warm once, then median-of-reps
+    timed solves. Returns rows/iters/solve seconds/per-iteration
+    seconds (the efficiency metric — iteration counts move with problem
+    size, per-iteration time is the comparable quantity)."""
+    import numpy as np
+    import jax.numpy as jnp
+    t_setup = 0.0
+    if solver_key in ("dist_cg", "dist_cg_pipelined"):
+        from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+        from amgcl_tpu.parallel.dist_solver import dist_cg
+        Ad = DistDiaMatrix.from_csr(A, mesh, jnp.float64)
+        dinv = jnp.asarray(A.diagonal(invert=True))
+        rhs_d = jnp.asarray(rhs)
+        pip = solver_key == "dist_cg_pipelined"
+
+        def run():
+            return dist_cg(Ad, mesh, rhs_d, dinv=dinv, maxiter=maxiter,
+                           tol=tol, pipelined=pip)
+    else:
+        from amgcl_tpu.parallel.dist_amg import DistAMGSolver
+        from amgcl_tpu.models.amg import AMGParams
+        from amgcl_tpu.solver.cg import CG
+        t0 = time.perf_counter()
+        s = DistAMGSolver(A, mesh, AMGParams(),
+                          CG(maxiter=maxiter, tol=tol))
+        t_setup = time.perf_counter() - t0
+
+        def run():
+            x, info = s(rhs)
+            return x, info.iters, info.resid
+    out = run()                                  # compile + warm
+    ts = []
+    for _ in range(max(int(reps), 1)):
+        t0 = time.perf_counter()
+        out = run()
+        ts.append(time.perf_counter() - t0)
+    iters = max(int(out[1]), 1)
+    solve_s = float(np.median(ts))
+    row = {"rows": int(A.nrows), "iters": iters,
+           "solve_s": round(solve_s, 5),
+           "t_iter_s": round(solve_s / iters, 6)}
+    if t_setup:
+        row["setup_s"] = round(t_setup, 3)
+    return row
+
+
+def scaling_record(devices=None, base_n=None, solvers=None, maxiter=None,
+                   tol=1e-6, reps=None):
+    """The structured multichip record: weak + strong sweeps per
+    distributed solver over the device ladder, measured comm
+    attribution + per-shard imbalance at the largest mesh, and the
+    collective census cross-checked against the declared
+    ``DIST_CG_COLLECTIVES`` contract. Callable with small parameters
+    from tests; ``bench.py --scaling`` drives it with the env defaults
+    and emits/persists the result."""
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.parallel.mesh import make_mesh
+    from amgcl_tpu.telemetry import comm as C
+    from amgcl_tpu.telemetry.ledger import DIST_CG_COLLECTIVES
+
+    def _env_int(name, default):
+        try:
+            return int(os.environ.get(name, default))
+        except ValueError:
+            return int(default)
+
+    base_n = base_n or _env_int("AMGCL_TPU_SCALING_N", 12)
+    maxiter = maxiter or _env_int("AMGCL_TPU_SCALING_MAXITER", 50)
+    reps = reps or _env_int("AMGCL_TPU_SCALING_REPS", 3)
+    nd_avail = len(jax.devices())
+    if devices is None:
+        raw = os.environ.get("AMGCL_TPU_SCALING_DEVICES", "1,2,4,8")
+        devices = [int(v) for v in raw.split(",") if v.strip()]
+    devices = sorted(d for d in set(int(d) for d in devices)
+                     if 1 <= d <= nd_avail
+                     and (base_n ** 3) % d == 0)
+    if not devices:
+        devices = [1]
+    if solvers is None:
+        raw = os.environ.get("AMGCL_TPU_SCALING_SOLVERS",
+                             "dist_cg,dist_cg_pipelined,dist_amg")
+        solvers = [s.strip() for s in raw.split(",") if s.strip()]
+    nd_max = devices[-1]
+    prov = C.hw_provenance(make_mesh(nd_max))
+    rec = {"event": "multichip_scaling", "schema": 2,
+           "metric": "multichip_scaling",
+           "base_n": base_n, "devices": devices,
+           "maxiter": maxiter, "tol": tol, "reps": reps,
+           "device_platform": prov.get("device_platform"),
+           "device_kind": prov.get("device_kind"),
+           "provenance": prov, "solvers": {}}
+
+    # strong problem = the base grid; weak ladder scales x with nd
+    A_strong, rhs_strong = _scaling_problem(base_n, 1)
+    weak_cache = {1: (A_strong, rhs_strong)}
+
+    def weak_problem(nd):
+        if nd not in weak_cache:
+            weak_cache[nd] = _scaling_problem(base_n, nd)
+        return weak_cache[nd]
+
+    for key in solvers:
+        srec = {"weak": {"devices": devices, "cells": []},
+                "strong": {"devices": devices, "cells": []}}
+        if key in DIST_CG_COLLECTIVES:
+            srec["collectives"] = dict(DIST_CG_COLLECTIVES[key])
+        for nd in devices:
+            mesh = make_mesh(nd)
+            Aw, fw = weak_problem(nd)
+            srec["weak"]["cells"].append(
+                {"devices": nd, **_scaling_measure(
+                    key, Aw, fw, mesh, maxiter, tol, reps)})
+            srec["strong"]["cells"].append(
+                {"devices": nd, **_scaling_measure(
+                    key, A_strong, rhs_strong, mesh, maxiter, tol,
+                    reps)})
+        for mode in ("weak", "strong"):
+            cells = srec[mode]["cells"]
+            t0_, tN = cells[0]["t_iter_s"], cells[-1]["t_iter_s"]
+            if t0_ and tN:
+                eff = t0_ / tN
+                if mode == "strong":
+                    eff /= max(devices[-1] / devices[0], 1)
+                srec[mode]["efficiency"] = round(eff, 4)
+        rec["solvers"][key] = srec
+
+    # comm attribution + per-shard imbalance at the largest mesh on the
+    # weak (headline) problem — DIA strip operator, the dist_cg path
+    mesh_max = make_mesh(nd_max)
+    try:
+        from amgcl_tpu.parallel.dist_matrix import DistDiaMatrix
+        Aw, _fw = weak_problem(nd_max)
+        Ad = DistDiaMatrix.from_csr(Aw, mesh_max, jnp.float64)
+        attr = C.comm_attribution(Ad, mesh_max, solver="dist_cg")
+        rec["comm"] = {k: v for k, v in attr.items()
+                       if not k.startswith("_")}
+        rec["imbalance"] = C.dist_resources(Ad, nd_max)
+        spread = C.measure_shard_spread(Ad, mesh_max)
+        if spread:
+            rec["imbalance"]["measured"] = {
+                "per_shard_us": spread["per_shard_us"],
+                "spread": spread["spread"]}
+    except Exception as e:
+        rec["comm"] = {"error": repr(e)[:200]}
+
+    # collective-census cross-check: the traced dist bodies vs the SAME
+    # DIST_CG_COLLECTIVES table the comm model prices from
+    if nd_max >= 2:
+        try:
+            from amgcl_tpu.analysis import jaxpr_audit as _ja
+            census = {}
+            ok = True
+            for pip in (False, True):
+                arec = _ja.audit_dist_cg(pipelined=pip, mesh=mesh_max)
+                errs = [f for f in _ja.check_dist(arec)
+                        if f["severity"] == "error"]
+                census[arec["entry"].rsplit(".", 1)[1]] = {
+                    "census": arec.get("collectives"),
+                    "match": not errs}
+                ok = ok and not errs
+            rec["collectives_census"] = {"ok": ok, "bodies": census}
+        except Exception as e:
+            rec["collectives_census"] = {"ok": None,
+                                         "error": repr(e)[:200]}
+
+    # headline: the gate's round-over-round quantities (dist_cg at the
+    # largest mesh; the first configured solver when dist_cg is absent)
+    head_key = "dist_cg" if "dist_cg" in rec["solvers"] \
+        else (solvers[0] if solvers else None)
+    head = {"devices": nd_max}
+    if head_key:
+        srec = rec["solvers"][head_key]
+        head["solver"] = head_key
+        head["weak_efficiency"] = srec["weak"].get("efficiency")
+        head["strong_efficiency"] = srec["strong"].get("efficiency")
+        head["iters"] = srec["weak"]["cells"][-1]["iters"]
+    pi = (rec.get("comm") or {}).get("per_iteration") or {}
+    head["comm_fraction"] = pi.get("comm_fraction")
+    head["wire_gbps"] = pi.get("wire_gbps")
+    imb = (rec.get("imbalance") or {}).get("imbalance") or {}
+    head["imbalance"] = imb.get("factor")
+    rec["headline"] = head
+    return rec
+
+
+def main_scaling(args=None):
+    """``bench.py --scaling``: run the weak+strong scaling sweep on the
+    available mesh (8 virtual CPU devices are forced when the host
+    platform is in play — the flag is a no-op on TPU), print the
+    ladder, emit ONE structured ``multichip_scaling`` JSONL record and
+    persist it to ``MULTICHIP_LATEST.json`` — the candidate
+    ``--gate``/``--check`` score against the previous round's committed
+    ``MULTICHIP_r*.json`` under ``AMGCL_TPU_GATE_MULTICHIP``."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "--xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested
+    apply_if_cpu_requested()
+    import jax
+    jax.config.update("jax_enable_x64", True)
+
+    rec = scaling_record()
+    for key, srec in rec["solvers"].items():
+        for mode in ("weak", "strong"):
+            cells = srec[mode]["cells"]
+            print("%s %s scaling: %s" % (key, mode, "  ".join(
+                "nd=%d %.0f rows %.1fus/it" % (
+                    c["devices"], c["rows"], c["t_iter_s"] * 1e6)
+                for c in cells)))
+            if srec[mode].get("efficiency") is not None:
+                print("  %s efficiency (per-iteration): %.3f"
+                      % (mode, srec[mode]["efficiency"]))
+    head = rec["headline"]
+    print("headline (nd=%d): weak eff %s, comm fraction %s, "
+          "imbalance %s" % (head["devices"], head.get("weak_efficiency"),
+                            head.get("comm_fraction"),
+                            head.get("imbalance")))
+    rec["commit"] = _git_head()
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    _sink.write_json_atomic(_MULTICHIP_LATEST, _sink.stamp(dict(rec)))
+    base = _multichip_baseline()
+    if base is not None:
+        ok, checks = run_multichip_gate(rec, base)
+        print("multichip gate vs %s: %s" % (
+            base.get("path", "baseline"), "ok" if ok else "REGRESSION"))
+        for c in checks:
+            if c.get("status") != "ok":
+                print("  %s: %s" % (c["check"], c["status"]))
+    return 0
+
+
+def multichip_tolerances():
+    """Multichip gate tolerances:
+
+      AMGCL_TPU_GATE_MULTICHIP — minimum allowed fraction of the
+                              baseline's scaling efficiency (default
+                              0.8: the candidate regresses when its
+                              weak/strong per-iteration efficiency
+                              drops below 80% of the previous round's);
+                              0 disables every multichip check
+      AMGCL_TPU_GATE_COMM_FRAC — maximum allowed ratio of the
+                              baseline's measured comm fraction
+                              (default 1.3, plus a 0.05 absolute slack
+                              so near-zero fractions don't gate on
+                              noise)
+    """
+    def _f(name, default):
+        try:
+            return float(os.environ.get(name, default))
+        except ValueError:
+            return float(default)
+
+    return {"efficiency": _f("AMGCL_TPU_GATE_MULTICHIP", 0.8),
+            "comm_frac": _f("AMGCL_TPU_GATE_COMM_FRAC", 1.3)}
+
+
+def run_multichip_gate(candidate, baseline, tol=None):
+    """Compare two structured multichip records round-over-round:
+    scaling efficiency (higher is better, min-fraction floor) and
+    measured comm fraction (lower is better, max-ratio ceiling +
+    absolute slack). Platform-mismatched pairs skip every ratio — the
+    provenance tag makes a CPU-fallback candidate vs a TPU baseline a
+    platform change, not a regression (the same rule the bench gate
+    applies to solve time)."""
+    tol = tol or multichip_tolerances()
+    checks = []
+    if tol["efficiency"] <= 0:
+        return True, [{"check": "multichip", "status": "skipped",
+                       "reason": "disabled (AMGCL_TPU_GATE_MULTICHIP=0)"}]
+    plat_c = _record_platform(candidate)
+    plat_b = _record_platform(baseline)
+    plat_skip = None
+    if plat_c is not None and plat_b is not None and plat_c != plat_b:
+        plat_skip = "platform_mismatch: candidate=%s baseline=%s" \
+            % (plat_c, plat_b)
+    hc = candidate.get("headline") or {}
+    hb = baseline.get("headline") or {}
+
+    def higher_better(name, cv, bv):
+        if plat_skip is not None:
+            checks.append({"check": name, "status": "skipped",
+                           "reason": plat_skip, "candidate": cv,
+                           "last_good": bv})
+        elif cv is None or bv is None:
+            checks.append({"check": name, "status": "skipped",
+                           "candidate": cv, "last_good": bv})
+        else:
+            floor = bv * tol["efficiency"]
+            checks.append({"check": name, "candidate": cv,
+                           "last_good": bv, "limit": round(floor, 6),
+                           "status": "ok" if cv >= floor
+                           else "regression"})
+
+    higher_better("weak_efficiency", hc.get("weak_efficiency"),
+                  hb.get("weak_efficiency"))
+    higher_better("strong_efficiency", hc.get("strong_efficiency"),
+                  hb.get("strong_efficiency"))
+    cf_c, cf_b = hc.get("comm_fraction"), hb.get("comm_fraction")
+    if plat_skip is not None:
+        checks.append({"check": "comm_fraction", "status": "skipped",
+                       "reason": plat_skip, "candidate": cf_c,
+                       "last_good": cf_b})
+    elif cf_c is None or cf_b is None:
+        checks.append({"check": "comm_fraction", "status": "skipped",
+                       "candidate": cf_c, "last_good": cf_b})
+    else:
+        limit = cf_b * tol["comm_frac"] + 0.05
+        checks.append({"check": "comm_fraction", "candidate": cf_c,
+                       "last_good": cf_b, "limit": round(limit, 6),
+                       "status": "ok" if cf_c <= limit
+                       else "regression"})
+    ok = not any(c["status"] == "regression" for c in checks)
+    return ok, checks
+
+
+def _multichip_candidate():
+    """This round's scaling record (``--scaling`` writes it):
+    ``AMGCL_TPU_GATE_MULTICHIP_CANDIDATE`` path override, else
+    ``MULTICHIP_LATEST.json``. (None, src) when unreadable/absent."""
+    path = os.environ.get("AMGCL_TPU_GATE_MULTICHIP_CANDIDATE",
+                          _MULTICHIP_LATEST)
+    try:
+        with open(path) as f:
+            return json.load(f), path
+    except Exception:
+        return None, path
+
+
+def _multichip_baseline():
+    """The previous round's committed structured multichip record —
+    the newest schema-carrying ``MULTICHIP_r*.json`` (legacy dryrun
+    logs carry no metrics to gate on)."""
+    m = _load_metrics()
+    rows = [r for r in m.multichip_history(_REPO)
+            if not r.get("legacy_dryrun")]
+    return rows[-1] if rows else None
+
+
+def multichip_gate_record():
+    """The multichip arm of ``--gate``/``--check``: None when the
+    feature is unused (no candidate AND no structured baseline), a
+    gate sub-record otherwise."""
+    tol = multichip_tolerances()
+    cand, src = _multichip_candidate()
+    base = _multichip_baseline()
+    if cand is None and base is None:
+        return None
+    if cand is None:
+        return {"ok": True, "status": "no_candidate",
+                "candidate_src": src, "tolerances": tol}
+    if base is None:
+        return {"ok": True, "status": "no_baseline",
+                "candidate_src": src, "tolerances": tol}
+    ok, checks = run_multichip_gate(cand, base, tol)
+    return {"ok": ok, "candidate_src": src,
+            "baseline": base.get("path"), "tolerances": tol,
+            "checks": checks}
 
 
 # ===========================================================================
@@ -1424,9 +1827,14 @@ def _record_ledger_bytes(rec):
 
 
 def _record_platform(rec):
-    """Device platform of a bench record; a record marked as a CPU
-    fallback counts as 'cpu' even if the field predates the split."""
+    """Device platform of a bench/scaling record — the ONE place every
+    gate's platform-mismatch skip reads. Resolution order: the
+    top-level field, the hardware-provenance stamp (newer records carry
+    ``provenance.device_platform`` uniformly), then the CPU-fallback
+    marker for records predating the split."""
     p = rec.get("device_platform")
+    if p is None:
+        p = (rec.get("provenance") or {}).get("device_platform")
     if p is None and rec.get("fallback"):
         return "cpu"
     return p
@@ -1587,6 +1995,13 @@ def main_gate(args=None):
     ok, checks = run_gate(cand, lg, tol)
     rec = {"event": "bench_gate", "ok": ok, "candidate_src": cand_src,
            "tolerances": tol, "checks": checks, "commit": _git_head()}
+    # multichip arm: this round's --scaling record vs the previous
+    # round's committed MULTICHIP_r*.json (AMGCL_TPU_GATE_MULTICHIP)
+    mc = multichip_gate_record()
+    if mc is not None:
+        rec["multichip"] = mc
+        ok = ok and mc["ok"]
+        rec["ok"] = ok
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0 if ok else 1
@@ -1623,6 +2038,18 @@ def main_trend(args=None):
     rollups = dict(summ["rollups"])
     rec = {"event": "bench_trend", "rows": summ["rows"],
            "rollups": summ["rollups"], "commit": _git_head()}
+    # multichip trajectory alongside the BENCH_r* table: structured
+    # rounds carry efficiency/comm-fraction/imbalance, legacy dryrun
+    # rounds degrade to device-count-only rows with gaps
+    mc_hist = m.multichip_history(_REPO)
+    if mc_hist:
+        mc_rows = m.trend(mc_hist, m.MULTICHIP_TREND_FIELDS)
+        print("\nmultichip trajectory (MULTICHIP_r*.json):")
+        print(m.format_trend(mc_rows, m.MULTICHIP_TREND_FIELDS))
+        rec["multichip_rows"] = mc_rows
+        mc_roll = m.trend_rollups(mc_rows, m.MULTICHIP_TREND_FIELDS)
+        for name, r in mc_roll.items():
+            rollups["multichip_" + name] = r
     if args:
         sink_records = m.iter_jsonl(args[0])
         ev_roll = m.rollup_events(sink_records)
@@ -1772,10 +2199,12 @@ def main_vecbench(args=None):
                  rows[-1]["axpby_dot_us"], rows[-1]["axpby_composed_us"],
                  rows[-1]["axpby_speedup"]))
     dev0 = jax.devices()[0]
+    from amgcl_tpu.telemetry.comm import hw_provenance
     rec = {"event": "bench_vecbench", "rows": rows,
            "fused_enabled": fv.fused_vec_enabled(),
            "device": str(dev0), "device_platform": dev0.platform,
            "device_kind": getattr(dev0, "device_kind", None),
+           "provenance": hw_provenance(),
            "commit": _git_head()}
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
@@ -1876,6 +2305,12 @@ def main_check(targets=None):
                         k: src.get(k) for k in
                         ("gbps", "gflops", "frac_hbm_peak", "bound")
                         if src.get(k) is not None}
+        # multichip arm rides --check exactly like --gate: a scaling
+        # efficiency / comm-fraction regression fails CI
+        mc = multichip_gate_record()
+        if mc is not None:
+            rec["multichip"] = mc
+            gate_ok = gate_ok and mc["ok"]
     analysis_ok = True
     if os.environ.get("AMGCL_TPU_ANALYSIS_IN_CHECK", "1") != "0":
         # static-analysis gate (amgcl_tpu/analysis): AST lint vs the
@@ -1942,5 +2377,8 @@ if __name__ == "__main__":
     elif "--throughput" in sys.argv:
         extra = sys.argv[sys.argv.index("--throughput") + 1:]
         sys.exit(main_throughput(extra))
+    elif "--scaling" in sys.argv:
+        extra = sys.argv[sys.argv.index("--scaling") + 1:]
+        sys.exit(main_scaling(extra))
     else:
         main_supervisor()

@@ -35,5 +35,10 @@ def test_table_covers_new_knobs():
                 "AMGCL_TPU_ANALYSIS_TIMEOUT",
                 "AMGCL_TPU_SERVE_METRICS_PORT", "AMGCL_TPU_SLO_P99_MS",
                 "AMGCL_TPU_SLO_TIMEOUT_RATE",
-                "AMGCL_TPU_SLO_UNHEALTHY_RATE", "AMGCL_TPU_SLO_WINDOW"):
+                "AMGCL_TPU_SLO_UNHEALTHY_RATE", "AMGCL_TPU_SLO_WINDOW",
+                "AMGCL_TPU_COMM_REPS", "AMGCL_TPU_PEAK_ICI_GBPS",
+                "AMGCL_TPU_SCALING_N", "AMGCL_TPU_SCALING_DEVICES",
+                "AMGCL_TPU_SCALING_SOLVERS",
+                "AMGCL_TPU_GATE_MULTICHIP",
+                "AMGCL_TPU_GATE_COMM_FRAC"):
         assert var in documented, var
