@@ -48,9 +48,13 @@ discipline the jaxpr auditor depends on):
     table the ``/metrics`` endpoint serves and the runtime registry
     validates against. An ad-hoc name would raise at serve time (or,
     with a private registry spec, scrape as a metric no dashboard
-    knows); the rule makes both impossible to merge. The registry
-    implementation itself (telemetry/live.py) is exempt — it passes
-    names through variables by construction.
+    knows); the rule makes both impossible to merge. Labeled updates
+    (``inc("farm_tenant_requests_total", tenant=...)``) are checked the
+    same way: every label KEY must be a literal keyword declared for
+    that metric in the ``METRIC_LABELS`` table (label values stay
+    runtime-free). The registry implementation itself
+    (telemetry/live.py) is exempt — it passes names through variables
+    by construction.
 
 Findings are plain dicts keyed for the baseline by ``(rule, file,
 symbol)`` — line numbers are carried for display but excluded from the
@@ -410,11 +414,54 @@ def declared_metric_names(root: Optional[str] = None) -> Set[str]:
     return set()
 
 
-def _rule_metric_name_literal(mod: _Module,
-                              declared: Set[str]) -> List[Dict[str, Any]]:
+def declared_metric_labels(root: Optional[str] = None
+                           ) -> Dict[str, Tuple[str, ...]]:
+    """The ``METRIC_LABELS`` dict literal in ``telemetry/live.py`` —
+    metric name -> allowed label keys, parsed statically (the same
+    table the runtime registry validates labeled updates against).
+    Empty when the file or the table is absent."""
+    root = root or os.path.join(REPO, "amgcl_tpu")
+    path = os.path.join(root, "telemetry", "live.py")
+    try:
+        with open(path) as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return {}
+    for node in tree.body:
+        if isinstance(node, (ast.Assign, ast.AnnAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            if any(isinstance(t, ast.Name) and t.id == "METRIC_LABELS"
+                   for t in targets) \
+                    and isinstance(node.value, ast.Dict):
+                out: Dict[str, Tuple[str, ...]] = {}
+                for k, v in zip(node.value.keys, node.value.values):
+                    if not (isinstance(k, ast.Constant)
+                            and isinstance(k.value, str)
+                            and isinstance(v, (ast.Tuple, ast.List))):
+                        continue
+                    out[k.value] = tuple(
+                        e.value for e in v.elts
+                        if isinstance(e, ast.Constant)
+                        and isinstance(e.value, str))
+                return out
+    return {}
+
+
+#: registry-method keyword args that are NOT metric labels (the write
+#: surface's own parameters) — anything else keyword-shaped on an
+#: inc/set_gauge/observe call is a label key the rule validates
+_METRIC_KWARGS = frozenset({"name", "by", "value"})
+
+
+def _rule_metric_name_literal(
+        mod: _Module, declared: Set[str],
+        declared_labels: Optional[Dict[str, Tuple[str, ...]]] = None
+        ) -> List[Dict[str, Any]]:
     if mod.rel.endswith("telemetry/live.py"):
         return []       # the registry implementation: names arrive in
         #                 variables, validated at runtime against METRICS
+    declared_labels = declared_labels or {}
     out = []
     for call in mod._calls():
         if not isinstance(call.func, ast.Attribute) \
@@ -436,6 +483,31 @@ def _rule_metric_name_literal(mod: _Module,
                     ".py METRICS — the /metrics endpoint serves only "
                     "the declared table, and the registry raises on "
                     "unknown names" % arg.value))
+                continue
+            # labeled update: every label KEY must be declared for this
+            # metric in METRIC_LABELS (label values stay runtime-free —
+            # tenant names arrive with traffic); a **splat hides the
+            # keys from static analysis, so it is rejected outright
+            allowed = declared_labels.get(arg.value, ())
+            for kw in call.keywords:
+                if kw.arg in _METRIC_KWARGS:
+                    continue
+                if kw.arg is None:
+                    out.append(finding(
+                        "metric-name-literal", mod.rel, call.lineno,
+                        arg.value,
+                        "labels for live metric %r must be literal "
+                        "keyword arguments (no **splat) so the "
+                        "declared METRIC_LABELS keys are statically "
+                        "checkable" % arg.value))
+                elif kw.arg not in allowed:
+                    out.append(finding(
+                        "metric-name-literal", mod.rel, call.lineno,
+                        arg.value,
+                        "label %r is not declared for live metric %r "
+                        "in telemetry/live.py METRIC_LABELS — the "
+                        "registry raises on undeclared label keys"
+                        % (kw.arg, arg.value)))
         else:
             out.append(finding(
                 "metric-name-literal", mod.rel, call.lineno,
@@ -568,6 +640,8 @@ def run_lint(root: Optional[str] = None,
                         "metric-name-literal"}
     declared = declared_metric_names(root) \
         if "metric-name-literal" in want else set()
+    declared_labels = declared_metric_labels(root) \
+        if "metric-name-literal" in want else {}
     for mod in (_modules(root) if ast_rules else []):
         if "bare-jit" in want:
             out += _rule_bare_jit(mod)
@@ -579,7 +653,8 @@ def run_lint(root: Optional[str] = None,
         if "pallas-no-interpret" in want:
             out += _rule_pallas_interpret(mod)
         if "metric-name-literal" in want:
-            out += _rule_metric_name_literal(mod, declared)
+            out += _rule_metric_name_literal(mod, declared,
+                                             declared_labels)
     if "undocumented-knob" in want:
         out += _rule_undocumented_knob(root, readme)
     out.sort(key=lambda f: (f["file"], f["line"], f["rule"]))

@@ -218,6 +218,83 @@ def serve_stats(h) -> str:
     return json.dumps(_handles[h].stats())
 
 
+def farm_create(max_bytes=0, batch=0, metrics_port=-1) -> int:
+    """Multi-tenant solver farm (serve/farm.py): N tenants with
+    different operators multiplexed over one device — registry-cached
+    hierarchies (same-sparsity re-registrations take the numeric
+    rebuild path), LRU HBM admission/eviction under ``max_bytes``
+    (0 = the AMGCL_TPU_FARM_MAX_BYTES knob, unset = unlimited),
+    cross-tenant batch packing, per-tenant SLOs. ``metrics_port``
+    follows the serve_create convention (-1 = the
+    AMGCL_TPU_FARM_METRICS_PORT knob, other negatives = off). Destroy
+    with ``handle_destroy`` (drains + stops the dispatch thread)."""
+    from amgcl_tpu.serve.farm import SolverFarm
+    mp = int(metrics_port)
+    return _register(SolverFarm(
+        max_bytes=int(max_bytes) or None, batch=int(batch) or None,
+        metrics_port=None if mp == -1 else mp).start())
+
+
+def farm_register(h, tenant: str, n, ptr_addr, col_addr, val_addr,
+                  prm_h, one_based=False) -> str:
+    """Register (or re-register) ``tenant`` with a CSR operator on farm
+    handle ``h``. ``prm_h`` carries the usual dotted config
+    (``solver.type``, ``precond.*``; f64 end-to-end like the other C
+    entry points). Returns JSON text: {tenant, outcome, fingerprint,
+    bytes, setup_s[, rebuild_s]} — ``outcome`` is the registry path
+    taken (hit / rebuild / miss)."""
+    from amgcl_tpu.models.runtime import (_as_dict,
+                                          precond_params_from_dict,
+                                          solver_from_params)
+    A = _csr_from_addrs(n, ptr_addr, col_addr, val_addr, one_based)
+    cfg = _as_dict(_params_for(prm_h))
+    solver = solver_from_params(dict(cfg.get("solver") or {}))
+    prm = precond_params_from_dict(dict(cfg.get("precond") or {}))
+    return json.dumps(_handles[h].register(str(tenant), A,
+                                           solver=solver, precond=prm))
+
+
+def farm_solve(h, tenant: str, rhs_addr, x_addr, n, nrhs):
+    """Push ``nrhs`` requests for ``tenant`` (layout as
+    ``solver_solve_batch``: ``x`` holds the initial guesses on entry —
+    all-zero = cold start — and the solutions on exit) through the
+    farm's fair-share queue and wait for all of them — co-tenant
+    requests for the same operator pack into shared (n, B) buckets.
+    Returns (max_iters, max_resid)."""
+    farm = _handles[h]
+    rhs = np.asarray(_view(rhs_addr, n * nrhs, ctypes.c_double))
+    x = _view(x_addr, n * nrhs, ctypes.c_double).reshape(nrhs, n)
+    futs = []
+    for k in range(nrhs):
+        # copy the guess BEFORE any solution lands in the shared buffer
+        x0 = np.array(x[k], copy=True)
+        futs.append(farm.submit(str(tenant), rhs[k * n:(k + 1) * n],
+                                x0=x0 if np.any(x0) else None,
+                                block=True))
+    worst_it, worst_res = 0, 0.0
+    for k, fut in enumerate(futs):
+        xk, rep = fut.result(timeout=farm.timeout_s + 120)
+        x[k, :] = np.asarray(xk, np.float64)
+        worst_it = max(worst_it, int(rep.iters))
+        worst_res = max(worst_res, float(rep.resid))
+    return worst_it, worst_res
+
+
+def farm_evict(h, tenant: str) -> int:
+    """Explicitly evict ``tenant``'s operator from the device (host CSR
+    + plans stay; the next solve readmits via rebuild). Returns 1 when
+    something was evicted, 0 when it was not resident."""
+    return int(_handles[h].evict(str(tenant)))
+
+
+def farm_stats(h) -> str:
+    """JSON text of the farm's lifetime stats: per-tenant rows
+    (requests, timeouts, unhealthy, SLO trips, latency percentiles,
+    residency + bytes), the registry hit/miss/rebuild counters, the
+    HBM pool state, and the eviction/readmission totals."""
+    return json.dumps(_handles[h].stats())
+
+
 def handle_n(h) -> int:
     """Scalar system size of the solver/preconditioner behind a handle."""
     obj = _handles[h]

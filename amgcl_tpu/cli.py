@@ -49,6 +49,27 @@ def main(argv=None):
     ap.add_argument("--serve-batch", type=int, default=0, metavar="B",
                     help="batch bucket for --serve (default: the "
                          "AMGCL_TPU_SERVE_BATCH env knob, then 8)")
+    ap.add_argument("--farm", type=int, default=0, metavar="T",
+                    help="multi-tenant solver-farm demo: register T "
+                         "tenants (>=3 recommended) with DISTINCT "
+                         "operators (graded Poisson sizes seeded from "
+                         "-n), cap the HBM pool below the resident set "
+                         "so round-robin traffic forces evictions and "
+                         "rebuild-path readmissions, then solve "
+                         "--farm-requests rounds per tenant and print "
+                         "the per-tenant reports, registry "
+                         "hit/miss/rebuild counters and pool activity "
+                         "(serve/farm.py); with --metrics-port the "
+                         "farm serves tenant-labeled gauges on "
+                         "/metrics, with --telemetry the farm events "
+                         "ride the sink")
+    ap.add_argument("--farm-requests", type=int, default=4, metavar="R",
+                    help="solve rounds per tenant for --farm (def 4)")
+    ap.add_argument("--farm-max-bytes", type=int, default=0,
+                    metavar="BYTES",
+                    help="explicit HBM pool budget for --farm (default "
+                         "0: auto — 75%% of the registered tenants' "
+                         "resident bytes, guaranteeing evictions)")
     ap.add_argument("--metrics-port", type=int, default=None,
                     metavar="PORT",
                     help="with --serve: serve live Prometheus metrics "
@@ -151,6 +172,15 @@ def main(argv=None):
     # device-synced scopes: totals mean wall-clock device time, not
     # dispatch time (utils/profiler.py)
     prof = Profiler.device()
+
+    if args.farm:
+        if args.mesh or args.serve or args.reorder or args.matrix:
+            ap.error("--farm is a self-contained demo (generated "
+                     "operators); it does not combine with --serve/"
+                     "--mesh/--reorder/-A")
+        return _run_farm_demo(args, ap, prof, overrides={
+            kv.partition("=")[0]: kv.partition("=")[2]
+            for kv in args.prm})
 
     with prof.scope("read"):
         if args.size:
@@ -585,6 +615,104 @@ def main(argv=None):
             pass
         dist_metrics_srv.close()
     return 0
+
+
+def _run_farm_demo(args, ap, prof, overrides):
+    """``--farm T``: the acceptance demo of the multi-tenant farm — T
+    distinct operators under a byte budget that forces at least one
+    eviction and one rebuild-path readmission, every solve converging
+    with a correct per-tenant report."""
+    from amgcl_tpu import telemetry
+    from amgcl_tpu.models.runtime import (precond_params_from_dict,
+                                          solver_from_params, _as_dict,
+                                          _nest)
+    from amgcl_tpu.serve.farm import SolverFarm
+    from amgcl_tpu.utils.sample_problem import poisson3d
+
+    T = max(int(args.farm), 2)
+    base = args.size or 8
+    cfg = _as_dict(args.params)
+    if overrides:
+        cfg.update(_nest(overrides))
+    rounds = max(int(args.farm_requests), 2)
+    rhs_by_tenant = {}
+    results = {}
+    with prof.scope("farm"):
+        with SolverFarm(metrics_port=args.metrics_port) as farm:
+            if farm.metrics_url:
+                print("farm: metrics at %s (and /healthz)"
+                      % farm.metrics_url)
+            for k in range(T):
+                # distinct sparsity per tenant: graded grid sizes
+                A, rhs = poisson3d(base + k)
+                scfg = dict(cfg.get("solver") or {})
+                scfg.setdefault("type", "cg")
+                pcfg = dict(cfg.get("precond") or {})
+                pcfg.setdefault("coarse_enough", 50)
+                name = "tenant%d" % k
+                rep = farm.register(
+                    name, A, solver=solver_from_params(scfg),
+                    precond=precond_params_from_dict(pcfg))
+                rhs_by_tenant[name] = rhs
+                print("farm: registered %-9s n=%-7d %s (%s, %.3fs "
+                      "setup)" % (name, A.nrows, rep["fingerprint"][:12],
+                                  rep["outcome"], rep["setup_s"]))
+            total = farm.stats()["pool"]["used_bytes"]
+            cap = args.farm_max_bytes or int(total * 0.75)
+            farm.set_max_bytes(cap)
+            print("farm: HBM pool capped at %d of %d resident bytes "
+                  "(evictions will follow)" % (cap, total))
+            for _ in range(rounds):
+                futs = [(name, farm.submit(name, rhs, block=True))
+                        for name, rhs in rhs_by_tenant.items()]
+                for name, fut in futs:
+                    x, rep = fut.result(timeout=farm.timeout_s + 300)
+                    results.setdefault(name, []).append(rep)
+            stats = farm.stats()
+    print()
+    print("farm: %d tenant(s) x %d round(s), batch bucket %d"
+          % (T, rounds, stats["batch_bucket"]))
+    for row in stats["tenants"]:
+        reps = results.get(row["tenant"], [])
+        lat = row.get("latency_ms") or {}
+        print("  %-9s requests %-3d iters %-12s resid_max %.2e  "
+              "p99 %sms  %s"
+              % (row["tenant"], row["requests"],
+                 "/".join(str(r.iters) for r in reps[:4]),
+                 max((r.resid for r in reps), default=float("nan")),
+                 lat.get("p99", "-"),
+                 "resident" if row["resident"] else "evicted"))
+    reg = stats["registry"]
+    print("  registry: %d hit(s) / %d miss(es) / %d rebuild(s)"
+          % (reg["hits"], reg["misses"], reg["rebuilds"]))
+    print("  pool: %d eviction(s), %d readmission(s), %d/%s bytes"
+          % (stats["evictions"], stats["readmissions"],
+             stats["pool"]["used_bytes"],
+             stats["pool"]["total_bytes"] or "unlimited"))
+    ok = True
+    for name, reps in results.items():
+        for rep in reps:
+            if not (rep.iters > 0 and rep.resid == rep.resid):
+                ok = False
+    if stats["evictions"] < 1 or stats["readmissions"] < 1:
+        ok = False
+        print("  WARNING: the byte budget forced no eviction/"
+              "readmission cycle — raise T or lower --farm-max-bytes")
+    # readmissions went through rebuild(), never a fresh setup: the
+    # registry's miss counter must equal the tenant registrations
+    if reg["misses"] > T:
+        ok = False
+        print("  WARNING: readmission paid a fresh setup (misses %d > "
+              "tenants %d)" % (reg["misses"], T))
+    print("  acceptance: %s" % ("OK" if ok else "FAILED"))
+    print()
+    print(prof)
+    if args.telemetry:
+        telemetry.emit(event="farm_demo", tenants=T, rounds=rounds,
+                       ok=ok, **{k: v for k, v in stats.items()
+                                 if k != "tenants"})
+        telemetry.emit(event="profile", **prof.to_dict())
+    return 0 if ok else 1
 
 
 if __name__ == "__main__":

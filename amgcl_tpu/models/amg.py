@@ -215,6 +215,7 @@ class AMG:
         prm = self.prm
         self._device_built = False
         self._dev_prefix = []
+        self._prefix_released = False
         self._ledger_cache = None
         self._probe_cache = None
         self._roofline_cache = None
@@ -339,7 +340,8 @@ class AMG:
                 or (np.array_equal(A.ptr, old0.ptr)
                     and np.array_equal(A.col, old0.col)))
         if getattr(self, "_device_built", False) \
-                or getattr(self, "_dev_prefix", []):
+                or getattr(self, "_dev_prefix", []) \
+                or getattr(self, "_prefix_released", False):
             # device-built (and hybrid device-prefix) hierarchies redo
             # the whole (cheap, on-device) build; the transfer structure
             # is re-derived identically. _device_built covers both today
@@ -381,7 +383,11 @@ class AMG:
             with setup_scope(prof, "level%d/galerkin" % i):
                 Acur = self._coarse_op(Acur, P, R)
         host.append((Acur, None, None))
-        old_levels = self.hierarchy.levels
+        # a released hierarchy (release_device) has no old device levels
+        # to reuse — the transfers re-pack fresh, but the numeric path
+        # above (cached plans, no aggregation/symbolic work) is the same
+        old_hier = getattr(self, "hierarchy", None)
+        old_levels = old_hier.levels if old_hier is not None else None
         self.host_levels = host
         self._to_device_levels(reuse_transfers=old_levels)
         self._setup_wall_s = time.perf_counter() - self._setup_t0
@@ -496,6 +502,47 @@ class AMG:
     @property
     def dtype(self):
         return self.prm.dtype
+
+    # -- eviction / readmission (serve/farm.py HBM admission) ---------------
+
+    def release_device(self):
+        """Eviction hook: drop every device-resident buffer — the
+        hierarchy pytree (level operators, transfers, smoother states,
+        fused kernel handles, coarse factor) and the derived caches —
+        while KEEPING the host CSR levels and the Galerkin/transfer
+        plans cached on them. Readmission is therefore ``rebuild(...)``
+        — the numeric segment passes plus fresh device conversion, no
+        strength graphs, no aggregation, no symbolic SpGEMM — never a
+        fresh setup. ``bytes()`` reports 0 while released."""
+        self.hierarchy = None
+        if getattr(self, "_dev_prefix", []):
+            # a HYBRID build (device prefix + classic continuation) must
+            # keep routing rebuild through _build after release — its
+            # host_levels start with meta rows (P=None) the numeric
+            # rebuild loop cannot process. Remember the prefix existed
+            # before dropping its device buffers.
+            self._prefix_released = True
+        self._dev_prefix = []
+        self._dwin_budget = None
+        self._ledger_cache = None
+        self._probe_cache = None
+        self._roofline_cache = None
+
+    @property
+    def device_resident(self) -> bool:
+        return getattr(self, "hierarchy", None) is not None
+
+    def readmit(self):
+        """Re-materialize the device hierarchy after
+        :meth:`release_device` — the same-values numeric rebuild path
+        (no-op when already resident)."""
+        if not self.device_resident:
+            A0 = self.host_levels[0][0]
+            if getattr(self, "_device_built", False):
+                self.rebuild(A0)
+            else:
+                self.rebuild(A0.val)   # values-only: skip the pattern
+                #                        comparison against itself
 
     # -- observability (reference: amgcl/amg.hpp:560-598) -------------------
 
@@ -651,7 +698,11 @@ class AMG:
         """Device bytes of the whole hierarchy pytree — operators,
         transfers, smoother states, coarse factor (the reference's bytes()
         additionally counts its preallocated f/u/t work vectors,
-        amg.hpp:332-343; here those are XLA-managed temporaries)."""
+        amg.hpp:332-343; here those are XLA-managed temporaries).
+        0 while evicted (``release_device``) — the number the farm pool
+        charges and the eviction tests assert drops."""
+        if getattr(self, "hierarchy", None) is None:
+            return 0
         import jax
         total = 0
         for leaf in jax.tree.leaves(self.hierarchy):

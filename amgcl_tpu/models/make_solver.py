@@ -71,6 +71,7 @@ class make_solver:
         self.solver_dtype = solver_dtype or self.precond_dtype
         self.refine = int(refine)
         self.matrix_format = matrix_format
+        self._built_from_A = built_from_A
         hier_A = getattr(getattr(self.precond, "hierarchy", None),
                          "system_matrix", None)
         if (built_from_A and hier_A is not None
@@ -207,12 +208,24 @@ class make_solver:
                             % type(self.precond).__name__)
         self.precond.rebuild(A)
         self.A_host = A
-        # same budget sharing as __init__: precond.rebuild() made a fresh
-        # hierarchy-wide pool — the Krylov-side copy must draw from it,
-        # not claim a second full dense-window allowance
-        self.A_dev = dev.to_device(
-            A, self.matrix_format, self.solver_dtype,
-            budget=getattr(self.precond, "_dwin_budget", None))
+        hier_A = getattr(getattr(self.precond, "hierarchy", None),
+                         "system_matrix", None)
+        if (getattr(self, "_built_from_A", False) and hier_A is not None
+                and self.solver_dtype == self.precond_dtype
+                and self.matrix_format == "auto"):
+            # same aliasing as __init__: the rebuilt hierarchy's finest
+            # operator IS this matrix in the same format/dtype — reuse
+            # it instead of materializing a duplicate device copy (the
+            # farm's eviction/readmission cycles would otherwise leak a
+            # finest-operator copy per readmission into HBM)
+            self.A_dev = hier_A
+        else:
+            # same budget sharing as __init__: precond.rebuild() made a
+            # fresh hierarchy-wide pool — the Krylov-side copy must draw
+            # from it, not claim a second full dense-window allowance
+            self.A_dev = dev.to_device(
+                A, self.matrix_format, self.solver_dtype,
+                budget=getattr(self.precond, "_dwin_budget", None))
         if self.refine > 0:
             if self.refine_mode == "df32":
                 if not isinstance(self.A_dev, dev.DiaMatrix):
@@ -228,6 +241,32 @@ class make_solver:
         self._compiled = None
         self._hier_stats_cache = None
         self._resources_cache = None
+
+    # -- eviction / readmission (serve/farm.py HBM admission) ---------------
+
+    def release_device(self):
+        """Eviction hook: drop the bundle's device state — the compiled
+        solve program, the Krylov-side operator copies, and (through
+        ``AMG.release_device``) the whole hierarchy — while keeping the
+        host matrix, the params, and the cached setup plans. Readmission
+        (:meth:`readmit`) is a ``rebuild()``-class numeric refresh, not
+        a fresh setup."""
+        self._compiled = None
+        self.A_dev = None
+        self.A_dev64 = None
+        self._hier_stats_cache = None
+        self._resources_cache = None
+        rel = getattr(self.precond, "release_device", None)
+        if callable(rel):
+            rel()
+
+    def readmit(self):
+        """Re-materialize the device state after
+        :meth:`release_device`: rebuild against the current host matrix
+        (numeric Galerkin on cached plans + device conversion). No-op
+        when already resident."""
+        if self.A_dev is None:
+            self.rebuild(self.A_host)
 
     def _wide_dtype(self):
         return jnp.complex128 if jnp.issubdtype(

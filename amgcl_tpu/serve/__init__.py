@@ -1,7 +1,7 @@
-"""Solve-as-a-service: batched multi-RHS Krylov + the resident solver
-loop (ROADMAP item 1).
+"""Solve-as-a-service: batched multi-RHS Krylov, the resident solver
+loop (ROADMAP item 1), and the multi-tenant solver farm.
 
-Two legs:
+Four legs:
 
 * ``serve.batched`` — stacked ``(n, B)`` operands through every Krylov
   solver (the ``rhs.ndim == 2`` entry seam in each solver body routes
@@ -11,12 +11,24 @@ Two legs:
   program per (shape, B) bucket with donated iterate buffers, a
   bounded async request queue, and a device sync only at batch
   boundaries.
+* ``serve.registry`` — :class:`OperatorRegistry`: hierarchies cached by
+  sparsity fingerprint with the PR-9 numeric ``rebuild()`` as the
+  same-pattern refresh path (hit/miss/rebuild counters).
+* ``serve.farm`` — :class:`SolverFarm`: N tenants multiplexed over one
+  device — registry-backed setup avoidance, LRU HBM
+  admission/eviction, cross-tenant (n, B) bucket packing behind a
+  fair-share dispatch loop, per-tenant SLO watchdogs and labeled
+  ``/metrics``.
 """
 
 from amgcl_tpu.serve.batched import (BlockCG, STACKED_LOWERING,
                                      decode_batched_health,
                                      lowering_kind, vmap_solve)
+from amgcl_tpu.serve.farm import SolverFarm
+from amgcl_tpu.serve.registry import (OperatorRegistry,
+                                      sparsity_fingerprint)
 from amgcl_tpu.serve.service import SolverService
 
-__all__ = ["BlockCG", "STACKED_LOWERING", "SolverService",
-           "decode_batched_health", "lowering_kind", "vmap_solve"]
+__all__ = ["BlockCG", "OperatorRegistry", "STACKED_LOWERING",
+           "SolverFarm", "SolverService", "decode_batched_health",
+           "lowering_kind", "sparsity_fingerprint", "vmap_solve"]

@@ -1,0 +1,853 @@
+"""Multi-tenant solver farm — many operators, one device, SLOs held.
+
+The resident :class:`~amgcl_tpu.serve.service.SolverService` (PRs 7-8)
+serves ONE operator per process; the "millions of users" shape is many
+tenants with *different* matrices sharing a chip. :class:`SolverFarm`
+multiplexes N tenants over one device out of four pieces:
+
+* **operator registry** (serve/registry.py) — hierarchies cached by
+  sparsity fingerprint: a tenant registering a same-sparsity matrix
+  gets the cached hierarchy refreshed via the PR-9 numeric
+  ``rebuild()`` (cached Galerkin plans, no aggregation, no symbolic
+  SpGEMM) instead of a fresh setup, and a bit-identical matrix shares
+  the resident hierarchy outright. Hit/miss/rebuild counters ride
+  ``stats()["registry"]`` — the acceptance check that readmission never
+  paid a setup.
+* **HBM admission/eviction** — a farm-wide
+  :class:`~amgcl_tpu.telemetry.ledger.LruMemoryPool` over the resident
+  hierarchies, ``AMG.bytes()`` the accounting unit per charge.
+  Admission under ``AMGCL_TPU_FARM_MAX_BYTES`` evicts the
+  least-recently-dispatched operator first
+  (``SolverService.release_device()`` — bucket executables, donated
+  buffers, device operators and the hierarchy all dropped; host CSR +
+  plans kept), so readmission is a rebuild, not a setup.
+* **cross-tenant batch packing** — each operator keeps ONE unstarted
+  ``SolverService`` whose ``_run_batch`` the farm's single dispatch
+  thread drives directly: requests from every tenant sharing an
+  operator pack into the same power-of-two (n, B) buckets (compile
+  count stays O(log B) per shape regardless of tenant count), while a
+  fair-share round-robin over the per-tenant bounded queues bounds any
+  tenant's wait at one batch per peer with pending work.
+* **per-tenant observability** — tenant-labeled counters/gauges on the
+  farm's :class:`~amgcl_tpu.telemetry.live.LiveRegistry` (scrapeable
+  via ``/metrics`` on ``AMGCL_TPU_FARM_METRICS_PORT``), a per-tenant
+  SLO watchdog (same thresholds surface as the serve watchdog,
+  overridable per tenant at ``register()``) whose findings feed
+  ``telemetry.diagnose(farm=...)``, and per-tenant rows in
+  :meth:`SolverFarm.stats`.
+
+Env knobs (read at construction; constructor args win):
+
+  AMGCL_TPU_FARM_MAX_BYTES     farm-wide resident-hierarchy byte budget
+                               (0/unset = unlimited)
+  AMGCL_TPU_FARM_QUEUE_MAX     per-tenant bounded queue depth (def 256)
+  AMGCL_TPU_FARM_METRICS_PORT  /metrics + /healthz scrape port for the
+                               farm registry (unset = no server; 0 =
+                               ephemeral; negative = off)
+  AMGCL_TPU_SERVE_FLUSH_MS / AMGCL_TPU_SERVE_TIMEOUT_S /
+  AMGCL_TPU_SERVE_BATCH / AMGCL_TPU_SLO_*
+                               shared with the single-operator service
+                               (per-tenant SLO overrides at register())
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from amgcl_tpu.serve.registry import (OperatorRegistry, RegistryEntry,
+                                      stable_config_key)
+from amgcl_tpu.serve.service import (SolverService, _Request, _env_float,
+                                     _env_int, _sink_attached)
+from amgcl_tpu.telemetry.live import (LiveRegistry, MetricsServer,
+                                      metrics_port_from_env)
+
+
+class _FarmRequest(_Request):
+    """A service request plus the tenant tag and a PUBLIC future.
+    ``_run_batch`` resolves the inner ``future``; the farm transfers it
+    onto ``public`` only after its own per-tenant accounting committed
+    — so a caller who sees its future done reads ``stats()``/SLO state
+    that already include its batch (the same resolve-last discipline
+    the service keeps for its own stats)."""
+    __slots__ = ("tenant", "public")
+
+    def __init__(self, rhs, timeout_s, x0=None, rid=0, tenant=""):
+        super().__init__(rhs, timeout_s, x0=x0, rid=rid)
+        self.tenant = tenant
+        from concurrent.futures import Future
+        self.public = Future()
+
+
+class _Tenant:
+    """Per-tenant state: the registry entry it maps onto, its bounded
+    request queue, lifetime counters, and the rolling SLO window."""
+
+    def __init__(self, name: str, entry: RegistryEntry, queue_max: int,
+                 slo: Dict[str, float], slo_window: int):
+        self.name = name
+        self.entry = entry
+        self.queue_max = int(queue_max)
+        self.q: deque = deque()
+        self.n_requests = 0
+        self.n_timeouts = 0
+        self.n_unhealthy = 0
+        self.slo = dict(slo)
+        self.slo_window = int(slo_window)
+        self.win: deque = deque(maxlen=max(self.slo_window, 8))
+        self.lat: deque = deque(maxlen=2048)
+        self.slo_trips = 0
+        self._slo_active: set = set()
+        self.outcome = None           # last register() outcome
+
+
+class SolverFarm:
+    """N tenants, one device: registry-cached hierarchies, an LRU HBM
+    pool, cross-tenant bucket packing, per-tenant SLOs.
+
+        farm = SolverFarm(max_bytes=2 << 30)
+        farm.register("acct-1", A1)            # miss: fresh setup
+        farm.register("acct-2", A1)            # hit: shared hierarchy
+        farm.register("acct-1", A1_next_step)  # rebuild: plan reuse
+        fut = farm.submit("acct-1", rhs)
+        x, report = fut.result()
+        farm.stats()["tenants"]                # per-tenant rows
+        farm.close()                           # or context manager
+
+    (A DIFFERENT tenant registering same-sparsity different-value data
+    is a deliberate miss — the registry never rebuilds a live
+    co-owner's hierarchy out from under it.)
+    """
+
+    def __init__(self, max_bytes: Optional[int] = None,
+                 batch: Optional[int] = None,
+                 flush_ms: Optional[float] = None,
+                 timeout_s: Optional[float] = None,
+                 queue_max: Optional[int] = None,
+                 metrics_port: Optional[int] = None,
+                 registry: Optional[OperatorRegistry] = None):
+        from amgcl_tpu.telemetry.ledger import LruMemoryPool
+        cap = max_bytes if max_bytes is not None \
+            else _env_int("AMGCL_TPU_FARM_MAX_BYTES", 0)
+        self.pool = LruMemoryPool(cap, name="farm_hbm")
+        self.registry = registry or OperatorRegistry()
+        self.batch = int(batch or _env_int("AMGCL_TPU_SERVE_BATCH", 8))
+        self.flush_s = (flush_ms if flush_ms is not None
+                        else _env_float("AMGCL_TPU_SERVE_FLUSH_MS",
+                                        50.0)) / 1e3
+        self.timeout_s = timeout_s if timeout_s is not None \
+            else _env_float("AMGCL_TPU_SERVE_TIMEOUT_S", 30.0)
+        self.queue_max = int(queue_max
+                             or _env_int("AMGCL_TPU_FARM_QUEUE_MAX", 256))
+        #: farm-default SLO thresholds — per-tenant overrides at
+        #: register(); same knob surface as the serve watchdog
+        self.slo_defaults = {
+            "p99_ms": _env_float("AMGCL_TPU_SLO_P99_MS", 0.0),
+            "timeout_rate": _env_float("AMGCL_TPU_SLO_TIMEOUT_RATE",
+                                       0.01),
+            "unhealthy_rate": _env_float("AMGCL_TPU_SLO_UNHEALTHY_RATE",
+                                         0.05),
+        }
+        self.slo_window = _env_int("AMGCL_TPU_SLO_WINDOW", 256)
+        self.tenants: Dict[str, _Tenant] = {}
+        self.live = LiveRegistry()
+        port = metrics_port if metrics_port is not None \
+            else metrics_port_from_env("AMGCL_TPU_FARM_METRICS_PORT")
+        self.metrics_port = None if (port is not None and port < 0) \
+            else port
+        self.metrics_server: Optional[MetricsServer] = None
+        self._cond = threading.Condition()
+        #: guards the pool + residency transitions AND is held across a
+        #: whole dispatch (ensure-resident -> _run_batch) so an evict
+        #: from register()/evict() can never release the device buffers
+        #: a batch is executing against
+        self._mem_lock = threading.RLock()
+        self._rid = itertools.count(1)
+        self._rr = 0                  # fair-share rotation cursor
+        self._thread: Optional[threading.Thread] = None
+        self._stop = False
+        self._closed = False
+        self._n_batches = 0
+        self._n_evictions = 0
+        self._n_readmissions = 0
+
+    # -- registration --------------------------------------------------------
+
+    def register(self, tenant: str, A, solver=None, precond=None,
+                 slo: Optional[Dict[str, float]] = None,
+                 slo_window: Optional[int] = None,
+                 queue_max: Optional[int] = None) -> Dict[str, Any]:
+        """Register (or re-register) ``tenant`` with operator ``A``
+        (CSR or scipy). ``solver``/``precond`` default to CG + SA-AMG
+        (float32); ``slo`` overrides the farm-default watchdog
+        thresholds for this tenant ({p99_ms, timeout_rate,
+        unhealthy_rate} — partial dicts merge over the defaults).
+
+        Routed through the operator registry: a bit-identical matrix
+        under the same config SHARES the resident hierarchy ("hit"), a
+        same-sparsity value update by this tenant refreshes it via the
+        numeric ``rebuild()`` ("rebuild"), anything else pays one fresh
+        setup ("miss") — then the hierarchy is admitted against the
+        byte budget, evicting the coldest resident operator(s) as
+        needed. Returns {tenant, outcome, fingerprint, bytes, ...}."""
+        from amgcl_tpu.ops.csr import CSR
+        from amgcl_tpu.models.amg import AMGParams
+        from amgcl_tpu.models.make_solver import make_solver
+        from amgcl_tpu.solver.cg import CG
+        if not isinstance(A, CSR):
+            A = CSR.from_scipy(A)
+        solver_obj = solver if solver is not None \
+            else CG(maxiter=200, tol=1e-8)
+        prm = precond if precond is not None else AMGParams()
+        cfg_key = stable_config_key(solver_obj, prm)
+
+        def build(Ah):
+            return make_solver(Ah, prm, solver_obj)
+
+        if self._closed:            # early, re-checked under the lock
+            raise RuntimeError("SolverFarm is closed")
+        prev = self.tenants.get(tenant)
+        if prev is not None:
+            # re-registration replaces the tenant's operator: drop its
+            # ownership first so its own (now sole-owned) entry is
+            # exactly the rebuild target the registry looks for
+            self.registry.release(tenant)
+        build_fn = build
+        if self.registry.probe(tenant, A, config_key=cfg_key) == "miss":
+            # the MISS path pays the full symbolic setup — run it
+            # OUTSIDE the dispatch lock (the fresh bundle is private
+            # until acquire publishes it), so a large registration does
+            # not stall every other tenant's in-flight traffic. The
+            # probe is advisory: a racing registration may flip the
+            # outcome, in which case the prebuild is discarded (wasted
+            # work, never a stall or a wrong entry).
+            prebuilt = build(A)
+            build_fn = lambda Ah: prebuilt    # noqa: E731
+        with self._mem_lock:
+            if self._closed:
+                raise RuntimeError("SolverFarm is closed")
+            entry, outcome = self.registry.acquire(tenant, A, build_fn,
+                                                   config_key=cfg_key)
+            if "service" not in entry.payload:
+                # per-operator resident program: the farm drives
+                # _run_batch directly from its own dispatch thread, so
+                # the service is never start()ed (no second worker, no
+                # second queue); its own watchdog is neutered — the
+                # farm's per-tenant windows are the only trip source
+                entry.payload["service"] = SolverService(
+                    entry.obj, batch=self.batch,
+                    flush_ms=self.flush_s * 1e3,
+                    timeout_s=self.timeout_s, metrics_port=-9,
+                    slo_p99_ms=0.0, slo_timeout_rate=1.0,
+                    slo_unhealthy_rate=1.0)
+            if entry.obj.A_dev is None:
+                # acquired an evicted cache entry ("hit" on bit-equal
+                # values): readmit before charging
+                entry.payload["service"].readmit()
+                self.registry.note_rebuild(entry)
+                self._n_readmissions += 1
+                self.live.inc("farm_readmissions_total")
+            self._charge_locked(entry)
+            merged_slo = dict(self.slo_defaults, **(slo or {}))
+            t = _Tenant(tenant, entry, queue_max or self.queue_max,
+                        merged_slo,
+                        slo_window or self.slo_window)
+            stranded: List[_FarmRequest] = []
+            if prev is not None:
+                t.n_requests = prev.n_requests
+                t.n_timeouts = prev.n_timeouts
+                t.n_unhealthy = prev.n_unhealthy
+                t.slo_trips = prev.slo_trips
+                t.lat = prev.lat
+                old_n = prev.entry.payload["service"].n
+                new_n = entry.payload["service"].n
+                if old_n == new_n:
+                    # queued work carries over — rhs sizes still match
+                    t.q = prev.q
+                else:
+                    # queued rhs were validated against the OLD size;
+                    # packing them into the new operator's bucket would
+                    # poison a whole batch — fail them instead (below,
+                    # outside the queue lock)
+                    with self._cond:
+                        while prev.q:
+                            stranded.append(prev.q.popleft())
+            t.outcome = outcome
+            with self._cond:
+                self.tenants[tenant] = t
+                self._cond.notify_all()
+            for req in stranded:
+                if not req.public.done():
+                    req.public.set_exception(RuntimeError(
+                        "tenant %r re-registered with a different "
+                        "system size (%d -> %d) while this request "
+                        "was queued" % (tenant, old_n, new_n)))
+            if outcome == "hit":
+                self.live.inc("farm_registry_hits_total")
+            elif outcome == "miss":
+                self.live.inc("farm_registry_misses_total")
+            else:
+                self.live.inc("farm_registry_rebuilds_total")
+            self.live.set_gauge("farm_tenants", len(self.tenants))
+            self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
+                                tenant=tenant)
+            # _charge_locked ran before this tenant joined the table —
+            # seed its residency gauges now that it is addressable
+            self.live.set_gauge(
+                "farm_tenant_resident",
+                1.0 if entry.uid in self.pool.resident() else 0.0,
+                tenant=tenant)
+            self.live.set_gauge(
+                "farm_tenant_bytes",
+                self.pool.resident().get(entry.uid, 0), tenant=tenant)
+            out = {"tenant": tenant, "outcome": outcome,
+                   "fingerprint": entry.fingerprint, "uid": entry.uid,
+                   "bytes": self.pool.resident().get(entry.uid, 0),
+                   "setup_s": round(entry.setup_s, 4)}
+            if entry.rebuild_s is not None:
+                out["rebuild_s"] = round(entry.rebuild_s, 4)
+            if _sink_attached():
+                from amgcl_tpu import telemetry
+                telemetry.emit(event="farm_register", **out)
+            return out
+
+    # -- admission / eviction ------------------------------------------------
+
+    def _entry_bytes(self, entry: RegistryEntry) -> int:
+        amg = getattr(entry.obj, "precond", None)
+        fn = getattr(amg, "bytes", None)
+        return int(fn()) if callable(fn) else 0
+
+    def _charge_locked(self, entry: RegistryEntry) -> None:
+        nbytes = self._entry_bytes(entry)
+        while not self.pool.charge(entry.uid, nbytes):
+            victim = self.pool.coldest(exclude=(entry.uid,))
+            if victim is None:
+                raise RuntimeError(
+                    "operator %s needs %d bytes but the farm budget is "
+                    "%d and nothing else is evictable — raise "
+                    "AMGCL_TPU_FARM_MAX_BYTES" %
+                    (entry.uid, nbytes, self.pool.total))
+            self._evict_uid_locked(victim)
+        self._residency_gauges_locked(entry, resident=True,
+                                      nbytes=nbytes)
+
+    def _entry_by_uid(self, uid: str) -> Optional[RegistryEntry]:
+        for e in self.registry.entries():
+            if e.uid == uid:
+                return e
+        return None
+
+    def _evict_uid_locked(self, uid: str) -> None:
+        entry = self._entry_by_uid(uid)
+        if entry is not None:
+            svc = entry.payload.get("service")
+            if svc is not None:
+                svc.release_device()
+            else:
+                rel = getattr(entry.obj, "release_device", None)
+                if callable(rel):
+                    rel()
+        self.pool.release(uid)
+        self._n_evictions += 1
+        self.live.inc("farm_evictions_total")
+        if entry is not None:
+            self._residency_gauges_locked(entry, resident=False,
+                                          nbytes=0)
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            telemetry.emit(event="farm_evict", uid=uid,
+                           pool_used=self.pool.used)
+
+    def _residency_gauges_locked(self, entry: RegistryEntry,
+                                 resident: bool, nbytes: int) -> None:
+        self.live.set_gauge("farm_hbm_used_bytes", self.pool.used)
+        self.live.set_gauge("farm_hbm_total_bytes",
+                            0 if self.pool.unlimited else self.pool.total)
+        self.live.set_gauge("farm_resident_operators",
+                            len(self.pool.resident()))
+        for name, t in list(self.tenants.items()):
+            if t.entry is entry:
+                self.live.set_gauge("farm_tenant_resident",
+                                    1.0 if resident else 0.0,
+                                    tenant=name)
+                self.live.set_gauge("farm_tenant_bytes", nbytes,
+                                    tenant=name)
+
+    def _ensure_resident_locked(self, entry: RegistryEntry
+                                ) -> SolverService:
+        svc = entry.payload["service"]
+        if entry.uid in self.pool.resident():
+            self.pool.touch(entry.uid)
+            return svc
+        t0 = time.perf_counter()
+        svc.readmit()          # numeric rebuild on cached plans — the
+        #                        registry counters record it as a
+        #                        rebuild, never a setup
+        self.registry.note_rebuild(entry, time.perf_counter() - t0)
+        self._n_readmissions += 1
+        self.live.inc("farm_readmissions_total")
+        self._charge_locked(entry)
+        return svc
+
+    def evict(self, tenant: str) -> bool:
+        """Explicitly evict ``tenant``'s operator (drops the device
+        buffers of every tenant sharing it; host CSR + plans stay —
+        the next dispatch readmits via rebuild). False when it was not
+        resident."""
+        t = self.tenants[tenant]
+        with self._mem_lock:
+            if t.entry.uid not in self.pool.resident():
+                return False
+            self._evict_uid_locked(t.entry.uid)
+            return True
+
+    def set_max_bytes(self, max_bytes: int) -> None:
+        """Re-arm the byte budget in place (the CLI/bench demos size
+        the cap from the tenants actually built), evicting coldest
+        operators until the resident set fits."""
+        with self._mem_lock:
+            self.pool.resize(max_bytes)
+            while not self.pool.unlimited \
+                    and self.pool.used > self.pool.total:
+                victim = self.pool.coldest()
+                if victim is None:
+                    break
+                self._evict_uid_locked(victim)
+            self.live.set_gauge(
+                "farm_hbm_total_bytes",
+                0 if self.pool.unlimited else self.pool.total)
+            self.live.set_gauge("farm_hbm_used_bytes", self.pool.used)
+
+    # -- request path --------------------------------------------------------
+
+    def start(self) -> "SolverFarm":
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("SolverFarm is closed")
+            if self.metrics_server is None \
+                    and self.metrics_port is not None:
+                self.live.set_gauge("farm_tenants", len(self.tenants))
+                self.live.set_gauge("farm_resident_operators",
+                                    len(self.pool.resident()))
+                self.metrics_server = MetricsServer(
+                    self.metrics_port, self.live.prometheus,
+                    self._health_json)
+            if self._thread is None:
+                self._stop = False
+                self._thread = threading.Thread(
+                    target=self._loop, daemon=True,
+                    name="amgcl-tpu-farm")
+                self._thread.start()
+        return self
+
+    @property
+    def metrics_url(self) -> Optional[str]:
+        return self.metrics_server.url if self.metrics_server else None
+
+    def _health_json(self) -> Dict[str, Any]:
+        alive = self._thread is not None and self._thread.is_alive()
+        with self._mem_lock:        # residency mutates under _mem_lock
+            resident = len(self.pool.resident())
+        with self._cond:            # taken SEQUENTIALLY, never nested
+            out = {                 # inside _mem_lock the other way —
+                #                     register() nests _mem_lock→_cond
+                "ok": bool(alive or (self._thread is None
+                                     and not self._stop)),
+                "tenants": len(self.tenants),
+                "resident": resident,
+                "batches": self._n_batches,
+                "evictions": self._n_evictions,
+                "queue_depth": sum(len(t.q)
+                                   for t in self.tenants.values()),
+            }
+        return out
+
+    def submit(self, tenant: str, rhs, x0=None,
+               timeout_s: Optional[float] = None,
+               block: bool = True):
+        """Enqueue one rhs for ``tenant``; returns a Future resolving
+        to ``(x, report)``. The tenant's queue is bounded: when full, a
+        non-blocking submit raises ``queue.Full`` immediately
+        (backpressure); ``block=True`` (default) waits for room up to
+        the request timeout."""
+        t = self.tenants[tenant]          # KeyError: unknown tenant
+        n = t.entry.payload["service"].n
+        rhs = np.asarray(rhs)
+        if rhs.shape != (n,):
+            raise ValueError(
+                "rhs has shape %s but tenant %r's system has %d "
+                "unknowns" % (rhs.shape, tenant, n))
+        if x0 is not None:
+            x0 = np.asarray(x0)
+            if x0.shape != (n,):
+                raise ValueError(
+                    "x0 has shape %s but tenant %r's system has %d "
+                    "unknowns" % (x0.shape, tenant, n))
+        self.start()
+        timeout = timeout_s if timeout_s is not None else self.timeout_s
+        req = _FarmRequest(rhs, timeout, x0=x0, rid=next(self._rid),
+                           tenant=tenant)
+        deadline = time.monotonic() + max(timeout, 0.0)
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("SolverFarm is closed")
+            while len(t.q) >= t.queue_max:
+                if not block:
+                    raise _queue.Full(
+                        "tenant %r queue is full (%d)"
+                        % (tenant, t.queue_max))
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    raise _queue.Full(
+                        "tenant %r queue stayed full for %.1fs"
+                        % (tenant, timeout))
+                self._cond.wait(timeout=left)
+                if self._closed:
+                    raise RuntimeError("SolverFarm is closed")
+            t.q.append(req)
+            self._cond.notify_all()
+        self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
+                            tenant=tenant)
+        return req.public
+
+    def solve(self, tenant: str, rhs, x0=None,
+              timeout_s: Optional[float] = None):
+        """Synchronous convenience: submit + wait."""
+        fut = self.submit(tenant, rhs, x0=x0, timeout_s=timeout_s)
+        return fut.result(timeout=(timeout_s or self.timeout_s) + 120)
+
+    # -- dispatch loop -------------------------------------------------------
+
+    def _pick_tenant_locked(self) -> Optional[_Tenant]:
+        """Fair-share: the next tenant (rotating order) with pending
+        work. The cursor advances past the pick, so a tenant that just
+        dispatched goes to the back of the line — any tenant with work
+        waits at most one batch per peer with work (the starvation
+        bound the tests pin)."""
+        names = list(self.tenants)
+        if not names:
+            return None
+        for k in range(len(names)):
+            i = (self._rr + k) % len(names)
+            t = self.tenants[names[i]]
+            if t.q:
+                self._rr = (i + 1) % len(names)
+                return t
+        return None
+
+    def _pop_for_entry_locked(self, entry: RegistryEntry
+                              ) -> Optional[_FarmRequest]:
+        """One more request for the SAME operator, from any tenant
+        sharing it (rotating order) — the cross-tenant packing that
+        keeps unrelated tenants out of each other's compile buckets
+        while co-tenants of one operator fill its (n, B) bucket."""
+        names = list(self.tenants)
+        for k in range(len(names)):
+            t = self.tenants[names[(self._rr + k) % len(names)]]
+            if t.entry is entry and t.q:
+                return t.q.popleft()
+        return None
+
+    def _next_batch(self):
+        with self._cond:
+            while True:
+                t = self._pick_tenant_locked()
+                if t is not None:
+                    break
+                if self._stop:
+                    return None, None
+                self._cond.wait(timeout=0.1)
+            entry = t.entry
+            batch: List[_FarmRequest] = [t.q.popleft()]
+            self._cond.notify_all()      # a bounded-queue submitter may
+            #                              be waiting for room
+            bucket = entry.payload["service"].batch
+            deadline = time.monotonic() + self.flush_s
+            while len(batch) < bucket:
+                got = self._pop_for_entry_locked(entry)
+                if got is not None:
+                    batch.append(got)
+                    self._cond.notify_all()
+                    continue
+                if self._stop:
+                    break
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    break
+                self._cond.wait(timeout=min(left, 0.02))
+            return batch, entry
+
+    def _loop(self):
+        while True:
+            batch, entry = self._next_batch()
+            if batch is None:
+                return
+            try:
+                with self._mem_lock:
+                    svc = self._ensure_resident_locked(entry)
+                    svc._run_batch(batch)
+            except Exception as e:     # noqa: BLE001 — a failed batch
+                for req in batch:      # fails ITS futures, not the farm
+                    if not req.future.done():
+                        req.future.set_exception(e)
+            try:
+                self._account(batch)
+            except Exception:          # noqa: BLE001 — accounting must
+                import traceback       # never kill the dispatch loop,
+                traceback.print_exc()  # but must not vanish either
+            if self._stop:
+                with self._cond:
+                    if not any(t.q for t in self.tenants.values()):
+                        return
+
+    def _account(self, batch: List[_FarmRequest]) -> None:
+        """Per-tenant bookkeeping between the INNER futures resolving
+        (inside ``_run_batch``) and the PUBLIC futures resolving (the
+        ``finally`` below): windows, labeled live metrics, SLO
+        watchdogs for the tenants involved — committed before any
+        caller can observe its result."""
+        try:
+            self._account_rows(batch)
+        finally:
+            # the public futures resolve LAST, accounting committed —
+            # and resolve even when the bookkeeping above raised, so a
+            # farm accounting bug can never strand a caller
+            for req in batch:
+                src, dst = req.future, req.public
+                if dst.done():
+                    continue
+                if not src.done():
+                    dst.set_exception(RuntimeError(
+                        "farm batch finished without resolving "
+                        "request %d" % req.rid))
+                    continue
+                err = src.exception()
+                if err is not None:
+                    dst.set_exception(err)
+                else:
+                    dst.set_result(src.result())
+
+    def _account_rows(self, batch: List[_FarmRequest]) -> None:
+        involved: Dict[str, _Tenant] = {}
+        for req in batch:
+            t = self.tenants.get(req.tenant)
+            if t is None:
+                continue
+            fut = req.future
+            err = fut.exception() if fut.done() else None
+            row: Dict[str, Any] = {"timeout": False, "unhealthy": False}
+            if isinstance(err, TimeoutError):
+                row["timeout"] = True
+                t.n_timeouts += 1
+                self.live.inc("farm_tenant_timeouts_total",
+                              tenant=t.name)
+            elif err is not None:
+                row["unhealthy"] = True
+                row["error"] = True
+                t.n_unhealthy += 1
+                self.live.inc("farm_tenant_unhealthy_total",
+                              tenant=t.name)
+            else:
+                _x, rep = fut.result()
+                serve = rep.serve or {}
+                lat_ms = serve.get("latency_ms")
+                row["lat_ms"] = lat_ms
+                for k in ("queue", "pad", "compile", "solve", "sync"):
+                    row[k + "_ms"] = serve.get(k + "_ms")
+                row["fill"] = serve.get("batch_fill")
+                healthy = rep.health["ok"] if rep.health else True
+                if not healthy:
+                    row["unhealthy"] = True
+                    t.n_unhealthy += 1
+                    self.live.inc("farm_tenant_unhealthy_total",
+                                  tenant=t.name)
+                if lat_ms is not None:
+                    with self._cond:   # lat/win are read by stats()/
+                        t.lat.append(lat_ms)   # slo_summary() from
+                    #                    other threads — mutations and
+                    #                    snapshots share _cond
+                    self.live.observe("farm_latency_ms", lat_ms)
+            t.n_requests += 1
+            self.live.inc("farm_tenant_requests_total", tenant=t.name)
+            with self._cond:
+                t.win.append(row)
+            involved[t.name] = t
+        self._n_batches += 1
+        self.live.inc("farm_batches_total")
+        for t in involved.values():
+            self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
+                                tenant=t.name)
+            summ = self.tenant_slo_summary(t.name)
+            if summ["p99_ms"] is not None:
+                self.live.set_gauge("farm_tenant_p99_ms",
+                                    summ["p99_ms"], tenant=t.name)
+            self._check_tenant_slo(t, summ)
+
+    # -- per-tenant SLO watchdog ---------------------------------------------
+
+    def tenant_slo_summary(self, tenant: str) -> Dict[str, Any]:
+        """Rolling-window summary per tenant — the same shape the serve
+        watchdog evaluates (``SolverService.slo_summary``), so
+        ``telemetry.health.serve_findings`` (and ``diagnose(farm=...)``)
+        consume it unchanged, plus the tenant tag."""
+        from amgcl_tpu.telemetry import metrics as _metrics
+        t = self.tenants[tenant]
+        with self._cond:        # the dispatch thread appends under the
+            rows = list(t.win)  # same lock — a torn deque iteration
+        #                         would 500 a concurrent scrape
+        lat = [r["lat_ms"] for r in rows if r.get("lat_ms") is not None]
+        n = len(rows)
+
+        def mean(key):
+            vals = [r[key] for r in rows if r.get(key) is not None]
+            return round(sum(vals) / len(vals), 3) if vals else None
+
+        out: Dict[str, Any] = {
+            "tenant": tenant,
+            "window": n,
+            "p50_ms": round(_metrics.percentile(lat, 50), 3)
+            if lat else None,
+            "p99_ms": round(_metrics.percentile(lat, 99), 3)
+            if lat else None,
+            "timeout_rate": round(sum(
+                1 for r in rows if r.get("timeout")) / n, 4) if n else 0,
+            "unhealthy_rate": round(sum(
+                1 for r in rows if r.get("unhealthy")) / n, 4)
+            if n else 0,
+            "batch_fill": mean("fill"),
+            "spans_ms": {k: mean(k + "_ms") for k in
+                         ("queue", "pad", "compile", "solve", "sync")},
+            "slo": dict(t.slo, window=t.slo_window),
+        }
+        trips = []
+        if t.slo["p99_ms"] and out["p99_ms"] is not None \
+                and out["p99_ms"] > t.slo["p99_ms"]:
+            trips.append("p99")
+        if out["timeout_rate"] > t.slo["timeout_rate"]:
+            trips.append("timeout_rate")
+        if out["unhealthy_rate"] > t.slo["unhealthy_rate"]:
+            trips.append("unhealthy_rate")
+        out["trips"] = trips
+        return out
+
+    def _check_tenant_slo(self, t: _Tenant,
+                          summ: Dict[str, Any]) -> None:
+        """Edge-triggered, per tenant: a trip kind fires once when it
+        ENTERS the tripped state and re-arms when the tenant's window
+        clears — one tenant's episode never touches another tenant's
+        trip state (the isolation the tests pin)."""
+        if not summ["window"]:
+            return
+        new = [k for k in summ["trips"] if k not in t._slo_active]
+        t._slo_active = set(summ["trips"])
+        if not new:
+            return
+        t.slo_trips += len(new)
+        self.live.inc("farm_tenant_slo_trips_total", by=len(new),
+                      tenant=t.name)
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            from amgcl_tpu.telemetry.health import serve_findings
+            telemetry.emit(event="farm_slo", new_trips=new,
+                           findings=serve_findings(summ), **summ)
+
+    # -- stats / lifecycle ---------------------------------------------------
+
+    def stats(self) -> Dict[str, Any]:
+        """Farm-lifetime rollup: per-tenant rows (requests, timeouts,
+        unhealthy, SLO trips, latency percentiles, residency + bytes,
+        window summary), the registry hit/miss/rebuild counters, the
+        HBM pool state, and the eviction/readmission totals — the
+        ``capi.farm_stats`` payload and the ``diagnose(farm=...)``
+        input."""
+        from amgcl_tpu.telemetry import metrics as _metrics
+        with self._mem_lock:     # residency mutates under _mem_lock;
+            resident = self.pool.resident()   # snapshot, then release
+        rows = []
+        with self._cond:
+            tenants = list(self.tenants.items())
+        for name, t in tenants:
+            with self._cond:
+                lat = list(t.lat)
+            row: Dict[str, Any] = {
+                "tenant": name,
+                "fingerprint": t.entry.fingerprint,
+                "uid": t.entry.uid,
+                "outcome": t.outcome,
+                "resident": t.entry.uid in resident,
+                "bytes": resident.get(t.entry.uid, 0),
+                "requests": t.n_requests,
+                "timeouts": t.n_timeouts,
+                "unhealthy": t.n_unhealthy,
+                "slo_trips": t.slo_trips,
+                "queue_depth": len(t.q),
+                "slo_summary": self.tenant_slo_summary(name),
+            }
+            if lat:
+                row["latency_ms"] = {
+                    "p50": round(_metrics.percentile(lat, 50), 3),
+                    "p99": round(_metrics.percentile(lat, 99), 3),
+                    "max": round(max(lat), 3)}
+            rows.append(row)
+        out: Dict[str, Any] = {
+            "tenants": rows,
+            "registry": self.registry.stats(),
+            "pool": {
+                "total_bytes": 0 if self.pool.unlimited
+                else self.pool.total,
+                "used_bytes": self.pool.used,
+                "resident": dict(resident)},
+            "requests": sum(r["requests"] for r in rows),
+            "batches": self._n_batches,
+            "evictions": self._n_evictions,
+            "readmissions": self._n_readmissions,
+            "batch_bucket": self.batch,
+        }
+        if self.metrics_server is not None:
+            out["metrics_port"] = self.metrics_server.port
+        return out
+
+    def close(self, timeout: float = 30.0):
+        """Drain every tenant queue, stop the dispatch thread (and the
+        scrape server), emit a final ``farm`` summary event. TERMINAL —
+        like ``SolverService.close``."""
+        with self._cond:
+            self._closed = True
+            self._stop = True
+            thread = self._thread
+            self._cond.notify_all()
+        if thread is not None:
+            thread.join(timeout)
+            if thread.is_alive():
+                return                 # still draining; a later close()
+                #                        (or process exit) finishes up
+        with self._cond:
+            self._thread = None
+            stragglers = []
+            for t in self.tenants.values():
+                while t.q:
+                    stragglers.append(t.q.popleft())
+        for req in stragglers:
+            if not req.public.done():
+                req.public.set_exception(
+                    RuntimeError("SolverFarm is closed"))
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            telemetry.emit(event="farm", final=True, **self.stats())
+        server, self.metrics_server = self.metrics_server, None
+        if server is not None:
+            server.close()
+
+    def __enter__(self) -> "SolverFarm":
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -1,0 +1,288 @@
+"""Operator registry — cached hierarchies keyed by sparsity fingerprint.
+
+The farm's (and pyamgcl_compat's) setup-avoidance seam: a solver setup
+is expensive (strength graphs, aggregation, symbolic SpGEMM), a PR-9
+numeric rebuild against the cached plans is cheap (~0.46x a fresh build
+on CPU, pure segment passes on device). Whether the cheap path applies
+is a property of the SPARSITY PATTERN, not the values — so the registry
+keys cached hierarchies by a fingerprint of exactly the pattern
+(``ptr``/``col``/shape/block), plus a caller-supplied config key (two
+tenants wanting different coarsening on the same pattern are different
+operators).
+
+Acquisition semantics (the hit/rebuild/miss counters the farm's
+acceptance asserts against):
+
+* **hit** — an entry with the same pattern AND bit-equal values exists:
+  share it as-is (refcounted by owner token; read-only use).
+* **rebuild** — same pattern, new values, and the matching entry is not
+  live under any OTHER owner (the registering owner refreshing its own
+  time-stepped operator, or an orphaned cache entry): refresh it in
+  place via the object's ``rebuild()`` — numeric Galerkin on cached
+  plans, bit-identical to a fresh build.
+* **miss** — no entry, or every same-pattern entry is another live
+  owner's (rebuilding it under them would corrupt their operator):
+  fresh build.
+
+Entries survive their owners (``release`` drops the owner token, not
+the entry) — an orphaned entry is exactly the cache a returning
+same-sparsity tenant wants to rebuild into. ``prune()`` drops orphans
+when the caller wants the memory back.
+
+Stdlib + numpy only at module level (the build callables pull in jax).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def sparsity_fingerprint(A) -> str:
+    """Hex digest of a CSR matrix's sparsity PATTERN — shape, block
+    size, and the ``ptr``/``col`` arrays; the values are deliberately
+    excluded (two time steps of one problem share a fingerprint, which
+    is what routes the second one to ``rebuild()``). Cached on the
+    matrix object — patterns are immutable by convention."""
+    cached = getattr(A, "_sparsity_fp", None)
+    if cached is not None:
+        return cached
+    h = hashlib.blake2b(digest_size=16)
+    br, bc = getattr(A, "block_size", (1, 1))
+    h.update(np.asarray([A.nrows, A.ncols, A.nnz, br, bc],
+                        np.int64).tobytes())
+    h.update(np.ascontiguousarray(A.ptr).tobytes())
+    h.update(np.ascontiguousarray(A.col).tobytes())
+    fp = h.hexdigest()
+    try:
+        A._sparsity_fp = fp
+    except AttributeError:
+        pass
+    return fp
+
+
+def _obj_key(obj, depth: int = 2) -> str:
+    """Type name + sorted scalar fields of ``obj``, recursing ``depth``
+    levels into nested config objects — so a coarsening policy's
+    ``eps_strong`` (or a smoother's damping) distinguishes two
+    otherwise same-typed configs instead of silently sharing one
+    hierarchy between them."""
+    if obj is None:
+        return "-"
+    if isinstance(obj, (int, float, str, bool)):
+        return repr(obj)
+    if isinstance(obj, type):
+        return obj.__name__
+    bits = [type(obj).__name__]
+    fields = getattr(obj, "__dict__", {})
+    for k, v in sorted(fields.items()):
+        if k.startswith("_"):
+            continue
+        if depth > 0 and not isinstance(
+                v, (int, float, str, bool, type, type(None))) \
+                and hasattr(v, "__dict__"):
+            bits.append("%s=(%s)" % (k, _obj_key(v, depth - 1)))
+        elif isinstance(v, (int, float, str, bool, type(None))):
+            bits.append("%s=%r" % (k, v))
+        elif isinstance(v, type):
+            bits.append("%s=%s" % (k, v.__name__))
+        else:
+            bits.append("%s=%s" % (k, type(v).__name__))
+    return ",".join(bits)
+
+
+def stable_config_key(*objs) -> str:
+    """Deterministic config key from solver/params objects: type names
+    plus scalar attributes, recursing two levels into nested config
+    objects (a coarsening policy's thresholds are part of the operator
+    identity) — without dragging object ``repr``s, whose default form
+    embeds addresses, into the key."""
+    return "|".join(_obj_key(obj) for obj in objs)
+
+
+class RegistryEntry:
+    """One cached operator: the rebuildable object (a ``make_solver``
+    bundle or a bare ``AMG`` — anything with ``rebuild``), the value
+    array it currently carries, the owner tokens sharing it, and the
+    build/rebuild cost record the acceptance criteria compare."""
+
+    _seq = 0
+
+    def __init__(self, fingerprint: str, config_key: str, obj: Any,
+                 A_val, setup_s: float):
+        RegistryEntry._seq += 1
+        #: unique pool key (fingerprint alone may collide across
+        #: same-pattern different-value entries)
+        self.uid = "%s/%d" % (fingerprint[:12], RegistryEntry._seq)
+        self.fingerprint = fingerprint
+        self.config_key = config_key
+        self.obj = obj
+        #: SNAPSHOT of the values the cached hierarchy was built from —
+        #: a copy, never a reference: a caller mutating its value array
+        #: in place and re-registering (the pyamgcl time-stepping
+        #: idiom) must compare against what was BUILT, or the identity
+        #: check would return "hit" on a hierarchy holding stale values
+        self.A_val = np.array(A_val, copy=True)
+        self.owners: set = set()
+        self.setup_s = float(setup_s)
+        self.rebuild_s: Optional[float] = None
+        self.rebuilds = 0
+        self.hits = 0
+        #: free slot for the farm's per-entry state (the SolverService)
+        self.payload: Dict[str, Any] = {}
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"uid": self.uid, "fingerprint": self.fingerprint,
+               "owners": sorted(str(o) for o in self.owners),
+               "setup_s": round(self.setup_s, 4),
+               "rebuilds": self.rebuilds, "hits": self.hits}
+        if self.rebuild_s is not None:
+            out["rebuild_s"] = round(self.rebuild_s, 4)
+        return out
+
+
+class OperatorRegistry:
+    """Thread-safe fingerprint-keyed cache of built operators with
+    hit/miss/rebuild counters (module docstring has the semantics).
+
+    ``max_orphans`` bounds how many OWNERLESS entries survive a
+    ``release`` (oldest dropped first): orphans are valuable as rebuild
+    targets for returning same-pattern registrants, but a long-running
+    multi-matrix workload must not accumulate unbounded dead
+    hierarchies — pre-registry, dropping the last reference freed them.
+    None (the default) keeps every orphan; the farm manages its own
+    byte budget through the HBM pool instead."""
+
+    def __init__(self, max_orphans: Optional[int] = None):
+        self._lock = threading.RLock()
+        #: (fingerprint, config_key) -> [RegistryEntry, ...] (a bucket:
+        #: same-pattern different-value operators coexist)
+        self._buckets: Dict[Tuple[str, str], List[RegistryEntry]] = {}
+        self.max_orphans = max_orphans
+        self.hits = 0
+        self.misses = 0
+        self.rebuilds = 0
+
+    def acquire(self, owner, A, build: Callable[[Any], Any],
+                config_key: str = "") -> Tuple[RegistryEntry, str]:
+        """Resolve ``A`` for ``owner``: returns ``(entry, outcome)``
+        with outcome in {"hit", "rebuild", "miss"}. ``build(A)`` runs
+        (under the lock — registrations serialize, solves do not) only
+        on a miss."""
+        fp = sparsity_fingerprint(A)
+        with self._lock:
+            bucket = self._buckets.setdefault((fp, config_key), [])
+            for e in bucket:
+                # value compare is against the entry's SNAPSHOT of what
+                # was built — never an `is` check on the caller's array
+                # (in-place mutation + re-register must NOT hit)
+                if np.array_equal(e.A_val, np.asarray(A.val)):
+                    self.hits += 1
+                    e.hits += 1
+                    e.owners.add(owner)
+                    return e, "hit"
+            for e in bucket:
+                if e.owners <= {owner}:
+                    # same pattern, new values, and nobody ELSE is live
+                    # on this entry: the numeric-rebuild fast path
+                    t0 = time.perf_counter()
+                    e.obj.rebuild(A)
+                    e.rebuild_s = time.perf_counter() - t0
+                    e.A_val = np.array(A.val, copy=True)
+                    e.rebuilds += 1
+                    self.rebuilds += 1
+                    e.owners.add(owner)
+                    return e, "rebuild"
+            t0 = time.perf_counter()
+            obj = build(A)
+            e = RegistryEntry(fp, config_key, obj, A.val,
+                              time.perf_counter() - t0)
+            e.owners.add(owner)
+            bucket.append(e)
+            self.misses += 1
+            return e, "miss"
+
+    def probe(self, owner, A, config_key: str = "") -> str:
+        """The outcome :meth:`acquire` WOULD take right now, without
+        building or mutating anything — callers use it to run
+        miss-path builds outside their own locks (serve/farm.py).
+        Advisory: a concurrent acquire can change the answer."""
+        fp = sparsity_fingerprint(A)
+        with self._lock:
+            bucket = self._buckets.get((fp, config_key), [])
+            for e in bucket:
+                if np.array_equal(e.A_val, np.asarray(A.val)):
+                    return "hit"
+            for e in bucket:
+                if e.owners <= {owner}:
+                    return "rebuild"
+        return "miss"
+
+    def note_rebuild(self, entry: RegistryEntry,
+                     rebuild_s: Optional[float] = None) -> None:
+        """Count an out-of-band rebuild against the registry (the
+        farm's eviction→readmission path rebuilds through the entry's
+        service rather than ``acquire`` — the counters the acceptance
+        criteria compare must still see it)."""
+        with self._lock:
+            entry.rebuilds += 1
+            self.rebuilds += 1
+            if rebuild_s is not None:
+                entry.rebuild_s = float(rebuild_s)
+
+    def release(self, owner) -> None:
+        """Drop ``owner`` from every entry it shares. Entries stay
+        cached (orphans are rebuild targets for returning tenants) up
+        to ``max_orphans``; :meth:`prune` reclaims them all."""
+        with self._lock:
+            for bucket in self._buckets.values():
+                for e in bucket:
+                    e.owners.discard(owner)
+            if self.max_orphans is not None:
+                orphans = [e for bucket in self._buckets.values()
+                           for e in bucket if not e.owners]
+                excess = len(orphans) - self.max_orphans
+                if excess > 0:
+                    # the uid's trailing _seq is creation order — drop
+                    # the oldest orphans first
+                    oldest = sorted(orphans,
+                                    key=lambda e: int(
+                                        e.uid.rsplit("/", 1)[-1]))
+                    doomed = {e.uid for e in oldest[:excess]}
+                    for key in list(self._buckets):
+                        keep = [e for e in self._buckets[key]
+                                if e.uid not in doomed]
+                        if keep:
+                            self._buckets[key] = keep
+                        else:
+                            del self._buckets[key]
+
+    def prune(self) -> int:
+        """Drop ownerless entries; returns how many were dropped."""
+        dropped = 0
+        with self._lock:
+            for key in list(self._buckets):
+                bucket = self._buckets[key]
+                keep = [e for e in bucket if e.owners]
+                dropped += len(bucket) - len(keep)
+                if keep:
+                    self._buckets[key] = keep
+                else:
+                    del self._buckets[key]
+        return dropped
+
+    def entries(self) -> List[RegistryEntry]:
+        with self._lock:
+            return [e for bucket in self._buckets.values()
+                    for e in bucket]
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            ents = [e.to_dict() for bucket in self._buckets.values()
+                    for e in bucket]
+            return {"hits": self.hits, "misses": self.misses,
+                    "rebuilds": self.rebuilds, "entries": ents}

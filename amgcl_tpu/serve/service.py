@@ -271,6 +271,55 @@ class SolverService:
                                     timing["wall_s"])
         return x, report
 
+    def _ensure_entry(self):
+        """The resident jit wrap, recreated after a
+        :meth:`release_device` (readmission path). Same name, same
+        donation contract — the compile watch keeps aggregating under
+        ``serve.solve_step``."""
+        if self._entry is None:
+            self._entry = _cwatch.watched_jit(
+                self.solver._solve_fn, name=_SERVE_STEP,
+                donate_argnums=(4,))
+        return self._entry
+
+    def release_device(self):
+        """Eviction hook (serve/farm.py): return the service's device
+        footprint to the pool — clear the resident (shape, B) bucket
+        executables (and with them the donated iterate buffers XLA
+        keeps aliased to the compiled programs), then drop the bundle's
+        device operators and hierarchy (``make_solver.release_device``).
+        The worker must not be running; :meth:`readmit` (or the next
+        dispatch after it) re-creates everything, with the hierarchy
+        coming back through the rebuild path rather than a fresh
+        setup. The ledger-visible effect — ``solver.precond.bytes()``
+        dropping to 0 — is what the farm pool and the eviction tests
+        assert."""
+        if self._thread is not None and self._thread.is_alive():
+            raise RuntimeError(
+                "release_device() needs an idle service — close() the "
+                "worker first")
+        ent = self._entry
+        if ent is not None and hasattr(ent, "clear_cache"):
+            try:
+                ent.clear_cache()      # drops every (shape, B) bucket
+            except Exception:          # executable + donated buffers
+                pass
+        self._entry = None
+        self._bucket_models.clear()
+        rel = getattr(self.solver, "release_device", None)
+        if callable(rel):
+            rel()
+
+    def readmit(self):
+        """Re-materialize after :meth:`release_device`: rebuild the
+        bundle's device state (numeric rebuild against cached plans) and
+        re-arm the resident jit wrap. Bucket programs recompile lazily
+        on the next dispatch per (shape, B)."""
+        readm = getattr(self.solver, "readmit", None)
+        if callable(readm):
+            readm()
+        self._ensure_entry()
+
     def _dispatch(self, rhs, x0):
         """ONE resident-program dispatch: solve, sync at the batch
         boundary, fetch every per-column stat in a single host round
@@ -285,8 +334,9 @@ class SolverService:
         import jax
         cw0 = _cwatch.snapshot(_SERVE_STEP) if _cwatch.enabled() else None
         t0 = time.perf_counter()
-        got = self._entry(self.solver.A_dev, self.solver.A_dev64,
-                          self.solver.precond.hierarchy, rhs, x0)
+        got = self._ensure_entry()(
+            self.solver.A_dev, self.solver.A_dev64,
+            self.solver.precond.hierarchy, rhs, x0)
         x = got[0]
         jax.block_until_ready(x)         # the ONLY device sync
         t_solved = time.perf_counter()

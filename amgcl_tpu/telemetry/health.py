@@ -439,6 +439,51 @@ def serve_findings(serve: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def farm_findings(farm: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Farm-side findings from a :meth:`SolverFarm.stats` rollup: each
+    tenant's tripped SLO window becomes the serve-side findings with
+    the tenant named (one tenant's breach must be attributable without
+    polluting its neighbors' rows), plus the farm-level pathologies the
+    per-tenant windows cannot see — eviction thrash (the byte budget
+    cycling hierarchies in and out every few batches) and a pool at
+    its cap. Same {severity, code, message, suggestion} shape;
+    :func:`diagnose` folds these in via ``farm=``."""
+    out: List[Dict[str, Any]] = []
+    if not farm:
+        return out
+    for row in farm.get("tenants") or []:
+        summ = row.get("slo_summary") or {}
+        if not summ.get("trips"):
+            continue
+        for f in serve_findings(summ):
+            f = dict(f, tenant=row.get("tenant"),
+                     message="tenant %r: %s" % (row.get("tenant"),
+                                                f["message"]))
+            out.append(f)
+    batches = farm.get("batches") or 0
+    evictions = farm.get("evictions") or 0
+    if batches >= 4 and evictions > batches / 2:
+        out.append(_finding(
+            "warning", "farm_eviction_thrash",
+            "%d eviction(s) over %d batch(es) — the HBM budget cycles "
+            "hierarchies in and out faster than they amortize their "
+            "rebuild cost" % (evictions, batches),
+            "raise AMGCL_TPU_FARM_MAX_BYTES, shrink the working set "
+            "(fewer co-resident tenants per device), or batch each "
+            "tenant's traffic into longer runs so a resident "
+            "hierarchy serves more solves per admission"))
+    pool = farm.get("pool") or {}
+    total = pool.get("total_bytes") or 0
+    used = pool.get("used_bytes") or 0
+    if total and used > 0.95 * total and not out:
+        out.append(_finding(
+            "info", "farm_pool_near_cap",
+            "farm HBM pool at %.0f%% of its %d-byte budget — the next "
+            "admission will evict" % (100.0 * used / total, total),
+            None))
+    return out
+
+
 def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              probe: Optional[List[Dict[str, Any]]] = None,
              tol: Optional[float] = None,
@@ -446,7 +491,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              roofline: Optional[Dict[str, Any]] = None,
              compile_stats: Optional[Dict[str, Any]] = None,
              serve: Optional[Dict[str, Any]] = None,
-             comm: Optional[Dict[str, Any]] = None
+             comm: Optional[Dict[str, Any]] = None,
+             farm: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
@@ -458,8 +504,11 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     findings (:func:`serve_findings`). ``comm`` takes a measured comm
     attribution (``telemetry.comm.comm_attribution()``) and folds in
     the model-vs-measured divergence findings — comm-bound iterations,
-    wire rates far off the ICI peak, host-virtual-mesh caveats. Each
-    finding: {severity, code, message, suggestion}. Pure host-side
+    wire rates far off the ICI peak, host-virtual-mesh caveats.
+    ``farm`` takes a :meth:`SolverFarm.stats` rollup and folds in the
+    per-tenant SLO breaches (tenant-named) plus the eviction-thrash /
+    pool-pressure findings (:func:`farm_findings`). Each finding:
+    {severity, code, message, suggestion}. Pure host-side
     dict-crunching — never raises on missing pieces."""
     out: List[Dict[str, Any]] = []
     health = getattr(report, "health", None) or {}
@@ -622,6 +671,9 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
             fs = comm_findings(comm)
         out.extend(f for f in fs
                    if isinstance(f, dict) and "severity" in f)
+    if isinstance(farm, dict):
+        # farm leg: per-tenant SLO breaches + eviction thrash
+        out.extend(farm_findings(farm))
     if isinstance(compile_stats, dict):
         from amgcl_tpu.telemetry import compile_watch as _cw
         out.extend(_cw.findings(compile_stats))

@@ -90,6 +90,89 @@ def dense_window_budget() -> DeviceMemoryBudget:
     return DeviceMemoryBudget(max_total_bytes(), name="dense_window")
 
 
+class LruMemoryPool(DeviceMemoryBudget):
+    """:class:`DeviceMemoryBudget` generalized to a farm-wide RESIDENT
+    SET: named charges that can be released again (eviction returns the
+    bytes) and re-charged (readmission), with least-recently-used
+    ordering maintained by :meth:`touch` so the farm's admission loop
+    can always name the coldest resident hierarchy to evict
+    (serve/farm.py; ``AMG.bytes()`` is the accounting unit per charge).
+
+    ``total_bytes <= 0`` means unlimited — the pool still tracks
+    residency and LRU order, it just never refuses a charge. The charge
+    log inherited from the base class stays append-only: a release
+    appends a negative-byte row rather than rewriting history, so the
+    ledger remains an audit trail."""
+
+    def __init__(self, total_bytes: int = 0, name: str = "farm_hbm"):
+        total = int(total_bytes or 0)
+        self.unlimited = total <= 0
+        super().__init__(total if total > 0 else (1 << 62), name)
+        # the base class's append-only charge log was sized for ONE
+        # hierarchy build; a farm pool lives for the process and under
+        # eviction pressure appends ~2 rows per batch — bound it (the
+        # recent tail is still an audit trail, the totals are exact)
+        from collections import deque
+        self.charges = deque(self.charges, maxlen=256)
+        #: key -> bytes; insertion order IS the LRU order (coldest first)
+        self._resident: Dict[str, int] = {}
+
+    def charge(self, key: str, nbytes: int) -> bool:
+        """Admit ``key`` at ``nbytes`` (re-charging a resident key first
+        releases its old charge — a rebuild may change the footprint).
+        False when it does not fit; the caller evicts ``coldest()`` and
+        retries."""
+        if key in self._resident:
+            self.release(key)
+        if not self.try_charge(int(nbytes), tag=key):
+            return False
+        self._resident[key] = int(nbytes)
+        return True
+
+    def release(self, key: str) -> int:
+        """Evict ``key``: return its bytes to the pool (0 when it was
+        not resident)."""
+        nbytes = self._resident.pop(key, 0)
+        if nbytes:
+            self.used -= nbytes
+            self.charges.append((key + ":released", -nbytes))
+        return nbytes
+
+    def touch(self, key: str) -> None:
+        """Mark ``key`` most-recently-used (dict re-insertion moves it
+        to the warm end of the LRU order)."""
+        if key in self._resident:
+            self._resident[key] = self._resident.pop(key)
+
+    def coldest(self, exclude=()) -> Optional[str]:
+        """The least-recently-used resident key outside ``exclude`` —
+        the eviction victim; None when nothing is evictable."""
+        for key in self._resident:
+            if key not in exclude:
+                return key
+        return None
+
+    def resident(self) -> Dict[str, int]:
+        """Copy of the resident map in LRU order (coldest first)."""
+        return dict(self._resident)
+
+    def resize(self, total_bytes: int) -> None:
+        """Change the budget in place (the CLI/bench demos size the cap
+        from the tenants actually built). The caller evicts down to the
+        new cap; the pool only re-arms the refusal threshold."""
+        total = int(total_bytes or 0)
+        self.unlimited = total <= 0
+        self.total = total if total > 0 else (1 << 62)
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = super().to_dict()
+        if self.unlimited:
+            out["total_bytes"] = 0
+            out["remaining_bytes"] = None
+        out["resident"] = dict(self._resident)
+        return out
+
+
 # ---------------------------------------------------------------------------
 # per-format analytic SpMV cost
 # ---------------------------------------------------------------------------

@@ -98,6 +98,66 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "dist_comm_fraction": (
         "gauge", "measured collective wall fraction of one distributed "
                  "iteration (telemetry/comm.py ablation)"),
+    # -- multi-tenant solver farm (serve/farm.py) -------------------------
+    "farm_tenants": (
+        "gauge", "tenants registered with the farm"),
+    "farm_resident_operators": (
+        "gauge", "operator hierarchies currently device-resident"),
+    "farm_hbm_used_bytes": (
+        "gauge", "bytes charged against the farm HBM pool"),
+    "farm_hbm_total_bytes": (
+        "gauge", "farm HBM pool budget (0 = unlimited)"),
+    "farm_batches_total": (
+        "counter", "cross-tenant device batches dispatched by the farm"),
+    "farm_evictions_total": (
+        "counter", "resident hierarchies evicted under HBM pressure"),
+    "farm_readmissions_total": (
+        "counter", "evicted hierarchies readmitted via rebuild()"),
+    "farm_registry_hits_total": (
+        "counter", "operator-registry fingerprint hits (shared as-is)"),
+    "farm_registry_misses_total": (
+        "counter", "operator-registry misses (fresh hierarchy setup)"),
+    "farm_registry_rebuilds_total": (
+        "counter", "operator-registry numeric rebuilds (same sparsity, "
+                   "new values, or readmission after eviction)"),
+    "farm_latency_ms": (
+        "histogram", "end-to-end per-request latency across all tenants"),
+    "farm_tenant_requests_total": (
+        "counter", "requests completed per tenant (label: tenant)"),
+    "farm_tenant_timeouts_total": (
+        "counter", "queue-expired requests per tenant (label: tenant)"),
+    "farm_tenant_unhealthy_total": (
+        "counter", "unhealthy/errored solves per tenant (label: tenant)"),
+    "farm_tenant_slo_trips_total": (
+        "counter", "per-tenant SLO watchdog trips (label: tenant)"),
+    "farm_tenant_queue_depth": (
+        "gauge", "requests waiting per tenant queue (label: tenant)"),
+    "farm_tenant_resident": (
+        "gauge", "1 when the tenant's hierarchy is device-resident "
+                 "(label: tenant)"),
+    "farm_tenant_bytes": (
+        "gauge", "ledger bytes of the tenant's hierarchy (label: tenant)"),
+    "farm_tenant_p99_ms": (
+        "gauge", "rolling-window p99 latency per tenant (label: tenant)"),
+}
+
+#: THE declared label-key table: metric name -> allowed label keys.
+#: A labeled update whose metric is not a row here (or whose label key
+#: is not listed) raises at runtime, and the ``metric-name-literal``
+#: lint rule rejects the call site statically — same two-sided contract
+#: as :data:`METRICS` itself. Label VALUES stay free-form (tenant names
+#: arrive at runtime); only the keys are declared.
+METRIC_LABELS: Dict[str, Tuple[str, ...]] = {
+    "serve_health_flags_total": ("flag",),
+    "serve_bucket_solves_total": ("bucket",),
+    "farm_tenant_requests_total": ("tenant",),
+    "farm_tenant_timeouts_total": ("tenant",),
+    "farm_tenant_unhealthy_total": ("tenant",),
+    "farm_tenant_slo_trips_total": ("tenant",),
+    "farm_tenant_queue_depth": ("tenant",),
+    "farm_tenant_resident": ("tenant",),
+    "farm_tenant_bytes": ("tenant",),
+    "farm_tenant_p99_ms": ("tenant",),
 }
 
 # the ONE name-mangling rule, shared with the rollup exposition so the
@@ -119,16 +179,21 @@ class LiveRegistry:
     ``metric-name-literal`` lint rule enforces statically)."""
 
     def __init__(self, spec: Optional[Dict[str, Tuple[str, str]]] = None,
-                 hist_cap: int = 2048):
+                 hist_cap: int = 2048,
+                 labels_spec: Optional[Dict[str, Tuple[str, ...]]] = None):
         self.spec = dict(METRICS if spec is None else spec)
+        self.labels_spec = dict(METRIC_LABELS if labels_spec is None
+                                else labels_spec)
         self.hist_cap = int(hist_cap)
         self._lock = threading.Lock()
         #: (name, labels-tuple) -> float, labels sorted for identity
         self._counters: Dict[Tuple[str, Tuple], float] = {}
-        self._gauges: Dict[str, float] = {}
+        #: (name, labels-tuple) -> float — unlabeled gauges key on
+        #: (name, ()), so the pre-farm callers see unchanged behavior
+        self._gauges: Dict[Tuple[str, Tuple], float] = {}
         self._hists: Dict[str, deque] = {}
 
-    def _check(self, name: str, kind: str) -> None:
+    def _check(self, name: str, kind: str, labels=()) -> None:
         row = self.spec.get(name)
         if row is None:
             raise KeyError(
@@ -138,19 +203,29 @@ class LiveRegistry:
         if row[0] != kind:
             raise TypeError("metric %r is declared %r, not %r"
                             % (name, row[0], kind))
+        if labels:
+            allowed = self.labels_spec.get(name, ())
+            for k in labels:
+                if k not in allowed:
+                    raise KeyError(
+                        "label %r is not declared for metric %r — add "
+                        "it to telemetry/live.py METRIC_LABELS (the "
+                        "metric-name-literal rule enforces the same "
+                        "table statically)" % (k, name))
 
     # -- updates (the worker's hot path: one lock, one dict write) ----------
 
     def inc(self, name: str, by: float = 1, **labels) -> None:
-        self._check(name, "counter")
+        self._check(name, "counter", labels)
         key = (name, tuple(sorted(labels.items())))
         with self._lock:
             self._counters[key] = self._counters.get(key, 0) + by
 
-    def set_gauge(self, name: str, value: float) -> None:
-        self._check(name, "gauge")
+    def set_gauge(self, name: str, value: float, **labels) -> None:
+        self._check(name, "gauge", labels)
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
-            self._gauges[name] = float(value)
+            self._gauges[key] = float(value)
 
     def observe(self, name: str, value: float) -> None:
         self._check(name, "histogram")
@@ -163,28 +238,29 @@ class LiveRegistry:
     # -- reads ---------------------------------------------------------------
 
     def get(self, name: str, **labels) -> Optional[float]:
-        """Current value: counter (with exact labels) or gauge; the last
+        """Current value: counter or gauge (with exact labels); the last
         observation for a histogram. None when never touched."""
         kind = self.spec.get(name, (None,))[0]
+        key = (name, tuple(sorted(labels.items())))
         with self._lock:
             if kind == "counter":
-                return self._counters.get(
-                    (name, tuple(sorted(labels.items()))))
+                return self._counters.get(key)
             if kind == "gauge":
-                return self._gauges.get(name)
+                return self._gauges.get(key)
             if kind == "histogram":
                 h = self._hists.get(name)
                 return h[-1] if h else None
         return None
 
     def snapshot(self) -> Dict[str, Any]:
-        """JSON-clean copy: counters (labels flattened into the key),
-        gauges, and histogram rollups ({count, min, p50, p90, p99, max,
-        mean, last} via the fleet percentile helpers)."""
+        """JSON-clean copy: counters and gauges (labels flattened into
+        the key), and histogram rollups ({count, min, p50, p90, p99,
+        max, mean, last} via the fleet percentile helpers)."""
         with self._lock:
             counters = {name + _prom_labels(labels): v
                         for (name, labels), v in self._counters.items()}
-            gauges = dict(self._gauges)
+            gauges = {name + _prom_labels(labels): v
+                      for (name, labels), v in self._gauges.items()}
             hists = {name: list(h) for name, h in self._hists.items()}
         return {"counters": counters, "gauges": gauges,
                 "histograms": {name: _metrics.rollup(vals)
@@ -209,11 +285,14 @@ class LiveRegistry:
                              % (metric, self.spec[name][1]))
                 lines.append("# TYPE %s counter" % metric)
             lines.append("%s%s %s" % (metric, _prom_labels(labels), v))
-        for name, v in gauges:
+        for (name, labels), v in gauges:
             metric = _prom_name(prefix, name)
-            lines.append("# HELP %s %s" % (metric, self.spec[name][1]))
-            lines.append("# TYPE %s gauge" % metric)
-            lines.append("%s %s" % (metric, v))
+            if metric not in seen_type:
+                seen_type.add(metric)
+                lines.append("# HELP %s %s"
+                             % (metric, self.spec[name][1]))
+                lines.append("# TYPE %s gauge" % metric)
+            lines.append("%s%s %s" % (metric, _prom_labels(labels), v))
         rollups = {name: r for name, r in
                    ((name, _metrics.rollup(vals))
                     for name, vals in sorted(hists.items()))
@@ -240,10 +319,12 @@ def publish_dist_gauges(registry: "LiveRegistry",
         registry.set_gauge("dist_comm_fraction", float(comm_fraction))
 
 
-def metrics_port_from_env() -> Optional[int]:
-    """``AMGCL_TPU_SERVE_METRICS_PORT``: unset/unparseable = no scrape
-    server; an integer (0 = ephemeral port) enables it."""
-    raw = os.environ.get("AMGCL_TPU_SERVE_METRICS_PORT")
+def metrics_port_from_env(
+        var: str = "AMGCL_TPU_SERVE_METRICS_PORT") -> Optional[int]:
+    """Scrape-port knob convention, shared by the serve and farm
+    surfaces (``var`` selects the knob): unset/empty/unparseable = no
+    scrape server; an integer (0 = ephemeral port) enables it."""
+    raw = os.environ.get(var)
     if raw is None or raw == "":
         return None
     try:

@@ -65,6 +65,16 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # emitted as a bench_throughput JSONL
                                     # record and gated round-over-round via
                                     # AMGCL_TPU_GATE_THROUGHPUT
+    python bench.py --farm [T [R]]  # multi-tenant farm throughput: T
+                                    # tenants (default 3) with distinct
+                                    # operators round-robined R rounds
+                                    # (default 6) through one SolverFarm
+                                    # under an eviction-forcing byte
+                                    # budget; aggregate solves/sec +
+                                    # per-tenant p99 + eviction counts,
+                                    # emitted as a bench_farm JSONL record
+                                    # and gated round-over-round via
+                                    # AMGCL_TPU_GATE_FARM
 
 All JSON emission routes through the telemetry sink
 (amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
@@ -1199,6 +1209,15 @@ def main_worker():
                                                        on_tpu)
         except Exception as e:
             _PARTIAL["throughput"] = {"error": repr(e)[:200]}
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_FARM") == "1") \
+            and _enough("farm", 240):
+        # multi-tenant farm throughput under eviction pressure — the
+        # AMGCL_TPU_GATE_FARM metric (agg_sps) rides the record
+        _stage("farm")
+        try:
+            _PARTIAL["farm"] = _bench_farm(on_tpu)
+        except Exception as e:
+            _PARTIAL["farm"] = {"error": repr(e)[:200]}
     if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_UNSTRUCT") == "1") \
             and _enough("unstructured", 320):
         _stage("unstructured spmv")
@@ -1327,6 +1346,114 @@ def _serve_latency(slv, rhs_dev, B, factor=2):
     except Exception as e:            # noqa: BLE001 — latency detail is
         return {"latency_error": repr(e)[:120]}   # optional, the gate
         #                                           metric is b32_sps
+
+
+def _bench_farm(on_tpu, tenants=3, rounds=6):
+    """Multi-tenant farm throughput (serve/farm.py): ``tenants``
+    distinct graded-Poisson operators round-robined through one
+    SolverFarm under a byte budget capped at 75% of the resident set —
+    every round pays real eviction/readmission traffic, which is the
+    number the farm gate protects. Reports aggregate solves/sec across
+    tenants, per-tenant p99 latency, the eviction/readmission counts
+    and the registry hit/miss/rebuild counters (readmission must stay
+    on the rebuild path: misses == tenants)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.serve.farm import SolverFarm
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    base = int(os.environ.get("AMGCL_TPU_BENCH_FARM_N", "0")) \
+        or (24 if on_tpu else 8)
+    tenants = max(int(tenants), 2)
+    rounds = max(int(rounds), 2)
+    with SolverFarm(metrics_port=-9) as farm:
+        rhs_by = {}
+        for k in range(tenants):
+            A, rhs = poisson3d(base + 2 * k)
+            name = "t%d" % k
+            farm.register(name, A, solver=CG(maxiter=100, tol=1e-6),
+                          precond=AMGParams(dtype=jnp.float32,
+                                            coarse_enough=200))
+            rhs_by[name] = np.asarray(rhs)
+        total = farm.stats()["pool"]["used_bytes"]
+        farm.set_max_bytes(int(total * 0.75))
+        # warm one round outside the measured window (cold compiles)
+        for name, rhs in rhs_by.items():
+            farm.solve(name, rhs)
+        t0 = time.perf_counter()
+        futs = []
+        for _ in range(rounds):
+            futs += [(name, farm.submit(name, rhs, block=True))
+                     for name, rhs in rhs_by.items()]
+        iters_max = 0
+        for name, fut in futs:
+            _x, rep = fut.result(timeout=farm.timeout_s + 600)
+            iters_max = max(iters_max, int(rep.iters))
+        wall = time.perf_counter() - t0
+        stats = farm.stats()
+    nreq = rounds * tenants
+    out = {
+        "tenants": tenants, "rounds": rounds, "n_base": base,
+        "requests": nreq, "wall_s": round(wall, 4),
+        "agg_sps": round(nreq / wall, 3) if wall > 0 else None,
+        "evictions": stats["evictions"],
+        "readmissions": stats["readmissions"],
+        "registry": {k: stats["registry"][k]
+                     for k in ("hits", "misses", "rebuilds")},
+        "iters_max": iters_max,
+        "pool_bytes": stats["pool"]["total_bytes"],
+        "per_tenant": [
+            {"tenant": r["tenant"], "requests": r["requests"],
+             "p99_ms": (r.get("latency_ms") or {}).get("p99"),
+             "slo_trips": r["slo_trips"],
+             "unhealthy": r["unhealthy"]}
+            for r in stats["tenants"]],
+    }
+    # the acceptance invariant, recorded where the gate can see it:
+    # readmissions never paid a fresh setup
+    out["rebuild_only_readmission"] = \
+        stats["registry"]["misses"] <= tenants
+    return out
+
+
+def main_farm(args=None):
+    """``bench.py --farm [T ...]``: measure the multi-tenant farm
+    throughput (T tenants round-robin under an eviction-forcing byte
+    budget) and emit ONE ``bench_farm`` JSONL record — the
+    AMGCL_TPU_GATE_FARM metric is ``agg_sps``."""
+    from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested
+    apply_if_cpu_requested()
+    import jax
+    nums = [int(a) for a in (args or []) if a.isdigit()]
+    tenants = nums[0] if nums else 3
+    rounds = nums[1] if len(nums) > 1 else 6
+    on_tpu = jax.default_backend() == "tpu"
+    rec = _bench_farm(on_tpu, tenants=tenants, rounds=rounds)
+    dev0 = jax.devices()[0]
+    print("farm (%d tenant(s) x %d round(s), base n=%d^3, %s): "
+          "%.2f solves/s aggregate, %d eviction(s), %d readmission(s)"
+          % (rec["tenants"], rec["rounds"], rec["n_base"],
+             dev0.platform, rec["agg_sps"] or 0.0, rec["evictions"],
+             rec["readmissions"]))
+    for row in rec["per_tenant"]:
+        print("  %-6s %3d request(s)  p99 %sms  slo_trips %d"
+              % (row["tenant"], row["requests"], row["p99_ms"],
+                 row["slo_trips"]))
+    reg = rec["registry"]
+    print("  registry: %d hit / %d miss / %d rebuild  "
+          "(rebuild-only readmission: %s)"
+          % (reg["hits"], reg["misses"], reg["rebuilds"],
+             rec["rebuild_only_readmission"]))
+    from amgcl_tpu.telemetry.comm import hw_provenance
+    out = {"event": "bench_farm", **rec,
+           "device": str(dev0), "device_platform": dev0.platform,
+           "device_kind": getattr(dev0, "device_kind", None),
+           "provenance": hw_provenance(),
+           "commit": _git_head()}
+    _stdout_sink.emit(out)
+    _sink.emit(dict(out))
+    return 0
 
 
 def main_throughput(args=None):
@@ -1787,6 +1914,15 @@ def gate_tolerances():
                               is better). 0 disables both setup checks;
                               both skip across device_platform
                               mismatches like the time ratio.
+      AMGCL_TPU_GATE_FARM   — minimum allowed fraction of the baseline's
+                              multi-tenant farm throughput (bench_farm
+                              agg_sps; default 0.7 — eviction traffic
+                              jitters more than the single-operator
+                              path); platform-mismatch-skipped like the
+                              other time gates. The same check also
+                              fails a candidate whose readmissions left
+                              the rebuild path (rebuild_only_readmission
+                              false) regardless of speed.
     """
     def _f(name, default):
         try:
@@ -1798,7 +1934,8 @@ def gate_tolerances():
             "time": _f("AMGCL_TPU_GATE_TIME", 1.25),
             "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10),
             "throughput": _f("AMGCL_TPU_GATE_THROUGHPUT", 0.75),
-            "setup": _f("AMGCL_TPU_GATE_SETUP", 0.7)}
+            "setup": _f("AMGCL_TPU_GATE_SETUP", 0.7),
+            "farm": _f("AMGCL_TPU_GATE_FARM", 0.7)}
 
 
 def _record_health_flags(rec):
@@ -1912,6 +2049,33 @@ def run_gate(candidate, last_good, tol=None):
                        "last_good": tp_b, "limit": round(floor, 6),
                        "status": "ok" if tp_c >= floor
                        else "regression"})
+    # multi-tenant farm throughput (bench_farm / the worker's farm
+    # stage): higher-is-better like throughput_b32, same platform and
+    # pre-metric skips. A candidate whose readmissions left the rebuild
+    # path regresses outright — speed cannot buy back a broken registry.
+    fm_c = (candidate.get("farm") or {}).get("agg_sps")
+    fm_b = (last_good.get("farm") or {}).get("agg_sps")
+    if fm_c is None and fm_b is None:
+        pass          # neither record carries the metric: no check row
+    elif plat_skip is not None:
+        checks.append({"check": "farm_sps", "status": "skipped",
+                       "reason": plat_skip,
+                       "candidate": fm_c, "last_good": fm_b})
+    elif fm_c is None or fm_b is None:
+        checks.append({"check": "farm_sps", "status": "skipped",
+                       "candidate": fm_c, "last_good": fm_b})
+    else:
+        floor = fm_b * tol.get("farm", 0.7)
+        rebuild_ok = (candidate.get("farm") or {}).get(
+            "rebuild_only_readmission", True)
+        row = {"check": "farm_sps", "candidate": fm_c,
+               "last_good": fm_b, "limit": round(floor, 6),
+               "status": "ok" if (fm_c >= floor and rebuild_ok)
+               else "regression"}
+        if not rebuild_ok:
+            row["reason"] = "readmission paid a fresh setup " \
+                "(rebuild_only_readmission false)"
+        checks.append(row)
     # setup speed + same-sparsity rebuild (ROADMAP item 2): both skip on
     # platform mismatch and on records predating the metrics.
     # setup_vs_baseline is higher-is-better (like throughput), the
@@ -2377,6 +2541,9 @@ if __name__ == "__main__":
     elif "--throughput" in sys.argv:
         extra = sys.argv[sys.argv.index("--throughput") + 1:]
         sys.exit(main_throughput(extra))
+    elif "--farm" in sys.argv:
+        extra = sys.argv[sys.argv.index("--farm") + 1:]
+        sys.exit(main_farm(extra))
     elif "--scaling" in sys.argv:
         extra = sys.argv[sys.argv.index("--scaling") + 1:]
         sys.exit(main_scaling(extra))

@@ -40,5 +40,7 @@ def test_table_covers_new_knobs():
                 "AMGCL_TPU_SCALING_N", "AMGCL_TPU_SCALING_DEVICES",
                 "AMGCL_TPU_SCALING_SOLVERS",
                 "AMGCL_TPU_GATE_MULTICHIP",
-                "AMGCL_TPU_GATE_COMM_FRAC"):
+                "AMGCL_TPU_GATE_COMM_FRAC",
+                "AMGCL_TPU_FARM_MAX_BYTES", "AMGCL_TPU_FARM_QUEUE_MAX",
+                "AMGCL_TPU_FARM_METRICS_PORT", "AMGCL_TPU_GATE_FARM"):
         assert var in documented, var

@@ -1,0 +1,641 @@
+"""Multi-tenant solver farm (ISSUE 11): the operator registry's
+hit/rebuild/miss paths (rebuild bit-identity preserved through the
+registry), LRU eviction + readmission determinism under a tiny byte
+budget, cross-tenant isolation of health/SLO state and metric labels,
+the fair-share starvation bound, concurrent submit stress, the capi
+roundtrip, the farm gate, and the serial CLI ``--farm`` smoke."""
+
+import gc
+import json
+import os
+import subprocess
+import sys
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from amgcl_tpu.models.amg import AMGParams
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.serve import SolverFarm, SolverService
+from amgcl_tpu.serve.registry import (OperatorRegistry,
+                                      sparsity_fingerprint,
+                                      stable_config_key)
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _prm():
+    return AMGParams(dtype=jnp.float32, coarse_enough=50)
+
+
+def _bundle_builder():
+    return lambda Ah: make_solver(Ah, _prm(), CG(maxiter=80, tol=1e-7))
+
+
+def _farm(**kw):
+    kw.setdefault("batch", 4)
+    kw.setdefault("flush_ms", 10)
+    kw.setdefault("metrics_port", -9)
+    return SolverFarm(**kw)
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+def test_sparsity_fingerprint_pattern_keyed():
+    """The fingerprint keys the PATTERN: value changes keep it, pattern
+    changes move it — and it is cached on the matrix object."""
+    A, _ = poisson3d(6)
+    B, _ = poisson3d(7)
+    fp = sparsity_fingerprint(A)
+    assert fp == sparsity_fingerprint(CSR(A.ptr, A.col, 2.0 * A.val,
+                                          A.ncols))
+    assert fp != sparsity_fingerprint(B)
+    assert A._sparsity_fp == fp          # cached
+
+
+def test_registry_hit_rebuild_miss_paths():
+    """The three acquire outcomes, their counters, and the acceptance
+    invariant: the rebuild path is measurably cheaper than the fresh
+    setup it replaces, and the rebuilt hierarchy is bit-identical to a
+    fresh build (PR-9 contract preserved through the registry)."""
+    A, rhs = poisson3d(8)
+    reg = OperatorRegistry()
+    key = stable_config_key(CG(maxiter=80, tol=1e-7), _prm())
+    e1, o1 = reg.acquire("owner", A, _bundle_builder(), config_key=key)
+    assert o1 == "miss" and reg.misses == 1
+    # bit-identical matrix: shared as-is
+    A_same = CSR(A.ptr, A.col, A.val.copy(), A.ncols)
+    e2, o2 = reg.acquire("other", A_same, _bundle_builder(),
+                         config_key=key)
+    assert o2 == "hit" and e2 is e1 and reg.hits == 1
+    # same pattern, new values, sole/orphaned ownership: rebuild
+    reg.release("other")
+    A2 = CSR(A.ptr, A.col, 2.0 * A.val, A.ncols)
+    e3, o3 = reg.acquire("owner", A2, _bundle_builder(),
+                         config_key=key)
+    assert o3 == "rebuild" and e3 is e1 and reg.rebuilds == 1
+    assert e3.rebuild_s is not None and e3.rebuild_s < e3.setup_s
+    # bit-identity through the registry: the rebuilt bundle solves
+    # exactly like a fresh build of the new matrix
+    x_reg, _ = e3.obj(rhs)
+    fresh = make_solver(A2, _prm(), CG(maxiter=80, tol=1e-7))
+    x_fresh, _ = fresh(rhs)
+    assert np.array_equal(np.asarray(x_reg), np.asarray(x_fresh))
+    # a different config key is a different operator
+    key2 = stable_config_key(CG(maxiter=50, tol=1e-5), _prm())
+    _e4, o4 = reg.acquire("owner", A2, _bundle_builder(),
+                          config_key=key2)
+    assert o4 == "miss"
+
+
+def test_registry_snapshot_defeats_inplace_mutation():
+    """Mutating the value array IN PLACE and re-registering (the
+    pyamgcl time-stepping idiom) must take the rebuild path, not 'hit'
+    a hierarchy built from the stale values — the entry compares
+    against a snapshot of what was built, never the caller's live
+    buffer."""
+    A, rhs = poisson3d(6)
+    reg = OperatorRegistry()
+    e1, o1 = reg.acquire("o", A, _bundle_builder())
+    assert o1 == "miss"
+    x_old, _ = e1.obj(rhs)
+    A.val *= 2.0                    # in place: same array object
+    A2 = CSR(A.ptr, A.col, A.val, A.ncols)
+    e2, o2 = reg.acquire("o", A2, _bundle_builder())
+    assert o2 == "rebuild" and e2 is e1
+    x_new, _ = e2.obj(rhs)
+    np.testing.assert_allclose(np.asarray(x_new),
+                               np.asarray(x_old) / 2.0,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_config_key_sees_nested_policy_fields():
+    """Two same-typed coarsening policies with different thresholds are
+    different operators — the config key recurses into nested config
+    objects' scalar fields."""
+    from amgcl_tpu.coarsening.smoothed_aggregation import \
+        SmoothedAggregation
+    k1 = stable_config_key(AMGParams(
+        coarsening=SmoothedAggregation(eps_strong=0.08)))
+    k2 = stable_config_key(AMGParams(
+        coarsening=SmoothedAggregation(eps_strong=0.25)))
+    assert k1 != k2
+    k3 = stable_config_key(AMGParams(
+        coarsening=SmoothedAggregation(eps_strong=0.08)))
+    assert k1 == k3                  # deterministic across instances
+
+
+def test_registry_never_rebuilds_a_live_co_owner():
+    """Same sparsity + new values while ANOTHER owner is live on the
+    entry must NOT clobber it — fresh build (miss), both operators keep
+    their own values."""
+    A, rhs = poisson3d(6)
+    reg = OperatorRegistry()
+    e1, _ = reg.acquire("a", A, _bundle_builder())
+    A2 = CSR(A.ptr, A.col, 3.0 * A.val, A.ncols)
+    e2, o2 = reg.acquire("b", A2, _bundle_builder())
+    assert o2 == "miss" and e2 is not e1
+    x1, _ = e1.obj(rhs)
+    x2, _ = e2.obj(rhs)
+    # 3A x = b  =>  x = (1/3) A^{-1} b — the two entries really carry
+    # different operators
+    np.testing.assert_allclose(np.asarray(x2), np.asarray(x1) / 3.0,
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_registry_orphan_cap_and_probe():
+    """max_orphans bounds ownerless entries (oldest dropped first —
+    the pre-registry free-on-drop behavior, bounded); probe() predicts
+    acquire's outcome without building."""
+    reg = OperatorRegistry(max_orphans=1)
+    mats = [poisson3d(m)[0] for m in (5, 6, 7)]
+    for k, A in enumerate(mats):
+        assert reg.probe("o%d" % k, A) == "miss"
+        reg.acquire("o%d" % k, A, _bundle_builder())
+    assert reg.probe("o0", mats[0]) == "hit"
+    for k in range(3):
+        reg.release("o%d" % k)      # orphan one at a time; cap = 1
+    assert len(reg.entries()) == 1  # only the newest orphan survives
+    assert reg.entries()[0].fingerprint == \
+        sparsity_fingerprint(mats[2])
+    # an orphaned entry is a rebuild target for a returning registrant
+    A2 = CSR(mats[2].ptr, mats[2].col, 2.0 * mats[2].val,
+             mats[2].ncols)
+    assert reg.probe("new", A2) == "rebuild"
+    _e, o = reg.acquire("new", A2, _bundle_builder())
+    assert o == "rebuild"
+
+
+def test_farm_reregister_different_size_fails_stale_queue():
+    """Queued requests were validated against the OLD operator size; a
+    size-changing re-registration must fail them instead of poisoning
+    the new operator's batches."""
+    A6, rhs6 = poisson3d(6)
+    A7, rhs7 = poisson3d(7)
+    farm = _farm()
+    try:
+        farm.register("t", A6, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm())
+        # hold the dispatch lock (RLock — register on this thread
+        # re-enters it) so the re-registration lands while requests
+        # are still queued, deterministically
+        with farm._mem_lock:
+            futs = [farm.submit("t", rhs6 * (1 + k), block=True)
+                    for k in range(6)]
+            farm.register("t", A7, solver=CG(maxiter=40, tol=1e-7),
+                          precond=_prm())
+        outcomes = []
+        for f in futs:
+            try:
+                f.result(timeout=300)
+                outcomes.append("ok")
+            except RuntimeError as e:
+                assert "re-registered with a different" in str(e)
+                outcomes.append("stranded")
+        assert "stranded" in outcomes    # the still-queued tail failed
+        # the re-registered tenant serves its NEW size cleanly
+        x, rep = farm.solve("t", rhs7)
+        assert rep.resid < 1e-6
+    finally:
+        farm.close()
+
+
+def test_pyamgcl_compat_routes_through_registry():
+    """Repeated same-sparsity constructions take the registry: identical
+    matrix = hit, a dropped predecessor's pattern with new values =
+    rebuild (the reference's time-stepping workflow)."""
+    import amgcl_tpu.pyamgcl_compat as pyamgcl
+    A, rhs = poisson3d(7)
+    prm = {"coarse_enough": 50}
+    before = pyamgcl.registry_stats()
+    P1 = pyamgcl.amgcl(A, prm)
+    assert P1.registry_outcome == "miss"
+    P2 = pyamgcl.amgcl(A, prm)
+    assert P2.registry_outcome == "hit"
+    solve = pyamgcl.solver(P2, {"type": "cg", "tol": 1e-8})
+    x = solve(rhs)
+    rel = np.linalg.norm(rhs - A.spmv(np.asarray(x, np.float64))) \
+        / np.linalg.norm(rhs)
+    assert rel < 1e-5
+    del P1, P2, solve
+    gc.collect()                       # finalizers release ownership
+    P3 = pyamgcl.amgcl(CSR(A.ptr, A.col, 2.0 * A.val, A.ncols), prm)
+    assert P3.registry_outcome == "rebuild"
+    after = pyamgcl.registry_stats()
+    assert after["misses"] == before["misses"] + 1
+    assert after["rebuilds"] == before["rebuilds"] + 1
+
+
+# ---------------------------------------------------------------------------
+# eviction / readmission
+# ---------------------------------------------------------------------------
+
+def test_lru_pool_semantics():
+    from amgcl_tpu.telemetry.ledger import LruMemoryPool
+    pool = LruMemoryPool(100)
+    assert pool.charge("a", 40) and pool.charge("b", 40)
+    assert not pool.charge("c", 40)          # does not fit
+    assert pool.coldest() == "a"
+    pool.touch("a")                          # b is now coldest
+    assert pool.coldest() == "b"
+    assert pool.coldest(exclude=("b",)) == "a"
+    assert pool.release("b") == 40 and pool.used == 40
+    assert pool.charge("c", 40)
+    assert sorted(pool.resident()) == ["a", "c"]
+    pool.resize(0)                           # unlimited
+    assert pool.unlimited and pool.charge("d", 10 ** 12)
+    unl = LruMemoryPool(0)
+    assert unl.unlimited and unl.to_dict()["total_bytes"] == 0
+
+
+def test_service_release_device_returns_bytes():
+    """The satellite fix: close() alone left the donated iterate buffer
+    and bucket executables resident — release_device() drops them, the
+    ledger bytes drop to zero, and readmission restores bit-identical
+    solves."""
+    A, rhs = poisson3d(8)
+    ms = make_solver(A, _prm(), CG(maxiter=80, tol=1e-7))
+    svc = SolverService(ms, batch=2, flush_ms=5, metrics_port=-9)
+    x1, _ = svc.solve_batch(rhs)
+    b0 = ms.precond.bytes()
+    assert b0 > 0
+    with pytest.raises(RuntimeError):
+        # a running worker may own in-flight device buffers
+        svc.start().release_device()
+    svc.close()
+    svc.release_device()
+    assert ms.precond.bytes() == 0           # the ledger assertion
+    assert ms.A_dev is None and svc._entry is None
+    svc.readmit()
+    assert ms.precond.bytes() == b0
+    x2, _ = svc.solve_batch(rhs)
+    np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+
+
+def test_farm_eviction_readmission_determinism():
+    """Three tenants under a budget that holds only two hierarchies:
+    round-robin traffic forces eviction + readmission every round, the
+    readmissions ride rebuild() (registry misses stay == tenants), and
+    every tenant's solution is bit-identical across the cycles."""
+    farm = _farm()
+    rhs_by = {}
+    try:
+        for k, m in enumerate((6, 7, 8)):
+            A, rhs = poisson3d(m)
+            rep = farm.register("t%d" % k, A,
+                                solver=CG(maxiter=80, tol=1e-7),
+                                precond=_prm())
+            assert rep["outcome"] == "miss"
+            rhs_by["t%d" % k] = rhs
+        total = farm.stats()["pool"]["used_bytes"]
+        farm.set_max_bytes(int(total * 0.75))
+        assert len(farm.pool.resident()) < 3   # something was evicted
+        first = {}
+        for rnd in range(2):
+            futs = [(t, farm.submit(t, rhs))
+                    for t, rhs in rhs_by.items()]
+            for t, fut in futs:
+                x, rep = fut.result(timeout=300)
+                assert rep.resid < 1e-6 and rep.iters > 0
+                if rnd == 0:
+                    first[t] = np.asarray(x)
+                else:
+                    np.testing.assert_array_equal(first[t],
+                                                  np.asarray(x))
+        st = farm.stats()
+        assert st["evictions"] >= 1 and st["readmissions"] >= 1
+        # the acceptance counter check: every readmission was a
+        # rebuild, never a fresh setup
+        assert st["registry"]["misses"] == 3
+        assert st["registry"]["rebuilds"] >= st["readmissions"]
+        assert all(r["requests"] == 2 for r in st["tenants"])
+        # pool stayed within budget and an under-budget operator is
+        # still resident
+        assert st["pool"]["used_bytes"] <= st["pool"]["total_bytes"]
+    finally:
+        farm.close()
+
+
+def test_farm_budget_too_small_for_one_operator():
+    A, _ = poisson3d(6)
+    farm = _farm(max_bytes=1024)     # smaller than any hierarchy
+    try:
+        with pytest.raises(RuntimeError, match="FARM_MAX_BYTES"):
+            farm.register("t0", A, solver=CG(maxiter=10, tol=1e-5),
+                          precond=_prm())
+    finally:
+        farm.close()
+
+
+# ---------------------------------------------------------------------------
+# isolation / fairness / stress
+# ---------------------------------------------------------------------------
+
+def test_cross_tenant_isolation():
+    """One tenant's guard trips + SLO breach stay on ITS labels and
+    windows: the co-tenant's health, counters and trip state remain
+    clean, and diagnose(farm=...) names the offender."""
+    A, rhs = poisson3d(6)
+    farm = _farm()
+    try:
+        tight = {"unhealthy_rate": 0.0}   # any unhealthy solve trips
+        farm.register("bad", A, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm(), slo=tight)
+        farm.register("good", A, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm(), slo=tight)
+        # an x0 so large the first iteration overflows to NaN — trips
+        # the NAN guard at iteration 0 (the test_serve poisoning idiom,
+        # scaled to stay finite in the float32 cast)
+        xb, rb = farm.solve("bad", rhs, x0=np.full(rhs.shape, 1e30))
+        xg, rg = farm.solve("good", rhs)
+        assert rb.health is not None and not rb.health["ok"]
+        assert rg.health is not None and rg.health["ok"]
+        st = farm.stats()
+        rows = {r["tenant"]: r for r in st["tenants"]}
+        assert rows["bad"]["unhealthy"] == 1
+        assert rows["bad"]["slo_trips"] >= 1
+        assert rows["good"]["unhealthy"] == 0
+        assert rows["good"]["slo_trips"] == 0
+        assert "unhealthy_rate" not in \
+            rows["good"]["slo_summary"]["trips"]
+        # labeled metrics: the bad tenant's counter exists, the good
+        # tenant's was never created
+        assert farm.live.get("farm_tenant_unhealthy_total",
+                             tenant="bad") == 1
+        assert farm.live.get("farm_tenant_unhealthy_total",
+                             tenant="good") is None
+        # the doctor names the tenant
+        from amgcl_tpu.telemetry.health import diagnose, farm_findings
+        finds = farm_findings(st)
+        assert any(f.get("tenant") == "bad"
+                   and f["code"] == "slo_unhealthy_rate"
+                   for f in finds)
+        assert not any(f.get("tenant") == "good" for f in finds)
+        dfinds = diagnose(rg, farm=st)
+        assert any(f.get("tenant") == "bad" for f in dfinds)
+    finally:
+        farm.close()
+
+
+def test_fair_share_starvation_bound():
+    """A flooding tenant cannot starve a peer: with the round-robin
+    cursor advancing past every pick, the late tenant's single request
+    completes before the flooder's tail."""
+    A6, rhs6 = poisson3d(6)
+    A7, rhs7 = poisson3d(7)
+    order = []
+    farm = _farm(batch=2, flush_ms=1)
+    try:
+        farm.register("flood", A6, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm())
+        farm.register("late", A7, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm())
+        floods = [farm.submit("flood", rhs6 * (1.0 + k), block=True)
+                  for k in range(10)]
+        late = farm.submit("late", rhs7, block=True)
+        for tag, fut in [("flood%d" % k, f)
+                         for k, f in enumerate(floods)] \
+                + [("late", late)]:
+            fut.add_done_callback(
+                lambda _f, tag=tag: order.append(tag))
+        for f in floods + [late]:
+            f.result(timeout=300)
+        assert order.index("late") < order.index("flood9"), order
+    finally:
+        farm.close()
+
+
+def test_concurrent_submit_stress():
+    """>= 3 tenants submitting from concurrent threads: every result
+    matches the tenant's direct solve (no cross-tenant leakage under
+    batching), no request is lost."""
+    farm = _farm(batch=4, flush_ms=5)
+    tenants = {}
+    try:
+        for k, m in enumerate((6, 7, 8)):
+            A, rhs = poisson3d(m)
+            name = "t%d" % k
+            farm.register(name, A, solver=CG(maxiter=80, tol=1e-7),
+                          precond=_prm())
+            direct = make_solver(A, _prm(), CG(maxiter=80, tol=1e-7))
+            xd, _ = direct(rhs)
+            tenants[name] = (rhs, np.asarray(xd))
+        reqs = 6
+        results = {}
+        errs = []
+
+        def feeder(name):
+            rhs, _xd = tenants[name]
+            try:
+                futs = [farm.submit(name, rhs * (1.0 + 0.5 * k),
+                                    block=True) for k in range(reqs)]
+                results[name] = [f.result(timeout=300) for f in futs]
+            except Exception as e:      # noqa: BLE001 — surfaced below
+                errs.append((name, e))
+
+        threads = [threading.Thread(target=feeder, args=(n,))
+                   for n in tenants]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=600)
+        assert not errs, errs
+        for name, (rhs, xd) in tenants.items():
+            assert len(results[name]) == reqs
+            for k, (x, rep) in enumerate(results[name]):
+                np.testing.assert_allclose(
+                    np.asarray(x), (1.0 + 0.5 * k) * xd,
+                    rtol=1e-4, atol=1e-5)
+                assert rep.health is None or rep.health["ok"]
+        st = farm.stats()
+        assert st["requests"] == 3 * reqs
+    finally:
+        farm.close()
+
+
+# ---------------------------------------------------------------------------
+# observability surface
+# ---------------------------------------------------------------------------
+
+def test_farm_metrics_endpoint_tenant_labels():
+    """/metrics serves per-tenant labeled gauges while the farm runs —
+    the acceptance criterion — plus /healthz liveness."""
+    A, rhs = poisson3d(6)
+    farm = _farm(metrics_port=0)
+    try:
+        farm.register("acct-a", A, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm())
+        farm.solve("acct-a", rhs)
+        farm.start()
+        url = farm.metrics_url
+        assert url
+        text = urllib.request.urlopen(url, timeout=10).read().decode()
+        assert 'amgcl_tpu_farm_tenant_requests_total'  \
+            '{tenant="acct-a"} 1' in text
+        assert 'amgcl_tpu_farm_tenant_resident{tenant="acct-a"} 1.0' \
+            in text
+        assert 'amgcl_tpu_farm_tenant_bytes{tenant="acct-a"}' in text
+        assert "amgcl_tpu_farm_hbm_used_bytes" in text
+        assert "amgcl_tpu_farm_registry_misses_total 1" in text
+        h = json.loads(urllib.request.urlopen(
+            url.replace("/metrics", "/healthz"), timeout=10).read())
+        assert h["ok"] and h["tenants"] == 1
+        assert farm.stats()["metrics_port"] == \
+            farm.metrics_server.port
+    finally:
+        farm.close()
+
+
+def test_labeled_gauges_declared_and_linted():
+    """The METRIC_LABELS contract is enforced at both ends: the runtime
+    registry rejects undeclared label keys, and the lint rule sees the
+    same table (plus flags undeclared label keys at call sites)."""
+    from amgcl_tpu.analysis import lint
+    from amgcl_tpu.telemetry.live import (LiveRegistry, METRIC_LABELS,
+                                          METRICS)
+    assert METRIC_LABELS["farm_tenant_p99_ms"] == ("tenant",)
+    assert set(METRIC_LABELS) <= set(METRICS)
+    assert lint.declared_metric_labels() == METRIC_LABELS
+    reg = LiveRegistry()
+    with pytest.raises(KeyError):
+        reg.inc("farm_tenant_requests_total", shard="x")
+    with pytest.raises(KeyError):
+        reg.set_gauge("farm_hbm_used_bytes", 1, tenant="a")
+    # no new metric-name/label findings anywhere in the package
+    finds = lint.run_lint(rules=["metric-name-literal"])
+    assert finds == [], finds
+
+
+# ---------------------------------------------------------------------------
+# capi / gate / CLI
+# ---------------------------------------------------------------------------
+
+def test_capi_farm_roundtrip():
+    """farm_create / farm_register / farm_solve / farm_evict /
+    farm_stats through the ctypes marshalling layer; handle_destroy
+    closes the farm."""
+    import ctypes
+    from amgcl_tpu import capi
+    A, rhs = poisson3d(6)
+    n = A.nrows
+    ptr = np.ascontiguousarray(A.ptr, np.int32)
+    col = np.ascontiguousarray(A.col, np.int32)
+    val = np.ascontiguousarray(A.val, np.float64)
+    prm_h = capi.params_create()
+    capi.params_set(prm_h, "solver.type", "cg")
+    capi.params_set(prm_h, "solver.tol", 1e-7)
+    capi.params_set(prm_h, "precond.dtype", "float32")
+    capi.params_set(prm_h, "precond.coarse_enough", 50)
+    h = capi.farm_create(batch=2)
+    rep = json.loads(capi.farm_register(
+        h, "acct", n, ptr.ctypes.data, col.ctypes.data,
+        val.ctypes.data, prm_h))
+    assert rep["outcome"] == "miss" and rep["bytes"] > 0
+    nrhs = 2
+    rhs2 = np.concatenate([rhs, 2.0 * rhs]).astype(np.float64)
+    x = np.zeros(n * nrhs)
+    it, res = capi.farm_solve(h, "acct", rhs2.ctypes.data,
+                              x.ctypes.data, n, nrhs)
+    assert it > 0 and res < 1e-6
+    rel = np.linalg.norm(rhs - A.spmv(x[:n])) / np.linalg.norm(rhs)
+    assert rel < 1e-5
+    np.testing.assert_allclose(x[n:], 2.0 * x[:n], rtol=1e-5,
+                               atol=1e-7)
+    # initial guesses are honored (solver_solve_batch contract): a
+    # warm restart from the exact solution converges immediately
+    x_warm = x.copy()
+    it_w, _ = capi.farm_solve(h, "acct", rhs2.ctypes.data,
+                              x_warm.ctypes.data, n, nrhs)
+    assert it_w <= 1, it_w
+    assert capi.farm_evict(h, "acct") == 1
+    assert capi.farm_evict(h, "acct") == 0     # already evicted
+    # readmission through the queue still works after an explicit evict
+    # (x zeroed: all-zero guesses = cold start, same iters as before)
+    x[:] = 0.0
+    it2, _res2 = capi.farm_solve(h, "acct", rhs2.ctypes.data,
+                                 x.ctypes.data, n, nrhs)
+    assert it2 == it
+    stats = json.loads(capi.farm_stats(h))
+    assert stats["requests"] == 6 and stats["evictions"] == 1
+    assert stats["registry"]["misses"] == 1
+    assert stats["readmissions"] == 1
+    capi.handle_destroy(h)
+    capi.handle_destroy(prm_h)
+
+
+def test_gate_farm_check():
+    """bench.py --gate: the farm agg_sps floor trips on a drop below
+    the AMGCL_TPU_GATE_FARM fraction, skips across platforms and on
+    pre-metric records, and fails a candidate whose readmissions left
+    the rebuild path regardless of speed."""
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    base = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+            "farm": {"agg_sps": 10.0}}
+    good = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+            "farm": {"agg_sps": 9.0, "rebuild_only_readmission": True}}
+    bad = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+           "farm": {"agg_sps": 3.0}}
+    fake = {"iters": 10, "value": 1.0, "device_platform": "cpu",
+            "farm": {"agg_sps": 50.0,
+                     "rebuild_only_readmission": False}}
+    other = {"iters": 10, "value": 1.0, "device_platform": "tpu",
+             "farm": {"agg_sps": 1.0}}
+
+    def row(cand, lg=base):
+        _ok, checks = bench.run_gate(cand, lg)
+        return [c for c in checks if c["check"] == "farm_sps"][0]
+
+    assert row(good)["status"] == "ok"
+    assert row(bad)["status"] == "regression"
+    r = row(fake)
+    assert r["status"] == "regression" and "rebuild" in r["reason"]
+    assert row(other)["status"] == "skipped"
+    assert row({"iters": 10, "value": 1.0,
+                "device_platform": "cpu"})["status"] == "skipped"
+    # neither side carries the metric: no check row at all
+    _ok, checks = bench.run_gate({"iters": 10, "value": 1.0},
+                                 {"iters": 10, "value": 1.0})
+    assert not [c for c in checks if c["check"] == "farm_sps"]
+
+
+@pytest.mark.serial
+def test_cli_farm_smoke(tmp_path):
+    """`python -m amgcl_tpu.cli --farm 3` end to end: >= 3 tenants with
+    distinct operators under a byte budget forcing >= 1 eviction and
+    readmission, converged per-tenant reports, rebuild-path
+    readmission asserted via the registry counters (the CLI exits
+    nonzero otherwise), farm events in the telemetry sink."""
+    out = tmp_path / "farm_cli.jsonl"
+    env = dict(os.environ,
+               JAX_PLATFORMS="cpu",
+               XLA_FLAGS=(os.environ.get("XLA_FLAGS", "")
+                          + " --xla_force_host_platform_device_count=8")
+               .strip())
+    r = subprocess.run(
+        [sys.executable, "-m", "amgcl_tpu.cli", "-n", "6", "--farm",
+         "3", "--farm-requests", "2", "-p", "solver.type=cg",
+         "--telemetry", str(out)],
+        capture_output=True, text=True, timeout=600, cwd=_REPO, env=env)
+    assert r.returncode == 0, r.stderr[-2000:] + r.stdout[-2000:]
+    assert "farm: 3 tenant(s) x 2 round(s)" in r.stdout
+    assert "registry:" in r.stdout and "eviction(s)" in r.stdout
+    assert "acceptance: OK" in r.stdout
+    recs = [json.loads(ln) for ln in open(out)]
+    events = {x.get("event") for x in recs}
+    assert {"farm_register", "farm_evict", "farm",
+            "farm_demo"} <= events
+    demo = [x for x in recs if x.get("event") == "farm_demo"][0]
+    assert demo["ok"] and demo["evictions"] >= 1 \
+        and demo["readmissions"] >= 1
