@@ -20,7 +20,10 @@ multiplexes N tenants over one device out of four pieces:
   least-recently-dispatched operator first
   (``SolverService.release_device()`` — bucket executables, donated
   buffers, device operators and the hierarchy all dropped; host CSR +
-  plans kept), so readmission is a rebuild, not a setup.
+  plans kept), so readmission is a rebuild, not a setup. Readmission
+  pre-evicts to the operator's last charged footprint before
+  re-materializing, and victim selection skips (waits out) operators
+  pinned by an in-flight batch.
 * **cross-tenant batch packing** — each operator keeps ONE unstarted
   ``SolverService`` whose ``_run_batch`` the farm's single dispatch
   thread drives directly: requests from every tenant sharing an
@@ -62,11 +65,18 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from amgcl_tpu.serve.registry import (OperatorRegistry, RegistryEntry,
+                                      sparsity_fingerprint,
                                       stable_config_key)
 from amgcl_tpu.serve.service import (SolverService, _Request, _env_float,
                                      _env_int, _sink_attached)
 from amgcl_tpu.telemetry.live import (LiveRegistry, MetricsServer,
                                       metrics_port_from_env)
+
+
+class _NeedsBuild(Exception):
+    """Internal sentinel: the registry took the MISS path but the full
+    symbolic setup has not been paid yet — register() catches it,
+    builds OUTSIDE the farm locks, and retries the acquire."""
 
 
 class _FarmRequest(_Request):
@@ -163,11 +173,30 @@ class SolverFarm:
             else port
         self.metrics_server: Optional[MetricsServer] = None
         self._cond = threading.Condition()
-        #: guards the pool + residency transitions AND is held across a
-        #: whole dispatch (ensure-resident -> _run_batch) so an evict
-        #: from register()/evict() can never release the device buffers
-        #: a batch is executing against
+        #: guards the pool + residency transitions and the pin table.
+        #: Solves do NOT run under it: the dispatch loop pins the
+        #: entry (refcount below) under the lock, releases it, and runs
+        #: the batch — eviction, ``set_max_bytes`` and the registry's
+        #: rebuild path all skip pinned entries, so register()/evict()/
+        #: stats()/the scrape server never serialize behind a solve
+        #: and can still never release or mutate the device buffers a
+        #: batch is executing against
         self._mem_lock = threading.RLock()
+        #: signalled on every unpin — admission waiting on a victim
+        #: that is mid-batch blocks here instead of failing
+        self._mem_cond = threading.Condition(self._mem_lock)
+        #: uid -> in-flight batch count (mutated under _mem_lock)
+        self._pins: Dict[str, int] = {}
+        #: uid -> in-progress admission count: an entry whose charge/
+        #: readmit is mid-flight (its waits drop _mem_lock) must not
+        #: be picked as an eviction victim by a concurrent admission —
+        #: it would be installed pool-resident but device-released,
+        #: and the dispatch fast path would never repair it
+        self._admitting: Dict[str, int] = {}
+        #: uid -> bytes at last charge: the pre-eviction estimate that
+        #: lets readmission make room BEFORE re-materializing, so a
+        #: tight budget is not transiently overshot by victim + new
+        self._bytes_hint: Dict[str, int] = {}
         self._rid = itertools.count(1)
         self._rr = 0                  # fair-share rotation cursor
         self._thread: Optional[threading.Thread] = None
@@ -212,53 +241,137 @@ class SolverFarm:
 
         if self._closed:            # early, re-checked under the lock
             raise RuntimeError("SolverFarm is closed")
-        prev = self.tenants.get(tenant)
-        if prev is not None:
-            # re-registration replaces the tenant's operator: drop its
-            # ownership first so its own (now sole-owned) entry is
-            # exactly the rebuild target the registry looks for
-            self.registry.release(tenant)
-        build_fn = build
-        if self.registry.probe(tenant, A, config_key=cfg_key) == "miss":
-            # the MISS path pays the full symbolic setup — run it
-            # OUTSIDE the dispatch lock (the fresh bundle is private
-            # until acquire publishes it), so a large registration does
-            # not stall every other tenant's in-flight traffic. The
-            # probe is advisory: a racing registration may flip the
-            # outcome, in which case the prebuild is discarded (wasted
-            # work, never a stall or a wrong entry).
+        rebuild_ok = self._rebuild_guard(tenant)
+        prebuilt = None
+
+        def build_fn(Ah):
+            # acquire calls this only on a MISS; the first attempt
+            # raises, register() pays the full symbolic setup OUTSIDE
+            # the farm and registry locks, then retries the acquire
+            # with the bundle in hand — so a large registration never
+            # stalls other tenants' dispatch, and (unlike an advisory
+            # probe) a racing registration can never flip the outcome
+            # into an under-lock build
+            if prebuilt is None:
+                raise _NeedsBuild
+            return prebuilt
+
+        while True:
+            with self._mem_lock:
+                if self._closed:
+                    raise RuntimeError("SolverFarm is closed")
+                # a time-stepped re-register keeps the rebuild fast
+                # path even while its own batch is in flight: wait out
+                # the pin (bounded by one batch, like evict()) rather
+                # than let the guard veto it into a fresh setup
+                self._await_rebuild_target_unpinned_locked(tenant, A,
+                                                           cfg_key)
+                # snapshot the would-be rebuild target's CURRENT host
+                # matrix: acquire's rebuild mutates the entry in
+                # place, and a failed admission must revert it or the
+                # tenant would silently keep serving the NEW operator
+                # after a register() that reported failure
+                revert_csr = None
+                with self._cond:
+                    trow = self.tenants.get(tenant)
+                if trow is not None \
+                        and trow.entry.fingerprint \
+                        == sparsity_fingerprint(A) \
+                        and trow.entry.config_key == cfg_key:
+                    host = getattr(trow.entry.obj, "A_host", None)
+                    if host is not None:
+                        # rebuild the snapshot from the ENTRY's value
+                        # copy, never from the host matrix's .val: in
+                        # the supported in-place-mutation idiom the
+                        # host matrix IS the caller's object and
+                        # already carries the new values (a revert
+                        # from it would be a no-op)
+                        revert_csr = CSR(host.ptr, host.col,
+                                         trow.entry.A_val, host.ncols)
+                    if trow.entry.uid not in self.pool.resident():
+                        # a rebuild of an EVICTED entry re-materializes
+                        # the hierarchy inside acquire: make room to
+                        # its last footprint FIRST, like the dispatch
+                        # readmission path, so the budget peak is not
+                        # victims-plus-new at once
+                        self._make_room_locked(
+                            self._bytes_hint.get(trow.entry.uid, 0),
+                            exclude=(trow.entry.uid,))
+                # NOTE the tenant's previous ownership is NOT released
+                # before acquiring: the rebuild path already accepts
+                # the sole owner re-registering (owners <= {tenant}),
+                # and releasing early would leave the old entry
+                # ownerless — a window where a concurrent same-pattern
+                # register() could take the rebuild path and mutate a
+                # hierarchy this tenant's queued requests still
+                # dispatch against. release(keep=) runs only after the
+                # new entry is installed, atomically under _mem_lock.
+                try:
+                    entry, outcome = self.registry.acquire(
+                        tenant, A, build_fn, config_key=cfg_key,
+                        rebuild_ok=rebuild_ok)
+                except _NeedsBuild:
+                    pass             # build below, outside the locks
+                else:
+                    return self._install_tenant_locked(
+                        tenant, entry, outcome, slo, slo_window,
+                        queue_max, revert_csr)
+            # the MISS path pays the full symbolic setup here, outside
+            # the locks (the fresh bundle is private until the retried
+            # acquire publishes it). The build materializes device
+            # buffers before admission can evict — a first-time
+            # operator's footprint is unknowable until built, so that
+            # transient overshoot is accepted; READMISSION pre-evicts
+            # to the last charged footprint instead (_readmit_locked).
             prebuilt = build(A)
-            build_fn = lambda Ah: prebuilt    # noqa: E731
-        with self._mem_lock:
-            if self._closed:
-                raise RuntimeError("SolverFarm is closed")
-            entry, outcome = self.registry.acquire(tenant, A, build_fn,
-                                                   config_key=cfg_key)
-            if "service" not in entry.payload:
-                # per-operator resident program: the farm drives
-                # _run_batch directly from its own dispatch thread, so
-                # the service is never start()ed (no second worker, no
-                # second queue); its own watchdog is neutered — the
-                # farm's per-tenant windows are the only trip source
-                entry.payload["service"] = SolverService(
-                    entry.obj, batch=self.batch,
-                    flush_ms=self.flush_s * 1e3,
-                    timeout_s=self.timeout_s, metrics_port=-9,
-                    slo_p99_ms=0.0, slo_timeout_rate=1.0,
-                    slo_unhealthy_rate=1.0)
+
+    def _install_tenant_locked(self, tenant: str, entry: RegistryEntry,
+                               outcome: str,
+                               slo: Optional[Dict[str, float]],
+                               slo_window: Optional[int],
+                               queue_max: Optional[int],
+                               revert_csr=None) -> Dict[str, Any]:
+        """The under-lock tail of :meth:`register`: admit the acquired
+        entry against the byte budget, install the tenant row, release
+        the previous entry's ownership, and publish counters/gauges."""
+        if "service" not in entry.payload:
+            # per-operator resident program: the farm drives
+            # _run_batch directly from its own dispatch thread, so
+            # the service is never start()ed (no second worker, no
+            # second queue); its own watchdog is neutered — the
+            # farm's per-tenant windows are the only trip source
+            entry.payload["service"] = SolverService(
+                entry.obj, batch=self.batch,
+                flush_ms=self.flush_s * 1e3,
+                timeout_s=self.timeout_s, metrics_port=-9,
+                slo_p99_ms=0.0, slo_timeout_rate=1.0,
+                slo_unhealthy_rate=1.0)
+        try:
             if entry.obj.A_dev is None:
                 # acquired an evicted cache entry ("hit" on bit-equal
-                # values): readmit before charging
-                entry.payload["service"].readmit()
-                self.registry.note_rebuild(entry)
-                self._n_readmissions += 1
-                self.live.inc("farm_readmissions_total")
-            self._charge_locked(entry)
-            merged_slo = dict(self.slo_defaults, **(slo or {}))
-            t = _Tenant(tenant, entry, queue_max or self.queue_max,
-                        merged_slo,
-                        slo_window or self.slo_window)
-            stranded: List[_FarmRequest] = []
+                # values): readmit (pre-evicting to its last footprint)
+                self._readmit_locked(entry)
+            else:
+                self._charge_locked(entry)
+            if self._closed:
+                # the admission waits above drop _mem_lock: close()
+                # may have completed meanwhile — do not install a
+                # tenant row (and charged device state with no
+                # lifecycle left to release it) on a closed farm
+                raise RuntimeError("SolverFarm is closed")
+        except Exception:
+            self._rollback_admission_locked(tenant, entry, outcome,
+                                            revert_csr)
+            raise
+        merged_slo = dict(self.slo_defaults, **(slo or {}))
+        t = _Tenant(tenant, entry, queue_max or self.queue_max,
+                    merged_slo,
+                    slo_window or self.slo_window)
+        t.outcome = outcome
+        stranded: List[_FarmRequest] = []
+        old_n = new_n = entry.payload["service"].n
+        with self._cond:
+            prev = self.tenants.get(tenant)
             if prev is not None:
                 t.n_requests = prev.n_requests
                 t.n_timeouts = prev.n_timeouts
@@ -266,56 +379,73 @@ class SolverFarm:
                 t.slo_trips = prev.slo_trips
                 t.lat = prev.lat
                 old_n = prev.entry.payload["service"].n
-                new_n = entry.payload["service"].n
                 if old_n == new_n:
-                    # queued work carries over — rhs sizes still match
+                    # queued work carries over — rhs sizes match
                     t.q = prev.q
                 else:
-                    # queued rhs were validated against the OLD size;
-                    # packing them into the new operator's bucket would
-                    # poison a whole batch — fail them instead (below,
-                    # outside the queue lock)
-                    with self._cond:
-                        while prev.q:
-                            stranded.append(prev.q.popleft())
-            t.outcome = outcome
-            with self._cond:
-                self.tenants[tenant] = t
-                self._cond.notify_all()
-            for req in stranded:
-                if not req.public.done():
-                    req.public.set_exception(RuntimeError(
-                        "tenant %r re-registered with a different "
-                        "system size (%d -> %d) while this request "
-                        "was queued" % (tenant, old_n, new_n)))
-            if outcome == "hit":
-                self.live.inc("farm_registry_hits_total")
-            elif outcome == "miss":
-                self.live.inc("farm_registry_misses_total")
-            else:
-                self.live.inc("farm_registry_rebuilds_total")
-            self.live.set_gauge("farm_tenants", len(self.tenants))
-            self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
-                                tenant=tenant)
-            # _charge_locked ran before this tenant joined the table —
-            # seed its residency gauges now that it is addressable
-            self.live.set_gauge(
-                "farm_tenant_resident",
-                1.0 if entry.uid in self.pool.resident() else 0.0,
-                tenant=tenant)
-            self.live.set_gauge(
-                "farm_tenant_bytes",
-                self.pool.resident().get(entry.uid, 0), tenant=tenant)
-            out = {"tenant": tenant, "outcome": outcome,
-                   "fingerprint": entry.fingerprint, "uid": entry.uid,
-                   "bytes": self.pool.resident().get(entry.uid, 0),
-                   "setup_s": round(entry.setup_s, 4)}
-            if entry.rebuild_s is not None:
-                out["rebuild_s"] = round(entry.rebuild_s, 4)
-            if _sink_attached():
-                from amgcl_tpu import telemetry
-                telemetry.emit(event="farm_register", **out)
-            return out
+                    # queued rhs were validated against the OLD
+                    # size; packing them into the new operator's
+                    # bucket would poison a whole batch — fail
+                    # them instead (below, outside the queue lock)
+                    while prev.q:
+                        stranded.append(prev.q.popleft())
+            self.tenants[tenant] = t
+            self._cond.notify_all()
+        # only NOW drop the tenant's ownership of any previous
+        # entry: release + acquire are one atomic step under
+        # _mem_lock, so no concurrent register() ever sees the old
+        # entry ownerless while this tenant was still live on it
+        self.registry.release(tenant, keep=entry)
+        # sweep state for entries the registry no longer holds (a
+        # max_orphans registry prunes on release): drop their
+        # footprint hints AND their pool charges — a pruned orphan's
+        # device buffers are freed by GC with the entry, and a charge
+        # left behind would overstate pool.used forever (its uid can
+        # never be evicted by name again)
+        live_uids = {e.uid for e in self.registry.entries()}
+        swept = False
+        for uid in list(self._bytes_hint):
+            if uid not in live_uids:
+                self._bytes_hint.pop(uid, None)
+                swept = self.pool.release(uid) > 0 or swept
+        if swept:
+            self.live.set_gauge("farm_hbm_used_bytes", self.pool.used)
+            self.live.set_gauge("farm_resident_operators",
+                                len(self.pool.resident()))
+        for req in stranded:
+            if not req.public.done():
+                req.public.set_exception(RuntimeError(
+                    "tenant %r re-registered with a different "
+                    "system size (%d -> %d) while this request "
+                    "was queued" % (tenant, old_n, new_n)))
+        if outcome == "hit":
+            self.live.inc("farm_registry_hits_total")
+        elif outcome == "miss":
+            self.live.inc("farm_registry_misses_total")
+        else:
+            self.live.inc("farm_registry_rebuilds_total")
+        self.live.set_gauge("farm_tenants", len(self.tenants))
+        self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
+                            tenant=tenant)
+        # _charge_locked ran before this tenant joined the table —
+        # seed its residency gauges now that it is addressable
+        self.live.set_gauge(
+            "farm_tenant_resident",
+            1.0 if entry.uid in self.pool.resident() else 0.0,
+            tenant=tenant)
+        self.live.set_gauge(
+            "farm_tenant_bytes",
+            self.pool.resident().get(entry.uid, 0), tenant=tenant)
+        out = {"tenant": tenant, "outcome": outcome,
+               "fingerprint": entry.fingerprint, "uid": entry.uid,
+               "bytes": self.pool.resident().get(entry.uid, 0),
+               "setup_s": round(entry.setup_s, 4)}
+        if entry.rebuild_s is not None:
+            out["rebuild_s"] = round(entry.rebuild_s, 4)
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            telemetry.emit(event="farm_register", **out)
+        return out
 
     # -- admission / eviction ------------------------------------------------
 
@@ -324,19 +454,253 @@ class SolverFarm:
         fn = getattr(amg, "bytes", None)
         return int(fn()) if callable(fn) else 0
 
+    def _await_rebuild_target_unpinned_locked(self, tenant: str, A,
+                                              cfg_key: str) -> None:
+        """Wait (under _mem_lock) until the tenant's CURRENT entry is
+        unpinned — but only when the coming acquire would actually
+        REBUILD it (same pattern + config, different values): the
+        rebuild guard vetoes pinned entries, and a time-stepped
+        re-register should pay one batch's wait for the numeric
+        fast path, not a whole fresh setup. A bit-identical "hit"
+        (read-only share) or a different-pattern "miss" needs no
+        unpin, so those registrations are not stalled behind the
+        in-flight batch. Re-resolves the entry after every wait."""
+        fp = sparsity_fingerprint(A)
+        while True:
+            if self._closed:
+                raise RuntimeError("SolverFarm is closed")
+            with self._cond:
+                t = self.tenants.get(tenant)
+                entry = t.entry if t is not None else None
+            if entry is None or entry.uid not in self._pins \
+                    or entry.fingerprint != fp \
+                    or entry.config_key != cfg_key \
+                    or not entry.owners <= {tenant} \
+                    or np.array_equal(entry.A_val, np.asarray(A.val)):
+                # no wait when the acquire cannot rebuild this entry
+                # anyway: a bit-equal hit shares pinned entries
+                # read-only, and a co-owned entry is a deliberate miss
+                # regardless of the pin
+                return
+            self._mem_cond.wait(timeout=0.5)
+
+    def _rebuild_guard(self, tenant: str):
+        """The ``rebuild_ok`` predicate for this tenant's registry
+        calls: vetoes rebuilding an entry that an in-flight batch is
+        pinned on (the solve runs outside _mem_lock — mutating its
+        hierarchy mid-batch would corrupt the results) or that another
+        live _Tenant still references (possible without registry
+        ownership after a failed re-registration left the table
+        pointing at a released entry)."""
+        def ok(entry: RegistryEntry) -> bool:
+            if entry.uid in self._pins:
+                return False
+            with self._cond:
+                return not any(t.entry is entry and name != tenant
+                               for name, t in self.tenants.items())
+        return ok
+
+    def _evict_coldest_locked(self, exclude=()) -> bool:
+        """One step of the evict-or-wait protocol shared by admission,
+        pre-eviction and resize: evict the coldest victim outside
+        ``exclude`` that is neither pinned nor mid-admission and
+        return True; when only pinned victims remain, wait for the
+        dispatch thread's unpin (it signals _mem_cond) and return True
+        so the caller retries; return False when nothing is evictable.
+        (Mid-admission victims are skipped but NOT waited on — two
+        concurrent tight admissions then fail with the budget error
+        rather than livelock waiting on each other.)"""
+        victim = self.pool.coldest(
+            exclude=tuple(exclude) + tuple(self._pins)
+            + tuple(self._admitting))
+        if victim is not None:
+            self._evict_uid_locked(victim)
+            return True
+        if self._pins:
+            self._mem_cond.wait(timeout=0.5)
+            return True
+        return False
+
+    def _admit_begin_locked(self, uid: str) -> None:
+        self._admitting[uid] = self._admitting.get(uid, 0) + 1
+
+    def _admit_end_locked(self, uid: str) -> None:
+        left = self._admitting.get(uid, 1) - 1
+        if left > 0:
+            self._admitting[uid] = left
+        else:
+            self._admitting.pop(uid, None)
+        self._mem_cond.notify_all()
+
     def _charge_locked(self, entry: RegistryEntry) -> None:
         nbytes = self._entry_bytes(entry)
-        while not self.pool.charge(entry.uid, nbytes):
-            victim = self.pool.coldest(exclude=(entry.uid,))
-            if victim is None:
-                raise RuntimeError(
-                    "operator %s needs %d bytes but the farm budget is "
-                    "%d and nothing else is evictable — raise "
-                    "AMGCL_TPU_FARM_MAX_BYTES" %
-                    (entry.uid, nbytes, self.pool.total))
-            self._evict_uid_locked(victim)
+        self._bytes_hint[entry.uid] = nbytes
+        self._admit_begin_locked(entry.uid)
+        try:
+            while not self.pool.charge(entry.uid, nbytes):
+                if not self._evict_coldest_locked(
+                        exclude=(entry.uid,)):
+                    raise RuntimeError(
+                        "operator %s needs %d bytes but the farm "
+                        "budget is %d and nothing else is evictable "
+                        "— raise AMGCL_TPU_FARM_MAX_BYTES" %
+                        (entry.uid, nbytes, self.pool.total))
+        finally:
+            self._admit_end_locked(entry.uid)
         self._residency_gauges_locked(entry, resident=True,
                                       nbytes=nbytes)
+
+    def _make_room_locked(self, need: int, exclude=()) -> None:
+        """Evict coldest victims until ``need`` bytes fit — BEFORE the
+        caller materializes them, so a tight budget's peak is never
+        old-victims-plus-new at once. Best effort: if nothing
+        (unpinned) is evictable the caller's charge loop decides."""
+        if self.pool.unlimited or need <= 0:
+            return
+        while self.pool.used + need > self.pool.total:
+            if not self._evict_coldest_locked(exclude=exclude):
+                return
+
+    def _rollback_admission_locked(self, tenant: str,
+                                   entry: RegistryEntry,
+                                   outcome: str,
+                                   revert_csr=None) -> None:
+        """Undo a register() whose admission step failed (or that lost
+        a race with close()): if acquire REBUILT the tenant's live
+        entry in place, revert it to the snapshotted pre-register
+        matrix (the caller was told registration failed — the tenant
+        must not silently keep serving the new operator); otherwise
+        drop the would-be phantom ownership — acquired but mirrored by
+        no tenant row, it would keep the entry unprunable and
+        unrebuildable forever — and, when nothing else references the
+        entry at all, return its charge and device buffers to the
+        pool. Never raises (rollback must not mask the original
+        error)."""
+        try:
+            with self._cond:
+                row = self.tenants.get(tenant)
+            if row is not None and row.entry is entry:
+                if outcome == "rebuild" and revert_csr is not None:
+                    while entry.uid in self._pins:
+                        # never mutate under an in-flight batch
+                        self._mem_cond.wait(timeout=0.5)
+                    try:
+                        entry.obj.rebuild(revert_csr)
+                        entry.A_val = np.array(revert_csr.val,
+                                               copy=True)
+                    except Exception:   # noqa: BLE001
+                        # the revert itself failed (likely OOM on the
+                        # same pressured device): the hierarchy's
+                        # values are indeterminate — strand the tenant
+                        # rather than let it silently serve them
+                        self._strand_tenant_locked(tenant, entry)
+                        raise
+                if entry.uid not in self.pool.resident() \
+                        and getattr(entry.obj, "A_dev", None) \
+                        is not None:
+                    # the failed admission left materialized device
+                    # state the pool has no room for — a hit's
+                    # readmit, or the revert above re-materializing an
+                    # evicted entry: drop it again (host state keeps
+                    # the right values; the next dispatch readmits via
+                    # the normal rebuild path). Non-resident implies
+                    # unpinned: pins only exist on charged entries.
+                    svc = entry.payload.get("service")
+                    if svc is not None:
+                        svc.release_device()
+                return
+            self.registry.disown(tenant, entry)
+            with self._cond:
+                referenced = any(t.entry is entry
+                                 for t in self.tenants.values())
+            if entry.owners or referenced or entry.uid in self._pins:
+                return            # shared: leave its residency alone
+            self.pool.release(entry.uid)
+            svc = entry.payload.get("service")
+            if svc is not None:
+                svc.release_device()
+            self._residency_gauges_locked(entry, resident=False,
+                                          nbytes=0)
+        except Exception:          # noqa: BLE001
+            import traceback
+            traceback.print_exc()
+
+    def _strand_tenant_locked(self, tenant: str,
+                              entry: RegistryEntry) -> None:
+        """Last-resort teardown when a rollback could not restore a
+        coherent operator: remove the tenant row (submits raise
+        KeyError until an explicit re-register), fail its queued
+        requests, and drop the entry's ownership, charge and device
+        buffers. The entry's value snapshot is poisoned so a future
+        bit-equal registration can never \"hit\" the broken hierarchy
+        (it remains a legal rebuild target — a rebuild recomputes
+        every value)."""
+        stranded: List[_FarmRequest] = []
+        with self._cond:
+            row = self.tenants.get(tenant)
+            if row is not None and row.entry is entry:
+                del self.tenants[tenant]
+                while row.q:
+                    stranded.append(row.q.popleft())
+            self._cond.notify_all()
+        for req in stranded:
+            if not req.public.done():
+                req.public.set_exception(RuntimeError(
+                    "tenant %r was stranded by a failed registration "
+                    "rollback — re-register it" % (tenant,)))
+        self.registry.disown(tenant, entry)
+        entry.A_val = np.empty(0)      # never value-matches again
+        self.pool.release(entry.uid)
+        svc = entry.payload.get("service")
+        try:
+            if svc is not None:
+                svc.release_device()
+        except Exception:              # noqa: BLE001 — best effort on
+            pass                       # an already-failing device
+        self._residency_gauges_locked(entry, resident=False, nbytes=0)
+        self.live.set_gauge("farm_tenants", len(self.tenants))
+
+    def _readmit_locked(self, entry: RegistryEntry) -> None:
+        """Re-materialize an evicted entry: make room first (sized by
+        its last charged footprint), numeric rebuild on cached plans —
+        the registry counters record it as a rebuild, never a setup —
+        then charge the actual bytes."""
+        self._admit_begin_locked(entry.uid)
+        try:
+            self._readmit_admitting_locked(entry)
+        finally:
+            self._admit_end_locked(entry.uid)
+
+    def _readmit_admitting_locked(self, entry: RegistryEntry) -> None:
+        self._make_room_locked(self._bytes_hint.get(entry.uid, 0),
+                               exclude=(entry.uid,))
+        if entry.uid in self.pool.resident():
+            # _make_room_locked's pin-waits drop _mem_lock: a dispatch
+            # readmission may have beaten us here and already be
+            # mid-batch on the entry — rebuilding its device state
+            # under that batch is exactly what the pins forbid
+            self.pool.touch(entry.uid)
+            return
+        t0 = time.perf_counter()
+        entry.payload["service"].readmit()
+        self.registry.note_rebuild(entry, time.perf_counter() - t0)
+        self._n_readmissions += 1
+        self.live.inc("farm_readmissions_total")
+        try:
+            self._charge_locked(entry)
+        except Exception:
+            # admission failed AFTER materializing: drop the uncharged
+            # device state (host plans keep the values; the next
+            # attempt rebuilds) instead of holding over-budget HBM
+            # that the pool cannot see — this covers the dispatch
+            # path, where no register() rollback runs
+            svc = entry.payload.get("service")
+            try:
+                if svc is not None:
+                    svc.release_device()
+            except Exception:          # noqa: BLE001 — cleanup must
+                pass                   # not mask the admission error
+            raise
 
     def _entry_by_uid(self, uid: str) -> Optional[RegistryEntry]:
         for e in self.registry.entries():
@@ -372,7 +736,9 @@ class SolverFarm:
                             0 if self.pool.unlimited else self.pool.total)
         self.live.set_gauge("farm_resident_operators",
                             len(self.pool.resident()))
-        for name, t in list(self.tenants.items()):
+        with self._cond:
+            tenants = list(self.tenants.items())
+        for name, t in tenants:
             if t.entry is entry:
                 self.live.set_gauge("farm_tenant_resident",
                                     1.0 if resident else 0.0,
@@ -386,40 +752,47 @@ class SolverFarm:
         if entry.uid in self.pool.resident():
             self.pool.touch(entry.uid)
             return svc
-        t0 = time.perf_counter()
-        svc.readmit()          # numeric rebuild on cached plans — the
-        #                        registry counters record it as a
-        #                        rebuild, never a setup
-        self.registry.note_rebuild(entry, time.perf_counter() - t0)
-        self._n_readmissions += 1
-        self.live.inc("farm_readmissions_total")
-        self._charge_locked(entry)
+        self._readmit_locked(entry)
         return svc
 
     def evict(self, tenant: str) -> bool:
         """Explicitly evict ``tenant``'s operator (drops the device
         buffers of every tenant sharing it; host CSR + plans stay —
-        the next dispatch readmits via rebuild). False when it was not
+        the next dispatch readmits via rebuild). Waits out any batch
+        currently pinned on the operator. False when it was not
         resident."""
-        t = self.tenants[tenant]
+        self.tenants[tenant]          # KeyError: unknown tenant
         with self._mem_lock:
-            if t.entry.uid not in self.pool.resident():
+            while True:
+                # re-resolve after every wait: a concurrent
+                # re-register may have moved the tenant onto a new
+                # entry, and evicting the captured OLD uid would
+                # miss the operator actually serving the tenant
+                with self._cond:
+                    t = self.tenants.get(tenant)
+                if t is None:
+                    raise KeyError(tenant)
+                uid = t.entry.uid
+                if uid not in self._pins \
+                        and uid not in self._admitting:
+                    break
+                self._mem_cond.wait(timeout=0.5)
+            if uid not in self.pool.resident():
                 return False
-            self._evict_uid_locked(t.entry.uid)
+            self._evict_uid_locked(uid)
             return True
 
     def set_max_bytes(self, max_bytes: int) -> None:
         """Re-arm the byte budget in place (the CLI/bench demos size
         the cap from the tenants actually built), evicting coldest
-        operators until the resident set fits."""
+        operators until the resident set fits (waiting out pinned
+        in-flight batches rather than evicting under them)."""
         with self._mem_lock:
             self.pool.resize(max_bytes)
             while not self.pool.unlimited \
                     and self.pool.used > self.pool.total:
-                victim = self.pool.coldest()
-                if victim is None:
+                if not self._evict_coldest_locked():
                     break
-                self._evict_uid_locked(victim)
             self.live.set_gauge(
                 "farm_hbm_total_bytes",
                 0 if self.pool.unlimited else self.pool.total)
@@ -496,9 +869,24 @@ class SolverFarm:
                            tenant=tenant)
         deadline = time.monotonic() + max(timeout, 0.0)
         with self._cond:
-            if self._closed:
-                raise RuntimeError("SolverFarm is closed")
-            while len(t.q) >= t.queue_max:
+            while True:
+                if self._closed:
+                    raise RuntimeError("SolverFarm is closed")
+                # re-resolve the tenant UNDER the lock (and again after
+                # every wait): a concurrent re-register installs a
+                # fresh _Tenant, and appending to the replaced one's
+                # abandoned deque would strand this request forever
+                cur = self.tenants.get(tenant)
+                if cur is None:
+                    raise KeyError(tenant)
+                if cur.entry.payload["service"].n != n:
+                    raise RuntimeError(
+                        "tenant %r re-registered with a different "
+                        "system size while this submit was in "
+                        "progress" % (tenant,))
+                t = cur
+                if len(t.q) < t.queue_max:
+                    break
                 if not block:
                     raise _queue.Full(
                         "tenant %r queue is full (%d)"
@@ -509,8 +897,6 @@ class SolverFarm:
                         "tenant %r queue stayed full for %.1fs"
                         % (tenant, timeout))
                 self._cond.wait(timeout=left)
-                if self._closed:
-                    raise RuntimeError("SolverFarm is closed")
             t.q.append(req)
             self._cond.notify_all()
         self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
@@ -584,20 +970,70 @@ class SolverFarm:
                 self._cond.wait(timeout=min(left, 0.02))
             return batch, entry
 
+    def _validate_batch_locked(self, batch: List[_FarmRequest],
+                               entry: RegistryEntry
+                               ) -> List[_FarmRequest]:
+        """Fail requests whose tenant was re-registered onto a
+        DIFFERENT entry between the queue pop and this dispatch: the
+        old entry may since have been released to the registry (an
+        ownerless entry is a legal rebuild target for the next
+        same-pattern registrant), so solving against it could read
+        another registration's values. Failing the narrow race beats a
+        silently wrong solve. The failure lands on the INNER future —
+        the displaced request stays in the accounting batch, so
+        per-tenant counters/windows/metrics book it like every other
+        failed request — and only the returned still-live sublist goes
+        to the solve."""
+        with self._cond:
+            current = {name: t.entry
+                       for name, t in self.tenants.items()}
+        live = []
+        for req in batch:
+            if current.get(req.tenant) is entry:
+                live.append(req)
+            elif not req.future.done():
+                req.future.set_exception(RuntimeError(
+                    "tenant %r re-registered with a different "
+                    "operator while request %d was in flight"
+                    % (req.tenant, req.rid)))
+        return live
+
     def _loop(self):
         while True:
             batch, entry = self._next_batch()
             if batch is None:
                 return
+            svc = None
+            live: List[_FarmRequest] = []
             try:
                 with self._mem_lock:
-                    svc = self._ensure_resident_locked(entry)
-                    svc._run_batch(batch)
+                    live = self._validate_batch_locked(batch, entry)
+                    if live:
+                        svc = self._ensure_resident_locked(entry)
+                        # pin, then solve OUTSIDE _mem_lock: eviction,
+                        # set_max_bytes and the registry rebuild path
+                        # all skip pinned entries, so control-plane
+                        # calls never serialize behind this batch
+                        self._pins[entry.uid] = \
+                            self._pins.get(entry.uid, 0) + 1
+                if svc is not None:
+                    try:
+                        svc._run_batch(live)
+                    finally:
+                        with self._mem_lock:
+                            left = self._pins.get(entry.uid, 1) - 1
+                            if left > 0:
+                                self._pins[entry.uid] = left
+                            else:
+                                self._pins.pop(entry.uid, None)
+                            self._mem_cond.notify_all()
             except Exception as e:     # noqa: BLE001 — a failed batch
                 for req in batch:      # fails ITS futures, not the farm
                     if not req.future.done():
                         req.future.set_exception(e)
             try:
+                # the FULL batch: displaced requests carry their inner
+                # exception into the per-tenant books + public futures
                 self._account(batch)
             except Exception:          # noqa: BLE001 — accounting must
                 import traceback       # never kill the dispatch loop,

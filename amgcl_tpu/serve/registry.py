@@ -19,7 +19,10 @@ acceptance asserts against):
   live under any OTHER owner (the registering owner refreshing its own
   time-stepped operator, or an orphaned cache entry): refresh it in
   place via the object's ``rebuild()`` — numeric Galerkin on cached
-  plans, bit-identical to a fresh build.
+  plans, bit-identical to a fresh build. Callers whose liveness the
+  ownership tokens cannot see pass a ``rebuild_ok`` guard that vetoes
+  entries per acquire (the farm rejects entries pinned by an in-flight
+  batch or referenced by a live tenant).
 * **miss** — no entry, or every same-pattern entry is another live
   owner's (rebuilding it under them would corrupt their operator):
   fresh build.
@@ -35,6 +38,7 @@ Stdlib + numpy only at module level (the build callables pull in jax).
 from __future__ import annotations
 
 import hashlib
+import itertools
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -110,14 +114,16 @@ class RegistryEntry:
     array it currently carries, the owner tokens sharing it, and the
     build/rebuild cost record the acceptance criteria compare."""
 
-    _seq = 0
+    #: atomic uid sequence — entries are minted under per-REGISTRY
+    #: locks, so two registries (the farm's and pyamgcl_compat's)
+    #: constructing concurrently must not race a bare read-modify-write
+    _seq = itertools.count(1)
 
     def __init__(self, fingerprint: str, config_key: str, obj: Any,
                  A_val, setup_s: float):
-        RegistryEntry._seq += 1
         #: unique pool key (fingerprint alone may collide across
         #: same-pattern different-value entries)
-        self.uid = "%s/%d" % (fingerprint[:12], RegistryEntry._seq)
+        self.uid = "%s/%d" % (fingerprint[:12], next(RegistryEntry._seq))
         self.fingerprint = fingerprint
         self.config_key = config_key
         self.obj = obj
@@ -168,11 +174,18 @@ class OperatorRegistry:
         self.rebuilds = 0
 
     def acquire(self, owner, A, build: Callable[[Any], Any],
-                config_key: str = "") -> Tuple[RegistryEntry, str]:
+                config_key: str = "",
+                rebuild_ok: Optional[Callable[[RegistryEntry], bool]]
+                = None) -> Tuple[RegistryEntry, str]:
         """Resolve ``A`` for ``owner``: returns ``(entry, outcome)``
         with outcome in {"hit", "rebuild", "miss"}. ``build(A)`` runs
         (under the lock — registrations serialize, solves do not) only
-        on a miss."""
+        on a miss. ``rebuild_ok(entry)``, when given, VETOES the
+        rebuild path per entry: the farm passes a guard that rejects
+        entries pinned by an in-flight batch or still referenced by a
+        live tenant other than ``owner`` — ownership tokens alone
+        cannot see either (serve/farm.py), and rebuilding such an
+        entry would mutate a hierarchy someone is solving against."""
         fp = sparsity_fingerprint(A)
         with self._lock:
             bucket = self._buckets.setdefault((fp, config_key), [])
@@ -186,7 +199,8 @@ class OperatorRegistry:
                     e.owners.add(owner)
                     return e, "hit"
             for e in bucket:
-                if e.owners <= {owner}:
+                if e.owners <= {owner} \
+                        and (rebuild_ok is None or rebuild_ok(e)):
                     # same pattern, new values, and nobody ELSE is live
                     # on this entry: the numeric-rebuild fast path
                     t0 = time.perf_counter()
@@ -206,11 +220,16 @@ class OperatorRegistry:
             self.misses += 1
             return e, "miss"
 
-    def probe(self, owner, A, config_key: str = "") -> str:
+    def probe(self, owner, A, config_key: str = "",
+              rebuild_ok: Optional[Callable[[RegistryEntry], bool]]
+              = None) -> str:
         """The outcome :meth:`acquire` WOULD take right now, without
-        building or mutating anything — callers use it to run
-        miss-path builds outside their own locks (serve/farm.py).
-        Advisory: a concurrent acquire can change the answer."""
+        building or mutating anything. Advisory: a concurrent acquire
+        can change the answer — callers who must not build under their
+        own locks should prefer the farm's acquire-retry idiom (a
+        build callable that raises on the first miss) over probing.
+        Pass the same ``rebuild_ok`` guard the later acquire will use,
+        or the prediction diverges on guarded entries."""
         fp = sparsity_fingerprint(A)
         with self._lock:
             bucket = self._buckets.get((fp, config_key), [])
@@ -218,7 +237,8 @@ class OperatorRegistry:
                 if np.array_equal(e.A_val, np.asarray(A.val)):
                     return "hit"
             for e in bucket:
-                if e.owners <= {owner}:
+                if e.owners <= {owner} \
+                        and (rebuild_ok is None or rebuild_ok(e)):
                     return "rebuild"
         return "miss"
 
@@ -234,14 +254,20 @@ class OperatorRegistry:
             if rebuild_s is not None:
                 entry.rebuild_s = float(rebuild_s)
 
-    def release(self, owner) -> None:
-        """Drop ``owner`` from every entry it shares. Entries stay
-        cached (orphans are rebuild targets for returning tenants) up
-        to ``max_orphans``; :meth:`prune` reclaims them all."""
+    def release(self, owner, keep: Optional[RegistryEntry] = None
+                ) -> None:
+        """Drop ``owner`` from every entry it shares — except ``keep``,
+        when given: a re-registering farm tenant releases its PREVIOUS
+        entry only after the new acquire landed, in one call, so the
+        old entry is never ownerless while the tenant's queued work
+        could still dispatch against it. Entries stay cached (orphans
+        are rebuild targets for returning tenants) up to
+        ``max_orphans``; :meth:`prune` reclaims them all."""
         with self._lock:
             for bucket in self._buckets.values():
                 for e in bucket:
-                    e.owners.discard(owner)
+                    if e is not keep:
+                        e.owners.discard(owner)
             if self.max_orphans is not None:
                 orphans = [e for bucket in self._buckets.values()
                            for e in bucket if not e.owners]
@@ -254,12 +280,20 @@ class OperatorRegistry:
                                         e.uid.rsplit("/", 1)[-1]))
                     doomed = {e.uid for e in oldest[:excess]}
                     for key in list(self._buckets):
-                        keep = [e for e in self._buckets[key]
-                                if e.uid not in doomed]
-                        if keep:
-                            self._buckets[key] = keep
+                        survivors = [e for e in self._buckets[key]
+                                     if e.uid not in doomed]
+                        if survivors:
+                            self._buckets[key] = survivors
                         else:
                             del self._buckets[key]
+
+    def disown(self, owner, entry: RegistryEntry) -> None:
+        """Drop ``owner`` from ONE entry — the admission-failure
+        rollback: the caller acquired the entry but cannot keep it
+        (serve/farm.py), and leaving it owned would make it
+        unevictable and unprunable forever."""
+        with self._lock:
+            entry.owners.discard(owner)
 
     def prune(self) -> int:
         """Drop ownerless entries; returns how many were dropped."""

@@ -118,15 +118,27 @@ class LruMemoryPool(DeviceMemoryBudget):
         self._resident: Dict[str, int] = {}
 
     def charge(self, key: str, nbytes: int) -> bool:
-        """Admit ``key`` at ``nbytes`` (re-charging a resident key first
-        releases its old charge — a rebuild may change the footprint).
-        False when it does not fit; the caller evicts ``coldest()`` and
-        retries."""
-        if key in self._resident:
-            self.release(key)
-        if not self.try_charge(int(nbytes), tag=key):
+        """Admit ``key`` at ``nbytes``. Re-charging a resident key
+        swaps its charge ATOMICALLY — on failure the old charge is
+        restored, never dropped: the key's buffers are still live, and
+        a window where a resident operator looks evicted would let the
+        farm's dispatch run a redundant readmission (and understate
+        ``used``) while the caller waits to retry. False when it does
+        not fit; the caller evicts ``coldest()`` and retries. A failed
+        or successful re-charge both move the key to the warm end of
+        the LRU order (it was just touched)."""
+        nbytes = int(nbytes)
+        old = self._resident.pop(key, None)
+        if old is not None:
+            self.used -= old
+        if not self.try_charge(nbytes, tag=key):
+            if old is not None:
+                self.used += old
+                self._resident[key] = old
             return False
-        self._resident[key] = int(nbytes)
+        if old is not None:
+            self.charges.append((key + ":released", -old))
+        self._resident[key] = nbytes
         return True
 
     def release(self, key: str) -> int:

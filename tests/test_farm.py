@@ -11,6 +11,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 import urllib.request
 
 import numpy as np
@@ -174,6 +175,184 @@ def test_registry_orphan_cap_and_probe():
     assert o == "rebuild"
 
 
+def test_registry_release_keep_preserves_new_entry():
+    """release(keep=) drops the owner from every OTHER entry in one
+    atomic call — the re-registration idiom that never leaves the
+    previous entry ownerless while the tenant is still live on it (an
+    ownerless entry is a legal rebuild target for any concurrent
+    same-pattern registrant)."""
+    A, _ = poisson3d(6)
+    B, _ = poisson3d(7)
+    reg = OperatorRegistry()
+    e1, _ = reg.acquire("o", A, _bundle_builder())
+    e2, _ = reg.acquire("o", B, _bundle_builder())
+    assert e1.owners == {"o"} and e2.owners == {"o"}
+    reg.release("o", keep=e2)
+    assert not e1.owners and e2.owners == {"o"}
+
+
+def test_registry_rebuild_ok_guard_vetoes_rebuild():
+    """A rebuild_ok guard turns the rebuild path into a miss (and
+    probe() predicts it) — the hook the farm uses to keep the registry
+    from rebuilding an entry pinned by an in-flight batch or still
+    referenced by a live tenant."""
+    A, _ = poisson3d(6)
+    reg = OperatorRegistry()
+    e1, _ = reg.acquire("o", A, _bundle_builder())
+    reg.release("o")                 # orphan: normally a rebuild target
+    A2 = CSR(A.ptr, A.col, 2.0 * A.val, A.ncols)
+    veto = lambda _e: False          # noqa: E731
+    assert reg.probe("p", A2, rebuild_ok=veto) == "miss"
+    e2, o2 = reg.acquire("p", A2, _bundle_builder(), rebuild_ok=veto)
+    assert o2 == "miss" and e2 is not e1
+    # without the veto the orphan is still the rebuild target
+    A3 = CSR(A.ptr, A.col, 3.0 * A.val, A.ncols)
+    assert reg.probe("q", A3) == "rebuild"
+
+
+def test_registry_uid_mint_is_atomic_across_threads():
+    """Concurrent entry construction (two registries, no shared lock)
+    never mints duplicate uids — the sequence is an atomic counter,
+    not a bare class-attribute read-modify-write."""
+    from amgcl_tpu.serve.registry import RegistryEntry
+    uids = []
+
+    def mint():
+        got = [RegistryEntry("fp", "", object(), np.zeros(1), 0.0).uid
+               for _ in range(200)]
+        uids.extend(got)
+
+    threads = [threading.Thread(target=mint) for _ in range(4)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join(timeout=60)
+    assert len(set(uids)) == len(uids) == 800
+
+
+def test_submit_waiting_on_full_queue_survives_reregister():
+    """A submit() blocked on a full queue re-resolves the tenant after
+    every wait: a size-changing re-registration fails it with a clear
+    error instead of appending to the replaced tenant's abandoned
+    deque (which would hang the caller forever)."""
+    A6, rhs6 = poisson3d(6)
+    A7, rhs7 = poisson3d(7)
+    farm = _farm()
+    try:
+        farm.register("t", A6, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm(), queue_max=1)
+        # park the dispatch loop so the queue stays deterministically
+        # full (instance attribute shadows the method; del restores)
+        farm._pick_tenant_locked = lambda: None
+        f1 = farm.submit("t", rhs6, block=False)
+        errs = []
+
+        def waiter():
+            try:
+                farm.submit("t", rhs6, block=True, timeout_s=120)
+            except RuntimeError as e:   # noqa: BLE001 — asserted below
+                errs.append(e)
+
+        th = threading.Thread(target=waiter)
+        th.start()
+        time.sleep(0.3)                # waiter parked on the full queue
+        farm.register("t", A7, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm(), queue_max=1)
+        th.join(timeout=60)
+        assert not th.is_alive()       # the caller did NOT hang
+        assert errs and "different system size" in str(errs[0])
+        with pytest.raises(RuntimeError):
+            f1.result(timeout=60)      # the queued head was stranded
+        del farm._pick_tenant_locked   # un-park the dispatch loop
+        x, rep = farm.solve("t", rhs7)
+        assert rep.resid < 1e-6
+    finally:
+        farm.close()
+
+
+def test_reregister_waits_out_inflight_pin_keeps_rebuild_path():
+    """Re-registering new values while the tenant's own batch is
+    in flight must WAIT for the unpin and then take the numeric
+    rebuild path — not degrade to a fresh setup (miss) because the
+    pin guard vetoed the entry mid-batch."""
+    A, rhs = poisson3d(6)
+    farm = _farm()
+    release = threading.Event()
+    try:
+        farm.register("t", A, solver=CG(maxiter=40, tol=1e-7),
+                      precond=_prm())
+        e = farm.tenants["t"].entry
+        svc = e.payload["service"]
+        entered = threading.Event()
+        orig = svc._run_batch
+
+        def slow(batch):
+            entered.set()              # the dispatch pin is held now
+            release.wait(timeout=120)
+            return orig(batch)
+
+        svc._run_batch = slow
+        fut = farm.submit("t", rhs)
+        assert entered.wait(timeout=120)
+        A2 = CSR(A.ptr, A.col, 2.0 * A.val, A.ncols)
+        out = {}
+        th = threading.Thread(target=lambda: out.update(
+            farm.register("t", A2, solver=CG(maxiter=40, tol=1e-7),
+                          precond=_prm())))
+        th.start()
+        time.sleep(0.3)
+        assert not out                 # parked on the pin, not missed
+        release.set()
+        th.join(timeout=300)
+        svc._run_batch = orig
+        assert out.get("outcome") == "rebuild", out
+        assert out["uid"] == e.uid     # same entry, refreshed in place
+        fut.result(timeout=300)        # the in-flight batch completed
+        x, rep = farm.solve("t", rhs)
+        assert rep.resid < 1e-6
+    finally:
+        release.set()
+        farm.close()
+
+
+def test_readmission_preevicts_before_materializing():
+    """Readmission makes room FIRST, sized by the entry's last charged
+    footprint: at every readmit() the pool already fits the incoming
+    bytes, so a tight budget's peak is never victims-plus-new at
+    once."""
+    farm = _farm()
+    try:
+        rhs_by = {}
+        for k, m in enumerate((6, 7, 8)):
+            A, rhs = poisson3d(m)
+            farm.register("t%d" % k, A,
+                          solver=CG(maxiter=40, tol=1e-7),
+                          precond=_prm())
+            rhs_by["t%d" % k] = rhs
+        total = farm.stats()["pool"]["used_bytes"]
+        farm.set_max_bytes(int(total * 0.75))
+        overshoots = []
+        for e in farm.registry.entries():
+            svc = e.payload["service"]
+
+            def wrapped(e=e, orig=svc.readmit):
+                hint = farm._bytes_hint.get(e.uid, 0)
+                if farm.pool.used + hint > farm.pool.total:
+                    overshoots.append(
+                        (e.uid, farm.pool.used, hint, farm.pool.total))
+                return orig()
+
+            svc.readmit = wrapped
+        for _rnd in range(2):
+            for t, rhs in rhs_by.items():
+                _x, rep = farm.solve(t, rhs)
+                assert rep.resid < 1e-6
+        assert farm.stats()["readmissions"] >= 1
+        assert not overshoots, overshoots
+    finally:
+        farm.close()
+
+
 def test_farm_reregister_different_size_fails_stale_queue():
     """Queued requests were validated against the OLD operator size; a
     size-changing re-registration must fail them instead of poisoning
@@ -331,6 +510,13 @@ def test_farm_budget_too_small_for_one_operator():
         with pytest.raises(RuntimeError, match="FARM_MAX_BYTES"):
             farm.register("t0", A, solver=CG(maxiter=10, tol=1e-5),
                           precond=_prm())
+        # the failed admission rolled back: the fresh entry is an
+        # orphan (prunable / a rebuild target) and its device buffers
+        # were dropped — no unevictable owned hierarchy leaks
+        ents = farm.registry.entries()
+        assert ents and all(not e.owners for e in ents)
+        assert all(e.obj.A_dev is None for e in ents)
+        assert farm.pool.used == 0
     finally:
         farm.close()
 
@@ -338,6 +524,65 @@ def test_farm_budget_too_small_for_one_operator():
 # ---------------------------------------------------------------------------
 # isolation / fairness / stress
 # ---------------------------------------------------------------------------
+
+def test_failed_admission_rolls_back_inplace_rebuild():
+    """A register() that fails admission must leave the tenant on its
+    ORIGINAL operator: the in-place rebuild acquire performed is
+    reverted (and the re-materialized device state dropped when the
+    entry was evicted going in) — never silently serving the new
+    values after reporting failure."""
+    A, rhs = poisson3d(6)
+    farm = _farm()
+    try:
+        farm.register("t", A, solver=CG(maxiter=80, tol=1e-7),
+                      precond=_prm())
+        x1, _ = farm.solve("t", rhs)
+        farm.set_max_bytes(1024)         # evicts; too small to readmit
+        A2 = CSR(A.ptr, A.col, 2.0 * A.val, A.ncols)
+        with pytest.raises(RuntimeError, match="FARM_MAX_BYTES"):
+            farm.register("t", A2, solver=CG(maxiter=80, tol=1e-7),
+                          precond=_prm())
+        # the bit-equal HIT path rolls back the same way: the
+        # readmitted device state is dropped, not leaked uncharged
+        with pytest.raises(RuntimeError, match="FARM_MAX_BYTES"):
+            farm.register("t", CSR(A.ptr, A.col, A.val.copy(),
+                                   A.ncols),
+                          solver=CG(maxiter=80, tol=1e-7),
+                          precond=_prm())
+        assert farm.tenants["t"].entry.obj.A_dev is None
+        assert farm.stats()["pool"]["used_bytes"] == 0
+        farm.set_max_bytes(0)            # unlimited again
+        x2, rep = farm.solve("t", rhs)
+        assert rep.resid < 1e-6
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+    finally:
+        farm.close()
+
+
+def test_failed_admission_rollback_inplace_mutation_idiom():
+    """The rollback revert must come from the ENTRY's value snapshot,
+    not the caller's matrix object: under the supported in-place
+    mutation idiom the caller's object already carries the new values,
+    and a revert from it would be a no-op — the tenant would silently
+    serve the new operator after register() reported failure."""
+    A, rhs = poisson3d(6)
+    farm = _farm()
+    try:
+        farm.register("t", A, solver=CG(maxiter=80, tol=1e-7),
+                      precond=_prm())
+        x1, _ = farm.solve("t", rhs)
+        farm.set_max_bytes(1024)         # evicts; too small to readmit
+        A.val *= 2.0                     # in place: A_host IS this A
+        with pytest.raises(RuntimeError, match="FARM_MAX_BYTES"):
+            farm.register("t", A, solver=CG(maxiter=80, tol=1e-7),
+                          precond=_prm())
+        farm.set_max_bytes(0)            # unlimited again
+        x2, rep = farm.solve("t", rhs)
+        assert rep.resid < 1e-6
+        np.testing.assert_array_equal(np.asarray(x1), np.asarray(x2))
+    finally:
+        farm.close()
+
 
 def test_cross_tenant_isolation():
     """One tenant's guard trips + SLO breach stay on ITS labels and
