@@ -81,6 +81,19 @@ def main(argv=None):
                          "AMGCL_TPU_SERVE_METRICS_PORT env knob, else "
                          "no server. The SLO watchdog thresholds ride "
                          "the AMGCL_TPU_SLO_* knobs")
+    ap.add_argument("--replay", metavar="BUNDLE",
+                    help="replay a flight-recorder bundle (a directory "
+                         "with manifest.json + system.npz, dumped on a "
+                         "health trip / SLO trip / failed batch / "
+                         "crash): reconstruct the matrix, config and "
+                         "AMGCL_TPU_* env snapshot, re-run the solve, "
+                         "and assert report parity — iteration count "
+                         "and health-flag identity exact on the same "
+                         "platform, residual within tolerance (exit 1 "
+                         "on mismatch); prints the recorded-vs-replayed "
+                         "attribution diff, and with --doctor folds it "
+                         "into the convergence doctor "
+                         "(telemetry/flight.py). Ignores -A/-n")
     ap.add_argument("-o", "--output", help="write solution (.mtx or .bin)")
     ap.add_argument("-x", "--x0", help="initial guess file")
     ap.add_argument("--telemetry", metavar="PATH",
@@ -169,9 +182,18 @@ def main(argv=None):
         # own records all land in the same JSONL file
         telemetry.set_default_sink(telemetry.JsonlSink(args.telemetry))
 
+    # flight recorder (telemetry/flight.py): an unhandled exception in
+    # any CLI run dumps the newest solve capsule as a replay bundle
+    # before the traceback prints (AMGCL_TPU_FLIGHT_DIR must be set for
+    # anything to land on disk; AMGCL_TPU_FLIGHT=0 disables)
+    telemetry.flight.install_excepthook()
+
     # device-synced scopes: totals mean wall-clock device time, not
     # dispatch time (utils/profiler.py)
     prof = Profiler.device()
+
+    if args.replay:
+        return _run_replay(args, prof)
 
     if args.farm:
         if args.mesh or args.serve or args.reorder or args.matrix:
@@ -615,6 +637,36 @@ def main(argv=None):
             pass
         dist_metrics_srv.close()
     return 0
+
+
+def _run_replay(args, prof):
+    """``--replay BUNDLE``: deterministic incident replay — rebuild the
+    dumped solve, re-run it under the recorded env, score parity, and
+    print the recorded-vs-replayed attribution diff. Exit 0 on parity
+    (every field incident becomes a reproducible test case)."""
+    from amgcl_tpu import telemetry
+    from amgcl_tpu.telemetry import diff as _diff
+    from amgcl_tpu.telemetry import flight
+
+    with prof.scope("replay"):
+        result = flight.run_replay(args.replay)
+    print(flight.format_replay(result))
+    d = result.get("diff")
+    if d is not None:
+        print()
+        print(_diff.format_diff(d))
+    if args.doctor:
+        # the diagnose(diff=...) fold: the doctor names the culprit
+        # stage of the recorded-vs-replayed movement (a replay that
+        # diverges IS a regression with an attribution)
+        from amgcl_tpu.telemetry.health import diagnose, format_findings
+        print()
+        print(format_findings(diagnose(None, diff=d)))
+    print()
+    print(prof)
+    telemetry.emit(event="replay",
+                   **{k: v for k, v in result.items() if k != "diff"})
+    return 0 if result.get("ok") else 1
 
 
 def _run_farm_demo(args, ap, prof, overrides):

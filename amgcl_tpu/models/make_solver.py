@@ -597,6 +597,21 @@ class make_solver:
             # the first call's wall time includes jit trace + compile —
             # flag it so sink consumers can separate it from steady state
             extra=extra)
+        # flight recorder (telemetry/flight.py): ring this solve's
+        # capsule (O(1) — refs to the immutable arrays, weakref to the
+        # bundle) and, on a FATAL guard trip, dump a self-contained
+        # replay bundle so the field incident becomes a deterministic
+        # repro. Best-effort: the recorder must never fail a solve.
+        try:
+            from amgcl_tpu.telemetry import flight as _flight
+            if _flight.enabled():
+                _flight.record_solve(self, rhs, x0, report)
+                if _flight.fatal_health(health):
+                    _flight.dump("health_trip", bundle=self, rhs=rhs,
+                                 x0=x0, report=report,
+                                 tags={"flags": health.get("flags")})
+        except Exception:
+            pass
         # process-global JSONL sink (telemetry/sink.py); the NullSink check
         # keeps the unconfigured hot path free of the to_dict() conversion
         # (this function already fights per-call host overhead — see the

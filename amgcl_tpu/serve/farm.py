@@ -1031,6 +1031,23 @@ class SolverFarm:
                 for req in batch:      # fails ITS futures, not the farm
                     if not req.future.done():
                         req.future.set_exception(e)
+                # flight recorder: dump the failed batch's first
+                # request as a tenant-tagged replay bundle
+                try:
+                    from amgcl_tpu.telemetry import flight as _fl
+                    if _fl.enabled() and batch:
+                        bundle = svc.solver if svc is not None else None
+                        if _fl.dump(
+                                "farm_batch_failed", bundle=bundle,
+                                rhs=batch[0].rhs, x0=batch[0].x0,
+                                tags={"tenant": batch[0].tenant,
+                                      "request_ids":
+                                      [r.rid for r in batch],
+                                      "exception": repr(e)[:200]}) \
+                                is not None:
+                            self.live.inc("flight_dumps_total")
+                except Exception:                # noqa: BLE001
+                    pass
             try:
                 # the FULL batch: displaced requests carry their inner
                 # exception into the per-tenant books + public futures
@@ -1193,6 +1210,26 @@ class SolverFarm:
             from amgcl_tpu.telemetry.health import serve_findings
             telemetry.emit(event="farm_slo", new_trips=new,
                            findings=serve_findings(summ), **summ)
+        # flight recorder: the tenant's SLO incident dumps a replay
+        # bundle of its service's most recent dispatched request,
+        # tenant-tagged. Best-effort — never fails the dispatch loop.
+        try:
+            from amgcl_tpu.telemetry import flight as _flight
+            if _flight.enabled():
+                svc = t.entry.payload.get("service")
+                probe = getattr(svc, "_flight_probe", None) \
+                    if svc is not None else None
+                if svc is not None and _flight.dump(
+                        "farm_slo_trip", bundle=svc.solver,
+                        rhs=probe[1] if probe else None,
+                        x0=probe[2] if probe else None,
+                        report=probe[3] if probe else None,
+                        tags={"tenant": t.name, "trips": new,
+                              "request_id": probe[0] if probe
+                              else None}) is not None:
+                    self.live.inc("flight_dumps_total")
+        except Exception:                        # noqa: BLE001
+            pass
 
     # -- stats / lifecycle ---------------------------------------------------
 

@@ -527,6 +527,22 @@ class SolverService:
                         self._win.extend(
                             {"timeout": False, "unhealthy": True,
                              "error": True} for _ in range(failed))
+                    # flight recorder: a failed batch is an incident —
+                    # dump a replay bundle of its first request, tagged
+                    # with every failed request id + the exception
+                    try:
+                        from amgcl_tpu.telemetry import flight as _fl
+                        if _fl.enabled() and _fl.dump(
+                                "serve_batch_failed",
+                                bundle=self.solver, rhs=batch[0].rhs,
+                                x0=batch[0].x0,
+                                tags={"request_ids":
+                                      [r.rid for r in batch],
+                                      "exception": repr(e)[:200]}) \
+                                is not None:
+                            self.live.inc("flight_dumps_total")
+                    except Exception:            # noqa: BLE001
+                        pass
                     self._check_slo()
             if self._stop and self.queue.empty():
                 return
@@ -702,6 +718,14 @@ class SolverService:
             if self._t_first is None:
                 self._t_first = t_now - wall   # dispatch start
             self._t_last = t_now
+        # flight-recorder probe: the newest dispatched request (rid,
+        # rhs, x0, report) — what an SLO-trip dump reproduces (x0
+        # included: a warm-started request replayed from zeros would
+        # fail parity on a perfectly deterministic solve). One tuple
+        # of refs per batch; rhs/x0 are the caller's immutable arrays
+        if resolved:
+            req0, _xcol0, rep0 = resolved[0]
+            self._flight_probe = (req0.rid, req0.rhs, req0.x0, rep0)
         # SLO state is a stat too: commit it BEFORE the futures resolve
         # so a caller who saw its future done reads stats()/slo state
         # that already include this batch (pure host dict math; the slo
@@ -823,6 +847,25 @@ class SolverService:
             from amgcl_tpu.telemetry.health import serve_findings
             telemetry.emit(event="slo", new_trips=new,
                            findings=serve_findings(summary), **summary)
+        # flight recorder: an SLO incident dumps a replay bundle of the
+        # most recent dispatched request (the solve the operator will
+        # want to reproduce), tagged with the trip kinds + request id.
+        # Best-effort — the watchdog must never fail a batch.
+        try:
+            from amgcl_tpu.telemetry import flight as _flight
+            if _flight.enabled():
+                probe = getattr(self, "_flight_probe", None)
+                if _flight.dump(
+                        "serve_slo_trip", bundle=self.solver,
+                        rhs=probe[1] if probe else None,
+                        x0=probe[2] if probe else None,
+                        report=probe[3] if probe else None,
+                        tags={"trips": new,
+                              "request_id": probe[0] if probe
+                              else None}) is not None:
+                    self.live.inc("flight_dumps_total")
+        except Exception:                        # noqa: BLE001
+            pass
         return summary
 
     def to_chrome_trace(self, tid: int = 0,

@@ -68,6 +68,10 @@ from amgcl_tpu.telemetry import metrics
 # like ``metrics``; the classes ride along for direct construction
 from amgcl_tpu.telemetry import live
 from amgcl_tpu.telemetry.live import LiveRegistry, MetricsServer
+# forensics leg (PR 12): flight recorder + replay bundles, and the
+# stdlib-only structured report diff (cross-run regression attribution)
+from amgcl_tpu.telemetry import diff
+from amgcl_tpu.telemetry import flight
 
 __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "setup_scope", "RequestSpans", "JsonlSink", "NullSink",
@@ -83,4 +87,4 @@ __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "measure_stages", "format_roofline",
            "solve_roofline", "counter_map", "xla_stage_check",
            "watched_jit", "compile_snapshot", "global_watch", "metrics",
-           "live", "LiveRegistry", "MetricsServer"]
+           "live", "LiveRegistry", "MetricsServer", "diff", "flight"]

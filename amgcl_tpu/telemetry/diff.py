@@ -1,0 +1,555 @@
+"""Structured report diffing — cross-run regression attribution.
+
+The observability stack can measure everything about ONE solve (ledger,
+health, roofline, compile watch, comm attribution) but until this module
+could explain nothing BETWEEN solves: a gate failure printed tolerances,
+a trend regression printed two numbers, and a human eyeballed the
+ledger/roofline tables to find the stage that moved. This module compares
+two records of the same kind — ``SolveReport.to_dict()`` outputs, bench
+worker records (``BENCH_r*.json`` payloads), or structured multichip
+records — stage by stage, and decomposes the headline delta into ranked
+per-stage contributions:
+
+* **wall-time split** — the exact two-term identity
+  ``wall_B − wall_A = Δiters · t_iter_B + iters_A · Δt_iter`` separates
+  "it takes more iterations" from "each iteration got slower" with no
+  residual term.
+* **stage join** — per-``(level, stage)`` measured cycle times (PR-4
+  roofline rows, keyed exactly like the PR-2 ledger cycle model's stage
+  keys) are joined across the two records; each joined stage contributes
+  ``Δt · visits`` and the rows are ranked by share of the total
+  per-stage movement. Records predating per-stage data degrade to a
+  ``gaps`` note, never an error.
+* **side channels** — setup seconds, ledger bytes, compile seconds /
+  retraces, and (multichip) efficiency + comm-fraction deltas ride the
+  same record.
+
+Cross-platform pairs are SKIPPED for every timed quantity (the same rule
+every gate applies through ``_record_platform``): a CPU-fallback run vs
+a TPU baseline is a platform change, not a regression — iteration counts
+and model bytes stay compared, the math is platform-independent.
+
+IMPORTANT: stdlib-only AND free of package-relative imports, like
+``telemetry/sink.py`` — ``bench.py``'s supervisor (which must never
+import jax) loads this by file path for ``--why``, the ``--trend`` why
+column and the gate-failure attribution. Keep it that way.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+#: schema version of the diff record itself (and the version stamped by
+#: ``SolveReport.to_dict()`` — a future incompatible report layout bumps
+#: both so old diffs stay interpretable)
+SCHEMA = 1
+
+#: |wall ratio − 1| below this is jitter, not signal — contributions are
+#: still reported but :func:`findings` stays quiet (chained bench
+#: timings move ~10-15% across sessions, the same slack the bench
+#: gate's time-ratio tolerance absorbs)
+_NOISE_RATIO = 0.10
+
+
+# ---------------------------------------------------------------------------
+# record introspection
+# ---------------------------------------------------------------------------
+
+def get_path(rec: Any, path: str) -> Any:
+    """Dotted-path lookup (``"compile.totals.compile_s"``), None when
+    any hop is missing — the ``metrics.extract`` contract, duplicated
+    here so this module stays import-free."""
+    cur = rec
+    for part in path.split("."):
+        if not isinstance(cur, dict):
+            return None
+        cur = cur.get(part)
+    return cur
+
+
+def _first(rec: Dict[str, Any], *paths: str) -> Any:
+    for p in paths:
+        v = get_path(rec, p)
+        if v is not None:
+            return v
+    return None
+
+
+def _num(v: Any) -> Optional[float]:
+    if isinstance(v, bool) or not isinstance(v, (int, float)):
+        return None
+    return float(v) if math.isfinite(float(v)) else None
+
+
+def record_kind(rec: Dict[str, Any]) -> str:
+    """One of ``"multichip"`` / ``"bench"`` / ``"solve"`` / ``"unknown"``
+    — the three record families the observability stack emits. Both
+    sides of a diff must agree."""
+    if not isinstance(rec, dict):
+        return "unknown"
+    if rec.get("event") == "multichip_scaling" or (
+            "solvers" in rec and "headline" in rec):
+        return "multichip"
+    if "metric" in rec or "value" in rec or "parsed" in rec:
+        return "bench"
+    if "iters" in rec and "resid" in rec:
+        return "solve"
+    return "unknown"
+
+
+def unwrap(rec: Dict[str, Any]) -> Dict[str, Any]:
+    """Driver-wrapper bench rounds keep the worker record under
+    ``"parsed"`` (the ``bench_history`` layout) — diff the payload."""
+    parsed = rec.get("parsed") if isinstance(rec, dict) else None
+    return parsed if isinstance(parsed, dict) else rec
+
+
+def platform_of(rec: Dict[str, Any]) -> Optional[str]:
+    """Device platform of any record kind — the same resolution order
+    as bench.py's ``_record_platform`` plus the ``hw_provenance`` stamp
+    solve-level reports carry (PR-12 satellite)."""
+    rec = unwrap(rec)
+    p = _first(rec, "device_platform", "provenance.device_platform",
+               "hw_provenance.device_platform")
+    if p is None and rec.get("fallback"):
+        return "cpu"
+    return p
+
+
+def stage_rows(rec: Dict[str, Any]) -> Dict[Tuple[int, str],
+                                            Dict[str, Any]]:
+    """Measured per-``(level, stage)`` rows of a record, keyed for the
+    join. Sources, in order: a full roofline record's ``stages`` (the
+    ``AMG.roofline()`` rows), a bench record's compact
+    ``roofline_stages``, or ``resources.roofline.stages`` on a solve
+    report that carried the full measurement. Empty dict when the
+    record predates per-stage data."""
+    rec = unwrap(rec)
+    rows = None
+    for path in ("roofline.stages", "roofline_stages",
+                 "resources.roofline.stages"):
+        rows = get_path(rec, path)
+        if isinstance(rows, list) and rows:
+            break
+        rows = None
+    out: Dict[Tuple[int, str], Dict[str, Any]] = {}
+    for r in rows or []:
+        if not isinstance(r, dict):
+            continue
+        lvl, stage, t = r.get("level"), r.get("stage"), _num(r.get("t_s"))
+        if lvl is None or stage is None or t is None:
+            continue
+        out[(int(lvl), str(stage))] = {
+            "t_s": t, "visits": int(r.get("visits", 1) or 1),
+            "model_bytes": r.get("model_bytes"),
+            "model_flops": r.get("model_flops")}
+    return out
+
+
+def _wall(rec: Dict[str, Any], kind: str) -> Optional[float]:
+    if kind == "bench":
+        return _num(_first(rec, "value", "wall_per_call_s"))
+    return _num(rec.get("wall_time_s"))
+
+
+def _bytes(rec: Dict[str, Any]) -> Optional[float]:
+    return _num(_first(rec, "ledger.hierarchy_bytes",
+                       "resources.memory.bytes", "hierarchy.bytes"))
+
+
+def _compile_s(rec: Dict[str, Any]) -> Optional[float]:
+    return _num(_first(rec, "compile.totals.compile_s",
+                       "compile.new_compile_s", "compile.compile_s"))
+
+
+def _retraces(rec: Dict[str, Any]) -> Optional[float]:
+    v = _first(rec, "compile.totals.retraces", "compile.retraces")
+    if v is None:
+        funcs = get_path(rec, "compile.functions")
+        if isinstance(funcs, dict):
+            v = sum(f.get("retraces", 0) for f in funcs.values()
+                    if isinstance(f, dict))
+    return _num(v)
+
+
+def _comm_fraction(rec: Dict[str, Any]) -> Optional[float]:
+    return _num(_first(rec, "headline.comm_fraction",
+                       "comm.per_iteration.comm_fraction",
+                       "resources.comm.per_iteration.comm_fraction"))
+
+
+# ---------------------------------------------------------------------------
+# the diff
+# ---------------------------------------------------------------------------
+
+def _pair(a: Optional[float], b: Optional[float],
+          higher_better: bool = False) -> Optional[Dict[str, Any]]:
+    """One headline row: both values, delta, ratio, and whether the
+    movement is a regression in this metric's direction."""
+    if a is None or b is None:
+        if a is None and b is None:
+            return None
+        return {"a": a, "b": b, "delta": None, "ratio": None}
+    out: Dict[str, Any] = {"a": a, "b": b, "delta": b - a,
+                           "ratio": round(b / a, 6) if a else None}
+    if a:
+        worse = (b < a) if higher_better else (b > a)
+        out["regressed"] = bool(worse and abs(b / a - 1.0) > 1e-9)
+    return out
+
+
+def _multichip_diff(a: Dict[str, Any], b: Dict[str, Any],
+                    out: Dict[str, Any]) -> Dict[str, Any]:
+    ha, hb = a.get("headline") or {}, b.get("headline") or {}
+    skip = out["platform"]["skip"]
+    head = {}
+    for key, hb_better in (("weak_efficiency", True),
+                           ("strong_efficiency", True),
+                           ("comm_fraction", False),
+                           ("imbalance", False),
+                           ("wire_gbps", True)):
+        if skip and key != "imbalance":
+            continue
+        row = _pair(_num(ha.get(key)), _num(hb.get(key)),
+                    higher_better=hb_better)
+        if row is not None:
+            head[key] = row
+    it = _pair(_num(ha.get("iters")), _num(hb.get("iters")))
+    if it is not None:
+        head["iters"] = it
+    out["headline"] = head
+    # per-solver per-mode per-iteration times on the largest shared mesh
+    contributions = []
+    for skey in sorted(set(a.get("solvers") or {})
+                       & set(b.get("solvers") or {})):
+        for mode in ("weak", "strong"):
+            ca = ((a["solvers"][skey].get(mode) or {}).get("cells")
+                  or [])
+            cb = ((b["solvers"][skey].get(mode) or {}).get("cells")
+                  or [])
+            by_nd_a = {c.get("devices"): c for c in ca}
+            for c in cb:
+                nd = c.get("devices")
+                pa = by_nd_a.get(nd)
+                if pa is None:
+                    continue
+                ta, tb = _num(pa.get("t_iter_s")), _num(c.get("t_iter_s"))
+                if ta is None or tb is None or skip:
+                    continue
+                contributions.append({
+                    "key": "%s/%s/nd%d" % (skey, mode, nd),
+                    "delta_s": tb - ta, "a_s": ta, "b_s": tb})
+    tot = sum(abs(c["delta_s"]) for c in contributions) or 1.0
+    for c in contributions:
+        c["share"] = round(abs(c["delta_s"]) / tot, 4)
+        c["delta_s"] = round(c["delta_s"], 9)
+    contributions.sort(key=lambda c: -abs(c["delta_s"]))
+    out["contributions"] = contributions
+    cf = head.get("comm_fraction")
+    slowest = contributions[0]["key"] if contributions else None
+    if cf is not None and cf.get("regressed") and cf.get("delta") \
+            and abs(cf["delta"]) > 0.05:
+        out["top"] = "comm_fraction"
+    else:
+        out["top"] = slowest
+    return out
+
+
+def diff(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
+    """Compare record ``a`` (baseline / older) with ``b`` (candidate /
+    newer). Returns the structured diff record (see module docstring);
+    never raises on missing pieces — absent metrics become ``gaps``
+    entries, platform mismatches skip timed rows."""
+    a, b = unwrap(a or {}), unwrap(b or {})
+    kind_a, kind_b = record_kind(a), record_kind(b)
+    out: Dict[str, Any] = {"schema": SCHEMA, "kind": kind_a,
+                           "gaps": [], "contributions": [],
+                           "stages": [], "by_stage": {}, "top": None}
+    plat_a, plat_b = platform_of(a), platform_of(b)
+    skip = plat_a is not None and plat_b is not None and plat_a != plat_b
+    out["platform"] = {"a": plat_a, "b": plat_b, "skip": skip}
+    if kind_a != kind_b and "unknown" not in (kind_a, kind_b):
+        out["error"] = "record kinds differ: %s vs %s" % (kind_a, kind_b)
+        return out
+    if kind_a == "unknown" and kind_b == "unknown":
+        out["error"] = "unrecognized record kind on both sides"
+        return out
+    kind = kind_a if kind_a != "unknown" else kind_b
+    out["kind"] = kind
+    if skip:
+        out["gaps"].append(
+            "platform mismatch (%s vs %s): every timed comparison "
+            "skipped — iteration counts and model bytes only"
+            % (plat_a, plat_b))
+    if kind == "multichip":
+        return _multichip_diff(a, b, out)
+
+    # -- solve / bench records ----------------------------------------------
+    wall_a, wall_b = _wall(a, kind), _wall(b, kind)
+    it_a, it_b = _num(a.get("iters")), _num(b.get("iters"))
+    head: Dict[str, Any] = {}
+    row = _pair(it_a, it_b)
+    if row is not None:
+        head["iters"] = row
+    if not skip:
+        row = _pair(wall_a, wall_b)
+        if row is not None:
+            head["wall_s"] = row
+        row = _pair(_num(a.get("setup_s")), _num(b.get("setup_s")))
+        if row is not None:
+            head["setup_s"] = row
+        row = _pair(_compile_s(a), _compile_s(b))
+        if row is not None:
+            head["compile_s"] = row
+    row = _pair(_bytes(a), _bytes(b))
+    if row is not None:
+        head["ledger_bytes"] = row
+    row = _pair(_retraces(a), _retraces(b))
+    if row is not None:
+        head["retraces"] = row
+    row = _pair(_comm_fraction(a), _comm_fraction(b))
+    if row is not None and not skip:
+        head["comm_fraction"] = row
+    out["headline"] = head
+
+    # exact wall split: wall = iters * t_iter, so
+    # Δwall = Δiters·t_iter_B + iters_A·Δt_iter (no residual term)
+    contributions: List[Dict[str, Any]] = []
+    if not skip and None not in (wall_a, wall_b, it_a, it_b) \
+            and it_a > 0 and it_b > 0:
+        t_a, t_b = wall_a / it_a, wall_b / it_b
+        contributions.append({"key": "iterations",
+                              "delta_s": (it_b - it_a) * t_b,
+                              "detail": "%d -> %d iterations"
+                              % (int(it_a), int(it_b))})
+        contributions.append({"key": "per_iteration",
+                              "delta_s": it_a * (t_b - t_a),
+                              "detail": "%.3g -> %.3g s/iter"
+                              % (t_a, t_b)})
+        head["t_iter_s"] = _pair(t_a, t_b)
+    elif None in (wall_a, wall_b) and not skip:
+        out["gaps"].append("wall time missing on one side — no "
+                           "iterations/per-iteration split")
+    sc = head.get("setup_s")
+    if sc is not None and sc.get("delta") is not None:
+        contributions.append({"key": "setup", "delta_s": sc["delta"]})
+    cc = head.get("compile_s")
+    if cc is not None and cc.get("delta") is not None:
+        contributions.append({"key": "compile", "delta_s": cc["delta"]})
+    tot = sum(abs(c["delta_s"]) for c in contributions) or 1.0
+    for c in contributions:
+        c["share"] = round(abs(c["delta_s"]) / tot, 4)
+        c["delta_s"] = round(c["delta_s"], 9)
+    contributions.sort(key=lambda c: -abs(c["delta_s"]))
+    out["contributions"] = contributions
+
+    # stage join: measured per-(level, stage) cycle times, ranked by
+    # contribution to the total per-stage movement
+    if not skip:
+        sa, sb = stage_rows(a), stage_rows(b)
+        if not sa or not sb:
+            missing = " and ".join(
+                side for side, rows in (("baseline", sa),
+                                        ("candidate", sb)) if not rows)
+            out["gaps"].append(
+                "no per-stage roofline rows on the %s record — stage "
+                "attribution unavailable (records predate per-stage "
+                "data, or the roofline stage was skipped)" % missing)
+        else:
+            joined = sorted(set(sa) & set(sb))
+            stages: List[Dict[str, Any]] = []
+            by_stage: Dict[str, float] = {}
+            for key in joined:
+                ra, rb = sa[key], sb[key]
+                visits = max(ra["visits"], rb["visits"])
+                dt = (rb["t_s"] - ra["t_s"]) * visits
+                stages.append({"level": key[0], "stage": key[1],
+                               "a_s": ra["t_s"], "b_s": rb["t_s"],
+                               "visits": visits, "delta_s": dt})
+                by_stage[key[1]] = by_stage.get(key[1], 0.0) + dt
+            only = sorted(set(sa) ^ set(sb))
+            if only:
+                out["gaps"].append(
+                    "%d stage key(s) present on one side only "
+                    "(structure changed): %s" % (len(only), ", ".join(
+                        "level%d/%s" % k for k in only[:4])))
+            stot = sum(abs(s["delta_s"]) for s in stages) or 1.0
+            for s in stages:
+                s["share"] = round(abs(s["delta_s"]) / stot, 4)
+                s["delta_s"] = round(s["delta_s"], 9)
+            stages.sort(key=lambda s: -abs(s["delta_s"]))
+            out["stages"] = stages
+            out["by_stage"] = {
+                name: {"delta_s": round(d, 9),
+                       "share": round(abs(d) / stot, 4)}
+                for name, d in sorted(by_stage.items(),
+                                      key=lambda kv: -abs(kv[1]))}
+    out["top"] = top_contributor(out)
+    return out
+
+
+def top_contributor(d: Dict[str, Any]) -> Optional[str]:
+    """The one name an operator reads first: the dominant joined stage
+    (aggregated across levels) when per-stage rows exist and the
+    per-iteration leg is what moved; the dominant coarse bucket
+    (iterations / setup / compile) otherwise."""
+    contributions = d.get("contributions") or []
+    if not contributions:
+        return None
+    top = contributions[0]
+    if top["key"] == "per_iteration" and d.get("by_stage"):
+        stage = next(iter(d["by_stage"]))
+        return "per_iteration:%s" % stage
+    return top["key"]
+
+
+# ---------------------------------------------------------------------------
+# findings / rendering
+# ---------------------------------------------------------------------------
+
+def findings(d: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Doctor-shaped findings ({severity, code, message, suggestion})
+    from a diff record — ``telemetry.diagnose(diff=...)`` folds these
+    in, and the gate-failure attribution prints them."""
+    out: List[Dict[str, Any]] = []
+    if d.get("error"):
+        return out
+    head = d.get("headline") or {}
+    wall = head.get("wall_s") or {}
+    ratio = wall.get("ratio")
+    top = d.get("top")
+    if ratio is not None and ratio - 1.0 > _NOISE_RATIO:
+        detail = ""
+        contributions = d.get("contributions") or []
+        if contributions:
+            c = contributions[0]
+            detail = " — top contributor %s (%+.3g s, %.0f%% of the " \
+                "movement)" % (top or c["key"], c["delta_s"],
+                               100 * c["share"])
+        stages = d.get("stages") or []
+        sugg = None
+        if stages and top and top.startswith("per_iteration:"):
+            s = stages[0]
+            sugg = ("the per-iteration time moved and the stage join "
+                    "names level %d %s (%+.3g s/cycle, %.0f%% of the "
+                    "per-stage movement) — start there"
+                    % (s["level"], s["stage"], s["delta_s"],
+                       100 * s["share"]))
+        elif top == "iterations":
+            sugg = ("the iteration count grew, not the per-iteration "
+                    "time — a numerics change (coarsening, smoother, "
+                    "tolerance), not a kernel regression")
+        elif top == "compile":
+            sugg = ("compile time moved — check the retrace findings "
+                    "and the persistent compilation cache")
+        out.append({"severity": "warning", "code": "cross_run_regression",
+                    "message": "solve wall time regressed %.2fx "
+                    "(%.4g s -> %.4g s)%s"
+                    % (ratio, wall.get("a"), wall.get("b"), detail),
+                    **({"suggestion": sugg} if sugg else {})})
+    it = head.get("iters") or {}
+    if it.get("delta") and it["delta"] > 0 and not out:
+        out.append({"severity": "info", "code": "cross_run_iters",
+                    "message": "iteration count grew %d -> %d between "
+                    "the two runs" % (int(it["a"]), int(it["b"]))})
+    cf = head.get("comm_fraction") or {}
+    if cf.get("regressed") and cf.get("delta") \
+            and abs(cf["delta"]) > 0.05:
+        out.append({"severity": "warning", "code": "cross_run_comm",
+                    "message": "measured comm fraction grew %.3f -> "
+                    "%.3f between the two runs" % (cf["a"], cf["b"]),
+                    "suggestion": "check the collective census and the "
+                    "halo-exchange plans (--dist-report attributes the "
+                    "exposed wall per collective)"})
+    rt = head.get("retraces") or {}
+    if rt.get("delta") and rt["delta"] > 0:
+        out.append({"severity": "info", "code": "cross_run_retraces",
+                    "message": "retrace count grew %d -> %d — a shape "
+                    "or gate-state change re-traces the solve program"
+                    % (int(rt["a"]), int(rt["b"]))})
+    return out
+
+
+def format_diff(d: Dict[str, Any], max_stages: int = 8) -> str:
+    """Text rendering — the ``bench.py --why`` / gate-failure section."""
+    if d.get("error"):
+        return "diff: %s" % d["error"]
+    lines = ["Cross-run attribution (%s records)" % d.get("kind")]
+    for gap in d.get("gaps") or []:
+        lines.append("  (gap: %s)" % gap)
+    head = d.get("headline") or {}
+    for key in ("wall_s", "t_iter_s", "iters", "setup_s", "compile_s",
+                "ledger_bytes", "retraces", "comm_fraction",
+                "weak_efficiency", "strong_efficiency", "imbalance",
+                "wire_gbps"):
+        row = head.get(key)
+        if not row:
+            continue
+        tag = ""
+        ratio = row.get("ratio")
+        # the arrow marks movement beyond the session-jitter band; the
+        # raw ``regressed`` boolean (any worse movement) stays in the
+        # record for programmatic consumers
+        if row.get("regressed") and (
+                ratio is None or abs(ratio - 1.0) > _NOISE_RATIO):
+            tag = "  <-- regressed"
+        elif ratio is not None:
+            tag = "  (%.3fx)" % ratio
+        lines.append("  %-14s %12s -> %-12s%s"
+                     % (key, _fmt(row.get("a")), _fmt(row.get("b")), tag))
+    contributions = d.get("contributions") or []
+    if contributions:
+        lines.append("  delta decomposition:")
+        for c in contributions:
+            lines.append("    %-16s %+12.4g s  (%.0f%% of movement)%s"
+                         % (c["key"], c["delta_s"], 100 * c["share"],
+                            "  [" + c["detail"] + "]"
+                            if c.get("detail") else ""))
+    stages = d.get("stages") or []
+    if stages:
+        lines.append("  per-stage join (measured cycle times):")
+        for s in stages[:max_stages]:
+            lines.append("    level%-2d %-12s %10.4g -> %-10.4g "
+                         "%+10.3g s  (%.0f%%)"
+                         % (s["level"], s["stage"], s["a_s"], s["b_s"],
+                            s["delta_s"], 100 * s["share"]))
+        if len(stages) > max_stages:
+            lines.append("    ... %d more stage row(s)"
+                         % (len(stages) - max_stages))
+    if d.get("top"):
+        lines.append("  top contributor: %s" % d["top"])
+    for f in findings(d):
+        lines.append("  [%s] %s" % (f["severity"].upper(), f["message"]))
+        if f.get("suggestion"):
+            lines.append("      -> %s" % f["suggestion"])
+    return "\n".join(lines)
+
+
+def _fmt(v: Any) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return "%.6g" % v
+    return str(v)
+
+
+def why(a: Dict[str, Any], b: Dict[str, Any]) -> Optional[str]:
+    """The compact ``--trend`` why-column label: the top attributed
+    contributor of ``diff(a, b)``, None when nothing is attributable
+    (platform skip, missing walls, kind mismatch)."""
+    d = diff(a, b)
+    if d.get("error") or d["platform"]["skip"]:
+        return None
+    return d.get("top")
+
+
+def compact(d: Dict[str, Any], max_stages: int = 8) -> Dict[str, Any]:
+    """Bounded copy for embedding in JSONL events / gate records: the
+    full headline + contributions, stage rows truncated."""
+    out = dict(d)
+    stages = d.get("stages") or []
+    if len(stages) > max_stages:
+        out["stages"] = stages[:max_stages]
+        out["stages_truncated"] = len(stages) - max_stages
+    return out

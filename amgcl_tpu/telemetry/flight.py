@@ -1,0 +1,773 @@
+"""Flight recorder — per-solve capsules, incident replay bundles, and
+deterministic solve replay.
+
+Every observability layer so far describes a solve that already
+happened; none of them leave a REPRODUCIBLE artifact behind when one
+goes wrong. A health guard trips in the field, an SLO watchdog fires,
+a batch dispatch raises — the operator gets flag names and ratios, but
+re-creating the failing solve means reconstructing the matrix, the rhs,
+the config and the env by hand. This module closes that loop:
+
+* **Capsules** — a bounded process-global ring of per-solve records
+  (``record_solve``, fed by ``make_solver.__call__``): a weak reference
+  to the solver bundle, the (immutable) rhs/x0 arrays, the report, and
+  a timestamp. Recording is O(1) — everything expensive (hashing,
+  config capture, provenance) happens only at dump time.
+* **Replay bundles** — on trigger (fatal health flag, serve/farm SLO
+  trip or failed batch, ``--check`` gate failure, unhandled exception
+  via the excepthook) ``dump()`` writes a self-contained directory:
+  ``system.npz`` (CSR matrix + rhs + x0) and ``manifest.json`` (the
+  operator sparsity fingerprint — the same blake2b key
+  ``serve/registry.py`` uses — plus the stable config key, rhs/x0
+  content hashes, the full ``AMGCL_TPU_*`` env snapshot,
+  ``hw_provenance``, and the report's ledger/health/compile/roofline
+  summaries). Each dump emits a ``flight_dump`` JSONL event; serving
+  surfaces additionally bump the ``flight_dumps_total`` live counter.
+* **Replay** — ``cli.py --replay <bundle>`` (and :func:`run_replay`)
+  reconstructs the matrix and config, applies the recorded env
+  deltas, re-runs the solve and asserts report parity: iteration count
+  and health-flag identity EXACT on the same platform, residual within
+  tolerance; cross-platform replays degrade to informational checks
+  (the ``_record_platform`` discipline).
+
+Knobs (README env table):
+
+  AMGCL_TPU_FLIGHT            0 disables the recorder entirely (no ring,
+                              no dumps, no ``--check`` self-replay)
+  AMGCL_TPU_FLIGHT_DIR        directory replay bundles land in; UNSET =
+                              capsules ring but nothing is written (the
+                              AMGCL_TPU_TELEMETRY convention: opt into
+                              disk artifacts explicitly)
+  AMGCL_TPU_FLIGHT_MAX_DUMPS  bundle-count bound per directory (def 8);
+                              at the bound new incidents are counted
+                              but not written
+
+Module level stays stdlib + numpy (jax and the model layer are imported
+lazily inside the replay path) so recording can never add a device
+sync to the solve hot path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+#: capsule ring capacity — the newest N solves are dumpable post-hoc
+#: (the excepthook path); refs are to immutable arrays, so the cost is
+#: holding at most N rhs/x0 buffers alive
+RING_CAPACITY = 8
+
+#: manifest schema version
+BUNDLE_SCHEMA = 1
+
+_lock = threading.Lock()
+_ring: deque = deque(maxlen=RING_CAPACITY)
+_dumps_total = 0
+_dump_seq = 0
+
+
+def enabled() -> bool:
+    """Kill switch: ``AMGCL_TPU_FLIGHT=0`` disables recording AND
+    dumping (read per call — tests flip it)."""
+    return os.environ.get("AMGCL_TPU_FLIGHT", "1") != "0"
+
+
+def flight_dir() -> Optional[str]:
+    """Dump directory, or None (= record capsules, write nothing)."""
+    return os.environ.get("AMGCL_TPU_FLIGHT_DIR") or None
+
+
+def max_dumps() -> int:
+    try:
+        return int(os.environ.get("AMGCL_TPU_FLIGHT_MAX_DUMPS", "8"))
+    except ValueError:
+        return 8
+
+
+def dumps_total() -> int:
+    """Bundles written by this process (the live-counter source)."""
+    return _dumps_total
+
+
+def _reset_for_tests() -> None:
+    global _dumps_total, _dump_seq
+    with _lock:
+        _ring.clear()
+        _dumps_total = 0
+        _dump_seq = 0
+
+
+# ---------------------------------------------------------------------------
+# capsules
+# ---------------------------------------------------------------------------
+
+def record_solve(bundle, rhs, x0, report) -> None:
+    """Ring one solve. O(1): refs only — rhs/x0 are immutable (numpy or
+    jax) arrays, the bundle rides a weakref so the recorder never keeps
+    a hierarchy alive. Called from ``make_solver.__call__`` on every
+    guarded solve when the recorder is enabled.
+
+    No-op while ``AMGCL_TPU_FLIGHT_DIR`` is unset: every ring consumer
+    (the excepthook, ``dump_capsule``) can only ever write into that
+    directory, so ringing without it would pin up to
+    :data:`RING_CAPACITY` rhs/x0 buffer sets for the process lifetime
+    with zero benefit."""
+    if flight_dir() is None:
+        return
+    try:
+        ref = weakref.ref(bundle)
+    except TypeError:
+        ref = (lambda b: (lambda: b))(bundle)
+    _ring.append({"ts": time.time(), "bundle": ref, "rhs": rhs,
+                  "x0": x0, "report": report})
+
+
+def last_capsule() -> Optional[Dict[str, Any]]:
+    return _ring[-1] if _ring else None
+
+
+def fatal_health(health: Optional[Dict[str, Any]]) -> bool:
+    """True when a decoded ``SolveReport.health`` carries a flag the
+    guards treat as fatal — NaN, any Krylov breakdown, or divergence
+    (the trigger condition for a health-trip dump). Stagnation and
+    indefiniteness are informational and do not dump."""
+    if not isinstance(health, dict) or health.get("ok", True):
+        return False
+    return bool(health.get("nan") or health.get("breakdown")
+                or health.get("diverged"))
+
+
+# ---------------------------------------------------------------------------
+# capture: config, hashes, provenance
+# ---------------------------------------------------------------------------
+
+def _content_hash(arr) -> Optional[str]:
+    if arr is None:
+        return None
+    try:
+        a = np.ascontiguousarray(np.asarray(arr))
+        return hashlib.blake2b(a.tobytes(), digest_size=16).hexdigest()
+    except Exception:
+        return None
+
+
+def _scalar_fields(obj) -> Dict[str, Any]:
+    import dataclasses
+    out: Dict[str, Any] = {}
+    if dataclasses.is_dataclass(obj):
+        for f in dataclasses.fields(obj):
+            v = getattr(obj, f.name, None)
+            if v is None or isinstance(v, (int, float, str, bool)):
+                out[f.name] = v
+    return out
+
+
+def _dtype_name(dtype) -> Optional[str]:
+    try:
+        return str(np.dtype(np.asarray([], dtype).dtype))
+    except Exception:
+        try:
+            return str(dtype.__name__)
+        except Exception:
+            return None
+
+
+def capture_config(bundle) -> Dict[str, Any]:
+    """Replayable config of a ``make_solver`` bundle: solver type +
+    scalar params, preconditioner class + params (AMG / dummy /
+    relaxation — the ``precond_from_config`` classes), refine mode,
+    dtypes. Marks ``replayable: False`` with a reason for compositions
+    the runtime config layer cannot rebuild (Schur, CPR, nested, block
+    engines) — those still get a manifest, just no replay contract."""
+    cfg: Dict[str, Any] = {"replayable": True, "notes": []}
+    try:
+        from amgcl_tpu.models import runtime as rt
+    except Exception as e:                       # pragma: no cover
+        return {"replayable": False, "notes": ["runtime import: %r" % e]}
+    solver = getattr(bundle, "solver", None)
+    inv = {cls: name for name, cls in rt.SOLVERS.items()}
+    sname = inv.get(type(solver))
+    if sname is None:
+        cfg["replayable"] = False
+        cfg["notes"].append("solver %r has no runtime name"
+                            % type(solver).__name__)
+    else:
+        cfg["solver"] = {"type": sname, **_scalar_fields(solver)}
+    precond = getattr(bundle, "precond", None)
+    prm = getattr(precond, "prm", None)
+    pcfg: Optional[Dict[str, Any]] = None
+    if prm is not None and type(prm).__name__ == "AMGParams":
+        inv_c = {cls: n for n, cls in rt.COARSENING.items()}
+        inv_r = {cls: n for n, cls in rt.RELAXATION.items()}
+        cname = inv_c.get(type(prm.coarsening))
+        rname = inv_r.get(type(prm.relax))
+        pcfg = {"class": "amg",
+                "coarse_enough": prm.coarse_enough,
+                "direct_coarse": prm.direct_coarse,
+                "max_levels": prm.max_levels, "npre": prm.npre,
+                "npost": prm.npost, "ncycle": prm.ncycle,
+                "pre_cycles": prm.pre_cycles,
+                "matrix_format": prm.matrix_format,
+                "dtype": _dtype_name(prm.dtype)}
+        if cname is not None:
+            pcfg["coarsening"] = {"type": cname,
+                                  **_scalar_fields(prm.coarsening)}
+        if rname is not None:
+            pcfg["relax"] = {"type": rname,
+                             **_scalar_fields(prm.relax)}
+        if cname is None or rname is None:
+            cfg["replayable"] = False
+            cfg["notes"].append("coarsening/relax has no runtime name")
+    elif type(precond).__name__ == "DummyPreconditioner":
+        pcfg = {"class": "dummy",
+                "dtype": _dtype_name(getattr(precond, "dtype", None))}
+    elif type(precond).__name__ == "AsPreconditioner":
+        inv_r = {cls: n for n, cls in rt.RELAXATION.items()}
+        rname = inv_r.get(type(getattr(precond, "relax", None)))
+        pcfg = {"class": "relaxation",
+                "dtype": _dtype_name(getattr(precond, "dtype", None))}
+        if rname is not None:
+            pcfg["relax"] = {"type": rname,
+                             **_scalar_fields(precond.relax)}
+        else:
+            cfg["replayable"] = False
+            cfg["notes"].append("relaxation has no runtime name")
+    else:
+        cfg["replayable"] = False
+        cfg["notes"].append("preconditioner %r is outside the runtime "
+                            "config classes" % type(precond).__name__)
+    if pcfg is not None:
+        cfg["precond"] = pcfg
+    cfg["refine"] = int(getattr(bundle, "refine", 0) or 0)
+    rm = getattr(bundle, "refine_mode", None)
+    if rm:
+        cfg["refine_dtype"] = rm
+    sd = _dtype_name(getattr(bundle, "solver_dtype", None))
+    if sd:
+        cfg["solver_dtype"] = sd
+    cfg["matrix_format"] = getattr(bundle, "matrix_format", "auto")
+    A = getattr(bundle, "A_host", None)
+    if A is not None and getattr(A, "block_size", (1, 1)) != (1, 1):
+        cfg["replayable"] = False
+        cfg["notes"].append("block-valued system matrix")
+    if not cfg["notes"]:
+        del cfg["notes"]
+    return cfg
+
+
+def env_snapshot() -> Dict[str, str]:
+    """Every ``AMGCL_TPU_*`` variable set right now — the knob state a
+    replay re-applies (minus the recorder's own and the sink's, see
+    :func:`_replay_env`)."""
+    return {k: v for k, v in os.environ.items()
+            if k.startswith("AMGCL_TPU_")}
+
+
+def _provenance() -> Dict[str, Any]:
+    # the ONE process-cached provenance helper (telemetry/report.py) —
+    # a dump/replay must not re-enumerate the device set per call
+    from amgcl_tpu.telemetry.report import _hw_provenance
+    return _hw_provenance()
+
+
+def _report_summary(report) -> Dict[str, Any]:
+    """The manifest's compact report record: headline numbers + the
+    ledger/health/compile/roofline summaries parity checks and
+    ``diff.py`` consume."""
+    if report is None:
+        return {}
+    to_dict = getattr(report, "to_dict", None)
+    rec = to_dict(with_history=False) if callable(to_dict) \
+        else dict(report)
+    out = {k: rec.get(k) for k in ("iters", "resid", "convergence_rate",
+                                   "wall_time_s", "solver", "health",
+                                   "compile", "schema", "hw_provenance")
+           if rec.get(k) is not None}
+    res = rec.get("resources") or {}
+    if isinstance(res, dict):
+        for k in ("memory", "roofline", "per_iteration"):
+            if res.get(k) is not None:
+                out.setdefault("resources", {})[k] = res[k]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# dump
+# ---------------------------------------------------------------------------
+
+def _existing_bundles(dirpath: str) -> List[str]:
+    try:
+        return sorted(d for d in os.listdir(dirpath)
+                      if d.startswith("flight-")
+                      and os.path.isdir(os.path.join(dirpath, d)))
+    except OSError:
+        return []
+
+
+def dump(reason: str, bundle=None, rhs=None, x0=None, report=None,
+         tags: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Write one self-contained replay bundle; returns its directory
+    path, or None when disabled / no ``AMGCL_TPU_FLIGHT_DIR`` / the
+    per-directory bound is reached / the write fails. Never raises —
+    an incident recorder that crashes the incident path is worse than
+    none. Emits one ``flight_dump`` JSONL event per written bundle;
+    with the dump dir configured but the bound reached (or the write
+    failing), the event still fires with ``skipped`` naming the reason
+    — an unset dir stays silent (no opt-in, no event spam)."""
+    global _dumps_total, _dump_seq
+    from amgcl_tpu.telemetry import sink as _sink
+    if not enabled():
+        return None
+    dirpath = flight_dir()
+    event: Dict[str, Any] = {"event": "flight_dump", "reason": reason}
+    if tags:
+        event.update({k: v for k, v in tags.items() if v is not None})
+    if dirpath is None:
+        # no dump dir = the operator never opted into disk artifacts:
+        # stay silent (a skipped-event per unhealthy solve would spam
+        # every telemetry stream); the bound-reached case below DOES
+        # emit — there the operator opted in and must see saturation
+        return None
+    path = None
+    try:
+        os.makedirs(dirpath, exist_ok=True)
+        bound = max_dumps()
+        if bound > 0 and len(_existing_bundles(dirpath)) >= bound:
+            event["skipped"] = "AMGCL_TPU_FLIGHT_MAX_DUMPS=%d reached" \
+                % bound
+            _sink.emit(event)
+            return None
+        with _lock:
+            _dump_seq += 1
+            seq = _dump_seq
+        name = "flight-%s-%d-%d-%s" % (
+            time.strftime("%Y%m%dT%H%M%S", time.gmtime()),
+            os.getpid(), seq, reason)
+        path = os.path.join(dirpath, name)
+        os.makedirs(path, exist_ok=True)
+        manifest: Dict[str, Any] = {
+            "schema": BUNDLE_SCHEMA, "reason": reason,
+            "ts": time.time(), "pid": os.getpid(),
+            "env": env_snapshot(), "hw_provenance": _provenance(),
+            "report": _report_summary(report),
+        }
+        if tags:
+            manifest["tags"] = {k: v for k, v in tags.items()
+                                if v is not None}
+        arrays: Dict[str, Any] = {}
+        A = getattr(bundle, "A_host", None) if bundle is not None \
+            else None
+        if A is not None:
+            try:
+                from amgcl_tpu.serve.registry import (
+                    sparsity_fingerprint, stable_config_key)
+                manifest["fingerprint"] = sparsity_fingerprint(A)
+                manifest["config_key"] = stable_config_key(
+                    getattr(bundle, "solver", None),
+                    getattr(getattr(bundle, "precond", None), "prm",
+                            None) or getattr(bundle, "precond", None))
+            except Exception:
+                pass
+            manifest["config"] = capture_config(bundle)
+            arrays.update(ptr=np.asarray(A.ptr), col=np.asarray(A.col),
+                          val=np.asarray(A.val),
+                          shape=np.asarray([A.nrows, A.ncols], np.int64))
+            manifest["matrix"] = {"rows": int(A.nrows),
+                                  "nnz": int(A.nnz)}
+        else:
+            manifest["config"] = {"replayable": False,
+                                  "notes": ["solver bundle unavailable "
+                                            "at dump time"]}
+        if rhs is not None:
+            rhs_np = np.asarray(rhs)
+            arrays["rhs"] = rhs_np
+            manifest["rhs_hash"] = _content_hash(rhs_np)
+        if x0 is not None:
+            x0_np = np.asarray(x0)
+            arrays["x0"] = x0_np
+            manifest["x0_hash"] = _content_hash(x0_np)
+        if arrays:
+            np.savez_compressed(os.path.join(path, "system.npz"),
+                                **arrays)
+        with open(os.path.join(path, "manifest.json"), "w") as f:
+            json.dump(_sink._clean(manifest), f, indent=1,
+                      default=_sink._jsonable)
+        with _lock:
+            _dumps_total += 1
+        event.update(path=path, fingerprint=manifest.get("fingerprint"),
+                     replayable=manifest["config"].get("replayable"),
+                     dumps_total=_dumps_total)
+        _sink.emit(event)
+        return path
+    except Exception as e:                       # noqa: BLE001
+        # a half-written bundle would both crash a later replay AND
+        # permanently consume a MAX_DUMPS slot (_existing_bundles
+        # counts directories) — remove it before reporting the skip
+        if path is not None:
+            import shutil
+            shutil.rmtree(path, ignore_errors=True)
+        event["skipped"] = "dump failed: %r" % e
+        try:
+            _sink.emit(event)
+        except Exception:
+            pass
+        return None
+
+
+def dump_capsule(reason: str, capsule: Optional[Dict[str, Any]] = None,
+                 tags: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Dump a ringed capsule (default: the newest) — the excepthook and
+    post-hoc paths. A dead bundle weakref still dumps the manifest +
+    rhs (marked non-replayable)."""
+    capsule = capsule or last_capsule()
+    if capsule is None:
+        return None
+    bundle = capsule["bundle"]()
+    return dump(reason, bundle=bundle, rhs=capsule.get("rhs"),
+                x0=capsule.get("x0"), report=capsule.get("report"),
+                tags=tags)
+
+
+# ---------------------------------------------------------------------------
+# excepthook
+# ---------------------------------------------------------------------------
+
+_prev_excepthook = None
+
+
+def install_excepthook() -> bool:
+    """Chain a crash dumper into ``sys.excepthook``: an unhandled
+    exception dumps the newest capsule (reason ``crash``, exception
+    repr in the tags) before the previous hook runs. Idempotent;
+    returns whether the hook is installed after the call."""
+    global _prev_excepthook
+    if not enabled():
+        return False
+    if _prev_excepthook is not None:
+        return True
+    _prev_excepthook = sys.excepthook
+
+    def _hook(exc_type, exc, tb):
+        try:
+            dump_capsule("crash", tags={
+                "exception": "%s: %s" % (exc_type.__name__, exc)})
+        except Exception:                        # noqa: BLE001
+            pass                 # the original traceback must still print
+        (_prev_excepthook or sys.__excepthook__)(exc_type, exc, tb)
+
+    sys.excepthook = _hook
+    return True
+
+
+def uninstall_excepthook() -> None:
+    global _prev_excepthook
+    if _prev_excepthook is not None:
+        sys.excepthook = _prev_excepthook
+        _prev_excepthook = None
+
+
+# ---------------------------------------------------------------------------
+# replay
+# ---------------------------------------------------------------------------
+
+def load_bundle(path: str):
+    """(manifest, arrays) of a bundle directory (or a direct path to
+    its ``manifest.json``)."""
+    if os.path.isfile(path):
+        path = os.path.dirname(path)
+    with open(os.path.join(path, "manifest.json")) as f:
+        manifest = json.load(f)
+    arrays = {}
+    npz = os.path.join(path, "system.npz")
+    if os.path.exists(npz):
+        with np.load(npz) as z:
+            arrays = {k: z[k] for k in z.files}
+    return manifest, arrays
+
+
+class _ReplayEnv:
+    """Apply the manifest's ``AMGCL_TPU_*`` snapshot for the duration
+    of the replay, then restore. The recorder's own knobs and the sink
+    path are excluded from the snapshot — AND the recorder is forced
+    OFF for the duration: a replayed health-trip solve re-trips the
+    same fatal guard inside ``make_solver.__call__``, and without the
+    kill switch every replay would recursively dump a fresh bundle
+    (burning an ``AMGCL_TPU_FLIGHT_MAX_DUMPS`` slot per replay until
+    real incidents are silently skipped)."""
+
+    _EXCLUDE_PREFIXES = ("AMGCL_TPU_FLIGHT", "AMGCL_TPU_TELEMETRY")
+
+    def __init__(self, snapshot: Dict[str, str]):
+        self.apply = {k: v for k, v in (snapshot or {}).items()
+                      if k.startswith("AMGCL_TPU_")
+                      and not k.startswith(self._EXCLUDE_PREFIXES)}
+        self.saved: Dict[str, Optional[str]] = {}
+
+    def __enter__(self):
+        live = {k for k in os.environ if k.startswith("AMGCL_TPU_")
+                and not k.startswith(self._EXCLUDE_PREFIXES)}
+        for k in live | set(self.apply):
+            self.saved[k] = os.environ.get(k)
+        for k in live - set(self.apply):
+            del os.environ[k]
+        os.environ.update(self.apply)
+        # recorder off while the replayed solve runs (restored on exit)
+        self.saved["AMGCL_TPU_FLIGHT"] = os.environ.get(
+            "AMGCL_TPU_FLIGHT")
+        os.environ["AMGCL_TPU_FLIGHT"] = "0"
+        return self
+
+    def __exit__(self, *exc):
+        for k, v in self.saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        return False
+
+
+def _flags_of(health: Optional[Dict[str, Any]]) -> List[str]:
+    if not isinstance(health, dict):
+        return []
+    return sorted(str(f) for f in health.get("flags") or [])
+
+
+def check_parity(recorded: Dict[str, Any], replayed: Dict[str, Any],
+                 same_platform: bool,
+                 rtol: float = 1e-4) -> Dict[str, Any]:
+    """The replay contract: iteration count and health-flag identity
+    EXACT on the same platform, residual within ``rtol`` relative; a
+    cross-platform replay reports every check as skipped (informational
+    values kept) and passes. Returns {ok, checks: [...]}."""
+    checks: List[Dict[str, Any]] = []
+
+    def row(name, a, b, ok, skipped=False):
+        r: Dict[str, Any] = {"check": name, "recorded": a, "replayed": b}
+        r["status"] = "skipped" if skipped else ("ok" if ok
+                                                 else "mismatch")
+        checks.append(r)
+
+    skip = not same_platform
+    it_a, it_b = recorded.get("iters"), replayed.get("iters")
+    if it_a is None or it_b is None:
+        row("iters", it_a, it_b, True, skipped=True)
+    else:
+        row("iters", int(it_a), int(it_b),
+            int(it_a) == int(it_b), skipped=skip)
+    fa = _flags_of(recorded.get("health"))
+    fb = _flags_of(replayed.get("health"))
+    row("health_flags", fa, fb, fa == fb,
+        skipped=skip or (recorded.get("health") is None))
+    ra, rb = recorded.get("resid"), replayed.get("resid")
+    if ra is None or rb is None:
+        row("resid", ra, rb, True, skipped=True)
+    else:
+        ra, rb = float(ra), float(rb)
+        both_nonfinite = not (np.isfinite(ra) or np.isfinite(rb))
+        close = both_nonfinite or (
+            np.isfinite(ra) and np.isfinite(rb)
+            and abs(ra - rb) <= rtol * max(abs(ra), abs(rb), 1e-300))
+        row("resid", ra, rb, bool(close), skipped=skip)
+    ok = not any(c["status"] == "mismatch" for c in checks)
+    out = {"ok": ok, "platform_skip": skip, "checks": checks}
+    if all(c["status"] == "skipped" for c in checks) and not skip:
+        # a bundle dumped without a report (failed-batch incidents
+        # resolve no report) compares NOTHING — say so instead of
+        # printing a vacuous green parity verdict
+        out["vacuous"] = True
+    return out
+
+
+def run_replay(path: str, rtol: float = 1e-4,
+               apply_env: bool = True) -> Dict[str, Any]:
+    """Load a bundle, rebuild the solve, re-run it under the recorded
+    env, and score parity. Returns {ok, parity, report, diff,
+    manifest_path, ...}; ``ok`` is False for a non-replayable bundle.
+    Imports jax/the model layer — callers who must stay jax-free run
+    this in a subprocess (``bench.py --check`` does)."""
+    manifest, arrays = load_bundle(path)
+    cfg = manifest.get("config") or {}
+    out: Dict[str, Any] = {"manifest_path": path,
+                           "reason": manifest.get("reason"),
+                           "fingerprint": manifest.get("fingerprint")}
+    if not cfg.get("replayable"):
+        out.update(ok=False,
+                   error="bundle is not replayable: %s"
+                   % "; ".join(cfg.get("notes") or ["no config"]))
+        return out
+    if "ptr" not in arrays or "rhs" not in arrays:
+        out.update(ok=False, error="bundle carries no matrix/rhs npz")
+        return out
+    env = manifest.get("env") or {}
+    ctx = _ReplayEnv(env) if apply_env else _ReplayEnv({})
+    with ctx:
+        import jax
+        needs_x64 = "float64" in (cfg.get("solver_dtype") or "") \
+            or "float64" in ((cfg.get("precond") or {}).get("dtype")
+                             or "")
+        if needs_x64 and not jax.config.jax_enable_x64:
+            jax.config.update("jax_enable_x64", True)
+        from amgcl_tpu.models import runtime as rt
+        from amgcl_tpu.models.make_solver import make_solver
+        from amgcl_tpu.ops.csr import CSR
+        A = CSR(arrays["ptr"], arrays["col"], arrays["val"],
+                int(arrays["shape"][0]))
+        for name in ("rhs", "x0"):
+            want = manifest.get(name + "_hash")
+            if want and name in arrays \
+                    and _content_hash(arrays[name]) != want:
+                out.update(ok=False,
+                           error="%s content hash mismatch — the "
+                                 "bundle was modified" % name)
+                return out
+        solver = rt.solver_from_params(dict(cfg.get("solver") or {}))
+        pcfg = dict(cfg.get("precond") or {"class": "amg"})
+        precond = rt.precond_from_config(A, pcfg)
+        kw: Dict[str, Any] = {"refine": int(cfg.get("refine", 0))}
+        if cfg.get("refine_dtype"):
+            kw["refine_dtype"] = cfg["refine_dtype"]
+        if cfg.get("solver_dtype"):
+            kw["solver_dtype"] = rt.DTYPES.get(cfg["solver_dtype"],
+                                               cfg["solver_dtype"])
+        if cfg.get("matrix_format"):
+            kw["matrix_format"] = cfg["matrix_format"]
+        bundle = make_solver(A, precond, solver, **kw)
+        x0 = arrays.get("x0")
+        x, report = bundle(arrays["rhs"],
+                           x0 if x0 is not None else None)
+        import jax as _jax
+        _jax.block_until_ready(x)
+    recorded = manifest.get("report") or {}
+    plat_rec = (manifest.get("hw_provenance") or {}).get(
+        "device_platform")
+    plat_now = _provenance().get("device_platform")
+    same = plat_rec is None or plat_now is None or plat_rec == plat_now
+    replayed = report.to_dict(with_history=False)
+    out["parity"] = check_parity(recorded, replayed, same, rtol=rtol)
+    out["ok"] = out["parity"]["ok"]
+    out["report"] = {k: replayed.get(k)
+                     for k in ("iters", "resid", "wall_time_s",
+                               "solver", "health")
+                     if replayed.get(k) is not None}
+    out["platform"] = {"recorded": plat_rec, "current": plat_now}
+    try:
+        from amgcl_tpu.telemetry import diff as _diff
+        out["diff"] = _diff.compact(_diff.diff(recorded, replayed))
+    except Exception:
+        pass
+    return out
+
+
+def format_replay(result: Dict[str, Any]) -> str:
+    """Human rendering of a :func:`run_replay` result."""
+    lines = ["Flight replay: %s" % result.get("manifest_path")]
+    if result.get("reason"):
+        lines.append("  incident reason: %s" % result["reason"])
+    if result.get("error"):
+        lines.append("  ERROR: %s" % result["error"])
+        return "\n".join(lines)
+    plat = result.get("platform") or {}
+    if plat:
+        lines.append("  platform: recorded=%s current=%s"
+                     % (plat.get("recorded"), plat.get("current")))
+    parity = result.get("parity") or {}
+    for c in parity.get("checks") or []:
+        lines.append("  %-13s %-24s vs %-24s %s"
+                     % (c["check"], c["recorded"], c["replayed"],
+                        c["status"].upper()))
+    if parity.get("vacuous"):
+        lines.append("  parity: NOT APPLICABLE — the bundle carries no "
+                     "recorded report (failed-batch incidents resolve "
+                     "none); the replay completed, nothing to compare")
+    else:
+        lines.append("  parity: %s%s"
+                     % ("OK" if parity.get("ok") else "MISMATCH",
+                        " (cross-platform: exact checks skipped)"
+                        if parity.get("platform_skip") else ""))
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# self-replay (bench.py --check determinism gate)
+# ---------------------------------------------------------------------------
+
+def selftest(n: int = 10, workdir: Optional[str] = None
+             ) -> Dict[str, Any]:
+    """Dump → replay → parity on a small generated problem: the
+    determinism self-check ``bench.py --check`` gates every round on.
+    Solves an n³ Poisson system with the headline CG+SA config, dumps
+    a bundle into ``workdir`` (a temp dir by default), replays it, and
+    returns the parity record."""
+    import tempfile
+    import jax.numpy as jnp
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    workdir = workdir or tempfile.mkdtemp(prefix="flight-selftest-")
+    A, rhs = poisson3d(int(n))
+    bundle = make_solver(A, AMGParams(dtype=jnp.float32,
+                                      coarse_enough=200),
+                         CG(maxiter=100, tol=1e-6))
+    x, report = bundle(rhs.astype(np.float32))
+    # the selftest dump is unbounded in ITS directory: a saturated
+    # incident bound must not misreport the round as a determinism
+    # failure (callers keep selftest bundles out of the incident dir —
+    # bench.py --check uses a `check/` subdirectory)
+    saved = {k: os.environ.get(k) for k in
+             ("AMGCL_TPU_FLIGHT_DIR", "AMGCL_TPU_FLIGHT_MAX_DUMPS")}
+    os.environ["AMGCL_TPU_FLIGHT_DIR"] = workdir
+    os.environ["AMGCL_TPU_FLIGHT_MAX_DUMPS"] = "0"
+    try:
+        path = dump("selftest", bundle=bundle,
+                    rhs=rhs.astype(np.float32), report=report)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    if path is None:
+        return {"ok": False, "error": "selftest dump failed "
+                "(recorder disabled?)", "n": int(n)}
+    result = run_replay(path)
+    result["n"] = int(n)
+    result["bundle"] = path
+    return result
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """``python -m amgcl_tpu.telemetry.flight --selftest [n] [--dir D]``
+    (the --check subprocess) or ``--replay <bundle>``. Prints ONE JSON
+    line; exit 0 on parity."""
+    args = list(argv if argv is not None else sys.argv[1:])
+    if "--replay" in args:
+        i = args.index("--replay")
+        result = run_replay(args[i + 1])
+    else:
+        n = 10
+        workdir = None
+        if "--dir" in args:
+            i = args.index("--dir")
+            workdir = args[i + 1]
+            del args[i:i + 2]
+        nums = [a for a in args if a.isdigit()]
+        if nums:
+            n = int(nums[0])
+        result = selftest(n=n, workdir=workdir)
+    from amgcl_tpu.telemetry import sink as _sink
+    print(json.dumps(_sink._clean(result), default=_sink._jsonable))
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

@@ -492,7 +492,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              compile_stats: Optional[Dict[str, Any]] = None,
              serve: Optional[Dict[str, Any]] = None,
              comm: Optional[Dict[str, Any]] = None,
-             farm: Optional[Dict[str, Any]] = None
+             farm: Optional[Dict[str, Any]] = None,
+             diff: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
@@ -507,7 +508,11 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     wire rates far off the ICI peak, host-virtual-mesh caveats.
     ``farm`` takes a :meth:`SolverFarm.stats` rollup and folds in the
     per-tenant SLO breaches (tenant-named) plus the eviction-thrash /
-    pool-pressure findings (:func:`farm_findings`). Each finding:
+    pool-pressure findings (:func:`farm_findings`). ``diff`` takes a
+    ``telemetry.diff.diff()`` record (two solves/bench rounds compared
+    stage by stage) and folds in the cross-run attribution findings —
+    the doctor names the culprit stage of a regression, not just the
+    regression. Each finding:
     {severity, code, message, suggestion}. Pure host-side
     dict-crunching — never raises on missing pieces."""
     out: List[Dict[str, Any]] = []
@@ -674,6 +679,12 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     if isinstance(farm, dict):
         # farm leg: per-tenant SLO breaches + eviction thrash
         out.extend(farm_findings(farm))
+    if isinstance(diff, dict):
+        # forensics leg: cross-run regression attribution
+        # (telemetry/diff.py — stdlib-only, safe to import here)
+        from amgcl_tpu.telemetry import diff as _diff_mod
+        out.extend(f for f in _diff_mod.findings(diff)
+                   if isinstance(f, dict) and "severity" in f)
     if isinstance(compile_stats, dict):
         from amgcl_tpu.telemetry import compile_watch as _cw
         out.extend(_cw.findings(compile_stats))

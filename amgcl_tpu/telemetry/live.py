@@ -93,6 +93,9 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "compile-watch cache hits of serve.solve_step"),
     "serve_compile_s": (
         "gauge", "cumulative XLA compile seconds of serve.solve_step"),
+    "flight_dumps_total": (
+        "counter", "flight-recorder replay bundles written on incident "
+                   "triggers (telemetry/flight.py)"),
     "dist_mesh_devices": (
         "gauge", "devices in the distributed solve mesh"),
     "dist_comm_fraction": (

@@ -27,6 +27,29 @@ from typing import Any, Dict, Optional
 # converter instead of duplicating it
 from amgcl_tpu.telemetry.sink import _clean, _jsonable
 
+#: schema version stamped onto every ``to_dict()`` (and the JSONL
+#: ``solve`` events built from it) so ``telemetry/diff.py`` can refuse
+#: or degrade comparisons across incompatible report layouts
+REPORT_SCHEMA = 1
+
+_hw_provenance_cache: Optional[Dict[str, Any]] = None
+
+
+def _hw_provenance() -> Dict[str, Any]:
+    """Process-cached hardware stamp (telemetry/comm.py): bench records
+    already carry provenance, solve-level events did not — ``diff.py``
+    needs it to platform-skip cross-platform comparisons the way the
+    ``_record_platform`` gates do. Cached once: the device set of a
+    process never changes."""
+    global _hw_provenance_cache
+    if _hw_provenance_cache is None:
+        try:
+            from amgcl_tpu.telemetry.comm import hw_provenance
+            _hw_provenance_cache = hw_provenance()
+        except Exception:
+            _hw_provenance_cache = {"device_platform": None}
+    return _hw_provenance_cache
+
 
 @dataclass
 class SolveReport:
@@ -105,6 +128,8 @@ class SolveReport:
 
     def to_dict(self, with_history: bool = True) -> Dict[str, Any]:
         out: Dict[str, Any] = {
+            "schema": REPORT_SCHEMA,
+            "hw_provenance": _hw_provenance(),
             "iters": int(self.iters),
             "resid": float(self.resid),
             "convergence_rate": self.convergence_rate,

@@ -37,7 +37,23 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # percentile rollups (p50/p90/p99),
                                     # optionally rolling up a JSONL sink
                                     # file too; --prom PATH additionally
-                                    # writes Prometheus exposition text
+                                    # writes Prometheus exposition text.
+                                    # Rounds that regressed beyond the
+                                    # gate's time tolerance gain a 'why'
+                                    # column — the top attributed stage
+                                    # from telemetry/diff.py ('-' when
+                                    # the older round predates per-stage
+                                    # data)
+    python bench.py --why A.json B.json
+                                    # cross-run regression attribution:
+                                    # compare two records of the same
+                                    # kind (bench worker records, solve
+                                    # reports, or multichip records)
+                                    # stage by stage and decompose the
+                                    # wall/iters/bytes delta into ranked
+                                    # per-stage contributions
+                                    # (telemetry/diff.py); emits ONE
+                                    # bench_why JSONL record
     python bench.py --vecbench [n ...]
                                     # microbenchmark: fused vector kernels
                                     # (ops/fused_vec.py) vs the composed
@@ -114,6 +130,14 @@ def _load_metrics():
     # stdlib-only, like the sink: the supervisor aggregates without jax
     return _load_by_path("_amgcl_tpu_metrics",
                          ("amgcl_tpu", "telemetry", "metrics.py"))
+
+
+def _load_diff():
+    # stdlib-only structured report diffing (telemetry/diff.py) — the
+    # --why / --trend / gate-failure attribution engine, loaded by file
+    # path for the same no-jax reason as the sink
+    return _load_by_path("_amgcl_tpu_diff",
+                         ("amgcl_tpu", "telemetry", "diff.py"))
 
 
 _sink = _load_sink()
@@ -1138,6 +1162,24 @@ def main_worker():
                                     % (left, est)}
         return False
 
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_STAGES") == "1") \
+            and _enough("roofline_stages", 150):
+        # measured per-(level, stage) cycle times (telemetry/roofline.
+        # measure_stages) in the compact form telemetry/diff.py joins —
+        # the rows that let a LATER round's gate failure name the stage
+        # that regressed instead of just the ratio (--why / --trend why)
+        _stage("roofline stages")
+        try:
+            roof = solver.precond.roofline()
+            _PARTIAL["roofline_stages"] = [
+                {"level": r["level"], "stage": r["stage"],
+                 "visits": r.get("visits", 1), "t_s": r["t_s"],
+                 "model_bytes": r.get("model_bytes"),
+                 "model_flops": r.get("model_flops")}
+                for r in roof.get("stages", [])]
+        except Exception as e:
+            _PARTIAL["roofline_stages"] = {"error": repr(e)[:200]}
+
     levels = None
     if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_LEVELS") == "1") \
             and _enough("levels", 180):
@@ -1749,7 +1791,11 @@ def main_scaling(args=None):
             base.get("path", "baseline"), "ok" if ok else "REGRESSION"))
         for c in checks:
             if c.get("status") != "ok":
-                print("  %s: %s" % (c["check"], c["status"]))
+                # the measured pair rides the failure line — a status
+                # name alone sends the reader back to the JSON
+                print("  %s: %s (candidate %s vs baseline %s, limit %s)"
+                      % (c["check"], c["status"], c.get("candidate"),
+                         c.get("last_good"), c.get("limit")))
     return 0
 
 
@@ -1876,9 +1922,15 @@ def multichip_gate_record():
         return {"ok": True, "status": "no_baseline",
                 "candidate_src": src, "tolerances": tol}
     ok, checks = run_multichip_gate(cand, base, tol)
-    return {"ok": ok, "candidate_src": src,
-            "baseline": base.get("path"), "tolerances": tol,
-            "checks": checks}
+    out = {"ok": ok, "candidate_src": src,
+           "baseline": base.get("path"), "tolerances": tol,
+           "checks": checks}
+    if not ok:
+        # same contract as the bench gate: the failure record carries
+        # the measured pairs + the cross-run attribution
+        out["failed"] = gate_failures(checks)
+        out["attribution"] = gate_attribution(cand, base)
+    return out
 
 
 # ===========================================================================
@@ -2159,6 +2211,12 @@ def main_gate(args=None):
     ok, checks = run_gate(cand, lg, tol)
     rec = {"event": "bench_gate", "ok": ok, "candidate_src": cand_src,
            "tolerances": tol, "checks": checks, "commit": _git_head()}
+    if not ok:
+        # failed checks with their measured candidate/baseline pairs in
+        # one place, plus the automatic cross-run attribution — the
+        # post-hoc `--why` answer rides the failure record itself
+        rec["failed"] = gate_failures(checks)
+        rec["attribution"] = gate_attribution(cand, lg)
     # multichip arm: this round's --scaling record vs the previous
     # round's committed MULTICHIP_r*.json (AMGCL_TPU_GATE_MULTICHIP)
     mc = multichip_gate_record()
@@ -2171,6 +2229,65 @@ def main_gate(args=None):
     return 0 if ok else 1
 
 
+def gate_failures(checks):
+    """The regression rows of a gate run, with the measured
+    candidate/baseline pair each (so post-hoc tooling never re-derives
+    them from the tolerance and the limit)."""
+    return [{"check": c["check"], "candidate": c.get("candidate"),
+             "baseline": c.get("last_good"), "limit": c.get("limit"),
+             **({"reason": c["reason"]} if c.get("reason") else {})}
+            for c in checks if c.get("status") == "regression"]
+
+
+def gate_attribution(cand, base):
+    """Automatic cross-run attribution of a gate failure: the
+    ``telemetry/diff.py`` record of candidate-vs-baseline, stage rows
+    bounded for the JSONL event. Never raises — a broken diff must not
+    mask the gate verdict."""
+    try:
+        dm = _load_diff()
+        d = dm.compact(dm.diff(base, cand))
+        print(dm.format_diff(d), file=sys.stderr)
+        return d
+    except Exception as e:     # noqa: BLE001
+        return {"error": repr(e)[:200]}
+
+
+# ===========================================================================
+# why: cross-run regression attribution (stdlib-only, telemetry/diff.py)
+# ===========================================================================
+
+def main_why(args=None):
+    """``bench.py --why A.json B.json``: structured attribution of the
+    delta between two records of the same kind — A is the baseline /
+    older run, B the candidate / newer one. Wraps ``telemetry/diff.py``
+    (stage join over the ledger stage keys + roofline rows, exact
+    iterations-vs-per-iteration wall split, compile/comm call-outs).
+    Exit 2 on unreadable/mismatched inputs; exit 0 otherwise — the
+    attribution is a report, the GATE is the verdict."""
+    args = [a for a in (args or []) if not a.startswith("-")]
+    if len(args) < 2:
+        print("usage: bench.py --why A.json B.json", file=sys.stderr)
+        return 2
+    recs = []
+    for path in args[:2]:
+        try:
+            with open(path) as f:
+                recs.append(json.load(f))
+        except Exception as e:
+            print("unreadable record %r: %r" % (path, e),
+                  file=sys.stderr)
+            return 2
+    dm = _load_diff()
+    d = dm.diff(recs[0], recs[1])
+    print(dm.format_diff(d))
+    rec = {"event": "bench_why", "a": args[0], "b": args[1],
+           "diff": dm.compact(d), "commit": _git_head()}
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    return 2 if d.get("error") else 0
+
+
 # ===========================================================================
 # trend: cross-round trajectory + percentile rollups (stdlib-only)
 # ===========================================================================
@@ -2181,8 +2298,33 @@ def trend_summary(metrics_mod=None):
     column}. Pre-ledger/pre-roofline rounds contribute gaps, never
     errors."""
     m = metrics_mod or _load_metrics()
-    rows = m.trend(m.bench_history(_REPO))
-    return {"rows": rows, "rollups": m.trend_rollups(rows)}
+    history = m.bench_history(_REPO)
+    rows = m.trend(history)
+    # the raw records ride along (underscored: not for the JSONL
+    # record) so --trend's why-attribution reuses them instead of
+    # re-reading every BENCH_r*.json from disk
+    return {"rows": rows, "rollups": m.trend_rollups(rows),
+            "_history": history}
+
+
+def _annotate_trend_why(rows, history):
+    """Attach the ``why`` column to trend rows IN PLACE: for each round
+    whose solve time regressed beyond the gate's time tolerance against
+    the previous round (same platform), the top attributed contributor
+    of ``telemetry/diff.py``; None (rendered '-') everywhere else,
+    including rounds whose predecessor predates per-stage data (the
+    label then degrades to the coarse iterations/per-iteration bucket
+    the wall split can still name)."""
+    dm = _load_diff()
+    limit = gate_tolerances()["time"]
+    prev_row = prev_rec = None
+    for rec, row in zip(history, rows):
+        row.setdefault("why", None)
+        if prev_row is not None:
+            t0, t1 = prev_row.get("solve_s"), row.get("solve_s")
+            if t0 and t1 and t1 > t0 * limit:
+                row["why"] = dm.why(prev_rec, rec)
+        prev_row, prev_rec = row, rec
 
 
 def main_trend(args=None):
@@ -2198,7 +2340,16 @@ def main_trend(args=None):
         prom_path = args[i + 1] if i + 1 < len(args) else None
         del args[i:i + 2]
     summ = trend_summary(m)
-    print(m.format_trend(summ["rows"]))
+    # the why column: each round-over-round regression beyond the
+    # gate's time tolerance gets the top attributed stage from
+    # telemetry/diff.py ('-' gap when the older record predates
+    # per-stage data or the platforms differ)
+    try:
+        _annotate_trend_why(summ["rows"], summ["_history"])
+    except Exception:       # noqa: BLE001 — attribution is a bonus
+        pass                # column; the table must still render
+    print(m.format_trend(summ["rows"],
+                         m.TREND_FIELDS + [("why", "why")]))
     rollups = dict(summ["rollups"])
     rec = {"event": "bench_trend", "rows": summ["rows"],
            "rollups": summ["rollups"], "commit": _git_head()}
@@ -2457,6 +2608,12 @@ def main_check(targets=None):
             gate_ok, checks = run_gate(cand, lg)
             rec["gate"] = {"ok": gate_ok, "candidate_src": cand_src,
                            "checks": checks}
+            if not gate_ok:
+                # failed checks carry their measured pairs, and the
+                # cross-run attribution section is appended to every
+                # gate failure — CI names the culprit stage itself
+                rec["gate"]["failed"] = gate_failures(checks)
+                rec["gate"]["attribution"] = gate_attribution(cand, lg)
         # the CI record carries the efficiency summaries of the record it
         # gated (roofline frac + compile totals travel with the gate
         # verdict), plus the cross-round trend rollups — pre-roofline
@@ -2475,6 +2632,41 @@ def main_check(targets=None):
         if mc is not None:
             rec["multichip"] = mc
             gate_ok = gate_ok and mc["ok"]
+    replay_ok = True
+    if os.environ.get("AMGCL_TPU_FLIGHT", "1") != "0":
+        # determinism self-check (telemetry/flight.py): dump a replay
+        # bundle of a small headline-config solve, replay it, and
+        # require report parity — so "a bundle replays identically on
+        # the same platform" is gated every round, not asserted once.
+        # A gate failure additionally persists the bundle into
+        # AMGCL_TPU_FLIGHT_DIR (when set): the failing round leaves a
+        # replayable artifact behind, not just ratios.
+        r_timeout = float(os.environ.get("AMGCL_TPU_CHECK_TIMEOUT",
+                                         "870")) / 2
+        cmd2 = [sys.executable, "-m", "amgcl_tpu.telemetry.flight",
+                "--selftest"]
+        keep_dir = os.environ.get("AMGCL_TPU_FLIGHT_DIR")
+        if not gate_ok and keep_dir:
+            # a `check/` SUBdirectory: the persisted bundle must not
+            # consume one of the incident dir's bounded dump slots
+            cmd2 += ["--dir", os.path.join(keep_dir, "check")]
+        try:
+            rr = subprocess.run(cmd2, capture_output=True, text=True,
+                                timeout=r_timeout, cwd=_REPO,
+                                env=dict(os.environ,
+                                         JAX_PLATFORMS="cpu"))
+            rrec = json.loads(rr.stdout.strip().splitlines()[-1])
+            replay_ok = bool(rrec.get("ok")) and rr.returncode == 0
+            rec["selfreplay"] = {
+                "ok": replay_ok, "n": rrec.get("n"),
+                "reason": rrec.get("reason"),
+                "parity": rrec.get("parity"),
+                "bundle": rrec.get("bundle")}
+            if not replay_ok and rrec.get("error"):
+                rec["selfreplay"]["error"] = rrec["error"]
+        except Exception as e:
+            replay_ok = False
+            rec["selfreplay"] = {"ok": False, "error": repr(e)[:300]}
     analysis_ok = True
     if os.environ.get("AMGCL_TPU_ANALYSIS_IN_CHECK", "1") != "0":
         # static-analysis gate (amgcl_tpu/analysis): AST lint vs the
@@ -2518,7 +2710,8 @@ def main_check(targets=None):
         rec["trend"] = {"error": repr(e)[:200]}
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
-    return 0 if (rc == 0 and gate_ok and analysis_ok) else 1
+    return 0 if (rc == 0 and gate_ok and analysis_ok
+                 and replay_ok) else 1
 
 
 if __name__ == "__main__":
@@ -2532,6 +2725,9 @@ if __name__ == "__main__":
     elif "--gate" in sys.argv:
         extra = sys.argv[sys.argv.index("--gate") + 1:]
         sys.exit(main_gate(extra))
+    elif "--why" in sys.argv:
+        extra = sys.argv[sys.argv.index("--why") + 1:]
+        sys.exit(main_why(extra))
     elif "--trend" in sys.argv:
         extra = sys.argv[sys.argv.index("--trend") + 1:]
         sys.exit(main_trend(extra))

@@ -1,0 +1,548 @@
+"""Flight recorder, deterministic solve replay, and cross-run
+regression attribution (ISSUE 12): capsule ring + incident dumps,
+replay parity on a health-trip bundle, the crash excepthook, the
+stdlib diff engine (exact wall split, stage join, platform skip),
+gate-failure attribution with measured pairs, the --trend why column,
+and the serial CLI --replay smoke."""
+
+import json
+import os
+import sys
+import subprocess
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from amgcl_tpu.models.make_solver import make_solver
+from amgcl_tpu.models.amg import AMG, AMGParams
+from amgcl_tpu.models.preconditioner import DummyPreconditioner
+from amgcl_tpu.ops.csr import CSR
+from amgcl_tpu.solver.cg import CG
+from amgcl_tpu.telemetry import JsonlSink, set_default_sink
+from amgcl_tpu.telemetry import diff as diffmod
+from amgcl_tpu.telemetry import flight
+from amgcl_tpu.telemetry.health import diagnose
+from amgcl_tpu.telemetry.report import SolveReport
+from amgcl_tpu.utils.sample_problem import poisson3d
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _bench():
+    sys.path.insert(0, _REPO)
+    try:
+        import bench
+    finally:
+        sys.path.pop(0)
+    return bench
+
+
+def _singular_system(n=12):
+    """Singular 1-D Neumann Laplacian as a host CSR + the null-space
+    rhs — every Krylov method breaks down on it (test_health's
+    fixture, kept on the host so the flight dump carries the CSR)."""
+    import scipy.sparse as sp
+    main = 2.0 * np.ones(n)
+    main[0] = main[-1] = 1.0
+    L = sp.diags([-np.ones(n - 1), main, -np.ones(n - 1)],
+                 [-1, 0, 1]).tocsr()
+    return CSR.from_scipy(L), np.ones(n)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_recorder():
+    flight._reset_for_tests()
+    yield
+    flight._reset_for_tests()
+
+
+# -- capsules, dumps, knobs --------------------------------------------------
+
+def test_dump_disabled_without_dir(monkeypatch, tmp_path):
+    """AMGCL_TPU_FLIGHT_DIR unset = nothing on disk AND nothing ringed
+    (every ring consumer writes into that directory, so ringing
+    without it would only pin buffers); AMGCL_TPU_FLIGHT=0 kills the
+    recorder outright."""
+    monkeypatch.delenv("AMGCL_TPU_FLIGHT_DIR", raising=False)
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    s(rhs)
+    assert flight.last_capsule() is None           # no dir, no ring
+    assert flight.dumps_total() == 0               # nothing written
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    s(rhs)
+    assert flight.last_capsule() is not None       # dir set -> ringed
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT", "0")
+    assert not flight.enabled()
+    assert flight.dump("x", bundle=s, rhs=rhs) is None
+
+
+def test_failed_dump_leaves_no_partial_bundle(monkeypatch, tmp_path):
+    """A dump that fails mid-write removes its half-written directory —
+    a partial bundle would both crash a later replay and permanently
+    consume a MAX_DUMPS slot."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = poisson3d(6)
+    s = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=100),
+                    CG(maxiter=50, tol=1e-6))
+
+    def boom(*a, **k):
+        raise OSError("disk full")
+
+    monkeypatch.setattr(flight.np, "savez_compressed", boom)
+    assert flight.dump("t", bundle=s, rhs=rhs) is None
+    assert flight._existing_bundles(str(tmp_path)) == []
+
+
+def test_health_trip_dumps_bundle_and_event(monkeypatch, tmp_path):
+    """A fatal guard trip during a make_solver solve dumps a
+    self-contained bundle: manifest with fingerprint/config/env/
+    provenance/report summaries + the npz system, and a flight_dump
+    JSONL event rides the sink."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path / "fd"))
+    sink_path = tmp_path / "t.jsonl"
+    set_default_sink(JsonlSink(str(sink_path)))
+    try:
+        A, rhs = _singular_system()
+        s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                        CG(maxiter=30, tol=1e-8))
+        _x, rep = s(rhs)
+        assert rep.health is not None and not rep.health["ok"]
+        assert flight.fatal_health(rep.health)
+    finally:
+        set_default_sink(None)
+    bundles = flight._existing_bundles(str(tmp_path / "fd"))
+    assert len(bundles) == 1 and "health_trip" in bundles[0]
+    manifest, arrays = flight.load_bundle(
+        os.path.join(str(tmp_path / "fd"), bundles[0]))
+    assert manifest["schema"] == flight.BUNDLE_SCHEMA
+    assert manifest["reason"] == "health_trip"
+    assert manifest["config"]["replayable"] is True
+    assert manifest["config"]["precond"]["class"] == "dummy"
+    assert manifest["fingerprint"]
+    assert manifest["rhs_hash"]
+    assert manifest["hw_provenance"]["device_platform"] == "cpu"
+    assert manifest["report"]["health"]["flags"]
+    assert isinstance(manifest["env"], dict)
+    assert arrays["rhs"].shape == (A.nrows,)
+    assert arrays["val"].shape[0] == A.nnz
+    events = [json.loads(line) for line in
+              open(sink_path).read().splitlines()]
+    fd = [e for e in events if e.get("event") == "flight_dump"]
+    assert fd and fd[0]["reason"] == "health_trip" \
+        and fd[0]["dumps_total"] == 1
+    assert fd[0]["flags"]
+
+
+def test_max_dumps_bound(monkeypatch, tmp_path):
+    """The per-directory bundle count is bounded: at the bound new
+    incidents write nothing (counted via the skipped event)."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_MAX_DUMPS", "2")
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    paths = [flight.dump("t%d" % k, bundle=s, rhs=rhs)
+             for k in range(4)]
+    assert [p is not None for p in paths] == [True, True, False, False]
+    assert len(flight._existing_bundles(str(tmp_path))) == 2
+
+
+# -- replay parity -----------------------------------------------------------
+
+def test_replay_parity_on_health_trip_bundle(monkeypatch, tmp_path):
+    """The acceptance contract: a health-trip bundle replays with
+    IDENTICAL iteration count and health-flag identity on the same
+    platform, residual within tolerance (singular system through
+    cg)."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    _x, rep = s(rhs)
+    assert not rep.health["ok"]
+    bundles = flight._existing_bundles(str(tmp_path))
+    assert bundles
+    path = os.path.join(str(tmp_path), bundles[0])
+    result = flight.run_replay(path)
+    assert result["ok"], result
+    parity = result["parity"]
+    assert not parity["platform_skip"]
+    rows = {c["check"]: c for c in parity["checks"]}
+    assert rows["iters"]["status"] == "ok" \
+        and rows["iters"]["recorded"] == rep.iters
+    assert rows["health_flags"]["status"] == "ok" \
+        and rows["health_flags"]["replayed"] == sorted(
+            rep.health["flags"])
+    assert rows["resid"]["status"] == "ok"
+    # the recorded-vs-replayed diff rides the result for the doctor
+    assert result["diff"]["kind"] == "solve"
+
+
+def test_replay_does_not_recursively_dump(monkeypatch, tmp_path):
+    """Replaying a health-trip bundle re-trips the same fatal guard —
+    the recorder must stay OFF during the replayed solve, or every
+    replay burns one MAX_DUMPS slot until real incidents are silently
+    skipped (the review-confirmed recursion)."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    s(rhs)
+    assert len(flight._existing_bundles(str(tmp_path))) == 1
+    path = os.path.join(str(tmp_path),
+                        flight._existing_bundles(str(tmp_path))[0])
+    result = flight.run_replay(path)
+    assert result["ok"]
+    assert len(flight._existing_bundles(str(tmp_path))) == 1
+    # and the live kill switch is restored afterwards
+    assert flight.enabled()
+
+
+def test_replay_refuses_tampered_x0(monkeypatch, tmp_path):
+    """The x0 hash is verified like the rhs hash — a modified initial
+    guess must refuse, not misdiagnose as solver nondeterminism."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    s(rhs, np.full(A.nrows, 0.5))
+    path = os.path.join(str(tmp_path),
+                        flight._existing_bundles(str(tmp_path))[0])
+    npz = os.path.join(path, "system.npz")
+    with np.load(npz) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["x0"] = arrays["x0"] + 1.0
+    np.savez_compressed(npz, **arrays)
+    result = flight.run_replay(path)
+    assert result["ok"] is False and "x0" in result["error"]
+
+
+def test_reportless_bundle_parity_is_not_vacuous_ok(monkeypatch,
+                                                    tmp_path):
+    """A bundle dumped with no report (the failed-batch incidents)
+    compares nothing — the parity verdict must say NOT APPLICABLE
+    instead of a vacuous green OK."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = poisson3d(6)
+    s = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=100),
+                    CG(maxiter=50, tol=1e-6))
+    path = flight.dump("serve_batch_failed", bundle=s,
+                       rhs=rhs.astype(np.float32),
+                       tags={"request_ids": [1, 2]})
+    result = flight.run_replay(path)
+    assert result["parity"]["vacuous"] is True
+    assert all(c["status"] == "skipped"
+               for c in result["parity"]["checks"])
+    assert "NOT APPLICABLE" in flight.format_replay(result)
+
+
+def test_replay_refuses_tampered_rhs(monkeypatch, tmp_path):
+    """The content hash is verified on load — a modified bundle does
+    not silently replay a different solve."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    s(rhs)
+    path = os.path.join(str(tmp_path),
+                        flight._existing_bundles(str(tmp_path))[0])
+    npz = os.path.join(path, "system.npz")
+    with np.load(npz) as z:
+        arrays = {k: z[k] for k in z.files}
+    arrays["rhs"] = arrays["rhs"] * 2.0
+    np.savez_compressed(npz, **arrays)
+    result = flight.run_replay(path)
+    assert result["ok"] is False and "hash" in result["error"]
+
+
+def test_selftest_roundtrip(tmp_path):
+    """flight.selftest (the bench.py --check determinism gate): dump →
+    replay → parity on a small headline-config solve."""
+    result = flight.selftest(n=6, workdir=str(tmp_path))
+    assert result["ok"], result
+    assert result["parity"]["checks"][0]["status"] == "ok"
+    assert flight._existing_bundles(str(tmp_path))
+
+
+def test_crash_excepthook_dumps_last_capsule(monkeypatch, tmp_path,
+                                             capsys):
+    """An unhandled exception dumps the newest capsule (reason crash,
+    exception repr tagged) and still chains to the previous hook."""
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = poisson3d(6)
+    s = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=100),
+                    CG(maxiter=50, tol=1e-6))
+    s(rhs.astype(np.float32))
+    assert flight.last_capsule() is not None
+    seen = []
+    # earlier in-process CLI runs (test_dist_setup's smoke) leave the
+    # chained hook installed — reset so THIS install wraps the collector
+    flight.uninstall_excepthook()
+    monkeypatch.setattr(sys, "excepthook",
+                        lambda *a: seen.append(a))
+    try:
+        assert flight.install_excepthook()
+        try:
+            raise ValueError("boom for the recorder")
+        except ValueError:
+            sys.excepthook(*sys.exc_info())
+    finally:
+        flight.uninstall_excepthook()
+    assert seen, "previous hook must still run"
+    bundles = flight._existing_bundles(str(tmp_path))
+    assert len(bundles) == 1 and "crash" in bundles[0]
+    manifest, arrays = flight.load_bundle(
+        os.path.join(str(tmp_path), bundles[0]))
+    assert "boom for the recorder" in manifest["tags"]["exception"]
+    assert manifest["config"]["replayable"] is True
+    assert "rhs" in arrays
+
+
+# -- report schema / provenance stamp ---------------------------------------
+
+def test_report_schema_and_provenance_stamp():
+    """SolveReport.to_dict() carries the schema version and the
+    hw_provenance stamp (the diff platform gate's solve-level source —
+    bench records already had provenance, solve events did not)."""
+    rec = SolveReport(5, 1e-8).to_dict()
+    assert rec["schema"] == 1
+    assert rec["hw_provenance"]["device_platform"] == "cpu"
+    assert diffmod.platform_of(rec) == "cpu"
+
+
+# -- diff engine (stdlib) ----------------------------------------------------
+
+def test_diff_exact_wall_split():
+    """The two-term identity Δwall = Δiters·t_B + iters_A·Δt is exact:
+    the contributions sum to the headline wall delta."""
+    a = {"iters": 10, "resid": 1e-8, "wall_time_s": 1.0,
+         "hw_provenance": {"device_platform": "cpu"}}
+    b = {"iters": 14, "resid": 1e-8, "wall_time_s": 2.1,
+         "hw_provenance": {"device_platform": "cpu"}}
+    d = diffmod.diff(a, b)
+    assert d["kind"] == "solve" and not d["platform"]["skip"]
+    split = {c["key"]: c["delta_s"] for c in d["contributions"]}
+    assert split["iterations"] + split["per_iteration"] == \
+        pytest.approx(2.1 - 1.0, rel=1e-12)
+    assert d["headline"]["wall_s"]["regressed"]
+    # findings name the regression with its top contributor
+    folds = diagnose(None, diff=d)
+    assert any(f["code"] == "cross_run_regression" for f in folds)
+
+
+def test_diff_platform_skip():
+    """Cross-platform pairs skip every timed row (the
+    _record_platform rule) — iters stay compared."""
+    a = {"metric": "m", "value": 0.07, "iters": 25,
+         "device_platform": "tpu"}
+    b = {"metric": "m", "value": 2.1, "iters": 25,
+         "device_platform": "cpu"}
+    d = diffmod.diff(a, b)
+    assert d["platform"]["skip"]
+    assert "wall_s" not in d["headline"]
+    assert d["headline"]["iters"]["delta"] == 0
+    assert d["contributions"] == []
+    assert diffmod.why(a, b) is None
+
+
+def test_diff_kind_mismatch_and_gaps():
+    d = diffmod.diff({"iters": 3, "resid": 1e-9},
+                     {"metric": "m", "value": 1.0})
+    assert "error" in d
+    # no per-stage rows on either side -> a gap note, never an error
+    d = diffmod.diff({"metric": "m", "value": 1.0, "iters": 5,
+                      "device_platform": "cpu"},
+                     {"metric": "m", "value": 2.0, "iters": 5,
+                      "device_platform": "cpu"})
+    assert any("per-stage" in g for g in d["gaps"])
+    assert diffmod.format_diff(d)
+
+
+def test_diff_multichip_records():
+    """Multichip diffs join per-(solver, mode, devices) cells and call
+    out the comm-fraction movement."""
+    def rec(eff, cf, t8):
+        return {"event": "multichip_scaling", "schema": 2,
+                "device_platform": "cpu",
+                "headline": {"devices": 8, "weak_efficiency": eff,
+                             "comm_fraction": cf, "iters": 20},
+                "solvers": {"dist_cg": {
+                    "weak": {"cells": [
+                        {"devices": 1, "t_iter_s": 1e-4},
+                        {"devices": 8, "t_iter_s": t8}]},
+                    "strong": {"cells": []}}}}
+    d = diffmod.diff(rec(0.5, 0.2, 2e-4), rec(0.25, 0.4, 4e-4))
+    assert d["kind"] == "multichip"
+    assert d["headline"]["weak_efficiency"]["regressed"]
+    assert d["headline"]["comm_fraction"]["regressed"]
+    assert d["top"] == "comm_fraction"
+    assert d["contributions"][0]["key"] == "dist_cg/weak/nd8"
+
+
+# -- injected regression: the acceptance scenario ---------------------------
+
+@pytest.mark.serial
+def test_injected_regression_attributes_perturbed_stage(tmp_path,
+                                                        monkeypatch):
+    """Force one V-cycle stage slower — npre 1 -> 8 multiplies exactly
+    the pre-smooth work — measure real per-stage roofline rows for
+    both builds, and assert diff.py attributes the majority (>=50%)
+    of the per-stage delta to pre_smooth; then drive the same pair
+    through `bench.py --why` as gate-failure-style bench records and
+    check the printed attribution names the stage. (serial: the stages
+    are µs-scale timed measurements — concurrent host load swamps the
+    injected delta with jitter, the documented re-run-alone
+    protocol.)"""
+    monkeypatch.setenv("AMGCL_TPU_ROOFLINE_REPS", "7")
+    A, _rhs = poisson3d(12)
+
+    def record(npre):
+        amg = AMG(A, AMGParams(dtype=jnp.float64, coarse_enough=100,
+                               npre=npre))
+        roof = amg.roofline()
+        stages = [{"level": r["level"], "stage": r["stage"],
+                   "visits": r.get("visits", 1), "t_s": r["t_s"],
+                   "model_bytes": r.get("model_bytes")}
+                  for r in roof["stages"]]
+        iters = 30
+        wall = iters * sum(r["t_s"] * r.get("visits", 1)
+                           for r in roof["stages"])
+        return {"metric": "inj", "value": wall, "iters": iters,
+                "device_platform": "cpu", "roofline_stages": stages}
+
+    a, b = record(1), record(8)
+    d = diffmod.diff(a, b)
+    assert d["stages"], d["gaps"]
+    by = d["by_stage"]
+    assert "pre_smooth" in by
+    assert by["pre_smooth"]["share"] >= 0.5, by
+    assert d["top"] == "per_iteration:pre_smooth"
+    # the same pair through the bench surface (stdlib supervisor path)
+    pa, pb = tmp_path / "a.json", tmp_path / "b.json"
+    pa.write_text(json.dumps(a))
+    pb.write_text(json.dumps(b))
+    r = subprocess.run([sys.executable,
+                        os.path.join(_REPO, "bench.py"), "--why",
+                        str(pa), str(pb)],
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "pre_smooth" in r.stdout
+    assert "top contributor: per_iteration:pre_smooth" in r.stdout
+
+
+# -- gate failure attribution + measured pairs ------------------------------
+
+def test_gate_failure_carries_measured_pairs_and_attribution():
+    """run_gate failures surface the measured candidate/baseline pair
+    per failed check (gate_failures) and the attribution section
+    (gate_attribution) — the post-hoc --why answer rides the failure
+    record itself."""
+    bench = _bench()
+    lg = {"iters": 10, "value": 1.0, "device_platform": "cpu"}
+    bad = {"iters": 16, "value": 2.0, "device_platform": "cpu"}
+    ok, checks = bench.run_gate(bad, lg)
+    assert not ok
+    failed = bench.gate_failures(checks)
+    assert {f["check"] for f in failed} == {"iters", "solve_time"}
+    row = [f for f in failed if f["check"] == "solve_time"][0]
+    assert row["candidate"] == 2.0 and row["baseline"] == 1.0 \
+        and row["limit"] is not None
+    attr = bench.gate_attribution(bad, lg)
+    assert attr.get("error") is None
+    assert attr["headline"]["wall_s"]["regressed"]
+    assert attr["contributions"]
+
+
+def test_trend_why_column():
+    """--trend's why annotation: only rounds beyond the gate's time
+    tolerance get a label; the label names the top attributed
+    contributor (gap '-' rendered for None)."""
+    bench = _bench()
+    hist = [
+        {"round": 1, "value": 1.0, "iters": 10,
+         "device_platform": "cpu"},
+        {"round": 2, "value": 1.02, "iters": 10,
+         "device_platform": "cpu"},                 # within tolerance
+        {"round": 3, "value": 2.0, "iters": 20,
+         "device_platform": "cpu"},                 # regression
+    ]
+    rows = [{"round": r["round"], "solve_s": r["value"]} for r in hist]
+    bench._annotate_trend_why(rows, hist)
+    assert rows[0]["why"] is None and rows[1]["why"] is None
+    assert rows[2]["why"] in ("iterations", "per_iteration")
+    m = bench._load_metrics()
+    table = m.format_trend(rows, [("solve_s", "value"),
+                                  ("why", "why")])
+    assert "why" in table.splitlines()[0]
+
+
+# -- live counter declaration ------------------------------------------------
+
+def test_flight_dumps_total_declared():
+    """The live-metric name is declared in live.METRICS (the
+    metric-name-literal lint enforces the call sites against the same
+    table) and a registry accepts it."""
+    from amgcl_tpu.telemetry.live import METRICS, LiveRegistry
+    assert METRICS["flight_dumps_total"][0] == "counter"
+    reg = LiveRegistry()
+    reg.inc("flight_dumps_total")
+    assert reg.get("flight_dumps_total") == 1
+
+
+# -- serve trigger -----------------------------------------------------------
+
+def test_serve_slo_trip_dumps_bundle(monkeypatch, tmp_path):
+    """An SLO trip inside a SolverService dumps a replay bundle of the
+    most recent dispatched request, tagged with the trip kinds + a
+    request id, and bumps flight_dumps_total."""
+    from amgcl_tpu.serve import SolverService
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = poisson3d(6)
+    s = make_solver(A, AMGParams(dtype=jnp.float32, coarse_enough=100),
+                    CG(maxiter=50, tol=1e-6))
+    x0 = np.full(A.nrows, 0.25, np.float32)
+    with SolverService(s, batch=2, slo_p99_ms=1e-6) as svc:
+        fut = svc.submit(rhs.astype(np.float32), x0=x0, block=True)
+        fut.result(timeout=300)
+        # any finished request breaches the absurd 1ns p99 target
+        assert svc.stats()["slo_trips"] >= 1
+        assert svc.live.get("flight_dumps_total") >= 1
+    bundles = flight._existing_bundles(str(tmp_path))
+    assert bundles and "serve_slo_trip" in bundles[0]
+    manifest, arrays = flight.load_bundle(
+        os.path.join(str(tmp_path), bundles[0]))
+    assert manifest["tags"]["trips"] == ["p99"]
+    assert manifest["tags"]["request_id"] is not None
+    assert "rhs" in arrays and manifest["config"]["replayable"]
+    # the probe carries the request's WARM START — a bundle replayed
+    # from zeros would fail parity on a deterministic solve
+    assert np.array_equal(arrays["x0"], x0)
+
+
+# -- CLI surface -------------------------------------------------------------
+
+@pytest.mark.serial
+def test_cli_replay_smoke(monkeypatch, tmp_path, capsys):
+    """cli --replay on a health-trip bundle: exit 0, parity table +
+    attribution printed, doctor fold runs (serial: CLI smokes are
+    load-sensitive on shared hosts)."""
+    from amgcl_tpu import cli
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    A, rhs = _singular_system()
+    s = make_solver(A, DummyPreconditioner(A, dtype=jnp.float64),
+                    CG(maxiter=30, tol=1e-8))
+    s(rhs)
+    path = os.path.join(str(tmp_path),
+                        flight._existing_bundles(str(tmp_path))[0])
+    try:
+        rc = cli.main(["--replay", path, "--doctor"])
+    finally:
+        flight.uninstall_excepthook()
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "parity: OK" in out
+    assert "Cross-run attribution" in out
+    assert "Convergence doctor" in out
