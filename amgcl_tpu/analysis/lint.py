@@ -56,6 +56,19 @@ discipline the jaxpr auditor depends on):
     (telemetry/live.py) is exempt — it passes names through variables
     by construction.
 
+``swallowed-worker-exception``
+    a bare ``except:`` (or ``except Exception/BaseException:``) whose
+    body is only ``pass``/``continue``/``...`` inside the call tree of
+    a thread-target function (``threading.Thread(target=...)`` /
+    ``threading.Timer(..., fn)``, followed through same-module
+    ``self.X()``/``X()`` calls). A worker loop that swallows an
+    exception silently strands the futures riding on it — the exact
+    failure mode the serve-worker supervisor (serve/service.py
+    ``_worker_died``) exists to prevent; worker-path errors must route
+    to futures or telemetry. Best-effort emit paths (flight-recorder
+    dumps, ledger models) that genuinely have nowhere to route carry
+    suppressions with reasons in ANALYSIS_BASELINE.json.
+
 Findings are plain dicts keyed for the baseline by ``(rule, file,
 symbol)`` — line numbers are carried for display but excluded from the
 key so unrelated edits above a finding do not churn the baseline.
@@ -80,7 +93,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 #: the rules this module implements, in report order
 RULES = ("bare-jit", "host-sync-in-loop", "np-in-jit",
          "undocumented-knob", "mutable-default", "pallas-no-interpret",
-         "metric-name-literal")
+         "metric-name-literal", "swallowed-worker-exception")
 
 #: live-registry update methods the metric-name rule inspects (the
 #: LiveRegistry public write surface, telemetry/live.py)
@@ -385,6 +398,99 @@ def _rule_pallas_interpret(mod: _Module) -> List[Dict[str, Any]]:
 
 
 # ---------------------------------------------------------------------------
+# swallowed-worker-exception rule (worker loops must route errors)
+# ---------------------------------------------------------------------------
+
+def _thread_target_functions(mod: _Module) -> List[ast.AST]:
+    """Function nodes reachable from a thread entry point: the
+    ``target=`` of a ``threading.Thread`` (or the callable of a
+    ``threading.Timer``), closed transitively over same-module
+    ``self.X()`` / bare ``X()`` calls — the static approximation of
+    'code that runs on a worker thread'."""
+    roots: Set[str] = set()
+    for call in mod._calls():
+        tail = _attr_tail(call.func)
+        is_thread = tail == "Thread" \
+            or mod.resolves_to(call.func, "threading", "Thread")
+        is_timer = tail == "Timer" \
+            or mod.resolves_to(call.func, "threading", "Timer")
+        if not (is_thread or is_timer):
+            continue
+        tgt = next((kw.value for kw in call.keywords
+                    if kw.arg == "target"), None)
+        if tgt is None and is_timer and len(call.args) >= 2:
+            tgt = call.args[1]
+        if isinstance(tgt, ast.Name):
+            roots.add(tgt.id)
+        elif isinstance(tgt, ast.Attribute):
+            roots.add(tgt.attr)
+    nodes: List[ast.AST] = []
+    seen: Set[str] = set()
+    work = sorted(roots)
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for fn in mod.by_name.get(name, ()):
+            nodes.append(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self":
+                    work.append(f.attr)
+                elif isinstance(f, ast.Name):
+                    work.append(f.id)
+    return nodes
+
+
+def _broad_handler(h: ast.ExceptHandler) -> bool:
+    if h.type is None:
+        return True
+    elts = h.type.elts if isinstance(h.type, ast.Tuple) else [h.type]
+    return any(isinstance(e, ast.Name)
+               and e.id in ("Exception", "BaseException")
+               for e in elts)
+
+
+def _trivial_body(body: List[ast.stmt]) -> bool:
+    for st in body:
+        if isinstance(st, (ast.Pass, ast.Continue)):
+            continue
+        if isinstance(st, ast.Expr) \
+                and isinstance(st.value, ast.Constant) \
+                and st.value.value is Ellipsis:
+            continue
+        return False
+    return True
+
+
+def _rule_swallowed_worker(mod: _Module) -> List[Dict[str, Any]]:
+    out = []
+    seen_handlers: Set[int] = set()
+    for fn in _thread_target_functions(mod):
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.ExceptHandler) \
+                    or id(node) in seen_handlers:
+                continue
+            seen_handlers.add(id(node))
+            if _broad_handler(node) and _trivial_body(node.body):
+                out.append(finding(
+                    "swallowed-worker-exception", mod.rel, node.lineno,
+                    _enclosing_symbol(mod, node),
+                    "broad except with a pass-only body inside a "
+                    "thread-target call tree — a swallowed worker "
+                    "error strands the futures riding on it; route it "
+                    "to futures/telemetry (or suppress with a reason "
+                    "for genuinely best-effort emits)"))
+    out.sort(key=lambda f: f["line"])
+    return out
+
+
+# ---------------------------------------------------------------------------
 # live-metric declaration rule (the /metrics contract)
 # ---------------------------------------------------------------------------
 
@@ -637,7 +743,8 @@ def run_lint(root: Optional[str] = None,
     out: List[Dict[str, Any]] = []
     ast_rules = want & {"bare-jit", "host-sync-in-loop", "np-in-jit",
                         "mutable-default", "pallas-no-interpret",
-                        "metric-name-literal"}
+                        "metric-name-literal",
+                        "swallowed-worker-exception"}
     declared = declared_metric_names(root) \
         if "metric-name-literal" in want else set()
     declared_labels = declared_metric_labels(root) \
@@ -655,6 +762,8 @@ def run_lint(root: Optional[str] = None,
         if "metric-name-literal" in want:
             out += _rule_metric_name_literal(mod, declared,
                                              declared_labels)
+        if "swallowed-worker-exception" in want:
+            out += _rule_swallowed_worker(mod)
     if "undocumented-knob" in want:
         out += _rule_undocumented_knob(root, readme)
     out.sort(key=lambda f: (f["file"], f["line"], f["rule"]))

@@ -1,0 +1,82 @@
+"""Fault-tolerance layer: deterministic fault injection + recovery.
+
+Two halves (ISSUE 13):
+
+* :mod:`amgcl_tpu.faults.inject` — a seeded, plan-driven fault injector
+  (``AMGCL_TPU_FAULT_PLAN`` JSON) with hook points at the seams that
+  already exist: numeric faults at the HistoryMixin guard seam,
+  allocation faults at the ledger charge seam, device faults at the
+  solve/serve dispatch seams, serve faults (worker death, queue
+  saturation, timeout storms, poison requests) in the service worker.
+* :mod:`amgcl_tpu.faults.recovery` — the bounded recovery policy ladder
+  consumed by ``models/make_solver.py`` (re-run from last-good iterate →
+  f64 precision escalation → solver switch cg→bicgstab→gmres → smoother
+  fallback, with host-side Krylov-iterate checkpoints behind
+  ``AMGCL_TPU_CKPT_EVERY``), plus the serve-level retry/bisection and
+  the farm admission/shedding policies implemented in
+  ``serve/service.py`` / ``serve/farm.py``.
+
+``python -m amgcl_tpu.faults --selftest`` runs the chaos matrix
+(:mod:`amgcl_tpu.faults.chaos`): every injected scenario must either
+*recover* (converged, parity with the un-faulted solve) or *fail
+cleanly* (typed error + flight bundle) under a global deadline.
+
+The typed error taxonomy below is the "fails cleanly" contract: every
+fault path that gives up raises one of these (all ``RuntimeError``
+subclasses, so existing broad handlers keep working).
+"""
+
+from __future__ import annotations
+
+
+class FaultError(RuntimeError):
+    """Base of the typed fault/recovery error taxonomy."""
+
+
+class DeviceLostError(FaultError):
+    """The device executing a solve was lost or preempted (real or
+    injected via the ``device.loss`` site). Recoverable: the ladder
+    resumes from the last host-side checkpoint, the serve layer
+    retries with backoff."""
+
+
+class WorkerDiedError(FaultError):
+    """A serve/farm dispatch thread died on an unexpected exception.
+    Every pending and queued future is failed with this (never
+    stranded); the supervisor restarts the worker."""
+
+
+class PoisonRequestError(FaultError):
+    """A request isolated by batch bisection as the one that keeps
+    failing its batch (``serve.poison`` injection, or any
+    deterministically-fatal rhs)."""
+
+
+class LoadShedError(FaultError):
+    """Typed reject: the tenant is shedding load under a sustained SLO
+    breach (``AMGCL_TPU_SHED_BREACHES``). Retry later or against
+    another replica."""
+
+
+class AdmissionError(FaultError):
+    """HBM admission failed after eviction attempts and backoff — the
+    farm budget cannot fit the operator. The message names
+    AMGCL_TPU_FARM_MAX_BYTES (the existing test contract)."""
+
+
+class RecoveryExhausted(FaultError):
+    """The recovery ladder ran out of rungs without a healthy solve.
+    Carries the attempt trail (``.attempts``) and the last report
+    (``.report``)."""
+
+    def __init__(self, message, attempts=None, report=None):
+        super().__init__(message)
+        self.attempts = attempts or []
+        self.report = report
+
+
+__all__ = [
+    "FaultError", "DeviceLostError", "WorkerDiedError",
+    "PoisonRequestError", "LoadShedError", "AdmissionError",
+    "RecoveryExhausted",
+]

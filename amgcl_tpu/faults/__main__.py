@@ -1,0 +1,9 @@
+"""``python -m amgcl_tpu.faults --selftest`` — run the chaos matrix
+(amgcl_tpu/faults/chaos.py) and print one JSON verdict line."""
+
+import sys
+
+from amgcl_tpu.faults.chaos import main
+
+if __name__ == "__main__":
+    sys.exit(main())

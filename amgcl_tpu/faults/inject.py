@@ -1,0 +1,335 @@
+"""Deterministic, plan-driven fault injector.
+
+The plan rides ``AMGCL_TPU_FAULT_PLAN`` — a JSON object (one rule) or
+list of objects (many), parsed once per distinct env value:
+
+    {"site": "numeric.nan", "at": 3}
+    [{"site": "device.loss", "count": 1},
+     {"site": "alloc.farm", "after": 1, "count": 2, "seed": 7}]
+
+Rule fields (all optional except ``site``):
+
+  site       one of :data:`SITES` — the seam the fault fires at
+  at         iteration index for the in-loop numeric sites (default 0)
+  count      how many times the rule fires (default 1; -1 = unlimited)
+  after      skip the first N matching checks before arming (default 0)
+  p          fire probability per check, decided by a rule-seeded PRNG —
+             DETERMINISTIC for a fixed seed (default 1.0)
+  seed       PRNG seed for ``p`` (default 0)
+  delay_ms   stall length for the delay sites (default 0)
+  rid        serve request id filter (``serve.poison``): the rule fires
+             only for a batch containing this request id
+  target     free-form site-specific filter (budget name, seam tag)
+
+Sites and their seams:
+
+  numeric.nan / numeric.inf   NaN/Inf planted into the guarded residual
+                              at iteration ``at`` (HistoryMixin guard
+                              seam — trips the NAN guard, freezes the
+                              iterate, exits the loop)
+  numeric.breakdown           an injected BREAKDOWN_RHO trip at ``at``
+  alloc.dwin / alloc.farm     forced DeviceMemoryBudget / LruMemoryPool
+                              charge refusal (simulated HBM OOM at
+                              dense-window conversion / farm admission)
+  device.loss                 DeviceLostError raised from the solve /
+                              serve.solve_step dispatch seams
+  dist.delay                  host-side stall at the dist_matrix halo-
+                              exchange seam (fires when the exchange
+                              program is built — never a host callback
+                              inside the device loop, which the comm
+                              census contracts forbid)
+  serve.worker                unexpected exception in the dispatch
+                              worker loop (worker death)
+  serve.timeout               the next matching requests are treated as
+                              queue-expired (timeout storm)
+  serve.reject                submit() raises queue.Full (saturation)
+  serve.poison                any batch containing request ``rid``
+                              raises PoisonRequestError (bisection bait)
+
+Every firing emits a ``fault`` JSONL telemetry event and trips the
+flight recorder (a ``fault_injected`` bundle when a dump dir is
+configured), so forensics is exercised by the same harness. Module
+counters (:func:`injected_total`, :func:`fired`) back the chaos-matrix
+assertions. Everything here is stdlib-only and thread-safe; with
+``AMGCL_TPU_FAULT_PLAN`` unset every hook is a single env read.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+#: the declared fault sites — a rule naming anything else is ignored
+#: (and reported by :func:`plan_errors`)
+SITES = (
+    "numeric.nan", "numeric.inf", "numeric.breakdown",
+    "alloc.dwin", "alloc.farm",
+    "device.loss", "dist.delay",
+    "serve.worker", "serve.timeout", "serve.reject", "serve.poison",
+)
+
+NUMERIC_SITES = ("numeric.nan", "numeric.inf", "numeric.breakdown")
+
+_lock = threading.Lock()
+_state: Dict[str, Any] = {
+    "raw": None,        # env value the parse below corresponds to
+    "rules": [],        # parsed rules
+    "errors": [],       # parse problems (bad JSON, unknown sites)
+    "checks": {},       # rule id -> times the site was consulted
+    "fires": {},        # rule id -> times the rule fired
+    "fired": [],        # [{site, seq, ...}] event log (bounded)
+    "seq": 0,
+}
+
+
+def enabled() -> bool:
+    """One env read — the zero-cost gate every hook checks first."""
+    return bool(os.environ.get("AMGCL_TPU_FAULT_PLAN"))
+
+
+def _parse(raw: str) -> (List[Dict[str, Any]], List[str]):
+    errors: List[str] = []
+    try:
+        data = json.loads(raw)
+    except ValueError as e:
+        return [], ["AMGCL_TPU_FAULT_PLAN is not valid JSON: %s" % e]
+    if isinstance(data, dict):
+        data = [data]
+    if not isinstance(data, list):
+        return [], ["AMGCL_TPU_FAULT_PLAN must be an object or a list"]
+    rules = []
+    for i, r in enumerate(data):
+        if not isinstance(r, dict) or "site" not in r:
+            errors.append("rule %d has no 'site'" % i)
+            continue
+        site = str(r["site"])
+        if site not in SITES:
+            errors.append("rule %d: unknown site %r" % (i, site))
+            continue
+        try:
+            rules.append({
+                "id": i, "site": site,
+                "at": int(r.get("at", 0)),
+                "count": int(r.get("count", 1)),
+                "after": int(r.get("after", 0)),
+                "p": float(r.get("p", 1.0)),
+                "seed": int(r.get("seed", 0)),
+                "delay_ms": float(r.get("delay_ms", 0.0)),
+                # coerced like the other numeric fields: a JSON string
+                # rid would silently never match integer request ids
+                "rid": int(r["rid"]) if r.get("rid") is not None
+                else None,
+                "target": r.get("target"),
+            })
+        except (TypeError, ValueError) as e:
+            errors.append("rule %d: bad field: %s" % (i, e))
+    return rules, errors
+
+
+def _rules() -> List[Dict[str, Any]]:
+    """Parsed plan, re-parsed whenever the env value changes (tests and
+    the chaos runner flip it between scenarios). Counters reset with
+    the plan — a new plan is a new experiment."""
+    raw = os.environ.get("AMGCL_TPU_FAULT_PLAN") or ""
+    with _lock:
+        if _state["raw"] != raw:
+            rules, errors = _parse(raw) if raw else ([], [])
+            _state.update(raw=raw, rules=rules, errors=errors,
+                          checks={}, fires={}, fired=[], seq=0)
+        return _state["rules"]
+
+
+def plan_errors() -> List[str]:
+    _rules()
+    return list(_state["errors"])
+
+
+def _reset_for_tests() -> None:
+    with _lock:
+        _state.update(raw=None, rules=[], errors=[], checks={},
+                      fires={}, fired=[], seq=0)
+
+
+# ---------------------------------------------------------------------------
+# firing
+# ---------------------------------------------------------------------------
+
+def _matches(rule: Dict[str, Any], site: str,
+             target: Optional[str],
+             rids: Optional[Sequence[int]]) -> bool:
+    if rule["site"] != site:
+        return False
+    if rule["target"] is not None and target is not None \
+            and rule["target"] != target:
+        return False
+    if rule["rid"] is not None:
+        if rids is None or rule["rid"] not in rids:
+            return False
+    return True
+
+
+def armed(site: str, target: Optional[str] = None
+          ) -> Optional[Dict[str, Any]]:
+    """Non-consuming probe: the first rule for ``site`` that still has
+    firing budget, or None. Checks only the ``count`` budget (no
+    check-counting, no ``after``/``p`` draw) — the trigger logic runs
+    in :func:`should_fire` / :func:`begin_numeric_dispatch`. For
+    callers (tests, harnesses) that must know whether a site can still
+    fire without spending it."""
+    if not enabled():
+        return None
+    for rule in _rules():
+        if not _matches(rule, site, target, None):
+            continue
+        with _lock:
+            fires = _state["fires"].get(rule["id"], 0)
+        if rule["count"] < 0 or fires < rule["count"]:
+            return dict(rule)
+    return None
+
+
+def armed_numeric() -> Optional[Dict[str, Any]]:
+    """The armed numeric-site rule, if any (one seam, three kinds)."""
+    for site in NUMERIC_SITES:
+        spec = armed(site)
+        if spec is not None:
+            return spec
+    return None
+
+
+#: the numeric rule being traced into the CURRENT faulted dispatch —
+#: set only inside make_solver's begin/end window, so a trace happening
+#: anywhere else (a serve bucket compile, an audit trace) can never
+#: bake the fault into a cached program
+_pending_numeric: Optional[Dict[str, Any]] = None
+
+
+def begin_numeric_dispatch() -> Optional[Dict[str, Any]]:
+    """Called once per solve dispatch (make_solver._solve_once): run
+    the FULL trigger logic for the numeric sites — ``after``, ``count``
+    and ``p`` each see one check per dispatch, exactly like the
+    consuming sites — and, when a rule fires, mark it pending so the
+    guard seam (:func:`pending_numeric`, read at trace time inside the
+    throwaway jit wrap) plants the fault. The caller must pair this
+    with :func:`end_numeric_dispatch` (the firing itself is already
+    booked + announced here). Numeric injection is dispatch-scoped, not
+    thread-safe: concurrent traces during the window would see the
+    pending spec — the chaos harness runs scenarios sequentially."""
+    global _pending_numeric
+    for site in NUMERIC_SITES:
+        spec = should_fire(site)
+        if spec is not None:
+            _pending_numeric = spec
+            return spec
+    return None
+
+
+def pending_numeric() -> Optional[Dict[str, Any]]:
+    """The numeric rule of the dispatch currently being traced (None
+    outside a begin/end window — the common case for every other
+    trace in the process)."""
+    return _pending_numeric
+
+
+def end_numeric_dispatch() -> None:
+    global _pending_numeric
+    _pending_numeric = None
+
+
+def should_fire(site: str, target: Optional[str] = None,
+                rids: Optional[Sequence[int]] = None
+                ) -> Optional[Dict[str, Any]]:
+    """Consult-and-consume: returns a copy of the first matching rule
+    that fires at this check (honoring ``after``/``count``/``p``), or
+    None. Deterministic for a fixed plan + seed: the probability draw
+    is seeded per (rule, check ordinal). Fires emit telemetry + trip
+    the flight recorder."""
+    if not enabled():
+        return None
+    for rule in _rules():
+        if not _matches(rule, site, target, rids):
+            continue
+        with _lock:
+            checks = _state["checks"].get(rule["id"], 0) + 1
+            _state["checks"][rule["id"]] = checks
+            fires = _state["fires"].get(rule["id"], 0)
+            if checks <= rule["after"]:
+                continue
+            if rule["count"] >= 0 and fires >= rule["count"]:
+                continue
+            if rule["p"] < 1.0 and random.Random(
+                    rule["seed"] * 1000003 + checks).random() \
+                    >= rule["p"]:
+                continue
+            spec = dict(rule)
+            _record_fire_locked(spec)
+        _announce(spec)
+        return spec
+    return None
+
+
+def consume(spec: Dict[str, Any]) -> None:
+    """Book (and announce) a firing for a rule obtained via
+    :func:`armed` — the generic probe-then-book flow for external
+    harnesses. The production numeric path does NOT use this: it books
+    up-front inside :func:`begin_numeric_dispatch` (which runs the
+    full trigger logic) and exposes the fired spec to the guard seam
+    via :func:`pending_numeric`."""
+    with _lock:
+        _record_fire_locked(dict(spec))
+    _announce(spec)
+
+
+def _record_fire_locked(spec: Dict[str, Any]) -> None:
+    rid = spec["id"]
+    _state["fires"][rid] = _state["fires"].get(rid, 0) + 1
+    _state["seq"] += 1
+    spec["seq"] = _state["seq"]
+    log = _state["fired"]
+    log.append({"site": spec["site"], "seq": spec["seq"],
+                "rule": rid, "ts": time.time()})
+    del log[:-256]
+
+
+def _announce(spec: Dict[str, Any]) -> None:
+    """One ``fault`` JSONL event + a flight-recorder trip per firing.
+    Best-effort on both: the injector must never fail the seam it is
+    injecting into."""
+    try:
+        from amgcl_tpu.telemetry import sink as _sink
+        _sink.emit({"event": "fault", "site": spec["site"],
+                    "rule": spec["id"], "seq": spec.get("seq"),
+                    "at": spec.get("at"), "target": spec.get("target")})
+    except Exception:
+        pass
+    try:
+        from amgcl_tpu.telemetry import flight as _flight
+        if _flight.enabled():
+            _flight.dump("fault_injected",
+                         tags={"site": spec["site"],
+                               "rule": spec["id"],
+                               "seq": spec.get("seq")})
+    except Exception:
+        pass
+
+
+# ---------------------------------------------------------------------------
+# counters (chaos-matrix assertions)
+# ---------------------------------------------------------------------------
+
+def injected_total() -> int:
+    """Faults fired since the current plan was armed."""
+    _rules()
+    with _lock:
+        return _state["seq"]
+
+
+def fired() -> List[Dict[str, Any]]:
+    """Recent firing log (site, seq, rule, ts) for the current plan."""
+    _rules()
+    with _lock:
+        return list(_state["fired"])

@@ -1,0 +1,431 @@
+"""Recovery policy ladder + host-side Krylov-iterate checkpoints.
+
+The health guards (PR 3) DETECT a broken solve — NaN, breakdown,
+divergence — and freeze the iterate; this module RECOVERS. When
+``make_solver`` runs with recovery enabled (``recovery=`` arg or
+``AMGCL_TPU_RECOVERY=1``) a fatal guard trip or a device loss walks a
+bounded escalation ladder instead of returning a frozen iterate:
+
+  1. ``last_good``   re-run the SAME bundle from the last good iterate
+                     (the frozen state / the newest checkpoint) — cures
+                     transient faults (an injected NaN, a one-off
+                     device loss) at zero rebuild cost;
+  2. ``precision``   escalate the Krylov loop to float64 (a sibling
+                     bundle, cached per make_solver) — cures genuine
+                     f32 range/cancellation failures;
+  3. ``solver``      switch down the robustness chain cg → bicgstab →
+                     gmres — cures method breakdowns (rho/omega ≈ 0,
+                     indefiniteness under CG);
+  4. ``smoother``    rebuild the AMG hierarchy with damped Jacobi
+                     relaxation (the most conservative smoother) —
+                     cures a diverging smoother, the last resort before
+                     giving up.
+
+Every attempt lands in the trail recorded on
+``SolveReport.recovery = {"recovered", "attempts": [...], "runs"}`` —
+deterministic for a fixed fault plan/seed. Exhausting the ladder raises
+the typed :class:`~amgcl_tpu.faults.RecoveryExhausted` (attempt trail +
+last report attached) after tripping the flight recorder.
+
+Checkpoints: with ``AMGCL_TPU_CKPT_EVERY=k`` (> 0) the solve runs as
+host-checkpointed segments of k iterations — after each segment the
+iterate is snapshotted to host memory, so a device loss resumes from
+the newest snapshot as a warm ``x0`` instead of restarting the whole
+solve. Segmenting restarts the Krylov space at each boundary (warm
+iterate, fresh subspace), so segmented iteration counts can exceed the
+single-run count; the convergence target is unchanged.
+
+The serve-level recovery (per-request retry with backoff, poison
+bisection, worker supervisor) and the farm policies (admission retry,
+load shedding) live in ``serve/service.py`` / ``serve/farm.py``; this
+module only provides the shared backoff helper and counters.
+"""
+
+from __future__ import annotations
+
+import copy
+import os
+import random
+import threading
+import time
+from dataclasses import dataclass, replace
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from amgcl_tpu.faults import DeviceLostError, RecoveryExhausted
+
+#: solver robustness chain for the ``solver`` rung — each step trades
+#: speed for generality (cg needs SPD, bicgstab cures indefiniteness,
+#: gmres cures the bicgstab breakdowns)
+SOLVER_CHAIN = ("cg", "bicgstab", "gmres")
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def _env_float(name: str, default: float) -> float:
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+def ckpt_every() -> int:
+    """Checkpoint interval in Krylov iterations (0 = off)."""
+    return max(_env_int("AMGCL_TPU_CKPT_EVERY", 0), 0)
+
+
+def retry_max() -> int:
+    """Serve-level per-request retry cap (0 = retries/bisection off)."""
+    return max(_env_int("AMGCL_TPU_RETRY_MAX", 0), 0)
+
+
+def backoff_s(attempt: int, key: int = 0) -> float:
+    """Exponential backoff with deterministic jitter for retry
+    ``attempt`` (1-based): base * 2^(attempt-1) * (1 + jitter*u), u
+    drawn from a PRNG seeded by ``key``+attempt so a replayed incident
+    backs off identically. Knobs: AMGCL_TPU_RETRY_BACKOFF_MS (default
+    50), AMGCL_TPU_RETRY_JITTER (fraction, default 0.1)."""
+    base = _env_float("AMGCL_TPU_RETRY_BACKOFF_MS", 50.0) / 1e3
+    jitter = _env_float("AMGCL_TPU_RETRY_JITTER", 0.1)
+    u = random.Random(int(key) * 1000003 + int(attempt)).random()
+    return max(base * (2.0 ** max(attempt - 1, 0)) * (1.0 + jitter * u),
+               0.0)
+
+
+@dataclass
+class RecoveryPolicy:
+    """Which rungs the ladder may take, and the checkpoint cadence."""
+    last_good: bool = True
+    precision: bool = True
+    solver_switch: bool = True
+    smoother_fallback: bool = True
+    ckpt: int = 0                 # checkpoint interval (0 = off)
+    max_ckpt_resumes: int = 3     # device-loss resumes per attempt
+
+    @classmethod
+    def from_env(cls) -> "RecoveryPolicy":
+        return cls(ckpt=ckpt_every())
+
+
+# -- module counters (chaos-matrix + gauge sources) -------------------------
+
+_lock = threading.Lock()
+_recoveries = 0
+_ladder_runs = 0
+_last_ckpt_ts: Optional[float] = None
+
+
+def recoveries_total() -> int:
+    return _recoveries
+
+
+def ladder_runs_total() -> int:
+    return _ladder_runs
+
+
+def last_checkpoint_age_s() -> Optional[float]:
+    """Seconds since the newest host-side iterate checkpoint (the
+    ``recovery_checkpoint_age_s`` gauge source); None before any."""
+    ts = _last_ckpt_ts
+    return None if ts is None else max(time.time() - ts, 0.0)
+
+
+def _reset_for_tests() -> None:
+    global _recoveries, _ladder_runs, _last_ckpt_ts
+    with _lock:
+        _recoveries = 0
+        _ladder_runs = 0
+        _last_ckpt_ts = None
+
+
+# ---------------------------------------------------------------------------
+# checkpointed solve
+# ---------------------------------------------------------------------------
+
+def _chunk_bundle(bundle, chunk_iters: int):
+    """A shallow sibling of ``bundle`` whose solver runs at most
+    ``chunk_iters`` iterations per call — shares the hierarchy and the
+    device operators (nothing is rebuilt), compiles its own (smaller)
+    loop. Cached on the bundle per chunk size."""
+    cache = getattr(bundle, "_recovery_chunks", None)
+    if cache is None:
+        cache = bundle._recovery_chunks = {}
+    cb = cache.get(chunk_iters)
+    if cb is None:
+        cb = copy.copy(bundle)
+        cb.solver = replace(bundle.solver, maxiter=int(chunk_iters))
+        cb._compiled = None
+        cb._lowering_tags = {}
+        cb._recovery_chunks = cache   # share, don't recurse
+        cache[chunk_iters] = cb
+    return cb
+
+
+def checkpointed_solve(bundle, rhs, x0, every: int,
+                       max_resumes: int = 3,
+                       notes: Optional[Dict[str, Any]] = None):
+    """Run ``bundle`` as host-checkpointed segments of ``every``
+    iterations. After each segment the iterate is copied to host memory
+    (the checkpoint); a :class:`DeviceLostError` raised by a segment
+    resumes from the newest checkpoint (up to ``max_resumes`` times)
+    instead of failing the solve. Returns ``(x, report)`` with the
+    segment totals folded into the report; a fatal guard trip inside a
+    segment returns immediately (the ladder handles it)."""
+    global _last_ckpt_ts
+    from amgcl_tpu.telemetry import flight as _flight
+    solver = bundle.solver
+    total_max = int(getattr(solver, "maxiter", 100))
+    every = max(int(every), 1)
+    cb = _chunk_bundle(bundle, min(every, total_max))
+    x = x0
+    ckpt = None if x0 is None else np.array(x0, copy=True)
+    done = 0
+    resumes = 0
+    segments = 0
+    wall = 0.0
+    rep = None
+    while done < total_max:
+        try:
+            x_new, rep = cb._solve_once(rhs, x)
+        except DeviceLostError:
+            resumes += 1
+            if resumes > max_resumes:
+                raise
+            # resume from the newest host snapshot as a warm x0 — the
+            # work up to the last checkpoint is not lost
+            x = None if ckpt is None else np.array(ckpt, copy=True)
+            continue
+        segments += 1
+        done += int(rep.iters)
+        wall += float(rep.wall_time_s or 0.0)
+        ckpt = np.asarray(x_new)
+        with _lock:
+            _last_ckpt_ts = time.time()
+        fatal = _flight.fatal_health(getattr(rep, "health", None))
+        converged = int(rep.iters) < min(every, total_max) \
+            or float(rep.resid) <= float(getattr(solver, "tol", 1e-8))
+        x = x_new
+        if fatal or converged:
+            break
+    if rep is not None:
+        rep.iters = int(done)
+        rep.wall_time_s = round(wall, 6)
+        rep.extra = dict(rep.extra or {},
+                         checkpoints={"every": every,
+                                      "segments": segments,
+                                      "resumes": resumes})
+    if notes is not None:
+        notes["segments"] = segments
+        notes["resumes"] = resumes
+    return x, rep
+
+
+# ---------------------------------------------------------------------------
+# the ladder
+# ---------------------------------------------------------------------------
+
+def _fatal(report) -> bool:
+    from amgcl_tpu.telemetry import flight as _flight
+    return _flight.fatal_health(getattr(report, "health", None))
+
+
+def _flags(report) -> List[str]:
+    h = getattr(report, "health", None) or {}
+    return list(h.get("flags") or [])
+
+
+def _sibling(bundle, label: str, build):
+    """Rung-sibling bundle cache (per make_solver instance): the f64 /
+    solver-switch / smoother-fallback bundles are built once and reused
+    across ladder runs."""
+    cache = getattr(bundle, "_recovery_siblings", None)
+    if cache is None:
+        cache = bundle._recovery_siblings = {}
+    sib = cache.get(label)
+    if sib is None:
+        sib = build()
+        cache[label] = sib
+    return sib
+
+
+def _solver_clone(cls, like):
+    """A fresh solver of ``cls`` inheriting maxiter/tol from ``like``."""
+    return cls(maxiter=int(getattr(like, "maxiter", 100)),
+               tol=float(getattr(like, "tol", 1e-8)))
+
+
+def _rungs(bundle, policy: RecoveryPolicy):
+    """The ladder as (name, bundle-or-builder, detail) rows, in
+    escalation order. Builders run lazily — a rung that is never
+    reached never builds its sibling."""
+    import jax
+    from amgcl_tpu.models import runtime as rt
+    rows = []
+    if policy.last_good:
+        rows.append(("last_good", lambda: bundle, {}))
+    prm = getattr(getattr(bundle, "precond", None), "prm", None)
+    is_amg = type(prm).__name__ == "AMGParams" \
+        and getattr(bundle, "_built_from_A", False)
+    import jax.numpy as jnp
+    f32 = jnp.dtype(bundle.solver_dtype) == jnp.dtype(jnp.float32)
+    if policy.precision and is_amg and f32 \
+            and jax.config.jax_enable_x64:
+
+        def build_f64(bundle=bundle, prm=prm):
+            from amgcl_tpu.models.make_solver import make_solver
+            prm64 = replace(prm, dtype=jnp.float64)
+            return make_solver(bundle.A_host, prm64,
+                               copy.copy(bundle.solver),
+                               solver_dtype=jnp.float64)
+
+        rows.append(("precision", build_f64, {"dtype": "float64"}))
+    if policy.solver_switch:
+        inv = {cls: name for name, cls in rt.SOLVERS.items()}
+        cur = inv.get(type(bundle.solver))
+        start = SOLVER_CHAIN.index(cur) + 1 if cur in SOLVER_CHAIN else 0
+        for name in SOLVER_CHAIN[start:]:
+
+            def build_switch(bundle=bundle, name=name):
+                sib = copy.copy(bundle)
+                sib.solver = _solver_clone(rt.SOLVERS[name],
+                                           bundle.solver)
+                sib._compiled = None
+                sib._lowering_tags = {}
+                return sib
+
+            rows.append(("solver", build_switch, {"solver": name}))
+    if policy.smoother_fallback and is_amg:
+
+        def build_smoother(bundle=bundle, prm=prm):
+            from amgcl_tpu.models.make_solver import make_solver
+            from amgcl_tpu.relaxation.jacobi import DampedJacobi
+            prm_j = replace(prm, relax=DampedJacobi())
+            inv = {cls: name for name, cls in rt.SOLVERS.items()}
+            cur = inv.get(type(bundle.solver))
+            solver = bundle.solver if cur == SOLVER_CHAIN[-1] \
+                else _solver_clone(rt.SOLVERS[SOLVER_CHAIN[-1]],
+                                   bundle.solver)
+            return make_solver(bundle.A_host, prm_j, copy.copy(solver))
+
+        rows.append(("smoother", build_smoother,
+                     {"relax": "damped_jacobi"}))
+    return rows
+
+
+def solve_with_recovery(bundle, rhs, x0, policy: RecoveryPolicy):
+    """The recovery-enabled solve path (``make_solver.__call__`` routes
+    here when recovery is on). Runs the initial solve (checkpointed
+    when ``policy.ckpt`` > 0), walks the ladder on a fatal guard trip
+    or device loss, and returns ``(x, report)`` with the attempt trail
+    on ``report.recovery``. Raises :class:`RecoveryExhausted` when no
+    rung produces a healthy solve."""
+    global _recoveries, _ladder_runs
+    attempts: List[Dict[str, Any]] = []
+    last_good_x: Optional[np.ndarray] = \
+        None if x0 is None else np.asarray(x0)
+    last_report = None
+
+    def run(label: str, b, x_start, detail: Dict[str, Any]):
+        nonlocal last_good_x, last_report
+        row: Dict[str, Any] = {"rung": label,
+                               "solver": type(b.solver).__name__}
+        row.update(detail)
+        t0 = time.perf_counter()
+        try:
+            if policy.ckpt > 0:
+                notes: Dict[str, Any] = {}
+                x, rep = checkpointed_solve(
+                    b, rhs, x_start, policy.ckpt,
+                    max_resumes=policy.max_ckpt_resumes, notes=notes)
+                if notes.get("resumes"):
+                    row["ckpt_resumes"] = notes["resumes"]
+            else:
+                x, rep = b._solve_once(rhs, x_start)
+        except DeviceLostError as e:
+            row.update(ok=False, error="device_lost: %s" % e,
+                       wall_s=round(time.perf_counter() - t0, 4))
+            attempts.append(row)
+            return None
+        last_report = rep
+        ok = not _fatal(rep)
+        row.update(ok=ok, iters=int(rep.iters),
+                   resid=float(rep.resid), flags=_flags(rep),
+                   wall_s=round(time.perf_counter() - t0, 4))
+        attempts.append(row)
+        if ok:
+            return x, rep
+        # the frozen iterate (finite by the guard-commit contract) is
+        # the next rung's warm start when it is actually finite
+        xa = np.asarray(x)
+        if np.all(np.isfinite(xa)):
+            last_good_x = xa
+        return None
+
+    with _lock:
+        _ladder_runs += 1
+    got = run("initial", bundle, x0, {})
+    if got is None:
+        for label, build, detail in _rungs(bundle, policy):
+            try:
+                b = bundle if label == "last_good" \
+                    else _sibling(bundle, _rung_key(label, detail),
+                                  build)
+            except Exception as e:      # a rung that cannot BUILD is
+                attempts.append({"rung": label, "ok": False,   # skipped,
+                                 "error": "build: %r" % e})    # not fatal
+                continue
+            x_start = last_good_x
+            if b is not bundle and last_good_x is not None:
+                x_start = np.asarray(last_good_x)
+            got = run(label, b, x_start, detail)
+            if got is not None:
+                break
+    runs_on_bundle = getattr(bundle, "_recovery_runs", 0)
+    if len(attempts) > 1:
+        bundle._recovery_runs = runs_on_bundle = runs_on_bundle + 1
+    if got is None:
+        _dump_exhausted(bundle, rhs, x0, last_report, attempts)
+        raise RecoveryExhausted(
+            "recovery ladder exhausted after %d attempt(s): %s"
+            % (len(attempts),
+               " -> ".join(a["rung"] for a in attempts)),
+            attempts=attempts, report=last_report)
+    x, rep = got
+    recovered = len(attempts) > 1
+    if recovered:
+        with _lock:
+            _recoveries += 1
+    rep.recovery = {"recovered": recovered, "attempts": attempts,
+                    "final_rung": attempts[-1]["rung"],
+                    "runs": runs_on_bundle}
+    if recovered:
+        # the per-solve `solve` JSONL events are emitted inside each
+        # attempt (before the trail exists) — a ladder that actually
+        # ran gets its own dedicated, greppable event
+        from amgcl_tpu.telemetry import sink as _sink
+        _sink.emit({"event": "recovery", **rep.recovery,
+                    "iters": int(rep.iters),
+                    "resid": float(rep.resid)})
+    return x, rep
+
+
+def _rung_key(label: str, detail: Dict[str, Any]) -> str:
+    return label + ":" + ",".join(
+        "%s=%s" % (k, v) for k, v in sorted(detail.items()))
+
+
+def _dump_exhausted(bundle, rhs, x0, report, attempts) -> None:
+    try:
+        from amgcl_tpu.telemetry import flight as _flight
+        if _flight.enabled():
+            _flight.dump("recovery_exhausted", bundle=bundle, rhs=rhs,
+                         x0=x0, report=report,
+                         tags={"rungs": [a["rung"] for a in attempts]})
+    except Exception:
+        pass

@@ -44,7 +44,12 @@ class make_solver:
     def __init__(self, A, precond: Any = None, solver: Any = None,
                  solver_dtype=None, matrix_format: str = "auto",
                  refine: int = 0, refine_dtype: str = "auto",
-                 batch: Any = None):
+                 batch: Any = None, recovery: Any = None):
+        # ``recovery``: the fault-tolerance ladder (faults/recovery.py).
+        # None = follow AMGCL_TPU_RECOVERY (off unless "1"); True =
+        # policy from env (checkpoint cadence via AMGCL_TPU_CKPT_EVERY);
+        # False = off; a RecoveryPolicy instance is used as-is.
+        self.recovery = recovery
         # ``batch``: declared multi-RHS bucket size (serve/): ``__call__``
         # accepts a stacked (n, B) rhs regardless; the declared value is
         # the default bucket a SolverService built on this bundle uses
@@ -424,7 +429,36 @@ class make_solver:
         x, resid = finalize(state, rt, scale.astype(rhs.dtype))
         return x, iters, resid, hstate
 
+    def _recovery_policy(self):
+        """Resolve the ``recovery=`` constructor arg (see __init__) to
+        a RecoveryPolicy or None. Imported lazily — the faults layer
+        never loads on the plain solve path."""
+        rec = self.recovery
+        if rec is None:
+            import os
+            if os.environ.get("AMGCL_TPU_RECOVERY", "0") != "1":
+                return None
+            rec = True
+        if rec is False:
+            return None
+        from amgcl_tpu.faults.recovery import RecoveryPolicy
+        if isinstance(rec, RecoveryPolicy):
+            return rec
+        return RecoveryPolicy.from_env()
+
     def __call__(self, rhs, x0=None):
+        """One solve. With recovery off (the default) this is exactly
+        the historical single-dispatch path (:meth:`_solve_once`); with
+        recovery on, fatal guard trips and device losses walk the
+        bounded escalation ladder (faults/recovery.py) and the attempt
+        trail lands on ``SolveReport.recovery``."""
+        policy = self._recovery_policy()
+        if policy is None:
+            return self._solve_once(rhs, x0)
+        from amgcl_tpu.faults.recovery import solve_with_recovery
+        return solve_with_recovery(self, rhs, x0, policy)
+
+    def _solve_once(self, rhs, x0=None):
         n = self.A_host.nrows * self.A_host.block_size[0]
         shp = np.shape(rhs)
         batched = len(shp) == 2
@@ -449,9 +483,42 @@ class make_solver:
         first_call = self._compiled is None
         if first_call:
             self._wrapped_solve_fn()
+        # fault seams (faults/inject.py), both one env read when no
+        # plan is armed: ``device.loss`` raises the typed error at the
+        # dispatch boundary (the recovery ladder resumes from the last
+        # checkpoint); a fired ``numeric.*`` rule routes THIS call
+        # through a fresh jit wrap so the fault bakes into a throwaway
+        # trace — begin/end scope the pending spec to this dispatch,
+        # so the clean cached program (and any OTHER trace in the
+        # process) never carries the fault, and the rule's
+        # after/count/p trigger logic sees one check per dispatch
+        entry = self._compiled
+        nspec = None
+        import os as _os
+        if _os.environ.get("AMGCL_TPU_FAULT_PLAN"):
+            from amgcl_tpu.faults import DeviceLostError
+            from amgcl_tpu.faults import inject as _inject
+            if _inject.should_fire("device.loss",
+                                   target="solve") is not None:
+                raise DeviceLostError(
+                    "injected device loss at the solve dispatch seam")
+            if getattr(self.solver, "guard", False):
+                # guard=False solvers never reach the numeric seam —
+                # firing the rule there would book a fault (event,
+                # counter, flight trip) that was never actually
+                # planted; leave it armed instead
+                nspec = _inject.begin_numeric_dispatch()
+            if nspec is not None:
+                entry = _cwatch.watched_jit(self._solve_fn,
+                                            name=_SOLVE_FN)
         cw0 = _cwatch.snapshot(_SOLVE_FN) if _cwatch.enabled() else None
-        got = self._compiled(self.A_dev, self.A_dev64,
-                             self.precond.hierarchy, rhs, x0)
+        try:
+            got = entry(self.A_dev, self.A_dev64,
+                        self.precond.hierarchy, rhs, x0)
+        finally:
+            if nspec is not None:
+                from amgcl_tpu.faults import inject as _inject
+                _inject.end_numeric_dispatch()
         x = got[0]
         # ONE device->host round trip for everything the SolverInfo needs —
         # separate int()/float()/np.asarray() conversions each pay a full

@@ -133,6 +133,27 @@ def _gather_local(x_l, nd):
     return jnp.tile(x_l, nd)
 
 
+def _maybe_stall_exchange():
+    """Fault seam (faults/inject.py): a ``dist.delay`` rule stalls the
+    halo-exchange SpMV by ``delay_ms`` — a slow-interconnect simulation
+    for the serve/SLO layers. Fires at TRACE time (once per compiled
+    exchange program), never as a host callback inside the device loop:
+    the comm-stage census contracts (ledger.COMM_STAGE_CONTRACTS) and
+    the host-sync lint forbid runtime callbacks at this seam. One env
+    read when no plan is armed."""
+    import os
+    if not os.environ.get("AMGCL_TPU_FAULT_PLAN"):
+        return
+    try:
+        from amgcl_tpu.faults import inject as _inject
+        spec = _inject.should_fire("dist.delay", target="dia_halo")
+        if spec is not None and spec.get("delay_ms", 0) > 0:
+            import time
+            time.sleep(float(spec["delay_ms"]) / 1e3)
+    except Exception:
+        pass
+
+
 def dia_halo_mv(data_l, flat_offs, x_l, exchange=_ring_exchange,
                 gather=_gather_ring):
     """y = A x on one shard with comm/compute overlap.
@@ -153,6 +174,7 @@ def dia_halo_mv(data_l, flat_offs, x_l, exchange=_ring_exchange,
     the real ppermute/all_gather; telemetry/comm.py passes the local
     same-shape stand-ins to measure the comm-ablated variant of exactly
     this program."""
+    _maybe_stall_exchange()
     w = max(max(flat_offs), -min(flat_offs), 0) if flat_offs else 0
     nl = x_l.shape[0]
     acc_dt = jnp.result_type(data_l.dtype, x_l.dtype)

@@ -64,6 +64,9 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from amgcl_tpu.faults import (AdmissionError, LoadShedError,
+                              WorkerDiedError)
+from amgcl_tpu.faults import recovery as _frecovery
 from amgcl_tpu.serve.registry import (OperatorRegistry, RegistryEntry,
                                       sparsity_fingerprint,
                                       stable_config_key)
@@ -115,6 +118,11 @@ class _Tenant:
         self.slo_trips = 0
         self._slo_active: set = set()
         self.outcome = None           # last register() outcome
+        #: consecutive watchdog evaluations with a tripped window —
+        #: at AMGCL_TPU_SHED_BREACHES the tenant sheds load (typed
+        #: reject) until the cooldown passes
+        self.breaches = 0
+        self.shed_until = 0.0         # monotonic deadline, 0 = serving
 
 
 class SolverFarm:
@@ -205,6 +213,19 @@ class SolverFarm:
         self._n_batches = 0
         self._n_evictions = 0
         self._n_readmissions = 0
+        # -- fault tolerance (faults/): admission retry budget, load
+        #    shedding thresholds, dispatch-worker supervisor state
+        self._retry_max = _frecovery.retry_max()
+        self._shed_breaches = _env_int("AMGCL_TPU_SHED_BREACHES", 0)
+        self._shed_cooldown = _env_float("AMGCL_TPU_SHED_COOLDOWN_S",
+                                         5.0)
+        self._restart_max = _env_int("AMGCL_TPU_WORKER_RESTART_MAX", 2)
+        self._worker_restarts = 0
+        self._n_worker_deaths = 0
+        self._n_shed = 0
+        #: batch popped off the tenant queues but not yet accounted —
+        #: what the supervisor fails if the dispatch thread dies
+        self._inflight_reqs: List[_FarmRequest] = []
 
     # -- registration --------------------------------------------------------
 
@@ -533,18 +554,36 @@ class SolverFarm:
         self._mem_cond.notify_all()
 
     def _charge_locked(self, entry: RegistryEntry) -> None:
+        """Admit ``entry`` against the pool: evict coldest victims
+        while the charge refuses; when nothing is evictable, back off
+        and retry up to ``AMGCL_TPU_RETRY_MAX`` times (a transient
+        refusal — an injected OOM, a pinned victim mid-batch — clears
+        under the wait) before raising the typed
+        :class:`AdmissionError` (a ``RuntimeError``, so the historical
+        handlers keep working)."""
         nbytes = self._entry_bytes(entry)
         self._bytes_hint[entry.uid] = nbytes
         self._admit_begin_locked(entry.uid)
+        tries = 0
         try:
             while not self.pool.charge(entry.uid, nbytes):
-                if not self._evict_coldest_locked(
-                        exclude=(entry.uid,)):
-                    raise RuntimeError(
+                if self._evict_coldest_locked(exclude=(entry.uid,)):
+                    continue
+                tries += 1
+                if tries > self._retry_max:
+                    raise AdmissionError(
                         "operator %s needs %d bytes but the farm "
-                        "budget is %d and nothing else is evictable "
-                        "— raise AMGCL_TPU_FARM_MAX_BYTES" %
-                        (entry.uid, nbytes, self.pool.total))
+                        "budget is %d and nothing else is evictable"
+                        "%s — raise AMGCL_TPU_FARM_MAX_BYTES" %
+                        (entry.uid, nbytes, self.pool.total,
+                         " after %d backoff retr%s" % (
+                             tries - 1, "y" if tries == 2 else "ies")
+                         if tries > 1 else ""))
+                self.live.inc("recovery_retries_total")
+                # _mem_cond rides _mem_lock (held here): an unpin or a
+                # concurrent release wakes the wait early
+                self._mem_cond.wait(
+                    timeout=_frecovery.backoff_s(tries))
         finally:
             self._admit_end_locked(entry.uid)
         self._residency_gauges_locked(entry, resident=True,
@@ -884,6 +923,15 @@ class SolverFarm:
                         "tenant %r re-registered with a different "
                         "system size while this submit was in "
                         "progress" % (tenant,))
+                if cur.shed_until > time.monotonic():
+                    # graceful load shedding: a typed reject beats
+                    # queueing a request the breached SLO says cannot
+                    # be served in time
+                    raise LoadShedError(
+                        "tenant %r is shedding load under a sustained "
+                        "SLO breach — retry after %.1fs"
+                        % (tenant,
+                           max(cur.shed_until - time.monotonic(), 0.0)))
                 t = cur
                 if len(t.q) < t.queue_max:
                     break
@@ -899,6 +947,15 @@ class SolverFarm:
                 self._cond.wait(timeout=left)
             t.q.append(req)
             self._cond.notify_all()
+            gone = self._thread is None
+        if gone:
+            # raced a dispatch-worker death past start()'s fast path:
+            # the supervisor drains the tenant queues and nulls
+            # _thread atomically under _cond, so an append landing
+            # AFTER that block sees _thread is None — revive a worker
+            # (the restart budget bounds only supervisor
+            # self-restarts) so this request is never stranded
+            self.start()
         self.live.set_gauge("farm_tenant_queue_depth", len(t.q),
                             tenant=tenant)
         return req.public
@@ -999,10 +1056,30 @@ class SolverFarm:
         return live
 
     def _loop(self):
+        """Dispatch-thread entry: the inner loop under a supervisor —
+        an unexpected exception (outside the per-batch handling) fails
+        every in-flight and queued PUBLIC future through
+        :meth:`_worker_died` and restarts the thread (bounded), so a
+        farm worker death can never strand a tenant's futures."""
+        try:
+            self._loop_inner()
+        except Exception as e:           # noqa: BLE001 — supervisor
+            self._worker_died(e)
+
+    def _loop_inner(self):
+        from amgcl_tpu.faults import inject as _inject
         while True:
             batch, entry = self._next_batch()
             if batch is None:
                 return
+            self._inflight_reqs = batch
+            if _inject.enabled() and _inject.should_fire(
+                    "serve.worker", target="farm") is not None:
+                # worker-death fault seam (mirrors the service's)
+                self.live.inc("faults_injected_total",
+                              site="serve.worker")
+                raise WorkerDiedError(
+                    "injected farm dispatch-worker death")
             svc = None
             live: List[_FarmRequest] = []
             try:
@@ -1055,10 +1132,64 @@ class SolverFarm:
             except Exception:          # noqa: BLE001 — accounting must
                 import traceback       # never kill the dispatch loop,
                 traceback.print_exc()  # but must not vanish either
+            finally:
+                self._inflight_reqs = []
             if self._stop:
                 with self._cond:
                     if not any(t.q for t in self.tenants.values()):
                         return
+
+    def _worker_died(self, exc):
+        """Supervisor tail (on the dying dispatch thread): fail every
+        in-flight and tenant-queued public future with the typed
+        WorkerDiedError — never strand a submit() — then restart the
+        dispatch thread unless the farm closed or the restart budget
+        is spent."""
+        import traceback
+        if isinstance(exc, WorkerDiedError):
+            err = exc
+        else:
+            err = WorkerDiedError(
+                "farm dispatch worker died: %r" % exc)
+            err.__cause__ = exc
+        stragglers, self._inflight_reqs = self._inflight_reqs, []
+        with self._cond:
+            for t in self.tenants.values():
+                while t.q:
+                    stragglers.append(t.q.popleft())
+            self._thread = None
+            closed = self._closed
+            restarts = self._worker_restarts
+            self._n_worker_deaths += 1
+        for req in stragglers:
+            for fut in (req.future, req.public):
+                if not fut.done():
+                    fut.set_exception(err)
+        self.live.inc("serve_worker_deaths_total")
+        if not isinstance(exc, WorkerDiedError):
+            traceback.print_exception(type(exc), exc,
+                                      exc.__traceback__)
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            telemetry.emit(event="farm_worker_death",
+                           error=repr(exc)[:200],
+                           failed=len(stragglers), restarts=restarts)
+        try:
+            from amgcl_tpu.telemetry import flight as _fl
+            if _fl.enabled() and _fl.dump(
+                    "farm_worker_death",
+                    tags={"exception": repr(exc)[:200]}) is not None:
+                self.live.inc("flight_dumps_total")
+        except Exception:                        # noqa: BLE001
+            pass
+        if not closed and restarts < self._restart_max:
+            with self._cond:
+                self._worker_restarts += 1
+            self.live.inc("serve_worker_restarts_total")
+            try:
+                self.start()
+            except Exception:                    # noqa: BLE001
+                traceback.print_exc()
 
     def _account(self, batch: List[_FarmRequest]) -> None:
         """Per-tenant bookkeeping between the INNER futures resolving
@@ -1198,6 +1329,29 @@ class SolverFarm:
         trip state (the isolation the tests pin)."""
         if not summ["window"]:
             return
+        if self._shed_breaches > 0:
+            # load-shedding ladder: consecutive tripped evaluations
+            # accumulate; at the threshold the tenant sheds (typed
+            # submit reject) for a cooldown, then probes again
+            if summ["trips"]:
+                t.breaches += 1
+                if t.breaches >= self._shed_breaches \
+                        and t.shed_until <= time.monotonic():
+                    t.shed_until = time.monotonic() \
+                        + max(self._shed_cooldown, 0.0)
+                    self._n_shed += 1
+                    self.live.inc("farm_load_shed_total",
+                                  tenant=t.name)
+                    if _sink_attached():
+                        from amgcl_tpu import telemetry
+                        telemetry.emit(
+                            event="farm_shed", tenant=t.name,
+                            trips=summ["trips"],
+                            cooldown_s=self._shed_cooldown,
+                            breaches=t.breaches)
+            else:
+                t.breaches = 0
+                t.shed_until = 0.0
         new = [k for k in summ["trips"] if k not in t._slo_active]
         t._slo_active = set(summ["trips"])
         if not new:
@@ -1261,6 +1415,7 @@ class SolverFarm:
                 "unhealthy": t.n_unhealthy,
                 "slo_trips": t.slo_trips,
                 "queue_depth": len(t.q),
+                "shedding": t.shed_until > time.monotonic(),
                 "slo_summary": self.tenant_slo_summary(name),
             }
             if lat:
@@ -1283,6 +1438,11 @@ class SolverFarm:
             "readmissions": self._n_readmissions,
             "batch_bucket": self.batch,
         }
+        rec = {"worker_deaths": self._n_worker_deaths,
+               "worker_restarts": self._worker_restarts,
+               "shed": self._n_shed}
+        if any(rec.values()):
+            out["recovery"] = rec
         if self.metrics_server is not None:
             out["metrics_port"] = self.metrics_server.port
         return out

@@ -72,6 +72,25 @@ Env knobs (read at construction; constructor args win):
                                 (default 0.05)
   AMGCL_TPU_SLO_WINDOW          rolling window size in requests
                                 (default 256)
+  AMGCL_TPU_RETRY_MAX           per-request retry cap on failed batch
+                                dispatch; also arms batch bisection
+                                (default 0 = off, fail-the-batch)
+  AMGCL_TPU_RETRY_BACKOFF_MS /  exponential-backoff base + seeded
+  AMGCL_TPU_RETRY_JITTER        jitter for retries (faults/recovery.py)
+  AMGCL_TPU_WORKER_RESTART_MAX  dispatch-worker restarts the supervisor
+                                allows (default 2); worker death always
+                                fails in-flight/queued futures typed
+
+Fault tolerance (ISSUE 13): the worker runs under a SUPERVISOR —
+an unexpected exception anywhere in the dispatch loop fails every
+in-flight and queued future with the typed
+:class:`~amgcl_tpu.faults.WorkerDiedError` (futures are never
+stranded) and restarts the worker; with ``AMGCL_TPU_RETRY_MAX`` > 0 a
+failed batch is bisected to isolate poison requests and survivors are
+retried with exponential backoff + deterministic jitter. The
+``faults/inject.py`` seams (device.loss at dispatch, serve.worker /
+serve.timeout / serve.reject / serve.poison in the worker path) make
+every one of those paths deterministically testable.
 """
 
 from __future__ import annotations
@@ -113,7 +132,8 @@ def _env_float(name: str, default: float) -> float:
 
 
 class _Request:
-    __slots__ = ("rhs", "x0", "future", "t_submit", "timeout_s", "rid")
+    __slots__ = ("rhs", "x0", "future", "t_submit", "timeout_s", "rid",
+                 "attempts", "started")
 
     def __init__(self, rhs, timeout_s, x0=None, rid=0):
         self.rhs = rhs
@@ -124,6 +144,12 @@ class _Request:
         self.t_submit = time.perf_counter()
         self.timeout_s = timeout_s
         self.rid = rid
+        #: failed dispatch attempts so far (faults/recovery.py retry
+        #: ladder: retried with backoff up to AMGCL_TPU_RETRY_MAX)
+        self.attempts = 0
+        #: Future.set_running_or_notify_cancel() may only be called
+        #: once — a retried/bisected request skips it the second time
+        self.started = False
 
 
 _SENTINEL = object()
@@ -229,6 +255,20 @@ class SolverService:
         self._last_slo: Optional[Dict[str, Any]] = None
         self._waste = {"flops": 0, "bytes": 0, "padded_col_iters": 0}
         self._bucket_models: Dict[int, Dict[str, Any]] = {}
+        # -- fault tolerance (faults/): per-request retry + bisection
+        #    behind AMGCL_TPU_RETRY_MAX (0 = off, the historical
+        #    fail-the-batch behavior); the worker supervisor below is
+        #    unconditional — a dead worker must never strand futures
+        from amgcl_tpu.faults import recovery as _frec
+        self.retry_max = _frec.retry_max()
+        self._restart_max = _env_int("AMGCL_TPU_WORKER_RESTART_MAX", 2)
+        self._n_retries = 0
+        self._n_recovered = 0
+        self._n_worker_deaths = 0
+        self._worker_restarts = 0
+        #: requests popped off the queue but not yet resolved — what
+        #: the supervisor fails if the worker dies mid-assembly
+        self._inflight_reqs: List[_Request] = []
 
     # -- sizing ---------------------------------------------------------------
 
@@ -332,6 +372,18 @@ class SolverService:
         (stats on host), plus the compile-watch delta of this call
         (``compile_s`` > 0 exactly on a cold (shape, B) bucket)."""
         import jax
+        from amgcl_tpu.faults import inject as _inject
+        if _inject.enabled():
+            # device fault seam: simulated device loss / preemption
+            # raised from the serve.solve_step dispatch boundary (the
+            # retry + bisection layer above absorbs it)
+            if _inject.should_fire("device.loss",
+                                   target="serve") is not None:
+                from amgcl_tpu.faults import DeviceLostError
+                self.live.inc("faults_injected_total",
+                              site="device.loss")
+                raise DeviceLostError(
+                    "injected device loss at serve.solve_step")
         cw0 = _cwatch.snapshot(_SERVE_STEP) if _cwatch.enabled() else None
         t0 = time.perf_counter()
         got = self._ensure_entry()(
@@ -455,6 +507,17 @@ class SolverService:
                 raise ValueError("x0 has shape %s but the system has %d "
                                  "unknowns" % (x0.shape, self.n))
         self.start()
+        from amgcl_tpu.faults import inject as _inject
+        if _inject.enabled():
+            # queue-saturation fault seam: a fired ``serve.reject``
+            # rule surfaces as the same backpressure signal a full
+            # queue raises
+            spec = _inject.should_fire("serve.reject")
+            if spec is not None:
+                self.live.inc("faults_injected_total",
+                              site="serve.reject")
+                raise queue.Full(
+                    "injected queue saturation (serve.reject)")
         timeout = timeout_s if timeout_s is not None else self.timeout_s
         req = _Request(rhs, timeout, x0=x0, rid=next(self._rid))
         self.queue.put(req, block=block,
@@ -471,10 +534,38 @@ class SolverService:
                 self._fail_stragglers()
             if req.future.done() and req.future.exception() is not None:
                 raise RuntimeError("SolverService is closed")
+        else:
+            with self._lock:
+                gone = self._thread is None
+            if gone:
+                # raced a worker DEATH past start()'s fast path: the
+                # supervisor may have declined to restart (budget
+                # spent) after draining the queue, so this entry would
+                # otherwise sit unserviced — revive a worker (a live
+                # submit may always demand one; the restart budget
+                # bounds only supervisor self-restarts)
+                try:
+                    self.start()
+                except RuntimeError:
+                    self._fail_stragglers()
         self.live.set_gauge("serve_queue_depth", self.queue.qsize())
         return req.future
 
     def _loop(self):
+        """The worker thread entry: the inner dispatch loop under a
+        supervisor. An unexpected exception anywhere in the loop (not
+        just inside a batch) fails EVERY in-flight and queued future
+        through :meth:`_worker_died` — futures are never stranded —
+        and the worker is restarted (bounded by
+        ``AMGCL_TPU_WORKER_RESTART_MAX``)."""
+        try:
+            self._loop_inner()
+        except Exception as e:           # noqa: BLE001 — supervisor
+            self._worker_died(e)
+
+    def _loop_inner(self):
+        from amgcl_tpu.faults import WorkerDiedError
+        from amgcl_tpu.faults import inject as _inject
         while True:
             try:
                 first = self.queue.get(timeout=0.1)
@@ -484,6 +575,15 @@ class SolverService:
                 continue
             if first is _SENTINEL:
                 return
+            self._inflight_reqs = [first]
+            if _inject.enabled() and _inject.should_fire(
+                    "serve.worker", target="serve") is not None:
+                # worker-death fault seam: raises OUTSIDE the per-batch
+                # try, exactly like a real unexpected worker exception
+                self.live.inc("faults_injected_total",
+                              site="serve.worker")
+                raise WorkerDiedError(
+                    "injected dispatch-worker death")
             batch = [first]
             deadline = time.monotonic() + self.flush_s
             # flush-on-partial-batch: wait for a full bucket only up to
@@ -500,67 +600,221 @@ class SolverService:
                     self._stop = True
                     break
                 batch.append(got)
+                self._inflight_reqs = batch
             try:
                 self._run_batch(batch)
             except Exception as e:       # noqa: BLE001 — a failed batch
-                failed = 0
-                for req in batch:        # must fail ITS futures, not
-                    if not req.future.done():   # kill the service loop
-                        req.future.set_exception(e)
-                        failed += 1
-                if not failed:
-                    # every future already resolved: nothing to attach
-                    # the error to — print it or it vanishes entirely
-                    import traceback
-                    traceback.print_exc()
-                else:
-                    # the error must stay visible to the observability
-                    # surface too: the batch is over (in-flight back to
-                    # 0), and error-failed requests count as unhealthy
-                    # in the lifetime stats and the SLO window
-                    self.live.set_gauge("serve_inflight", 0)
-                    self.live.set_gauge("serve_queue_depth",
-                                        self.queue.qsize())
-                    self.live.inc("serve_unhealthy_total", failed)
-                    with self._lock:
-                        self._n_unhealthy += failed
-                        self._win.extend(
-                            {"timeout": False, "unhealthy": True,
-                             "error": True} for _ in range(failed))
-                    # flight recorder: a failed batch is an incident —
-                    # dump a replay bundle of its first request, tagged
-                    # with every failed request id + the exception
-                    try:
-                        from amgcl_tpu.telemetry import flight as _fl
-                        if _fl.enabled() and _fl.dump(
-                                "serve_batch_failed",
-                                bundle=self.solver, rhs=batch[0].rhs,
-                                x0=batch[0].x0,
-                                tags={"request_ids":
-                                      [r.rid for r in batch],
-                                      "exception": repr(e)[:200]}) \
-                                is not None:
-                            self.live.inc("flight_dumps_total")
-                    except Exception:            # noqa: BLE001
-                        pass
-                    self._check_slo()
+                # must fail (or retry/bisect) ITS requests, not kill
+                # the service loop
+                self._handle_batch_failure(batch, e)
+            # cleared only on the NORMAL path: if _run_batch or the
+            # failure handler itself raised, the batch must stay
+            # visible to the supervisor (_worker_died fails it) — a
+            # finally here would clear it before the exception
+            # propagates and silently strand the batch's futures
+            self._inflight_reqs = []
             if self._stop and self.queue.empty():
                 return
 
+    def _handle_batch_failure(self, batch, e, depth: int = 0):
+        """A batch dispatch raised. With retries off (the default),
+        fail the futures — the historical behavior. With
+        ``AMGCL_TPU_RETRY_MAX`` > 0: a multi-request batch is BISECTED
+        (each half re-dispatched independently, isolating a poison
+        request in O(log B) dispatches); a single request is re-queued
+        with exponential backoff + deterministic jitter until its
+        attempts run out, then failed with the typed error."""
+        if self.retry_max <= 0 or not batch:
+            self._fail_batch(batch, e)
+            return
+        if len(batch) > 1:
+            mid = len(batch) // 2
+            for half in (batch[:mid], batch[mid:]):
+                try:
+                    self._run_batch(half)
+                except Exception as e2:          # noqa: BLE001
+                    self._handle_batch_failure(half, e2,
+                                               depth=depth + 1)
+            return
+        req = batch[0]
+        req.attempts += 1
+        if req.attempts <= self.retry_max and not req.future.done() \
+                and not self._closed:
+            from amgcl_tpu.faults import recovery as _frec
+            delay = _frec.backoff_s(req.attempts, key=req.rid)
+            self.live.inc("recovery_retries_total")
+            with self._lock:
+                self._n_retries += 1
+            if _sink_attached():
+                from amgcl_tpu import telemetry
+                telemetry.emit(event="serve_retry", request_id=req.rid,
+                               attempt=req.attempts,
+                               backoff_s=round(delay, 4),
+                               error=repr(e)[:200])
+            timer = threading.Timer(delay, self._requeue, args=(req,))
+            timer.daemon = True
+            timer.start()
+            return
+        self._fail_batch(batch, e)
+
+    def _requeue(self, req):
+        """Backoff-timer callback: put the retried request back on the
+        queue. Mirrors submit(): start() first, so a worker exists to
+        drain it — the worker may have died (and exhausted its restart
+        budget) while the timer was pending, and re-queueing onto a
+        worker-less queue would strand the future forever. Any failure
+        to re-enter fails the future instead (never silent)."""
+        try:
+            if self._closed:
+                raise RuntimeError("SolverService closed before the "
+                                   "retry of request %d" % req.rid)
+            self.start()
+            self.queue.put(req, block=False)
+        except Exception as e:               # noqa: BLE001 — the retry
+            if not req.future.done():        # path must resolve, not
+                req.future.set_exception(e)  # strand
+
+    def _fail_batch(self, batch, e):
+        """Terminal batch failure: fail the futures, keep the error
+        visible to the observability surface (unhealthy counts, SLO
+        window, flight bundle)."""
+        failed = 0
+        for req in batch:
+            if not req.future.done():
+                req.future.set_exception(e)
+                failed += 1
+        if not failed:
+            # every future already resolved: nothing to attach
+            # the error to — print it or it vanishes entirely
+            import traceback
+            traceback.print_exc()
+            return
+        # the error must stay visible to the observability
+        # surface too: the batch is over (in-flight back to
+        # 0), and error-failed requests count as unhealthy
+        # in the lifetime stats and the SLO window
+        self.live.set_gauge("serve_inflight", 0)
+        self.live.set_gauge("serve_queue_depth",
+                            self.queue.qsize())
+        self.live.inc("serve_unhealthy_total", failed)
+        with self._lock:
+            self._n_unhealthy += failed
+            self._win.extend(
+                {"timeout": False, "unhealthy": True,
+                 "error": True} for _ in range(failed))
+        # flight recorder: a failed batch is an incident —
+        # dump a replay bundle of its first request, tagged
+        # with every failed request id + the exception
+        try:
+            from amgcl_tpu.telemetry import flight as _fl
+            if _fl.enabled() and _fl.dump(
+                    "serve_batch_failed",
+                    bundle=self.solver, rhs=batch[0].rhs,
+                    x0=batch[0].x0,
+                    tags={"request_ids":
+                          [r.rid for r in batch],
+                          "exception": repr(e)[:200]}) \
+                    is not None:
+                self.live.inc("flight_dumps_total")
+        except Exception:            # noqa: BLE001
+            pass
+        self._check_slo()
+
+    def _worker_died(self, exc):
+        """Supervisor tail, run ON the dying worker thread: fail every
+        in-flight and queued future with the typed WorkerDiedError
+        (satellite: an unhandled worker death used to leave submit()
+        futures unresolved forever), publish the death, and restart a
+        fresh worker unless the service is closed or the restart
+        budget is spent."""
+        import traceback
+        from amgcl_tpu.faults import WorkerDiedError
+        if isinstance(exc, WorkerDiedError):
+            err = exc
+        else:
+            err = WorkerDiedError(
+                "serve dispatch worker died: %r" % exc)
+            err.__cause__ = exc
+        # _thread is nulled BEFORE the queue drain: a submit() racing
+        # past start()'s fast path then lands its request either
+        # before the drain (failed here) or after it — in which case
+        # submit()'s own post-put gone-check sees _thread is None and
+        # revives a worker, so the raced request is never stranded
+        with self._lock:
+            self._n_worker_deaths += 1
+            self._thread = None
+            closed = self._closed
+            restarts = self._worker_restarts
+        inflight, self._inflight_reqs = self._inflight_reqs, []
+        for req in inflight:
+            if not req.future.done():
+                req.future.set_exception(err)
+        while True:
+            try:
+                item = self.queue.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _SENTINEL and not item.future.done():
+                item.future.set_exception(err)
+        self.live.inc("serve_worker_deaths_total")
+        self.live.set_gauge("serve_inflight", 0)
+        self.live.set_gauge("serve_queue_depth", self.queue.qsize())
+        if not isinstance(exc, WorkerDiedError):
+            traceback.print_exception(type(exc), exc,
+                                      exc.__traceback__)
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            telemetry.emit(event="serve_worker_death",
+                           error=repr(exc)[:200],
+                           failed=len(inflight),
+                           restarts=restarts)
+        try:
+            from amgcl_tpu.telemetry import flight as _fl
+            if _fl.enabled() and _fl.dump(
+                    "serve_worker_death", bundle=self.solver,
+                    tags={"exception": repr(exc)[:200]}) is not None:
+                self.live.inc("flight_dumps_total")
+        except Exception:                        # noqa: BLE001
+            pass
+        if not closed and restarts < self._restart_max:
+            with self._lock:
+                self._worker_restarts += 1
+            self.live.inc("serve_worker_restarts_total")
+            try:
+                self.start()
+            except Exception:                    # noqa: BLE001
+                traceback.print_exc()
+
     def _run_batch(self, batch):
         import jax.numpy as jnp
+        from amgcl_tpu.faults import inject as _inject
         from amgcl_tpu.serve.batched import STACKED_LOWERING
         t_start = time.perf_counter()
         live = []
         timeouts = 0
+        injecting = _inject.enabled()
         for req in batch:
-            if t_start - req.t_submit > req.timeout_s:
-                req.future.set_exception(TimeoutError(
-                    "request waited %.2fs in the serve queue "
-                    "(timeout %.2fs)" % (t_start - req.t_submit,
-                                         req.timeout_s)))
+            expired = t_start - req.t_submit > req.timeout_s
+            if not expired and injecting and _inject.should_fire(
+                    "serve.timeout", rids=(req.rid,)) is not None:
+                # timeout-storm fault seam: the request is treated as
+                # queue-expired, exercising the timeout accounting
+                self.live.inc("faults_injected_total",
+                              site="serve.timeout")
+                expired = True
+            if expired:
+                # done() guard: a caller may have cancel()ed a still-
+                # PENDING future — set_exception would then raise
+                # InvalidStateError and fail the whole batch
+                if not req.future.done():
+                    req.future.set_exception(TimeoutError(
+                        "request waited %.2fs in the serve queue "
+                        "(timeout %.2fs)" % (t_start - req.t_submit,
+                                             req.timeout_s)))
                 timeouts += 1
-            elif req.future.set_running_or_notify_cancel():
+            elif req.started \
+                    or req.future.set_running_or_notify_cancel():
+                req.started = True
                 live.append(req)
         if timeouts:
             self.live.inc("serve_timeouts_total", timeouts)
@@ -573,6 +827,15 @@ class SolverService:
             if timeouts:
                 self._check_slo()
             return
+        if injecting and _inject.should_fire(
+                "serve.poison", rids=[r.rid for r in live]) is not None:
+            # poison-request fault seam: any batch containing the
+            # rule's rid fails — the bisection above isolates it
+            from amgcl_tpu.faults import PoisonRequestError
+            self.live.inc("faults_injected_total", site="serve.poison")
+            raise PoisonRequestError(
+                "injected poison request in batch %s"
+                % [r.rid for r in live])
         self.live.set_gauge("serve_inflight", len(live))
         bucket = self._bucket(len(live))
         fill = len(live) / bucket
@@ -695,6 +958,16 @@ class SolverService:
         self.live.observe("serve_batch_fill", fill)
         self.live.observe("serve_solve_ms", solve_ms)
         self.live.set_gauge("serve_inflight", 0)
+        recovered = sum(1 for req in live if req.attempts)
+        if recovered:
+            # a retried request that landed: the retry ladder paid off
+            self.live.inc("recoveries_total", recovered)
+            with self._lock:
+                self._n_recovered += recovered
+        from amgcl_tpu.faults import recovery as _frec
+        age = _frec.last_checkpoint_age_s()
+        if age is not None:
+            self.live.set_gauge("recovery_checkpoint_age_s", age)
         if _cwatch.enabled():
             # compile-cache join: cache hits vs traces of the resident
             # program, live on /metrics (a bucket retrace under traffic
@@ -959,6 +1232,13 @@ class SolverService:
             out["compile"] = {"traces": snap["traces"],
                               "cache_hits": snap["cache_hits"],
                               "compile_s": snap["compile_s"]}
+        with self._lock:
+            rec = {"retries": self._n_retries,
+                   "recovered": self._n_recovered,
+                   "worker_deaths": self._n_worker_deaths,
+                   "worker_restarts": self._worker_restarts}
+        if any(rec.values()):
+            out["recovery"] = rec
         if self.metrics_server is not None:
             out["metrics_port"] = self.metrics_server.port
         return out

@@ -484,6 +484,67 @@ def farm_findings(farm: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def recovery_findings(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Findings from a recovery-ladder trail (``SolveReport.recovery``,
+    faults/recovery.py): how the solve was saved, whether the saving
+    rung should become the configuration (escalations that recur are a
+    config smell, not a fault), and thrash — the ladder re-running on
+    one operator solve after solve. Same {severity, code, message,
+    suggestion} shape; :func:`diagnose` folds these via ``recovery=``."""
+    out: List[Dict[str, Any]] = []
+    if not isinstance(rec, dict):
+        return out
+    attempts = rec.get("attempts") or []
+    final = rec.get("final_rung")
+    if rec.get("recovered"):
+        flags = sorted({f for a in attempts
+                        for f in (a.get("flags") or [])})
+        sug = {
+            "last_good": "the fault was transient (injected or "
+                         "environmental) — no config change needed; "
+                         "check the fault/flight events for the source",
+            "precision": "f32 ran out of range/accuracy for this "
+                         "system — build the bundle with "
+                         "dtype=float64 (or refine>0) instead of "
+                         "paying a failed f32 solve first",
+            "solver": "the configured solver breaks down on this "
+                      "operator — adopt the ladder's fallback solver "
+                      "as the configuration",
+            "smoother": "the smoother diverges on this operator — "
+                        "configure damped_jacobi (or chebyshev) "
+                        "directly",
+        }.get(final)
+        out.append(_finding(
+            "warning", "recovered",
+            "solve recovered on rung %r after %d attempt(s) "
+            "(flags along the way: %s)"
+            % (final, len(attempts), ", ".join(flags) or "none"), sug))
+    elif attempts and not attempts[-1].get("ok"):
+        # recovered=False with a SUCCESSFUL last attempt is the clean
+        # recovery-enabled solve (one ok initial attempt, no ladder) —
+        # not an exhaustion; only a failed trail is critical
+        out.append(_finding(
+            "critical", "recovery_exhausted",
+            "recovery ladder exhausted after %d attempt(s): %s"
+            % (len(attempts),
+               " -> ".join(a.get("rung", "?") for a in attempts)),
+            "the failure survives precision escalation, solver "
+            "switching and the smoother fallback — inspect the flight "
+            "bundle (reason recovery_exhausted) and the operator "
+            "itself (singular? inconsistent rhs?)"))
+    runs = rec.get("runs") or 0
+    if runs >= 3:
+        out.append(_finding(
+            "warning", "recovery_thrash",
+            "the recovery ladder has run %d times on this operator — "
+            "every solve is paying failed attempts before the rung "
+            "that works" % runs,
+            "promote the recovering rung to the configuration (see "
+            "the 'recovered' finding) instead of re-escalating per "
+            "solve"))
+    return out
+
+
 def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              probe: Optional[List[Dict[str, Any]]] = None,
              tol: Optional[float] = None,
@@ -493,7 +554,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              serve: Optional[Dict[str, Any]] = None,
              comm: Optional[Dict[str, Any]] = None,
              farm: Optional[Dict[str, Any]] = None,
-             diff: Optional[Dict[str, Any]] = None
+             diff: Optional[Dict[str, Any]] = None,
+             recovery: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
@@ -679,6 +741,12 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     if isinstance(farm, dict):
         # farm leg: per-tenant SLO breaches + eviction thrash
         out.extend(farm_findings(farm))
+    rec = recovery if recovery is not None \
+        else getattr(report, "recovery", None)
+    if isinstance(rec, dict):
+        # fault-tolerance leg: how the ladder saved (or lost) the
+        # solve, and whether the escalation is thrashing
+        out.extend(recovery_findings(rec))
     if isinstance(diff, dict):
         # forensics leg: cross-run regression attribution
         # (telemetry/diff.py — stdlib-only, safe to import here)

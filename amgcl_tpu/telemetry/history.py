@@ -29,9 +29,41 @@ the clean path beyond a few scalar compares.
 
 from __future__ import annotations
 
+import os
+
 import jax.numpy as jnp
 
 from amgcl_tpu.telemetry import health as _health
+
+
+def _inject_numeric(it, res, trips):
+    """Numeric fault seam (faults/inject.py): when a ``numeric.*``
+    rule FIRED for the dispatch currently being traced
+    (``inject.begin_numeric_dispatch`` in make_solver._solve_once —
+    the full after/count/p trigger logic runs there, once per
+    dispatch), plant NaN/Inf into the guarded residual (or an
+    artificial breakdown trip) at the rule's iteration. The pending
+    spec is visible ONLY inside make_solver's faulted-dispatch window,
+    which routes through a fresh throwaway jit wrap — any other trace
+    (a serve bucket compile, an audit) sees None, so no cached program
+    ever carries the fault. A no-op single env read when no plan is
+    set."""
+    if not os.environ.get("AMGCL_TPU_FAULT_PLAN"):
+        return res, trips
+    try:
+        from amgcl_tpu.faults import inject as _inject
+        spec = _inject.pending_numeric()
+    except Exception:
+        return res, trips
+    if spec is None:
+        return res, trips
+    hit = jnp.asarray(it) == int(spec.get("at", 0))
+    if spec["site"] == "numeric.breakdown":
+        trips = tuple(trips) + ((_health.BREAKDOWN_RHO, hit),)
+    else:
+        bad = jnp.inf if spec["site"] == "numeric.inf" else jnp.nan
+        res = jnp.where(hit, bad, res)
+    return res, trips
 
 
 class HistoryMixin:
@@ -89,6 +121,7 @@ class HistoryMixin:
         write; always-True when guards are off."""
         if not getattr(self, "guard", False):
             return jnp.asarray(True), hs
+        res, trips = _inject_numeric(it, res, trips)
         return _health.step(hs, it, res, trips)
 
     def _guard_go(self, hs):

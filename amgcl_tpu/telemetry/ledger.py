@@ -43,6 +43,23 @@ import numpy as np
 # shared device-memory budget
 # ---------------------------------------------------------------------------
 
+def _charge_fault(budget_name: str) -> bool:
+    """Allocation fault seam (faults/inject.py): an armed ``alloc.*``
+    rule in ``AMGCL_TPU_FAULT_PLAN`` forces the next charge(s) to be
+    refused — simulated HBM OOM at farm admission (``alloc.farm`` on
+    the ``farm_hbm`` pool) or dense-window conversion (``alloc.dwin``
+    on every other budget). One env read when no plan is set."""
+    if not os.environ.get("AMGCL_TPU_FAULT_PLAN"):
+        return False
+    try:
+        from amgcl_tpu.faults import inject as _inject
+        site = "alloc.farm" if budget_name == "farm_hbm" \
+            else "alloc.dwin"
+        return _inject.should_fire(site, target=budget_name) is not None
+    except Exception:
+        return False
+
+
 class DeviceMemoryBudget:
     """Byte budget shared across one hierarchy build.
 
@@ -63,6 +80,8 @@ class DeviceMemoryBudget:
 
     def try_charge(self, nbytes: int, tag: str = "") -> bool:
         nbytes = int(nbytes)
+        if _charge_fault(self.name):
+            return False
         if nbytes < 0 or self.used + nbytes > self.total:
             return False
         self.used += nbytes

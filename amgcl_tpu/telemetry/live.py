@@ -142,6 +142,26 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "ledger bytes of the tenant's hierarchy (label: tenant)"),
     "farm_tenant_p99_ms": (
         "gauge", "rolling-window p99 latency per tenant (label: tenant)"),
+    # -- fault injection + recovery (amgcl_tpu/faults/) -------------------
+    "faults_injected_total": (
+        "counter", "deterministic faults fired at serving-layer seams "
+                   "(faults/inject.py; label: site)"),
+    "recovery_retries_total": (
+        "counter", "recovery retries scheduled (request re-dispatch "
+                   "with backoff, farm admission backoff)"),
+    "recoveries_total": (
+        "counter", "retried requests that subsequently succeeded"),
+    "recovery_checkpoint_age_s": (
+        "gauge", "seconds since the newest host-side Krylov-iterate "
+                 "checkpoint (AMGCL_TPU_CKPT_EVERY)"),
+    "serve_worker_deaths_total": (
+        "counter", "dispatch-worker threads that died on an unexpected "
+                   "exception (futures failed, never stranded)"),
+    "serve_worker_restarts_total": (
+        "counter", "dispatch workers restarted by the supervisor"),
+    "farm_load_shed_total": (
+        "counter", "load-shedding episodes per tenant under sustained "
+                   "SLO breach (label: tenant)"),
 }
 
 #: THE declared label-key table: metric name -> allowed label keys.
@@ -161,6 +181,8 @@ METRIC_LABELS: Dict[str, Tuple[str, ...]] = {
     "farm_tenant_resident": ("tenant",),
     "farm_tenant_bytes": ("tenant",),
     "farm_tenant_p99_ms": ("tenant",),
+    "faults_injected_total": ("site",),
+    "farm_load_shed_total": ("tenant",),
 }
 
 # the ONE name-mangling rule, shared with the rollup exposition so the

@@ -99,6 +99,13 @@ class SolveReport:
     #: times sum to the end-to-end latency by construction. None for
     #: reports born outside the SolverService queue
     serve: Optional[Dict[str, Any]] = None
+    #: recovery-ladder trail (faults/recovery.py): ``{"recovered",
+    #: "attempts": [{rung, solver, ok, iters, resid, flags, ...}],
+    #: "final_rung", "runs"}`` — recorded when make_solver runs with
+    #: recovery enabled and the ladder executed (even a clean first
+    #: attempt records its row when a fault had to be absorbed). None
+    #: outside the recovery path
+    recovery: Optional[Dict[str, Any]] = None
     extra: Dict[str, Any] = field(default_factory=dict)
 
     def __post_init__(self):
@@ -152,6 +159,8 @@ class SolveReport:
             out["latency"] = self.latency
         if self.serve is not None:
             out["serve"] = self.serve
+        if self.recovery is not None:
+            out["recovery"] = self.recovery
         if self.extra:
             out.update(self.extra)
         return out

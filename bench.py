@@ -2667,6 +2667,43 @@ def main_check(targets=None):
         except Exception as e:
             replay_ok = False
             rec["selfreplay"] = {"ok": False, "error": repr(e)[:300]}
+    recovery_ok = True
+    if os.environ.get("AMGCL_TPU_GATE_RECOVERY", "1") != "0":
+        # chaos-matrix gate (amgcl_tpu/faults/chaos.py): every injected
+        # fault scenario (numeric x allocation x device x serve) must
+        # either recover with solution parity or fail cleanly (typed
+        # error + flight bundle) under the global deadline — a hang or
+        # an unclean failure fails the round, the flight-selftest
+        # pattern applied to the whole fault-tolerance layer.
+        try:
+            c_timeout = float(os.environ.get("AMGCL_TPU_CHAOS_TIMEOUT",
+                                             "900"))
+        except ValueError:
+            c_timeout = 900.0
+        try:
+            cr = subprocess.run(
+                [sys.executable, "-m", "amgcl_tpu.faults",
+                 "--selftest"],
+                capture_output=True, text=True, timeout=c_timeout + 60,
+                cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            crec = json.loads(cr.stdout.strip().splitlines()[-1])
+            recovery_ok = bool(crec.get("ok")) and cr.returncode == 0
+            rec["recovery"] = {
+                "ok": recovery_ok,
+                "scenarios": crec.get("total"),
+                "recovered": crec.get("recovered"),
+                "clean_fail": crec.get("clean_fail"),
+                "hangs": crec.get("hangs"),
+                "failures": crec.get("failures"),
+                "wall_s": crec.get("wall_s")}
+            if not recovery_ok:
+                # the actionable payload: the failing scenario rows
+                rec["recovery"]["failed_scenarios"] = [
+                    s for s in crec.get("scenarios", [])
+                    if not s.get("ok")]
+        except Exception as e:
+            recovery_ok = False
+            rec["recovery"] = {"ok": False, "error": repr(e)[:300]}
     analysis_ok = True
     if os.environ.get("AMGCL_TPU_ANALYSIS_IN_CHECK", "1") != "0":
         # static-analysis gate (amgcl_tpu/analysis): AST lint vs the
@@ -2711,7 +2748,7 @@ def main_check(targets=None):
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0 if (rc == 0 and gate_ok and analysis_ok
-                 and replay_ok) else 1
+                 and replay_ok and recovery_ok) else 1
 
 
 if __name__ == "__main__":

