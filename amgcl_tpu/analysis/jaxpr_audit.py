@@ -66,7 +66,7 @@ __all__ = [
     "iter_eqns", "find_while_bodies", "collective_census",
     "vector_streams", "dtype_casts", "host_callbacks", "donation_audit",
     "audit_solver", "audit_dist_cg", "audit_make_solver", "audit_serve",
-    "audit_setup", "check_setup",
+    "audit_setup", "check_setup", "audit_structure", "check_structure",
     "audit_entry_points", "run_audit", "format_report",
 ]
 
@@ -881,6 +881,99 @@ def check_setup(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
     return out
 
 
+def audit_structure(m: int = 6) -> Dict[str, Any]:
+    """Audit the operator X-ray's host-purity contract
+    (``ledger.STRUCTURE_CONTRACTS``), two halves:
+
+    * **static** — AST-scan ``telemetry/structure.py`` for imports of
+      ``jax`` or of jax-importing ``amgcl_tpu.ops`` modules
+      (``ops.csr`` is numpy-only and allowed): any hit means the
+      "host-side analytics only" claim is structurally false.
+    * **dynamic** — build a small hierarchy, snapshot the
+      compile-watch totals, run a FULL ``structure_report`` (advisor
+      included, every level) plus ``structure_findings``, and record
+      the trace/compile delta: the X-ray must compile nothing beyond
+      the entry points the build already created.
+    """
+    import ast
+    import os as _os
+
+    path = _os.path.join(_os.path.dirname(_os.path.dirname(
+        _os.path.abspath(__file__))), "telemetry", "structure.py")
+    with open(path) as f:
+        tree = ast.parse(f.read())
+    jax_imports = []
+    for node in ast.walk(tree):
+        names = []
+        if isinstance(node, ast.Import):
+            names = [a.name for a in node.names]
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            names = [node.module]
+        for name in names:
+            root = name.split(".")[0]
+            if root == "jax" or (
+                    name.startswith("amgcl_tpu.ops")
+                    and not name.startswith("amgcl_tpu.ops.csr")):
+                jax_imports.append(name)
+
+    rec: Dict[str, Any] = {"entry": "telemetry.structure",
+                           "jax_imports": len(jax_imports),
+                           "jax_import_names": jax_imports}
+    try:
+        from amgcl_tpu.utils.sample_problem import poisson3d
+        from amgcl_tpu.models.amg import AMG, AMGParams
+        from amgcl_tpu.telemetry import compile_watch as cw
+        from amgcl_tpu.telemetry.structure import structure_findings
+        A, _ = poisson3d(m)
+        amg = AMG(A, AMGParams(coarse_enough=20))
+        before = cw.snapshot()["totals"]
+        xray = amg.structure_report(advise=True)
+        structure_findings(xray)
+        after = cw.snapshot()["totals"]
+        rec["new_traces"] = after["traces"] - before["traces"]
+        rec["new_backend_compiles"] = (after["backend_compiles"]
+                                       - before["backend_compiles"])
+        rec["n_levels"] = len(xray.get("levels", []))
+    except Exception as e:
+        rec["skipped"] = "dynamic half failed: %r" % (e,)
+    return rec
+
+
+def check_structure(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Findings for the audit_structure record against
+    ``ledger.STRUCTURE_CONTRACTS`` — the X-ray path must stay
+    host-side (no jax imports) and compile-free (compile_watch delta
+    0)."""
+    from amgcl_tpu.telemetry.ledger import STRUCTURE_CONTRACTS
+    contract = STRUCTURE_CONTRACTS.get(rec["entry"])
+    out: List[Dict[str, Any]] = []
+    if contract is None:
+        return out
+    if rec["jax_imports"] != contract["jax_imports"]:
+        out.append({
+            "severity": "error", "pass": "host-sync",
+            "entry": rec["entry"],
+            "message": "telemetry/structure.py imports %s — the "
+            "operator X-ray is host-side analytics only (the module "
+            "may use numpy/scipy and ops.csr, never jax or a "
+            "jax-importing ops module)"
+            % ", ".join(rec.get("jax_import_names", []))})
+    if rec.get("skipped"):
+        out.append({"severity": "info", "pass": "host-sync",
+                    "entry": rec["entry"], "message": rec["skipped"]})
+        return out
+    for key in ("new_traces", "new_backend_compiles"):
+        if rec.get(key, 0) != contract[key]:
+            out.append({
+                "severity": "error", "pass": "host-sync",
+                "entry": rec["entry"],
+                "message": "structure_report(advise=True) moved the "
+                "process %s counter by %d (contract: %d) — the X-ray "
+                "path compiled device work; it must stay predict-only"
+                % (key, rec.get(key, 0), contract[key])})
+    return out
+
+
 def check_serve(rec: Dict[str, Any]) -> List[Dict[str, Any]]:
     """Donation contract of the resident loop: the lowered program must
     alias exactly ``DONATION_CONTRACTS['serve.solve_step']`` argument
@@ -1126,6 +1219,9 @@ def run_audit(solvers: Optional[Sequence[str]] = None,
     for rec in audit_setup():
         records.append(rec)
         findings += check_setup(rec)
+    rec = audit_structure()
+    records.append(rec)
+    findings += check_structure(rec)
     findings += check_entry_points()
     errors = [f for f in findings if f["severity"] == "error"]
     return {"records": records, "findings": findings,

@@ -138,6 +138,27 @@ def main(argv=None):
                          "--metrics-port publishes the mesh-size and "
                          "comm-fraction gauges on /metrics and keeps "
                          "the endpoint alive until Ctrl-C")
+    ap.add_argument("--xray", action="store_true",
+                    help="print the operator X-ray "
+                         "(telemetry/structure.py): per-level "
+                         "structural metrics (bandwidth/envelope, "
+                         "diagonal occupancy + DIA fill, ELL "
+                         "row-length/padding waste, dense-window "
+                         "density curve at TPU tile granularity, "
+                         "structure fingerprint), the to_device"
+                         "('auto') format-decision ledger (full "
+                         "candidate table with predicted bytes/flops "
+                         "per spmv, the recorded winner, margin, and "
+                         "reason incl. budget-starved picks), and the "
+                         "predict-only reorder-gain advisor "
+                         "(AMGCL_TPU_XRAY_VARIANTS selects RCM "
+                         "variants); host-side analytics only — "
+                         "nothing compiles. With --telemetry emits a "
+                         "'structure' event, with --doctor folds the "
+                         "structure findings (joined against "
+                         "--roofline when both given) into the "
+                         "convergence doctor, with --serve publishes "
+                         "the xray_* gauges on the service /metrics")
     ap.add_argument("--doctor", action="store_true",
                     help="run the convergence doctor: probe the measured "
                          "per-level convergence factors and smoother "
@@ -474,6 +495,29 @@ def main(argv=None):
         else:
             print("(no roofline: %r exposes none)" % type(inner))
 
+    xray_rec = None
+    if args.xray:
+        from amgcl_tpu.telemetry import structure as _structure
+        xray_fn = getattr(precond_obj, "structure_report", None)
+        if callable(xray_fn):
+            # host-side analytics over the already-built hierarchy —
+            # the STRUCTURE_CONTRACTS audit asserts this path compiles
+            # nothing (compile_watch delta 0)
+            with prof.scope("xray"):
+                xray_rec = xray_fn()
+            print()
+            print(_structure.format_xray(xray_rec))
+            telemetry.emit(event="structure", **xray_rec)
+            if serve_svc is not None and getattr(serve_svc, "live",
+                                                 None) is not None:
+                # live tie-in: the serve scrape endpoint gains the
+                # X-ray gauges (padding waste, predicted reorder gain)
+                from amgcl_tpu.telemetry.live import publish_xray_gauges
+                publish_xray_gauges(serve_svc.live,
+                                    xray_rec.get("summary"))
+        else:
+            print("(no operator X-ray: %r exposes none)" % type(inner))
+
     if args.doctor:
         from amgcl_tpu.telemetry.health import diagnose, format_findings
         probe = None
@@ -516,7 +560,10 @@ def main(argv=None):
                             if serve_svc is not None else None,
                             # distributed leg: --dist-report's measured
                             # comm attribution — divergence findings
-                            comm=dist_comm_rec)
+                            comm=dist_comm_rec,
+                            # structure leg: --xray's decision ledger +
+                            # advisor findings (joined vs --roofline)
+                            structure=xray_rec)
         print()
         print(format_findings(findings))
         telemetry.emit(event="doctor", findings=findings,

@@ -219,6 +219,8 @@ class AMG:
         self._ledger_cache = None
         self._probe_cache = None
         self._roofline_cache = None
+        self._structure_cache = None
+        self._format_decisions = None
         # setup-phase profiler (PR 1 instrumented the SOLVE phase only):
         # device-synced tic/toc scopes + amgcl/setup/* host annotations
         # around coarsening / galerkin / device transfer / smoother
@@ -366,6 +368,7 @@ class AMG:
         self._ledger_cache = None
         self._probe_cache = None
         self._roofline_cache = None
+        self._structure_cache = None
         # one-time on a first rebuild: when the numeric backend is the
         # device, make sure every CSR level carries a Galerkin plan so
         # this and every later rebuild is a pure numeric segment pass
@@ -411,15 +414,32 @@ class AMG:
         # fresh pool — the old hierarchy's buffers are dropped with it.
         from amgcl_tpu.telemetry.ledger import dense_window_budget
         self._dwin_budget = dense_window_budget()
+        # format-decision ledger (telemetry/structure.py): one record
+        # per level operator, collected off the converted matrices so
+        # the hierarchy carries its own decision history; a numeric
+        # rebuild's value-refreshed levels (no fresh conversion) keep
+        # the previous build's records — the structure is identical
+        prev_dec = getattr(self, "_format_decisions", None)
+        decisions = []
+
+        def _note_decision(i, M):
+            dec = getattr(M, "_format_decision", None)
+            if dec is None and prev_dec is not None \
+                    and i < len(prev_dec):
+                dec = prev_dec[i]
+            decisions.append(dec)
+
         for i, (Ai, P, R) in enumerate(host[:-1]):
             if i < len(prefix):
                 # device-built level (ops/stencil_device.py) — already
                 # device-resident, host row is bookkeeping metadata only
                 dev_levels.append(prefix[i])
+                decisions.append(None)
                 continue
             if self._device_filter is not None and not self._device_filter(
                     i, Ai.nrows * Ai.block_size[0], False):
                 dev_levels.append(Level(None, None, None, None))
+                decisions.append(None)
                 continue
             lvl = "level%d" % i
             spec = getattr(P, "_implicit_spec", None)
@@ -464,6 +484,7 @@ class AMG:
             with setup_scope(prof, lvl + "/fused_kernels"):
                 fd = build_fused_down(A_dev, R_dev, relax_state)
                 fu = build_fused_up(A_dev, P_dev, relax_state)
+            _note_decision(i, A_dev)
             dev_levels.append(Level(A_dev, relax_state, P_dev, R_dev,
                                     fd, fu))
         Alast = host[-1][0]
@@ -494,7 +515,9 @@ class AMG:
             else:
                 coarse = None
                 last = Level(A_last_dev, prm.relax.build(Alast, dtype))
+        _note_decision(len(host) - 1, A_last_dev)
         dev_levels.append(last)
+        self._format_decisions = decisions
         self.hierarchy = Hierarchy(
             dev_levels, coarse, prm.npre, prm.npost, prm.ncycle,
             prm.pre_cycles)
@@ -527,6 +550,7 @@ class AMG:
         self._ledger_cache = None
         self._probe_cache = None
         self._roofline_cache = None
+        self._structure_cache = None
 
     @property
     def device_resident(self) -> bool:
@@ -618,6 +642,41 @@ class AMG:
             self._probe_cache = cached
         return cached
 
+    def structure_report(self, advise=None, variants=None):
+        """The operator X-ray (telemetry/structure.py): per-level
+        structural analytics (bandwidth/envelope, diagonal occupancy,
+        ELL padding waste, dense-window density curve, structure
+        fingerprint), the format-decision ledger ``to_device('auto')``
+        recorded during this build (candidate table + winner + margin
+        + reason), and the reorder-gain advisor's predicted
+        densification per level. Host-side analytics only — nothing is
+        built or compiled (``STRUCTURE_CONTRACTS`` asserts a
+        compile-watch delta of zero). Cached per build; ``rebuild()``
+        invalidates (the values changed, the structure report did not
+        — but a rebuild may reconvert a level). ``advise``: True /
+        False / "auto" (default: "auto" — advisor on levels up to the
+        ``AMGCL_TPU_XRAY_MAX_ADVISE_NNZ`` ceiling); passing explicit
+        ``advise``/``variants`` re-runs instead of returning the
+        cached default."""
+        cached = getattr(self, "_structure_cache", None)
+        if cached is not None and advise is None and variants is None:
+            return cached
+        import jax
+        from amgcl_tpu.telemetry import structure as _structure
+        try:
+            itemsize = int(jnp.dtype(self.prm.dtype).itemsize)
+        except TypeError:
+            itemsize = 4
+        xray = _structure.hierarchy_xray(
+            self.host_levels,
+            decisions=getattr(self, "_format_decisions", None),
+            advise_mode="auto" if advise is None else advise,
+            variants=variants, itemsize=itemsize,
+            on_tpu=jax.default_backend() == "tpu")
+        if advise is None and variants is None:
+            self._structure_cache = xray
+        return xray
+
     def hierarchy_stats(self):
         """Structured hierarchy report: per-level rows/nnz/dtype/device
         format plus grid and operator complexity — the machine-readable
@@ -657,6 +716,31 @@ class AMG:
                 row["conv_factor"] = probe[i].get("conv_factor")
                 if probe[i].get("smoother_rho") is not None:
                     row["smoother_rho"] = probe[i]["smoother_rho"]
+            # operator X-ray fold (same pattern as the probe rows):
+            # once structure_report() has run, each level carries its
+            # compact structural metrics + the recorded format decision
+            xray = getattr(self, "_structure_cache", None)
+            if xray is not None and i < len(xray["levels"]):
+                xrow = xray["levels"][i]
+                met = xrow.get("metrics")
+                if met is not None:
+                    srow = {
+                        "bandwidth_max": met["bandwidth"]["max"],
+                        "ndiags": met["diagonals"]["ndiags"],
+                        "dia_fill": met["diagonals"]["fill"],
+                        "ell_pad_frac": met["ell"]["lane_pad_frac"],
+                        "window_fill": met["window"]["fill"],
+                    }
+                    dec = xrow.get("decision")
+                    if dec is not None:
+                        srow["decision"] = {
+                            "fmt": dec.get("fmt"),
+                            "reason": dec.get("reason"),
+                            "margin": dec.get("margin")}
+                    best = (xrow.get("advisor") or {}).get("best")
+                    if best and best.get("gain") is not None:
+                        srow["predicted_reorder_gain"] = best["gain"]
+                    row["structure"] = srow
             levels.append(row)
         out = {
             "n_levels": len(host),
@@ -671,6 +755,9 @@ class AMG:
         }
         if led.get("dense_window") is not None:
             out["dense_window"] = led["dense_window"]
+        xray = getattr(self, "_structure_cache", None)
+        if xray is not None and xray.get("summary"):
+            out["structure"] = xray["summary"]
         return out
 
     def __repr__(self):

@@ -300,7 +300,7 @@ def dense_window_scaled_correction(window_starts, blocks, w, f, x, win,
 def csr_to_dense_window(A: CSR, dtype=jnp.float32, tile: int = _TILE,
                         max_bytes: int | None = None,
                         require_kernel: bool = False,
-                        budget=None):
+                        budget=None, why=None):
     """Build the dense-window form of a scalar CSR, or None when any row
     tile's column span exceeds the storage budget (no banded locality —
     apply RCM first). The dense blocks are materialized ON DEVICE from
@@ -313,29 +313,51 @@ def csr_to_dense_window(A: CSR, dtype=jnp.float32, tile: int = _TILE,
     block storage would overdraw what earlier conversions left, and
     charges the pool on success — so ``to_device('auto')`` across a whole
     hierarchy can never materialize more dense-window bytes than ONE
-    budget, instead of one budget per matrix."""
-    if A.is_block or np.dtype(dtype).kind == "c":
+    budget, instead of one budget per matrix.
+
+    ``why`` (optional dict) receives the decline reason on a None
+    return; a budget-STARVED decline (the bytes fit the pool's total
+    but not what earlier levels left) reports exactly ``"budget"``, a
+    structurally-too-wide window reports ``"window"`` — the
+    distinction the format-decision ledger (telemetry/structure.py)
+    threads into the X-ray table."""
+    def _decline(reason):
+        if why is not None:
+            why["why"] = reason
         return None
+
+    if A.is_block or np.dtype(dtype).kind == "c":
+        return _decline("block values" if A.is_block
+                        else "complex dtype")
     n, m = A.shape
     if n == 0 or A.nnz == 0:
-        return None
+        return _decline("empty")
     from amgcl_tpu.ops.unstructured import tile_windows
     n_tiles, rows, tiles, starts, win = tile_windows(A, tile)
     itemsize = jnp.dtype(dtype).itemsize
     need = n_tiles * tile * win * itemsize
+    if why is not None:
+        why["need_bytes"] = int(need)
     if budget is not None:
         cap = budget.remaining() if max_bytes is None \
             else min(budget.remaining(), max_bytes)
     else:
         cap = max_total_bytes() if max_bytes is None else max_bytes
     if need > cap:
-        return None
+        # "budget": earlier conversions drained the shared pool this
+        # matrix would otherwise fit — distinguishable from "window"
+        # (too wide for the pool even when untouched)
+        hard = max_total_bytes() if max_bytes is None else max_bytes
+        if budget is not None:
+            hard = budget.total if max_bytes is None \
+                else min(budget.total, max_bytes)
+        return _decline("budget" if need <= hard else "window")
     # VMEM: the pipeline double-buffers the (tile, win) block + window
     if (2 * tile + 4) * win * itemsize > 10 << 20:
-        return None
+        return _decline("vmem")
     if require_kernel and not kernel_supported(win, tile, dtype):
         # probe BEFORE materializing the (possibly multi-GB) blocks
-        return None
+        return _decline("kernel")
 
     nnz_row = A.row_nnz()
     K = max(1, int(nnz_row.max()))

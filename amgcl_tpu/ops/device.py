@@ -342,6 +342,58 @@ def dia_efficiency(A: CSR):
     return nd, fill
 
 
+def _decision_candidates(A: CSR, dtype, on_tpu: bool,
+                         dense_cutoff: int, max_diags, max_fill,
+                         budget):
+    """Predicted candidate table for the format-decision ledger
+    (telemetry/structure.py candidate_table, priced with the thresholds
+    THIS conversion resolved). Never raises — a failed prediction
+    degrades to an unrecorded decision, never a failed conversion."""
+    try:
+        from amgcl_tpu.telemetry.structure import candidate_table
+        return candidate_table(
+            A, itemsize=jnp.dtype(dtype).itemsize, on_tpu=on_tpu,
+            dense_cutoff=dense_cutoff, max_diags=max_diags,
+            max_fill=max_fill,
+            budget_remaining=budget.remaining()
+            if budget is not None else None,
+            budget_total=budget.total if budget is not None else None)
+    except Exception:
+        return None
+
+
+def _mark_candidate(cands, fmt: str, why: dict):
+    """Overwrite a candidate's verdict with what the conversion
+    ACTUALLY reported (the predicted eligibility is a model; the
+    attempted conversion is ground truth)."""
+    if not cands or not why.get("why"):
+        return
+    for c in cands:
+        if c["format"] == fmt:
+            c["eligible"] = False
+            c["why"] = why["why"]
+            return
+
+
+def _decided(M, A: CSR, fmt: str, cands, forced: bool = False):
+    """Attach the format-decision record to a converted matrix — the
+    ledger entry ``models/amg.py`` collects per level. Decision
+    attributes ride the Python object (device pytrees keep host
+    attributes for their lifetime); recording never raises and never
+    changes what ``to_device`` returns."""
+    try:
+        from amgcl_tpu.telemetry.structure import decision_record
+        built = M.bytes() if hasattr(M, "bytes") else None
+        dec = decision_record(cands or [], fmt, forced=forced,
+                              built_bytes=built)
+        dec["shape"] = [int(A.shape[0]), int(A.shape[1])]
+        dec["nnz"] = int(A.nnz)
+        M._format_decision = dec
+    except Exception:
+        pass
+    return M
+
+
 def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
               max_diags: int | None = None, max_fill: float | None = None,
               dense_cutoff: int = 2048, budget=None):
@@ -356,7 +408,16 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
     dense-window conversion draws from — a hierarchy build passes ONE
     budget for all its levels (models/amg.py), so auto-selection can
     never stack per-matrix allowances into an OOM. Without a budget the
-    conversion falls back to the per-matrix env cap."""
+    conversion falls back to the per-matrix env cap.
+
+    Every conversion records a **format-decision ledger** entry on the
+    returned matrix (``M._format_decision``, telemetry/structure.py):
+    the full candidate table (format × predicted bytes-and-flops per
+    SpMV from the ledger cost models), the winner, the margin, and the
+    reason — ``"cost"``, ``"budget"`` (a cheaper candidate lost solely
+    on the shared HBM budget), or ``"forced"`` (caller-named format) —
+    instead of deciding silently. ``AMG.structure_report()`` /
+    ``cli --xray`` surface the records."""
     from amgcl_tpu.ops.stencil import HostDia
     if isinstance(A, HostDia):
         # stencil-setup smoother operators live in DIA layout already
@@ -366,12 +427,29 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
             [flat[k] for k in order],
             jnp.asarray(np.asarray(A.data[order], np.dtype(dtype))),
             A.shape)
-    if fmt == "dense" or (fmt == "auto" and not A.is_block
+    auto = fmt == "auto"
+    on_tpu = jax.default_backend() == "tpu"
+    if auto and not A.is_block:
+        # measured on v5e: gathers run ~130M elem/s while DIA streams
+        # at HBM bandwidth — DIA wins over ELL even at large fill, so
+        # accept many more diagonals on TPU (bounded by a 2 GB data
+        # guard); an explicit caller-supplied cap is honored as-is
+        if max_diags is None:
+            max_diags = 512 if on_tpu else 40
+        if max_fill is None:
+            max_fill = 16.0 if on_tpu else 1.5
+    cands = _decision_candidates(A, dtype, on_tpu, dense_cutoff,
+                                 max_diags, max_fill, budget) \
+        if auto else None
+    if fmt == "dense" or (auto and not A.is_block
                           and max(A.shape) <= dense_cutoff
                           and A.nnz > 0.02 * A.shape[0] * A.shape[1]):
-        return DenseMatrix(jnp.asarray(A.to_dense(), dtype=dtype))
+        return _decided(DenseMatrix(jnp.asarray(A.to_dense(),
+                                                dtype=dtype)),
+                        A, "dense", cands, forced=fmt == "dense")
     if fmt == "dia":
-        return csr_to_dia(A, dtype)
+        return _decided(csr_to_dia(A, dtype), A, "dia", None,
+                        forced=True)
     if fmt == "well":
         from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
         W = csr_to_windowed_ell(A, dtype)
@@ -379,7 +457,7 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
             raise ValueError(
                 "windowed-ELL format needs banded column locality; apply "
                 "a Cuthill-McKee reorder first (utils/adapters.Reordered)")
-        return W
+        return _decided(W, A, "well", None, forced=True)
     if fmt == "dwin":
         from amgcl_tpu.ops.densewin import csr_to_dense_window
         D = csr_to_dense_window(A, dtype, budget=budget)
@@ -388,25 +466,16 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 "dense-window format needs banded column locality within "
                 "the storage budget (AMGCL_TPU_DWIN_MAX_BYTES); apply a "
                 "Cuthill-McKee reorder first or raise the budget")
-        return D
-    if fmt == "auto":
+        return _decided(D, A, "dwin", None, forced=True)
+    if auto:
         if not A.is_block:
-            on_tpu = jax.default_backend() == "tpu"
-            # measured on v5e: gathers run ~130M elem/s while DIA streams
-            # at HBM bandwidth — DIA wins over ELL even at large fill, so
-            # accept many more diagonals on TPU (bounded by a 2 GB data
-            # guard); an explicit caller-supplied cap is honored as-is
-            if max_diags is None:
-                max_diags = 512 if on_tpu else 40
-            if max_fill is None:
-                max_fill = 16.0 if on_tpu else 1.5
             nd, fill = dia_efficiency(A)
             if (nd <= max_diags and fill <= max_fill
                     and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
-                return csr_to_dia(A, dtype)
+                return _decided(csr_to_dia(A, dtype), A, "dia", cands)
         if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
             if not A.is_block and A.shape[0] == A.shape[1] \
-                    and jax.default_backend() == "tpu":
+                    and on_tpu:
                 # gather-free dense-window blocks (ops/densewin.py): on
                 # real TPU the windowed-ELL Pallas gather does not
                 # legalize and the XLA take path runs at gather speed
@@ -419,10 +488,15 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 # shared ``budget`` (one per hierarchy build) is that
                 # seam (explicit fmt='dwin' remains available)
                 from amgcl_tpu.ops.densewin import csr_to_dense_window
+                why = {}
                 D = csr_to_dense_window(A, dtype, require_kernel=True,
-                                        budget=budget)
+                                        budget=budget, why=why)
                 if D is not None:
-                    return D
+                    return _decided(D, A, "dwin", cands)
+                # the attempted conversion's decline reason beats the
+                # prediction — "budget" here is what makes a
+                # budget-starved pick distinguishable in the X-ray
+                _mark_candidate(cands, "dwin", why)
             # unstructured but banded (e.g. after Cuthill-McKee): windowed
             # ELL replaces the HBM-serialized gather with per-tile VMEM
             # windows, for scalar AND block values (the budget scales by
@@ -431,10 +505,17 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
             # 'well' format so the window + pipeline tiles cannot blow
             # VMEM at solver-jit time
             from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
-            W = csr_to_windowed_ell(A, dtype, max_win_bytes=4 << 20)
+            why = {}
+            W = csr_to_windowed_ell(A, dtype, max_win_bytes=4 << 20,
+                                    why=why)
             if W is not None:
-                return W
-    return csr_to_ell(A, dtype)
+                return _decided(W, A, "well", cands)
+            _mark_candidate(cands, "well", why)
+        else:
+            _mark_candidate(cands, "dwin", {"why": "complex dtype"})
+            _mark_candidate(cands, "well", {"why": "complex dtype"})
+    M = csr_to_ell(A, dtype)
+    return _decided(M, A, "ell", cands, forced=not auto)
 
 
 def refresh_values(M, A: CSR, dtype):

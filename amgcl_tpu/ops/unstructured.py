@@ -732,13 +732,17 @@ def tile_windows(A: CSR, tile: int):
 
 
 def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,
-                        max_win_bytes: int = 8 << 20):
+                        max_win_bytes: int = 8 << 20, why=None):
     """Pack a host CSR (scalar or block-valued BCSR) into windowed ELL.
     Assumes the caller already applied a bandwidth-reducing permutation
     (RCM) if profitable; windows are computed from the matrix as given.
     Returns None when any row tile's column span exceeds the VMEM budget
     (no banded locality). Block matrices index BLOCK columns; the window
-    DMA budget scales by the block column width."""
+    DMA budget scales by the block column width.
+
+    ``why`` (optional dict) receives the decline reason on a None
+    return — the format-decision ledger (telemetry/structure.py)
+    records it so the X-ray table can say WHY a candidate lost."""
     br, bc = A.block_size
     n, m = A.shape                  # block units for BCSR
     nnz_row = A.row_nnz()
@@ -747,6 +751,9 @@ def csr_to_windowed_ell(A: CSR, dtype=jnp.float32, tile: int = _TILE,
     n_tiles, rows, tiles, starts, win = tile_windows(A, tile)
     # VMEM budget: window + one cols/vals/out tile must fit comfortably
     if win * bc * np.dtype(np.float32).itemsize > max_win_bytes:
+        if why is not None:
+            why["why"] = "window %d col x 4 B > %d B VMEM budget" \
+                % (win * bc, max_win_bytes)
         return None
     starts32 = starts.astype(np.int32)
 

@@ -72,6 +72,10 @@ from amgcl_tpu.telemetry.live import LiveRegistry, MetricsServer
 # stdlib-only structured report diff (cross-run regression attribution)
 from amgcl_tpu.telemetry import diff
 from amgcl_tpu.telemetry import flight
+# structure leg (PR 14): the operator X-ray — per-level structural
+# analytics, the to_device('auto') format-decision ledger, and the
+# predict-only reorder-gain advisor (host-side, never imports jax)
+from amgcl_tpu.telemetry import structure
 
 __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "setup_scope", "RequestSpans", "JsonlSink", "NullSink",
@@ -87,4 +91,5 @@ __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "measure_stages", "format_roofline",
            "solve_roofline", "counter_map", "xla_stage_check",
            "watched_jit", "compile_snapshot", "global_watch", "metrics",
-           "live", "LiveRegistry", "MetricsServer", "diff", "flight"]
+           "live", "LiveRegistry", "MetricsServer", "diff", "flight",
+           "structure"]

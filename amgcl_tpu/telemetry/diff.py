@@ -344,6 +344,24 @@ def diff(a: Dict[str, Any], b: Dict[str, Any]) -> Dict[str, Any]:
     contributions.sort(key=lambda c: -abs(c["delta_s"]))
     out["contributions"] = contributions
 
+    # format-decision join: bench records carry the operator X-ray's
+    # compact summary (``structure``, telemetry/structure.py) — a
+    # changed per-level format winner or decision reason between two
+    # rounds is exactly the cross-round movement --why should name
+    # (a format flip changes the per-iteration byte model before it
+    # changes any timed row)
+    st_a = a.get("structure") if isinstance(a.get("structure"), dict) \
+        else {}
+    st_b = b.get("structure") if isinstance(b.get("structure"), dict) \
+        else {}
+    if st_a.get("formats") and st_b.get("formats") and (
+            st_a.get("formats") != st_b.get("formats")
+            or st_a.get("reasons") != st_b.get("reasons")):
+        out["structure"] = {
+            "changed": True,
+            "formats": [st_a.get("formats"), st_b.get("formats")],
+            "reasons": [st_a.get("reasons"), st_b.get("reasons")]}
+
     # stage join: measured per-(level, stage) cycle times, ranked by
     # contribution to the total per-stage movement
     if not skip:
@@ -468,6 +486,17 @@ def findings(d: Dict[str, Any]) -> List[Dict[str, Any]]:
                     "message": "retrace count grew %d -> %d — a shape "
                     "or gate-state change re-traces the solve program"
                     % (int(rt["a"]), int(rt["b"]))})
+    st = d.get("structure") or {}
+    if st.get("changed"):
+        fm = st.get("formats") or ["-", "-"]
+        rs = st.get("reasons") or ["-", "-"]
+        out.append({"severity": "info", "code": "cross_run_format",
+                    "message": "per-level format decisions changed "
+                    "between the two runs: %s -> %s (reasons %s -> %s)"
+                    % (fm[0], fm[1], rs[0], rs[1]),
+                    "suggestion": "the X-ray candidate ledger "
+                    "(cli --xray) attributes which structural metric "
+                    "or budget moved the decision"})
     return out
 
 
@@ -517,6 +546,13 @@ def format_diff(d: Dict[str, Any], max_stages: int = 8) -> str:
         if len(stages) > max_stages:
             lines.append("    ... %d more stage row(s)"
                          % (len(stages) - max_stages))
+    st = d.get("structure") or {}
+    if st.get("changed"):
+        lines.append("  format decisions: %s -> %s (reasons %s -> %s)"
+                     % ((st.get("formats") or ["-", "-"])[0],
+                        (st.get("formats") or ["-", "-"])[1],
+                        (st.get("reasons") or ["-", "-"])[0],
+                        (st.get("reasons") or ["-", "-"])[1]))
     if d.get("top"):
         lines.append("  top contributor: %s" % d["top"])
     for f in findings(d):

@@ -555,7 +555,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              comm: Optional[Dict[str, Any]] = None,
              farm: Optional[Dict[str, Any]] = None,
              diff: Optional[Dict[str, Any]] = None,
-             recovery: Optional[Dict[str, Any]] = None
+             recovery: Optional[Dict[str, Any]] = None,
+             structure: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
@@ -574,7 +575,11 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     ``telemetry.diff.diff()`` record (two solves/bench rounds compared
     stage by stage) and folds in the cross-run attribution findings —
     the doctor names the culprit stage of a regression, not just the
-    regression. Each finding:
+    regression. ``structure`` takes an operator X-ray
+    (``AMG.structure_report()``) and folds in the structure findings —
+    advisor reorder gains, budget-starved format decisions, padding
+    waste, and (when ``roofline`` rode along too) the
+    predicted-vs-achieved divergence per format. Each finding:
     {severity, code, message, suggestion}. Pure host-side
     dict-crunching — never raises on missing pieces."""
     out: List[Dict[str, Any]] = []
@@ -753,6 +758,13 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
         from amgcl_tpu.telemetry import diff as _diff_mod
         out.extend(f for f in _diff_mod.findings(diff)
                    if isinstance(f, dict) and "severity" in f)
+    if isinstance(structure, dict):
+        # structure leg: the operator X-ray's advisor / decision-ledger
+        # findings, joined against the measured roofline when both ride
+        from amgcl_tpu.telemetry.structure import structure_findings
+        out.extend(f for f in structure_findings(
+            structure, roofline=roofline if isinstance(roofline, dict)
+            else None) if isinstance(f, dict) and "severity" in f)
     if isinstance(compile_stats, dict):
         from amgcl_tpu.telemetry import compile_watch as _cw
         out.extend(_cw.findings(compile_stats))

@@ -551,6 +551,24 @@ DONATION_CONTRACTS = {
 }
 
 
+#: host-purity CONTRACT of the operator X-ray (telemetry/structure.py,
+#: audited by analysis/jaxpr_audit.audit_structure): the X-ray path —
+#: structure metrics, the format-decision candidate table, the
+#: reorder-gain advisor — is host-side analytics ONLY. Statically, the
+#: module may import neither jax nor any jax-importing ops module
+#: (``jax_imports`` counts violations found by AST scan; ops.csr is
+#: numpy-only and allowed). Dynamically, a full ``structure_report``
+#: (+ advisor) over a built hierarchy must leave the process
+#: compile/trace counters untouched — no new traces, no new backend
+#: compiles beyond the spmv/solve entry points that already exist
+#: (compile_watch delta 0). A violation is an error finding in the
+#: analysis gate, not a slow chip-session surprise.
+STRUCTURE_CONTRACTS = {
+    "telemetry.structure": {"jax_imports": 0, "new_traces": 0,
+                            "new_backend_compiles": 0},
+}
+
+
 #: setup CONTRACT of the traced device-setup entry points (audited
 #: statically by analysis/jaxpr_audit.audit_setup): the per-level build
 #: programs — MIS rounds, segment-Galerkin, smoothing SpGEMM, stencil

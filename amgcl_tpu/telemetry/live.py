@@ -162,6 +162,16 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "farm_load_shed_total": (
         "counter", "load-shedding episodes per tenant under sustained "
                    "SLO breach (label: tenant)"),
+    # -- operator X-ray (telemetry/structure.py) --------------------------
+    "xray_padding_waste_frac": (
+        "gauge", "finest-level ELL lane-padding waste fraction from "
+                 "the operator X-ray (stored-but-zero slots / stored)"),
+    "xray_predicted_reorder_gain": (
+        "gauge", "reorder-gain advisor's best predicted SpMV-byte "
+                 "gain across hierarchy levels (1.0 = no gain)"),
+    "xray_dia_fill": (
+        "gauge", "finest-level DIA fill ratio (stored slots / nnz) "
+                 "from the operator X-ray"),
 }
 
 #: THE declared label-key table: metric name -> allowed label keys.
@@ -342,6 +352,27 @@ def publish_dist_gauges(registry: "LiveRegistry",
         registry.set_gauge("dist_mesh_devices", float(devices))
     if comm_fraction is not None:
         registry.set_gauge("dist_comm_fraction", float(comm_fraction))
+
+
+def publish_xray_gauges(registry: "LiveRegistry",
+                        summary: Optional[Dict[str, Any]]) -> None:
+    """Publish the operator X-ray gauges from a
+    ``telemetry.structure.xray_summary`` dict onto a live registry
+    (``cli --xray`` onto the serve registry / a dedicated scrape
+    server). Names are literals from :data:`METRICS` — the
+    metric-name-literal contract (this module is the declaring
+    site). Missing summary fields publish nothing."""
+    if not summary:
+        return
+    v = summary.get("padding_waste_frac")
+    if v is not None:
+        registry.set_gauge("xray_padding_waste_frac", float(v))
+    v = summary.get("predicted_reorder_gain")
+    if v is not None:
+        registry.set_gauge("xray_predicted_reorder_gain", float(v))
+    v = summary.get("dia_fill")
+    if v is not None:
+        registry.set_gauge("xray_dia_fill", float(v))
 
 
 def metrics_port_from_env(

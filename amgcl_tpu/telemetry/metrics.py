@@ -89,6 +89,20 @@ EVENT_FIELDS = {
     "serve_request": [("latency_ms", "latency_ms"),
                       ("queue_ms", "queue_ms"),
                       ("solve_ms", "solve_ms"), ("iters", "iters")],
+    # operator X-ray (telemetry/structure.py): the per-hierarchy
+    # 'structure' event (cli --xray / AMG.structure_report) and the
+    # bench --xray predicted-vs-measured reorder-gain join — declared
+    # here so rollup_events / --trend aggregate the new event kinds
+    # instead of silently skipping them
+    "structure": [("padding_waste_frac", "summary.padding_waste_frac"),
+                  ("predicted_reorder_gain",
+                   "summary.predicted_reorder_gain"),
+                  ("dia_fill", "summary.dia_fill"),
+                  ("window_fill", "summary.window_fill"),
+                  ("bandwidth_max", "summary.bandwidth_max")],
+    "bench_xray": [("predicted_gain", "join.predicted_gain"),
+                   ("measured_gain", "join.measured_gain"),
+                   ("gain_ratio", "join.ratio")],
 }
 
 

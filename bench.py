@@ -1071,6 +1071,20 @@ def main_worker():
     except Exception as e:
         _PARTIAL["ledger"] = {"error": repr(e)[:200]}
 
+    # operator X-ray summary (telemetry/structure.py): per-level format
+    # decisions (winner + reason) and waste metrics on EVERY record, so
+    # --why / --trend can attribute format-decision changes across
+    # rounds (AMGCL_TPU_XRAY=0 opts out). Metrics + decision ledger
+    # only — the advisor's RCM pass stays out of the headline worker
+    # (bench --xray is the advisor's measured validation arm)
+    if os.environ.get("AMGCL_TPU_XRAY", "1") != "0":
+        try:
+            from amgcl_tpu.telemetry.structure import xray_summary
+            _PARTIAL["structure"] = xray_summary(
+                solver.precond.structure_report(advise=False))
+        except Exception as e:
+            _PARTIAL["structure"] = {"error": repr(e)[:200]}
+
     # bandwidth observability: documented traffic model / measured time.
     # The ledger's per-iteration model is the primary source — it prices
     # the fused tiers (single-pass V-cycle legs, fused vector algebra)
@@ -2547,6 +2561,161 @@ def count_dots(text: str) -> int:
                if _DOTS_RE.match(line.strip()))
 
 
+def main_xray(args=None):
+    """``bench.py --xray``: the advisor-validation microbenchmark
+    (ISSUE 14 satellite) — ONE unstructured operator (the
+    permuted-banded fixture from telemetry/structure.py: a band
+    scrambled by a block-local symmetric permutation, the matrix class
+    the reorder advisor exists for), SpMV measured per candidate
+    device format under the identity ordering and under RCM, joined
+    against the X-ray's PREDICTED reorder gain. The headline join is
+    MECHANISM-MATCHED: the advisor's winning format measured on both
+    orderings (same packing, so time tracks the byte model on any
+    platform — DIA's shifted multiply-adds scale with ndiags whether
+    the bottleneck is HBM or cache); the cross-format end-to-end gain
+    (best identity format vs best reordered format) rides along as
+    ``end_to_end``. Emits ONE ``bench_xray`` record (platform-stamped
+    via hw_provenance; informational on the CPU fallback — the
+    cross-format mapping is only roofline-faithful where the SpMV is
+    HBM-bound). Exit 1 only when nothing could be measured."""
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.telemetry import structure as _structure
+    from amgcl_tpu.telemetry.comm import hw_provenance
+    from amgcl_tpu.ops import device as dev
+    from amgcl_tpu.utils.adapters import cuthill_mckee, permute
+
+    n = int(os.environ.get("AMGCL_TPU_XRAY_N", "4096"))
+    # bw 16 keeps the RCM-recovered band at ~33 diagonals — still
+    # inside auto's CPU max_diags=40 so the advisor genuinely picks
+    # DIA, and in the same XLA lowering regime as the scrambled
+    # identity's ~160 (below ~16 diagonals the whole DIA chain fuses
+    # into one pass and the per-diagonal cost drops ~40%, which would
+    # bias the matched join)
+    bw = int(os.environ.get("AMGCL_TPU_XRAY_BW", "16"))
+    local = int(os.environ.get("AMGCL_TPU_XRAY_LOCAL", "32"))
+    seed = 7
+    A, _A0, _perm = _structure.permuted_banded(n, bw=bw, seed=seed,
+                                               local=local or None)
+    rcm = cuthill_mckee(A)
+    B = permute(A, rcm)
+    on_tpu = jax.default_backend() == "tpu"
+    # the prediction: exactly the advisor row cli --xray would print
+    # for this operator (candidate tables identity vs RCM)
+    adv = _structure.advise(A, variants=("rcm",), on_tpu=on_tpu)
+    best = adv.get("best") or {}
+    best_fmt = best.get("format")
+    predicted = (best.get("per_format") or {}).get(best_fmt)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.rand(n).astype(np.float32))
+
+    def build(mat, fmt):
+        """Device matrix for one candidate format, None when the
+        format declines this structure (exactly what the X-ray table
+        records as ineligible)."""
+        try:
+            if fmt == "ell":
+                return dev.csr_to_ell(mat)
+            if fmt == "dia":
+                # the DIA SpMV unrolls one fused multiply-add per
+                # diagonal — thousands of diagonals (the scrambled
+                # identity ordering) would build an absurd XLA graph,
+                # the same reason auto rejects it
+                if len(dev._dia_offsets(mat)) > 512:
+                    return None
+                return dev.csr_to_dia(mat)
+            if fmt == "well":
+                from amgcl_tpu.ops.unstructured import \
+                    csr_to_windowed_ell
+                return csr_to_windowed_ell(mat, max_win_bytes=4 << 20)
+            if fmt == "dwin":
+                from amgcl_tpu.ops.densewin import csr_to_dense_window
+                return csr_to_dense_window(mat)
+        except Exception:
+            return None
+
+    chain = 16
+
+    def time_spmv(M, reps=7):
+        """Per-SpMV seconds, measured as a CHAIN of data-dependent
+        applications inside one dispatch — a single spmv at these
+        sizes is µs-scale and would drown in per-call dispatch
+        overhead (the bench _timed_chain lesson). Min-of-reps: the
+        joined quantity is a RATIO of two such measurements, and on a
+        shared host the best case is the one uncontaminated by
+        interference (median would fold ambient load into whichever
+        side ran during a busy window)."""
+        if M is None:
+            return None
+
+        def chained(v):
+            for _ in range(chain):       # square operator: y feeds x
+                v = dev.spmv(M, v)
+            return v
+
+        f = jax.jit(chained)
+        jax.block_until_ready(f(x))          # compile + warm
+        ts = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(f(x))
+            ts.append(time.perf_counter() - t0)
+        return float(min(ts)) / chain
+
+    rows = []
+    best_id = best_rcm = None
+    matched = {}
+    per_format_pred = best.get("per_format") or {}
+    for fmt in ("ell", "dia", "well", "dwin"):
+        t_id = time_spmv(build(A, fmt))
+        t_rcm = time_spmv(build(B, fmt))
+        row = {"format": fmt,
+               "t_identity_s": round(t_id, 7) if t_id else None,
+               "t_rcm_s": round(t_rcm, 7) if t_rcm else None}
+        if t_id and t_rcm:
+            row["gain"] = round(t_id / t_rcm, 4)
+            matched[fmt] = row["gain"]
+            if per_format_pred.get(fmt):
+                row["predicted_gain"] = per_format_pred[fmt]
+        rows.append(row)
+        if t_id is not None and (best_id is None or t_id < best_id):
+            best_id = t_id
+        if t_rcm is not None and (best_rcm is None or t_rcm < best_rcm):
+            best_rcm = t_rcm
+    measured = matched.get(best_fmt)
+    e2e = round(best_id / best_rcm, 4) if best_id and best_rcm else None
+    prov = hw_provenance()
+    join = {"format": best_fmt, "predicted_gain": predicted,
+            "measured_gain": measured,
+            "informational": prov.get("platform_tag") != "ici"}
+    if measured is None and e2e is not None:
+        # the matched pair could not be built on one side — fall back
+        # to the cross-format end-to-end gain, flagged as such
+        join["fallback"] = "end_to_end"
+        measured = e2e
+        join["measured_gain"] = measured
+        predicted = best.get("gain")
+        join["predicted_gain"] = predicted
+    if predicted and measured:
+        join["ratio"] = round(measured / predicted, 4)
+        join["within_25pct"] = bool(abs(join["ratio"] - 1.0) <= 0.25)
+    rec = {"event": "bench_xray", "metric": "xray_reorder_gain",
+           "value": measured, "unit": "x", "n": n, "bw": bw,
+           "local": local, "seed": seed, "provenance": prov,
+           "device_platform": prov.get("device_platform"),
+           "advisor": {"predicted_gain": best.get("gain"),
+                       "predicted_format_gain": predicted,
+                       "best_format": best_fmt,
+                       "densify": best.get("densify")},
+           "end_to_end": {"measured_gain": e2e,
+                          "predicted_gain": best.get("gain")},
+           "formats": rows, "join": join, "commit": _git_head()}
+    _stdout_sink.emit(rec)
+    _sink.emit(dict(rec))
+    return 0 if measured is not None else 1
+
+
 def main_check(targets=None):
     """Run the tier-1 pytest line in a subprocess (CPU-forced, like the
     driver) and emit ONE JSONL record carrying DOTS_PASSED, the return
@@ -2765,6 +2934,9 @@ if __name__ == "__main__":
     elif "--why" in sys.argv:
         extra = sys.argv[sys.argv.index("--why") + 1:]
         sys.exit(main_why(extra))
+    elif "--xray" in sys.argv:
+        extra = sys.argv[sys.argv.index("--xray") + 1:]
+        sys.exit(main_xray(extra))
     elif "--trend" in sys.argv:
         extra = sys.argv[sys.argv.index("--trend") + 1:]
         sys.exit(main_trend(extra))

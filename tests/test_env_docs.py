@@ -44,5 +44,7 @@ def test_table_covers_new_knobs():
                 "AMGCL_TPU_FARM_MAX_BYTES", "AMGCL_TPU_FARM_QUEUE_MAX",
                 "AMGCL_TPU_FARM_METRICS_PORT", "AMGCL_TPU_GATE_FARM",
                 "AMGCL_TPU_FLIGHT", "AMGCL_TPU_FLIGHT_DIR",
-                "AMGCL_TPU_FLIGHT_MAX_DUMPS"):
+                "AMGCL_TPU_FLIGHT_MAX_DUMPS", "AMGCL_TPU_XRAY",
+                "AMGCL_TPU_XRAY_VARIANTS",
+                "AMGCL_TPU_XRAY_MAX_ADVISE_NNZ"):
         assert var in documented, var
