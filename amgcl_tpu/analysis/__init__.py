@@ -1,12 +1,22 @@
 """Static analysis — the before-execution leg of the telemetry stack.
 
-Two passes over two representations of the same programs:
+Three passes over three representations of the same programs:
 
 * :mod:`amgcl_tpu.analysis.lint` — stdlib-``ast`` JAX-hazard linter over
   the source (bare ``jax.jit`` bypassing the compile watch, host syncs
   in traced loop bodies, ``np.*`` on tracers, undocumented
   ``AMGCL_TPU_*`` knobs, mutable defaults, Pallas calls without the
-  ``interpret=`` CI seam). Importable without jax.
+  ``interpret=`` CI seam, blocking calls under ad-hoc locks).
+  Importable without jax.
+* :mod:`amgcl_tpu.analysis.concurrency` — whole-module thread-safety
+  analyzer over the declared concurrent control-plane modules
+  (serve/service, serve/farm, the telemetry recorders): lock-order
+  graph vs the ``LOCK_ORDER`` contracts declared next to the code,
+  guarded-by inference with ``UNGUARDED_OK`` allowlists,
+  condition-variable discipline, and future-handoff ordering. Its
+  runtime counterpart, :mod:`amgcl_tpu.analysis.lockwitness`
+  (``AMGCL_TPU_LOCK_WITNESS=1``), validates witnessed lock-order edges
+  against the static graph under the chaos matrix.
 * :mod:`amgcl_tpu.analysis.jaxpr_audit` — abstract-traces the solver /
   distributed / ``make_solver`` entry points (``jax.make_jaxpr``, no
   execution) and verifies the declared contracts: collective census vs
@@ -16,7 +26,7 @@ Two passes over two representations of the same programs:
   ``ledger.DONATION_CONTRACTS``, and the compile-watch entry-point
   drift check.
 
-``python -m amgcl_tpu.analysis`` runs both against the committed
+``python -m amgcl_tpu.analysis`` runs all of them against the committed
 findings budget (``ANALYSIS_BASELINE.json``): new findings exit
 nonzero, like the bench gate. ``bench.py --check`` embeds the same run
 in its CI record.
@@ -31,6 +41,10 @@ from typing import Any, Dict, Optional
 from amgcl_tpu.analysis.lint import (  # noqa: F401  (public surface)
     RULES, apply_baseline, declared_metric_names, finding_key,
     format_findings, run_lint, undocumented_knobs, watched_entry_points,
+)
+from amgcl_tpu.analysis.concurrency import (  # noqa: F401
+    CONCURRENCY_RULES, CONCURRENT_MODULES, run_concurrency,
+    static_lock_graph,
 )
 
 #: committed findings budget at the repo root
@@ -49,23 +63,47 @@ def load_baseline(path: Optional[str] = None) -> Optional[Dict[str, Any]]:
 
 
 def run_all(baseline: Optional[Dict[str, Any]] = None,
-            with_audit: bool = True) -> Dict[str, Any]:
-    """Lint (+ jaxpr audit) against the baseline. Returns a JSON-clean
-    record with ``ok`` false on any new lint finding or audit error."""
+            with_audit: bool = True,
+            with_concurrency: bool = True,
+            root: Optional[str] = None) -> Dict[str, Any]:
+    """Lint + concurrency analyzer (+ jaxpr audit) against the one
+    shared baseline. Returns a JSON-clean record with ``ok`` false on
+    any new finding or audit error; the ``concurrency`` sub-record
+    carries the counts ``bench.py --check`` embeds."""
     if baseline is None:
         baseline = load_baseline()
-    findings = run_lint()
-    split = apply_baseline(findings, baseline)
+    findings = run_lint(root=root)
+    conc = run_concurrency(root=root) if with_concurrency else []
+    split = apply_baseline(findings + conc, baseline)
+    conc_rules = set(CONCURRENCY_RULES)
+    new_lint = [f for f in split["new"] if f["rule"] not in conc_rules]
+    new_conc = [f for f in split["new"] if f["rule"] in conc_rules]
+    sup_conc = sum(1 for f in split["suppressed"]
+                   if f["rule"] in conc_rules)
+    stale = split["stale"]
+    if not with_concurrency:
+        # a lint-only run produced no concurrency findings — the
+        # committed concurrency suppressions are DISABLED here, not
+        # stale, and must not be reported for removal
+        stale = [s for s in stale if s["rule"] not in conc_rules]
     out: Dict[str, Any] = {
         "lint": {
             "total": len(findings),
-            "new": split["new"],
-            "suppressed": len(split["suppressed"]),
-            "stale_suppressions": split["stale"],
+            "new": new_lint,
+            "suppressed": len(split["suppressed"]) - sup_conc,
+            "stale_suppressions": stale,
             "rules": list(RULES),
         },
         "ok": not split["new"],
     }
+    if with_concurrency:
+        out["concurrency"] = {
+            "total": len(conc),
+            "new": new_conc,
+            "suppressed": sup_conc,
+            "modules": list(CONCURRENT_MODULES),
+            "rules": list(CONCURRENCY_RULES),
+        }
     if with_audit:
         from amgcl_tpu.analysis import jaxpr_audit
         audit = jaxpr_audit.run_audit()

@@ -52,8 +52,15 @@ def main(argv=None) -> int:
                     help="findings-budget file (default: the committed "
                          "ANALYSIS_BASELINE.json)")
     ap.add_argument("--no-audit", action="store_true",
-                    help="lint only (no jax import; fast enough for a "
-                         "pre-commit hook)")
+                    help="lint + concurrency only (no jax import; fast "
+                         "enough for a pre-commit hook)")
+    ap.add_argument("--no-concurrency", action="store_true",
+                    help="skip the concurrency contract analyzer "
+                         "(analysis/concurrency.py; default ON)")
+    ap.add_argument("--root", metavar="DIR",
+                    help="package root to analyze instead of the "
+                         "installed amgcl_tpu/ (negative-injection "
+                         "fixtures and forks)")
     ap.add_argument("--write-baseline", action="store_true",
                     help="rewrite the baseline accepting every current "
                          "finding (reasons are kept for keys already "
@@ -67,7 +74,10 @@ def main(argv=None) -> int:
     baseline = analysis.load_baseline(baseline_path)
 
     if args.write_baseline:
-        findings = analysis.run_lint()
+        findings = analysis.run_lint(root=args.root)
+        if not args.no_concurrency:
+            findings = findings + analysis.run_concurrency(
+                root=args.root)
         old = {(s["rule"], s["file"], s["symbol"]): s.get("reason", "")
                for s in (baseline or {}).get("suppressions", [])}
         seen, sup = set(), []
@@ -79,6 +89,15 @@ def main(argv=None) -> int:
             sup.append({"rule": key[0], "file": key[1], "symbol": key[2],
                         "reason": old.get(key,
                                           "TODO: justify or fix")})
+        if args.no_concurrency:
+            # a lint-only rewrite ran no concurrency rules: keep the
+            # existing concurrency budget verbatim instead of silently
+            # dropping it (the default run would then fail on 'new'
+            # findings the analyzer had already accepted)
+            for s in (baseline or {}).get("suppressions", []):
+                if s.get("rule") in analysis.CONCURRENCY_RULES \
+                        and analysis.finding_key(s) not in seen:
+                    sup.append(s)
         with open(baseline_path, "w") as fh:
             json.dump({"version": 1, "suppressions": sup}, fh, indent=1)
             fh.write("\n")
@@ -89,7 +108,9 @@ def main(argv=None) -> int:
     if not args.no_audit:
         _force_test_topology()
     rec = analysis.run_all(baseline=baseline,
-                           with_audit=not args.no_audit)
+                           with_audit=not args.no_audit,
+                           with_concurrency=not args.no_concurrency,
+                           root=args.root)
     if args.json:
         print(json.dumps(rec, default=str))
     else:
@@ -99,6 +120,14 @@ def main(argv=None) -> int:
                  len(lint_rec["new"])))
         if lint_rec["new"]:
             print(analysis.format_findings(lint_rec["new"]))
+        if "concurrency" in rec:
+            conc = rec["concurrency"]
+            print("Concurrency: %d finding(s) over %d declared "
+                  "module(s), %d suppressed by baseline, %d new"
+                  % (conc["total"], len(conc["modules"]),
+                     conc["suppressed"], len(conc["new"])))
+            if conc["new"]:
+                print(analysis.format_findings(conc["new"]))
         for s in lint_rec["stale_suppressions"]:
             print("stale suppression (finding gone — remove from "
                   "baseline): %s %s %s" % (s["rule"], s["file"],

@@ -1,0 +1,926 @@
+"""Concurrency contract analyzer — lock-order / guarded-by / CV- and
+handoff-discipline static checks over the threaded host control plane.
+
+The jaxpr auditor (PR 6) put the DEVICE-side invariants under contract;
+this module does the same one layer up, for the host-side threaded
+serving stack (``serve/service.py``, ``serve/farm.py``, the telemetry
+recorders, ``faults/recovery.py`` — the :data:`CONCURRENT_MODULES`
+set). Every rule encodes a bug class this codebase has actually paid
+for: the PR-11 race-fix commit (atomic re-registration, admission
+rollback), the PR-13 review-hardening passes (stranded futures,
+worker-death teardown ordering, ``done()`` guards), the PR-8
+stats-read race. Four analyses, all stdlib ``ast``, jax-free:
+
+``lock-order``
+    every statically nested lock acquisition (``with self._X:`` scopes
+    followed through the intra-module call graph) must be an edge of
+    the transitively-closed ``LOCK_ORDER`` partial order DECLARED next
+    to the code it governs (serve/farm.py, serve/service.py — the
+    PR-6 contracts-next-to-models pattern), and the union graph must
+    be acyclic. A nested acquisition of a plain (non-reentrant)
+    ``Lock`` already held is reported as a self-deadlock.
+``guarded-by``
+    for each ``self._x`` field (and module-global) of a concurrent
+    module, the dominant guarding lock is inferred from the lock-held
+    WRITE sites; a write outside the inferred guard, or a read outside
+    it from code reachable from a thread entry point
+    (``threading.Thread``/``Timer`` targets and callback arguments to
+    ``MetricsServer`` — the scrape path), is a finding unless the
+    field is listed in the module's declared ``UNGUARDED_OK``
+    allowlist with a reason (single-writer disciplines, double-checked
+    fast paths).
+``cv-discipline``
+    a bare ``Condition.wait()`` must sit inside a ``while`` predicate
+    loop (``wait_for`` carries its own predicate and is exempt), wait
+    and ``notify``/``notify_all`` must run with the condition's lock
+    held on every statically known call path.
+``handoff-discipline``
+    ``Future.set_result``/``set_exception`` must not execute while any
+    registry/stats lock is held (a done-callback would run arbitrary
+    caller code under the control-plane lock), and must come AFTER the
+    function's locked stats commits (the resolve-last discipline: a
+    caller who saw its future done reads stats that already include
+    its batch). Blocking calls — ``time.sleep``, a thread ``join``, a
+    ``queue.get``/``put`` without timeout, ``block_until_ready``, a
+    ``Future.result`` — inside a lock-held region are findings
+    (``Condition.wait`` releases the lock and is exempt).
+
+Findings use the lint schema — plain dicts keyed ``(rule, file,
+symbol)`` — and ride the same ``ANALYSIS_BASELINE.json`` budget with
+reasoned suppressions. ``python -m amgcl_tpu.analysis`` runs this
+module by default; ``bench.py --check`` embeds the counts.
+
+:func:`static_lock_graph` exports the canonicalized allowed-edge set
+(declared closure + derived leaf locks) that the runtime lock witness
+(``analysis/lockwitness.py``, ``AMGCL_TPU_LOCK_WITNESS=1``) validates
+its actually-witnessed edges against — witnessed ⊆ static is the
+check that keeps this analyzer honest (an analyzer that models edges
+no execution ever takes, or misses edges executions do take, fails
+there, not in review).
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Any, Dict, Iterable, List, Optional, Set, Tuple
+
+from amgcl_tpu.analysis.lint import (REPO, _Module, _attr_tail,
+                                     _blocking_call_shape,
+                                     _enclosing_symbol, finding)
+
+#: the declared concurrent-module set the analyzer (and the runtime
+#: lock witness) covers — repo-relative under ``amgcl_tpu/``. Adding a
+#: threaded module means adding it here (and, if it declares locks,
+#: giving it a LOCK_ORDER/UNGUARDED_OK declaration when the analyzer
+#: asks for one).
+CONCURRENT_MODULES: Tuple[str, ...] = (
+    "serve/service.py",
+    "serve/farm.py",
+    "serve/registry.py",
+    "telemetry/flight.py",
+    "telemetry/live.py",
+    "telemetry/sink.py",
+    "telemetry/tracing.py",
+    "faults/recovery.py",
+    "faults/inject.py",
+)
+
+#: the rules this module implements, in report order
+CONCURRENCY_RULES = ("lock-order", "guarded-by", "cv-discipline",
+                     "handoff-discipline")
+
+#: thread-entry constructors: callable arguments to these are thread
+#: roots for the reachability analysis (Thread/Timer run targets on a
+#: worker; MetricsServer runs its callbacks on the scrape thread)
+_THREAD_ENTRY_CALLS = frozenset({"Thread", "Timer", "MetricsServer"})
+
+#: deque/dict/list/set mutator method names — an ``x.append(...)``
+#: counts as a WRITE to ``x`` for the guarded-by inference
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert", "add",
+    "remove", "discard", "pop", "popleft", "popitem", "clear",
+    "update", "setdefault", "sort", "reverse",
+})
+
+#: depth bound on the interprocedural held-set walk (call chains in
+#: these modules are shallow; the bound only guards pathological
+#: fixtures)
+_MAX_CALL_DEPTH = 12
+
+
+# ---------------------------------------------------------------------------
+# lock discovery
+# ---------------------------------------------------------------------------
+
+def _lock_ctor_kind(mod: _Module, node: ast.AST) -> Optional[str]:
+    """'lock' | 'rlock' | 'cond' when ``node`` is a Call constructing a
+    threading primitive (directly, or wrapped one level in a
+    ``maybe_wrap(name, Lock())`` witness seam)."""
+    if not isinstance(node, ast.Call):
+        return None
+    tail = _attr_tail(node.func)
+    if tail == "Lock":
+        return "lock"
+    if tail == "RLock":
+        return "rlock"
+    if tail == "Condition":
+        return "cond"
+    if tail and tail.endswith("wrap"):
+        # the witness seam in any import spelling (maybe_wrap,
+        # _wit_wrap, ...): the wrapped constructor is the lock
+        for arg in node.args:
+            kind = _lock_ctor_kind(mod, arg)
+            if kind:
+                return kind
+    return None
+
+
+def _cond_underlying(node: ast.Call) -> Optional[str]:
+    """Attribute name of the lock a ``Condition(self._x)`` rides, or
+    None for a Condition on its own internal lock."""
+    if node.args:
+        arg = node.args[0]
+        if isinstance(arg, ast.Attribute) \
+                and isinstance(arg.value, ast.Name) \
+                and arg.value.id == "self":
+            return arg.attr
+        if isinstance(arg, ast.Name):
+            return arg.id
+    return None
+
+
+class _LockModel:
+    """Per-module lock table: attr/global name -> kind, condition
+    aliasing, the declared LOCK_ORDER / UNGUARDED_OK contracts, and the
+    canonical (module-qualified) naming the witness shares."""
+
+    def __init__(self, mod: _Module):
+        self.mod = mod
+        self.stem = os.path.splitext(os.path.basename(mod.rel))[0]
+        #: lock name (self-attr or module global) -> kind
+        self.locks: Dict[str, str] = {}
+        #: condition name -> underlying lock name (same module); a
+        #: Condition() on its own internal lock maps to itself
+        self.alias: Dict[str, str] = {}
+        #: declared partial order, canonicalized pairs
+        self.declared: List[Tuple[str, str]] = []
+        #: declared unguarded-field allowlist {field: reason}
+        self.unguarded_ok: Dict[str, str] = {}
+        self._discover()
+
+    def _discover(self) -> None:
+        for node in ast.walk(self.mod.tree):
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+                continue
+            tgt = node.targets[0]
+            name = None
+            if isinstance(tgt, ast.Attribute) \
+                    and isinstance(tgt.value, ast.Name) \
+                    and tgt.value.id == "self":
+                name = tgt.attr
+            elif isinstance(tgt, ast.Name):
+                name = tgt.id
+            if name is None:
+                continue
+            kind = _lock_ctor_kind(self.mod, node.value)
+            if kind is None:
+                continue
+            self.locks[name] = kind
+            if kind == "cond":
+                call = node.value
+                tail = _attr_tail(call.func) \
+                    if isinstance(call, ast.Call) else None
+                if tail and tail.endswith("wrap"):
+                    call = next((a for a in call.args
+                                 if isinstance(a, ast.Call)), call)
+                under = _cond_underlying(call) \
+                    if isinstance(call, ast.Call) else None
+                self.alias[name] = under if under is not None else name
+        # declared contracts are module-level literals
+        for node in self.mod.tree.body:
+            if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                    or not isinstance(node.targets[0], ast.Name):
+                continue
+            tname = node.targets[0].id
+            if tname == "LOCK_ORDER" and isinstance(node.value,
+                                                    (ast.Tuple, ast.List)):
+                for elt in node.value.elts:
+                    if isinstance(elt, (ast.Tuple, ast.List)) \
+                            and len(elt.elts) == 2 \
+                            and all(isinstance(e, ast.Constant)
+                                    and isinstance(e.value, str)
+                                    for e in elt.elts):
+                        self.declared.append(
+                            (self.canonical(elt.elts[0].value),
+                             self.canonical(elt.elts[1].value)))
+            elif tname == "UNGUARDED_OK" and isinstance(node.value,
+                                                        ast.Dict):
+                for k, v in zip(node.value.keys, node.value.values):
+                    if isinstance(k, ast.Constant) \
+                            and isinstance(k.value, str) \
+                            and isinstance(v, ast.Constant) \
+                            and isinstance(v.value, str):
+                        self.unguarded_ok[k.value] = v.value
+
+    def canonical(self, name: str) -> str:
+        """Module-qualified canonical lock name: ``farm._mem_lock``;
+        conditions resolve to their underlying lock (``_mem_cond`` ->
+        ``farm._mem_lock``). Names already carrying a dot (declared
+        cross-module edges like ``registry._lock``) pass through."""
+        if "." in name:
+            return name
+        name = self.alias.get(name, name)
+        return "%s.%s" % (self.stem, name)
+
+    def kind_of(self, name: str) -> Optional[str]:
+        """Kind of the UNDERLYING primitive: a Condition on an RLock is
+        reentrant, one on its own internal lock is not."""
+        under = self.alias.get(name, name)
+        k = self.locks.get(under)
+        if k == "cond":
+            return "lock"       # Condition() internal lock: plain Lock
+        return k
+
+    def lock_expr_name(self, expr: ast.AST) -> Optional[str]:
+        """Local lock name when ``expr`` denotes one of this module's
+        locks (``self._x`` or a module-global ``_x``)."""
+        if isinstance(expr, ast.Attribute) \
+                and isinstance(expr.value, ast.Name) \
+                and expr.value.id == "self" and expr.attr in self.locks:
+            return expr.attr
+        if isinstance(expr, ast.Name) and expr.id in self.locks:
+            return expr.id
+        return None
+
+
+# ---------------------------------------------------------------------------
+# thread-entry reachability (lint rule 8's machinery, extended with
+# callback arguments to scrape/timer constructors)
+# ---------------------------------------------------------------------------
+
+def _thread_root_names(mod: _Module) -> Set[str]:
+    roots: Set[str] = set()
+    for call in mod._calls():
+        tail = _attr_tail(call.func)
+        if tail not in _THREAD_ENTRY_CALLS:
+            continue
+        cands: List[ast.AST] = []
+        cands += [kw.value for kw in call.keywords
+                  if kw.arg in ("target", "health_cb", "metrics_cb")]
+        if tail == "Timer" and len(call.args) >= 2:
+            cands.append(call.args[1])
+        if tail == "MetricsServer":
+            cands += call.args[1:]
+        for tgt in cands:
+            if isinstance(tgt, ast.Name):
+                roots.add(tgt.id)
+            elif isinstance(tgt, ast.Attribute):
+                roots.add(tgt.attr)
+    return roots
+
+
+def _reachable_from_threads(mod: _Module) -> Set[str]:
+    """Function NAMES reachable from a thread root through same-module
+    ``self.X()`` / ``X()`` calls."""
+    seen: Set[str] = set()
+    work = sorted(_thread_root_names(mod))
+    while work:
+        name = work.pop()
+        if name in seen:
+            continue
+        seen.add(name)
+        for fn in mod.by_name.get(name, ()):
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if isinstance(f, ast.Attribute) \
+                        and isinstance(f.value, ast.Name) \
+                        and f.value.id == "self":
+                    work.append(f.attr)
+                elif isinstance(f, ast.Name):
+                    work.append(f.id)
+    return seen
+
+
+# ---------------------------------------------------------------------------
+# the interprocedural held-set walk
+# ---------------------------------------------------------------------------
+
+class _Access:
+    __slots__ = ("field", "write", "held", "func", "line", "qual")
+
+    def __init__(self, field, write, held, func, line, qual):
+        self.field = field
+        self.write = write
+        self.held = held          # tuple of canonical lock names
+        self.func = func          # function NAME the access sits in
+        self.line = line
+        self.qual = qual          # display qualname
+
+
+class _ModuleAnalysis:
+    """One module's walk products: observed nested-acquisition edges,
+    field accesses with held-sets, CV/handoff/blocking findings raised
+    in-flight."""
+
+    def __init__(self, mod: _Module, model: _LockModel):
+        self.mod = mod
+        self.model = model
+        #: (src_canonical, dst_canonical) -> [(qualname, line), ...]
+        self.edges: Dict[Tuple[str, str], List[Tuple[str, int]]] = {}
+        self.accesses: List[_Access] = []
+        self.findings: List[Dict[str, Any]] = []
+        #: module-global names tracked for guarded-by (assigned at
+        #: module level to a container, or named in a `global` stmt)
+        self.globals: Set[str] = set()
+        self._seen_ctx: Set[Tuple[int, Tuple[str, ...]]] = set()
+        self._finding_keys: Set[Tuple] = set()
+        self._discover_globals()
+
+    # -- setup ---------------------------------------------------------------
+
+    def _discover_globals(self) -> None:
+        for node in self.mod.tree.body:
+            if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                    and isinstance(node.targets[0], ast.Name):
+                name = node.targets[0].id
+                val = node.value
+            elif isinstance(node, ast.AnnAssign) \
+                    and isinstance(node.target, ast.Name) \
+                    and node.value is not None:
+                name = node.target.id
+                val = node.value
+            else:
+                continue
+            if isinstance(val, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(val, ast.Call)
+                    and _attr_tail(val.func) in ("deque", "dict",
+                                                 "list", "set")):
+                self.globals.add(name)
+        for node in ast.walk(self.mod.tree):
+            if isinstance(node, ast.Global):
+                self.globals.update(node.names)
+        self.globals -= set(self.model.locks)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _emit(self, rule: str, line: int, symbol: str,
+              message: str) -> None:
+        key = (rule, symbol, message)
+        if key in self._finding_keys:
+            return
+        self._finding_keys.add(key)
+        self.findings.append(finding(rule, self.mod.rel, line, symbol,
+                                     message))
+
+    def _callees(self, node: ast.Call) -> List[str]:
+        f = node.func
+        if isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name) \
+                and f.value.id == "self":
+            return [f.attr]
+        if isinstance(f, ast.Name):
+            return [f.id]
+        return []
+
+    # -- the walk ------------------------------------------------------------
+
+    def run(self) -> None:
+        roots = self._roots()
+        for fn in roots:
+            self._walk_function(fn, held=(), depth=0)
+
+    def _roots(self) -> List[ast.AST]:
+        """Entry contexts with nothing held: functions never called
+        intra-module, plus every public (non-underscore) function —
+        external callers arrive lock-free. ``_locked``-suffix helpers
+        are only analyzed under their propagated calling contexts."""
+        called: Set[str] = set()
+        for call in self.mod._calls():
+            for name in self._callees(call):
+                called.add(name)
+        out = []
+        for fn, qn in self.mod.qualnames.items():
+            name = getattr(fn, "name", "")
+            if name not in called or not name.startswith("_"):
+                out.append(fn)
+        return out
+
+    def _walk_function(self, fn: ast.AST, held: Tuple[str, ...],
+                       depth: int) -> None:
+        ctx = (id(fn), held)
+        if ctx in self._seen_ctx or depth > _MAX_CALL_DEPTH:
+            return
+        self._seen_ctx.add(ctx)
+        qual = self.mod.qualnames.get(fn, "<module>")
+        for stmt in fn.body:
+            self._visit(stmt, fn, qual, held, depth, while_depth=0)
+
+    def _visit(self, node: ast.AST, fn: ast.AST, qual: str,
+               held: Tuple[str, ...], depth: int,
+               while_depth: int) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # nested defs (timer callbacks built inline) are analyzed
+            # as their own roots / call targets, not as inline code
+            return
+        if isinstance(node, ast.With):
+            new_held = held
+            for item in node.items:
+                name = self.model.lock_expr_name(item.context_expr)
+                if name is None:
+                    self._visit(item.context_expr, fn, qual, held,
+                                depth, while_depth)
+                    continue
+                canon = self.model.canonical(name)
+                self._note_acquire(canon, name, qual,
+                                   item.context_expr.lineno, new_held)
+                if canon not in new_held:
+                    new_held = new_held + (canon,)
+            for child in node.body:
+                self._visit(child, fn, qual, new_held, depth,
+                            while_depth)
+            return
+        if isinstance(node, ast.While):
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, fn, qual, held, depth,
+                            while_depth + 1)
+            return
+        if isinstance(node, ast.Call):
+            self._visit_call(node, fn, qual, held, depth, while_depth)
+            for child in ast.iter_child_nodes(node):
+                self._visit(child, fn, qual, held, depth, while_depth)
+            return
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = node.targets if isinstance(node, ast.Assign) \
+                else [node.target]
+            for tgt in targets:
+                self._note_target(tgt, qual, held)
+            for child in ast.iter_child_nodes(node):
+                if child not in targets:
+                    self._visit(child, fn, qual, held, depth,
+                                while_depth)
+            return
+        if isinstance(node, ast.Attribute):
+            self._note_attr(node, qual, held, write=False)
+        elif isinstance(node, ast.Name):
+            self._note_global(node.id, qual, held,
+                              write=isinstance(node.ctx, ast.Store),
+                              line=node.lineno)
+        for child in ast.iter_child_nodes(node):
+            self._visit(child, fn, qual, held, depth, while_depth)
+
+    # -- acquisition + edges -------------------------------------------------
+
+    def _note_acquire(self, canon: str, local: str, qual: str,
+                      line: int, held: Tuple[str, ...]) -> None:
+        kind = self.model.kind_of(local)
+        for h in held:
+            if h == canon:
+                if kind == "lock":
+                    self._emit(
+                        "lock-order", line, qual,
+                        "re-acquisition of non-reentrant lock %s while "
+                        "already held — self-deadlock" % canon)
+                return
+        for h in held:
+            self.edges.setdefault((h, canon), []).append((qual, line))
+
+    # -- calls: CV / handoff / blocking checks + propagation -----------------
+
+    def _visit_call(self, node: ast.Call, fn: ast.AST, qual: str,
+                    held: Tuple[str, ...], depth: int,
+                    while_depth: int) -> None:
+        f = node.func
+        tail = _attr_tail(f)
+        # condition-variable sites: self._cond.wait(...) etc.
+        recv_lock = None
+        if isinstance(f, ast.Attribute):
+            recv_lock = self.model.lock_expr_name(f.value)
+        if recv_lock is not None and tail in ("wait", "wait_for",
+                                              "notify", "notify_all"):
+            canon = self.model.canonical(recv_lock)
+            if canon not in held:
+                self._emit(
+                    "cv-discipline", node.lineno, qual,
+                    "%s.%s() on a statically lock-free path — the "
+                    "condition's lock (%s) must be held"
+                    % (recv_lock, tail, canon))
+            if tail == "wait" and while_depth == 0:
+                self._emit(
+                    "cv-discipline", node.lineno, qual,
+                    "bare %s.wait() outside a while-predicate loop — "
+                    "wakeups are spurious and the predicate must be "
+                    "re-checked under the lock (use a while loop or "
+                    "wait_for)" % recv_lock)
+            return
+        if recv_lock is not None and tail in ("acquire",):
+            canon = self.model.canonical(recv_lock)
+            self._note_acquire(canon, recv_lock, qual, node.lineno, held)
+            return
+        # future handoff under a lock
+        if tail in ("set_result", "set_exception") and held:
+            self._emit(
+                "handoff-discipline", node.lineno, qual,
+                "Future.%s while holding %s — a done-callback runs "
+                "arbitrary caller code under the control-plane lock; "
+                "resolve futures after the locked region" %
+                (tail, ", ".join(held)))
+        # blocking calls under a lock — THE shared classifier
+        # (lint._blocking_call_shape), so rule 9's lexical twin can
+        # never drift from this one on what counts as blocking
+        if held:
+            reason = _blocking_call_shape(node)
+            if reason:
+                self._emit(
+                    "handoff-discipline", node.lineno, qual,
+                    "%s while holding %s — blocking under a "
+                    "control-plane lock stalls every thread behind it"
+                    % (reason, ", ".join(held)))
+        # propagate into intra-module callees with the current held-set
+        for name in self._callees(node):
+            for callee in self.mod.by_name.get(name, ()):
+                self._walk_function(callee, held, depth + 1)
+
+    # -- field accesses ------------------------------------------------------
+
+    def _note_target(self, tgt: ast.AST, qual: str,
+                     held: Tuple[str, ...]) -> None:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for elt in tgt.elts:
+                self._note_target(elt, qual, held)
+            return
+        node = tgt
+        # self._x[...] = v and self._x.y = v are writes to _x
+        while isinstance(node, (ast.Subscript, ast.Attribute)) \
+                and not (isinstance(node, ast.Attribute)
+                         and isinstance(node.value, ast.Name)
+                         and node.value.id == "self"):
+            node = node.value
+        if isinstance(node, ast.Attribute):
+            self._note_attr(node, qual, held, write=True)
+        elif isinstance(node, ast.Name):
+            self._note_global(node.id, qual, held, write=True,
+                              line=node.lineno)
+
+    def _note_attr(self, node: ast.Attribute, qual: str,
+                   held: Tuple[str, ...], write: bool) -> None:
+        if not (isinstance(node.value, ast.Name)
+                and node.value.id == "self"):
+            return
+        field = node.attr
+        if field in self.model.locks:
+            return
+        self.accesses.append(_Access(
+            field, write, held, qual.rsplit(".", 1)[-1], node.lineno,
+            qual))
+
+    def _note_global(self, name: str, qual: str, held: Tuple[str, ...],
+                     write: bool, line: int) -> None:
+        if name not in self.globals:
+            return
+        self.accesses.append(_Access(
+            "<module>." + name, write, held, qual.rsplit(".", 1)[-1],
+            line, qual))
+
+def _upgrade_mutator_writes(analysis: _ModuleAnalysis) -> None:
+    """``self._x.append(v)`` / ``_ring.clear()`` record as reads of
+    ``_x`` during the walk (the Attribute leaf is a Load); upgrade an
+    access to a WRITE when its line holds a mutator call on the same
+    receiver."""
+    mut_lines: Dict[Tuple[str, int], bool] = {}
+    for node in ast.walk(analysis.mod.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        f = node.func
+        if not (isinstance(f, ast.Attribute) and f.attr in _MUTATORS):
+            continue
+        recv = f.value
+        if isinstance(recv, ast.Attribute) \
+                and isinstance(recv.value, ast.Name) \
+                and recv.value.id == "self":
+            mut_lines[(recv.attr, node.lineno)] = True
+        elif isinstance(recv, ast.Name):
+            mut_lines[("<module>." + recv.id, node.lineno)] = True
+    for acc in analysis.accesses:
+        if not acc.write and (acc.field, acc.line) in mut_lines:
+            acc.write = True
+
+
+# ---------------------------------------------------------------------------
+# rule evaluation over the walk products
+# ---------------------------------------------------------------------------
+
+def _closure(pairs: Iterable[Tuple[str, str]]) -> Set[Tuple[str, str]]:
+    edges = set(pairs)
+    changed = True
+    while changed:
+        changed = False
+        for a, b in list(edges):
+            for c, d in list(edges):
+                if b == c and (a, d) not in edges:
+                    edges.add((a, d))
+                    changed = True
+    return edges
+
+
+def _find_cycle(edges: Set[Tuple[str, str]]) -> Optional[List[str]]:
+    graph: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        graph.setdefault(a, set()).add(b)
+    WHITE, GRAY, BLACK = 0, 1, 2
+    color = {n: WHITE for n in set(graph)
+             | {b for _, b in edges}}
+    stack: List[str] = []
+
+    def dfs(n: str) -> Optional[List[str]]:
+        color[n] = GRAY
+        stack.append(n)
+        for m in sorted(graph.get(n, ())):
+            if color[m] == GRAY:
+                return stack[stack.index(m):] + [m]
+            if color[m] == WHITE:
+                got = dfs(m)
+                if got:
+                    return got
+        stack.pop()
+        color[n] = BLACK
+        return None
+
+    for n in sorted(color):
+        if color[n] == WHITE:
+            got = dfs(n)
+            if got:
+                return got
+    return None
+
+
+def _check_lock_order(analysis: _ModuleAnalysis,
+                      declared_all: Set[Tuple[str, str]],
+                      leaves: Set[str]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    model = analysis.model
+    for (src, dst), sites in sorted(analysis.edges.items()):
+        if (src, dst) in declared_all:
+            continue
+        # leaf allowance is CROSS-module only (utility locks like the
+        # live registry's): an undeclared intra-module nesting is a
+        # finding even when the inner lock nests nothing further —
+        # that is exactly how an inversion of a 2-lock order looks
+        if dst in leaves and dst.split(".")[0] != src.split(".")[0]:
+            continue
+        qual, line = sites[0]
+        out.append(finding(
+            "lock-order", analysis.mod.rel, line,
+            "%s->%s" % (src, dst),
+            "nested acquisition %s -> %s is not an edge of the "
+            "declared LOCK_ORDER partial order — declare the edge "
+            "with the rest of the order or restructure "
+            "(%d site(s), first at %s)" %
+            (src, dst, len(sites), qual)))
+    # declaration sanity: the declared order itself must be acyclic
+    cyc = _find_cycle(set(model.declared))
+    if cyc:
+        out.append(finding(
+            "lock-order", analysis.mod.rel, 0,
+            "LOCK_ORDER", "declared LOCK_ORDER contains a cycle: %s"
+            % " -> ".join(cyc)))
+    return out
+
+
+def _check_guarded_by(analysis: _ModuleAnalysis,
+                      thread_reachable: Set[str]) -> List[Dict[str, Any]]:
+    out: List[Dict[str, Any]] = []
+    model = analysis.model
+    by_field: Dict[str, List[_Access]] = {}
+    for acc in analysis.accesses:
+        by_field.setdefault(acc.field, []).append(acc)
+    for field, accs in sorted(by_field.items()):
+        if field.split(".")[-1] in model.unguarded_ok:
+            continue
+        # dedupe per (line, held) — a function analyzed under multiple
+        # contexts must not double-count a site — and drop constructor
+        # accesses: __init__ runs before the object is shared, so its
+        # lock-free writes are not races
+        seen: Set[Tuple[int, Tuple[str, ...], bool]] = set()
+        uniq: List[_Access] = []
+        for acc in accs:
+            if acc.func == "__init__":
+                continue
+            key = (acc.line, acc.held, acc.write)
+            if key not in seen:
+                seen.add(key)
+                uniq.append(acc)
+        writes = [a for a in uniq if a.write]
+        guard_votes: Dict[str, int] = {}
+        for a in writes:
+            for h in a.held:
+                guard_votes[h] = guard_votes.get(h, 0) + 1
+        if not guard_votes:
+            continue
+        guard, votes = max(sorted(guard_votes.items()),
+                           key=lambda kv: kv[1])
+        if votes * 2 < len(writes):
+            continue            # no dominant guard — not a lock-
+        #                         managed field
+        bad_writes = [a for a in writes if guard not in a.held]
+        bad_reads = [a for a in uniq
+                     if not a.write and guard not in a.held
+                     and a.func in thread_reachable]
+        if not bad_writes and not bad_reads:
+            continue
+        sites = ", ".join(sorted({"%s:%d" % (a.qual, a.line)
+                                  for a in bad_writes + bad_reads}))
+        kinds = []
+        if bad_writes:
+            kinds.append("%d write(s)" % len(bad_writes))
+        if bad_reads:
+            kinds.append("%d thread-reachable read(s)" % len(bad_reads))
+        symbol = field if field.startswith("<module>") \
+            else _owning_class(analysis, field)
+        out.append(finding(
+            "guarded-by", analysis.mod.rel,
+            (bad_writes + bad_reads)[0].line, symbol,
+            "field %s is dominantly guarded by %s (%d/%d locked "
+            "writes) but %s bypass it (%s) — guard them or declare "
+            "the field in UNGUARDED_OK with a reason" %
+            (field, guard, votes, len(writes),
+             " + ".join(kinds), sites)))
+    return out
+
+
+def _owning_class(analysis: _ModuleAnalysis, field: str) -> str:
+    """Display symbol ``Class._field`` from the first qualname that
+    touches the field."""
+    for acc in analysis.accesses:
+        if acc.field == field and "." in acc.qual:
+            return "%s.%s" % (acc.qual.split(".")[0], field)
+    return field
+
+
+# ---------------------------------------------------------------------------
+# handoff ordering (resolve-last) — lexical per-function check
+# ---------------------------------------------------------------------------
+
+def _check_resolve_last(mod: _Module,
+                        model: _LockModel) -> List[Dict[str, Any]]:
+    """A ``set_result``/``set_exception`` lexically BEFORE a later
+    locked stats-commit block in the same function breaks the
+    resolve-last discipline: a caller who saw its future done reads
+    stats that miss its own batch."""
+    out: List[Dict[str, Any]] = []
+    for fn, qual in mod.qualnames.items():
+        resolves: List[int] = []
+        commits: List[int] = []
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call) \
+                    and _attr_tail(node.func) in ("set_result",
+                                                  "set_exception"):
+                resolves.append(node.lineno)
+            elif isinstance(node, ast.With):
+                if any(model.lock_expr_name(it.context_expr)
+                       for it in node.items) \
+                        and _has_self_counter_write(node):
+                    commits.append(node.lineno)
+        if resolves and commits and min(resolves) < max(commits):
+            out.append(finding(
+                "handoff-discipline", mod.rel, min(resolves), qual,
+                "future resolved at line %d but a locked stats commit "
+                "follows at line %d — resolve futures LAST, after "
+                "every locked accounting commit (a caller who saw its "
+                "future done must read stats that include its batch)"
+                % (min(resolves), max(commits))))
+    return out
+
+
+def _has_self_counter_write(with_node: ast.With) -> bool:
+    for node in ast.walk(with_node):
+        tgt = None
+        if isinstance(node, ast.AugAssign):
+            tgt = node.target
+        elif isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+        if isinstance(tgt, ast.Attribute) \
+                and isinstance(tgt.value, ast.Name) \
+                and tgt.value.id == "self":
+            return True
+    return False
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def _load_modules(root: Optional[str],
+                  modules: Optional[Iterable[str]]) -> List[_Module]:
+    root = root or os.path.join(REPO, "amgcl_tpu")
+    base = os.path.dirname(root.rstrip(os.sep)) or REPO
+    declared = modules is None
+    names = tuple(modules) if modules is not None else CONCURRENT_MODULES
+    out: List[_Module] = []
+    for rel in names:
+        path = os.path.join(root, rel)
+        if not os.path.exists(path):
+            if declared:
+                # the real declared set: a rename/typo must fail the
+                # gate loudly, never silently drop a module's
+                # lock-order/guarded-by coverage (the lint discipline:
+                # a file the analyzer cannot read cannot be audited)
+                raise FileNotFoundError(
+                    "declared concurrent module %r is missing under %s"
+                    " — fix CONCURRENT_MODULES or restore the file"
+                    % (rel, root))
+            continue              # explicit fixture subsets may probe
+        with open(path) as f:
+            src = f.read()
+        relpath = os.path.relpath(path, base).replace(os.sep, "/")
+        out.append(_Module(path, relpath, ast.parse(src, filename=path)))
+    return out
+
+
+def _analyze(root: Optional[str] = None,
+             modules: Optional[Iterable[str]] = None
+             ) -> List[_ModuleAnalysis]:
+    out = []
+    for mod in _load_modules(root, modules):
+        model = _LockModel(mod)
+        analysis = _ModuleAnalysis(mod, model)
+        analysis.run()
+        _upgrade_mutator_writes(analysis)
+        out.append(analysis)
+    return out
+
+
+def static_lock_graph(root: Optional[str] = None,
+                      modules: Optional[Iterable[str]] = None
+                      ) -> Dict[str, Any]:
+    """The canonicalized static lock graph the runtime witness checks
+    against: ``allowed`` (transitive closure of every declared
+    LOCK_ORDER plus all statically observed intra-module edges),
+    ``leaves`` (locks with no outgoing edge anywhere — an edge INTO a
+    leaf is always legal), ``locks`` (canonical name -> kind) and
+    ``observed`` (the statically derived edges with site counts)."""
+    analyses = _analyze(root, modules)
+    declared: Set[Tuple[str, str]] = set()
+    observed: Dict[Tuple[str, str], int] = {}
+    locks: Dict[str, str] = {}
+    for a in analyses:
+        declared |= set(a.model.declared)
+        for (src, dst), sites in a.edges.items():
+            observed[(src, dst)] = observed.get((src, dst), 0) \
+                + len(sites)
+        for name in a.model.locks:
+            kind = a.model.locks[name]
+            if kind == "cond" and a.model.alias.get(name) != name:
+                continue        # canonicalizes onto its rlock
+            locks[a.model.canonical(name)] = a.model.kind_of(name) \
+                or kind
+    allowed = _closure(declared | set(observed))
+    srcs = {a for a, _ in allowed}
+    leaves = {name for name in locks if name not in srcs}
+    return {"allowed": sorted(allowed), "leaves": sorted(leaves),
+            "locks": locks,
+            "observed": {"%s->%s" % k: v
+                         for k, v in sorted(observed.items())},
+            "declared": sorted(declared)}
+
+
+def run_concurrency(root: Optional[str] = None,
+                    modules: Optional[Iterable[str]] = None
+                    ) -> List[Dict[str, Any]]:
+    """Run the four concurrency rules over the declared module set
+    (or ``modules`` under ``root`` for fixtures). Returns findings in
+    the lint schema, (file, line, rule) order."""
+    analyses = _analyze(root, modules)
+    declared_all: Set[Tuple[str, str]] = set()
+    for a in analyses:
+        declared_all |= set(a.model.declared)
+    declared_all = _closure(declared_all)
+    # leaves derive from the UNION graph: a lock is a leaf only when NO
+    # module's code acquires anything while holding it
+    srcs = {src for a in analyses for (src, _d) in a.edges} \
+        | {a for a, _b in declared_all}
+    all_locks: Set[str] = set()
+    for a in analyses:
+        for name in a.model.locks:
+            all_locks.add(a.model.canonical(name))
+    leaves = all_locks - srcs
+    out: List[Dict[str, Any]] = []
+    for a in analyses:
+        thread_reachable = _reachable_from_threads(a.mod)
+        out += a.findings
+        out += _check_lock_order(a, declared_all, leaves)
+        out += _check_guarded_by(a, thread_reachable)
+        out += _check_resolve_last(a.mod, a.model)
+    # cross-module cycle check over the union of everything
+    union = declared_all | {e for a in analyses for e in a.edges}
+    cyc = _find_cycle(set(union))
+    if cyc:
+        out.append(finding(
+            "lock-order", "amgcl_tpu/analysis/concurrency.py", 0,
+            "<union-graph>",
+            "the union lock graph (declared + observed) contains a "
+            "cycle: %s — a cross-module deadlock is reachable"
+            % " -> ".join(cyc)))
+    out.sort(key=lambda f: (f["file"], f["line"], f["rule"]))
+    return out

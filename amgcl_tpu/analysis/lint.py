@@ -69,6 +69,17 @@ discipline the jaxpr auditor depends on):
     dumps, ledger models) that genuinely have nowhere to route carry
     suppressions with reasons in ANALYSIS_BASELINE.json.
 
+``blocking-call-under-lock``
+    a known-blocking call — ``time.sleep``, a timeout-less thread
+    ``join``, a ``queue.get``/``put`` with no timeout, a device sync,
+    a ``Future.result`` — lexically inside a ``with <lock>:`` body.
+    The cheap single-function version of the concurrency analyzer's
+    handoff check (analysis/concurrency.py rule 4): the DECLARED
+    concurrent modules get the full interprocedural treatment there
+    and are skipped here, so one-off lock-holding helpers elsewhere
+    stay covered. ``Condition.wait``/``wait_for`` are exempt (they
+    release the lock while blocked).
+
 Findings are plain dicts keyed for the baseline by ``(rule, file,
 symbol)`` — line numbers are carried for display but excluded from the
 key so unrelated edits above a finding do not churn the baseline.
@@ -93,7 +104,8 @@ REPO = os.path.dirname(os.path.dirname(os.path.dirname(
 #: the rules this module implements, in report order
 RULES = ("bare-jit", "host-sync-in-loop", "np-in-jit",
          "undocumented-knob", "mutable-default", "pallas-no-interpret",
-         "metric-name-literal", "swallowed-worker-exception")
+         "metric-name-literal", "swallowed-worker-exception",
+         "blocking-call-under-lock")
 
 #: live-registry update methods the metric-name rule inspects (the
 #: LiveRegistry public write surface, telemetry/live.py)
@@ -491,6 +503,104 @@ def _rule_swallowed_worker(mod: _Module) -> List[Dict[str, Any]]:
 
 
 # ---------------------------------------------------------------------------
+# blocking-call-under-lock rule (rule 9 — the cheap lexical version of
+# the concurrency analyzer's handoff check, for every module OUTSIDE
+# the declared concurrent set so one-off lock-holding helpers are
+# still covered)
+# ---------------------------------------------------------------------------
+
+#: with-item receivers that look like a mutual-exclusion primitive
+_LOCKISH = re.compile(r"lock|cond|mutex", re.I)
+
+
+def _lockish_name(expr: ast.AST) -> Optional[str]:
+    """Name of a lock-looking ``with`` context (``self._lock`` /
+    ``_LOCK`` / ``pool.lock``) — None for everything else, including
+    calls (``open(...)``, ``lock_for(x)`` factories are out of
+    scope)."""
+    if isinstance(expr, ast.Attribute):
+        return expr.attr if _LOCKISH.search(expr.attr) else None
+    if isinstance(expr, ast.Name):
+        return expr.id if _LOCKISH.search(expr.id) else None
+    return None
+
+
+def _blocking_call_shape(node: ast.Call) -> Optional[str]:
+    """Human name of a known-blocking call shape, or None. Condition
+    ``wait``/``wait_for`` are exempt (they release the lock). THE one
+    classifier — the concurrency analyzer's interprocedural rule 4
+    (analysis/concurrency.py) delegates here, so the two rules can
+    never drift on what counts as blocking."""
+    tail = _attr_tail(node.func)
+    f = node.func
+    kw = {k.arg for k in node.keywords}
+    recv = f.value if isinstance(f, ast.Attribute) else None
+    rname = recv.attr if isinstance(recv, ast.Attribute) \
+        else recv.id if isinstance(recv, ast.Name) else ""
+    if tail == "sleep" and (recv is None or rname == "time"):
+        return "time.sleep()"
+    if tail == "block_until_ready":
+        return "jax.block_until_ready() (device sync)"
+    if tail == "join" and recv is not None and not node.args \
+            and "timeout" not in kw \
+            and ("thread" in rname.lower() or rname in ("th", "worker")):
+        return "%s.join() without a timeout" % rname
+    if tail in ("get", "put") and recv is not None \
+            and ("queue" in rname.lower() or rname == "q"):
+        nonblocking = any(
+            k.arg == "block" and isinstance(k.value, ast.Constant)
+            and k.value.value is False for k in node.keywords)
+        if "timeout" not in kw and len(node.args) < 2 \
+                and not nonblocking:
+            return "%s.%s() without a timeout" % (rname, tail)
+    if tail == "result" and recv is not None \
+            and "fut" in rname.lower() and "timeout" not in kw \
+            and not node.args:
+        return "%s.result() without a timeout" % rname
+    return None
+
+
+def _rule_blocking_under_lock(mod: _Module) -> List[Dict[str, Any]]:
+    out = []
+
+    def visit(node: ast.AST, lock: Optional[str]) -> None:
+        if isinstance(node, ast.With):
+            inner_lock = next((_lockish_name(it.context_expr)
+                               for it in node.items
+                               if _lockish_name(it.context_expr)),
+                              None) or lock
+            for it in node.items:
+                visit(it, lock)
+            for child in node.body:
+                visit(child, inner_lock)
+            return
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            # a closure DEFINED under the lock does not RUN under it —
+            # its body restarts lock-free (and is reached exactly once)
+            for child in ast.iter_child_nodes(node):
+                visit(child, None)
+            return
+        if isinstance(node, ast.Call) and lock is not None \
+                and _attr_tail(node.func) not in ("wait", "wait_for"):
+            shape = _blocking_call_shape(node)
+            if shape:
+                out.append(finding(
+                    "blocking-call-under-lock", mod.rel, node.lineno,
+                    _enclosing_symbol(mod, node),
+                    "%s inside a `with %s:` body — blocking while "
+                    "holding a lock stalls every thread behind it "
+                    "(move the blocking call outside the locked "
+                    "region)" % (shape, lock)))
+        for child in ast.iter_child_nodes(node):
+            visit(child, lock)
+
+    visit(mod.tree, None)
+    out.sort(key=lambda f: f["line"])
+    return out
+
+
+# ---------------------------------------------------------------------------
 # live-metric declaration rule (the /metrics contract)
 # ---------------------------------------------------------------------------
 
@@ -744,7 +854,16 @@ def run_lint(root: Optional[str] = None,
     ast_rules = want & {"bare-jit", "host-sync-in-loop", "np-in-jit",
                         "mutable-default", "pallas-no-interpret",
                         "metric-name-literal",
-                        "swallowed-worker-exception"}
+                        "swallowed-worker-exception",
+                        "blocking-call-under-lock"}
+    concurrent_set: Tuple[str, ...] = ()
+    if "blocking-call-under-lock" in want:
+        # the declared concurrent modules get the FULL interprocedural
+        # check (analysis/concurrency.py rule 4); this cheap lexical
+        # rule covers everything else. Function-level import — the
+        # concurrency module imports this one at module level.
+        from amgcl_tpu.analysis.concurrency import CONCURRENT_MODULES
+        concurrent_set = CONCURRENT_MODULES
     declared = declared_metric_names(root) \
         if "metric-name-literal" in want else set()
     declared_labels = declared_metric_labels(root) \
@@ -764,6 +883,10 @@ def run_lint(root: Optional[str] = None,
                                              declared_labels)
         if "swallowed-worker-exception" in want:
             out += _rule_swallowed_worker(mod)
+        if "blocking-call-under-lock" in want \
+                and not any(mod.rel.endswith(rel)
+                            for rel in concurrent_set):
+            out += _rule_blocking_under_lock(mod)
     if "undocumented-knob" in want:
         out += _rule_undocumented_knob(root, readme)
     out.sort(key=lambda f: (f["file"], f["line"], f["rule"]))

@@ -1,0 +1,414 @@
+"""Runtime lock witness — the dynamic half of the concurrency
+contract (``analysis/concurrency.py`` is the static half).
+
+A static lock-order analyzer is only as honest as its model: it can
+declare edges no execution ever takes, or miss edges executions DO
+take (callbacks, cross-module calls, monkeypatched seams). The witness
+closes that loop. Opt-in via ``AMGCL_TPU_LOCK_WITNESS=1``, it wraps
+the declared concurrent modules' ``Lock``/``RLock``/``Condition``
+objects (explicit ``maybe_instrument``/``maybe_wrap`` seams in each
+constructor — no monkeypatching) and records, per process:
+
+* **witnessed acquisition-order edges** — for every acquisition while
+  other witnessed locks are held, one ``held -> acquired`` edge with a
+  count. :func:`check_witness` asserts witnessed ⊆ static (the
+  canonicalized graph :func:`concurrency.static_lock_graph` exports:
+  declared ``LOCK_ORDER`` closure + statically observed edges +
+  cross-module edges into leaf locks). Run under the chaos matrix
+  (``faults/chaos.py`` folds the verdict in) this validates the
+  analyzer against real multi-threaded executions.
+* **hold-time histogram** — per lock: acquisition count, max and total
+  held milliseconds (condition waits excluded — the lock is released
+  while waiting). The ``lock_witness_max_hold_ms`` gauge source.
+* **starvation/deadlock watchdog** — a blocking acquire that has not
+  landed within ``AMGCL_TPU_LOCK_WITNESS_TIMEOUT_S`` (default 30)
+  records a trip (lock name, waited seconds, holder at the time) and
+  keeps waiting; a deadlock therefore shows up as repeating trips
+  instead of a silent hang. Zero trips is a chaos-matrix acceptance
+  criterion.
+
+:func:`validate` is the one-call verdict (subset check + zero trips),
+optionally emitting the ``lock_witness`` JSONL event and publishing
+the ``lock_witness_*`` gauges onto a live registry.
+
+Stdlib-only (the instrumented modules must stay importable without
+jax); the bookkeeping path is a few dict updates under one meta-lock,
+cheap enough to leave on for an entire chaos run.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+_LOCK_TYPES = (type(threading.Lock()), type(threading.RLock()))
+
+
+def enabled() -> bool:
+    """Kill switch — read per call so tests can flip it; wrapping
+    itself happens at construction/import time of the instrumented
+    objects."""
+    return os.environ.get("AMGCL_TPU_LOCK_WITNESS") == "1"
+
+
+def watchdog_timeout_s() -> float:
+    """Blocking-acquire patience before a starvation trip (seconds)."""
+    try:
+        return float(os.environ.get("AMGCL_TPU_LOCK_WITNESS_TIMEOUT_S",
+                                    "30"))
+    except ValueError:
+        return 30.0
+
+
+# ---------------------------------------------------------------------------
+# the witness state (process-global)
+# ---------------------------------------------------------------------------
+
+class _Witness:
+    def __init__(self):
+        self._meta = threading.Lock()      # plain, never wrapped
+        self._tls = threading.local()
+        #: (src, dst) -> count
+        self.edges: Dict[Tuple[str, str], int] = {}
+        #: name -> {count, max_ms, total_ms}
+        self.holds: Dict[str, Dict[str, float]] = {}
+        #: watchdog trip rows: {lock, waited_s, thread}
+        self.trips: List[Dict[str, Any]] = []
+
+    # -- per-thread held stack ----------------------------------------------
+
+    def _stack(self) -> List[List[Any]]:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+        return st
+
+    def note_acquired(self, name: str) -> None:
+        st = self._stack()
+        reentrant = any(row[0] == name for row in st)
+        if not reentrant:
+            held = []
+            for row in st:
+                if row[0] not in held and row[0] != name:
+                    held.append(row[0])
+            if held:
+                with self._meta:
+                    for h in held:
+                        key = (h, name)
+                        self.edges[key] = self.edges.get(key, 0) + 1
+        st.append([name, time.perf_counter()])
+
+    def note_released(self, name: str) -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i][0] == name:
+                row = st.pop(i)
+                break
+        else:
+            return
+        if any(r[0] == name for r in st):
+            return          # still reentrantly held — not the
+        #                     outermost release
+        held_ms = (time.perf_counter() - row[1]) * 1e3
+        with self._meta:
+            h = self.holds.setdefault(
+                name, {"count": 0, "max_ms": 0.0, "total_ms": 0.0})
+            h["count"] += 1
+            h["total_ms"] += held_ms
+            if held_ms > h["max_ms"]:
+                h["max_ms"] = held_ms
+
+    def suspend_for_wait(self, name: str) -> int:
+        """Condition.wait releases the lock: pop every reentrant frame
+        of ``name`` from this thread's stack (closing the hold
+        interval) and return how many to restore after the wakeup."""
+        st = self._stack()
+        depth = sum(1 for r in st if r[0] == name)
+        if depth:
+            # close the hold interval once (outermost), drop the rest
+            self.note_released(name)
+            self._tls.stack = [r for r in self._stack()
+                               if r[0] != name]
+        return depth
+
+    def resume_after_wait(self, name: str, depth: int) -> None:
+        # restore EXACTLY what was suspended: a wait() that raised
+        # because the lock was never witness-held suspended zero
+        # frames, and pushing one anyway would leave a phantom
+        # permanently-held frame poisoning every later edge
+        st = self._stack()
+        now = time.perf_counter()
+        for _ in range(depth):
+            st.append([name, now])
+        # deliberately NO edge recording: the wakeup re-acquires the
+        # same lock the wait released — the ordering edge (if any) was
+        # recorded at the original acquisition
+
+    def note_trip(self, name: str, waited_s: float) -> None:
+        with self._meta:
+            self.trips.append({
+                "lock": name, "waited_s": round(waited_s, 3),
+                "thread": threading.current_thread().name})
+
+    def snapshot(self) -> Dict[str, Any]:
+        with self._meta:
+            edges = [{"src": s, "dst": d, "count": c}
+                     for (s, d), c in sorted(self.edges.items())]
+            holds = {k: dict(v) for k, v in sorted(self.holds.items())}
+            trips = list(self.trips)
+        max_hold = max((h["max_ms"] for h in holds.values()),
+                       default=0.0)
+        return {"edges": edges, "edges_total": len(edges),
+                "holds": holds, "max_hold_ms": round(max_hold, 3),
+                "watchdog_trips": len(trips), "trips": trips}
+
+    def reset(self) -> None:
+        with self._meta:
+            self.edges.clear()
+            self.holds.clear()
+            self.trips.clear()
+
+
+_WITNESS = _Witness()
+
+
+def _reset_for_tests() -> None:
+    _WITNESS.reset()
+
+
+# ---------------------------------------------------------------------------
+# proxies
+# ---------------------------------------------------------------------------
+
+class _WitnessLock:
+    """Transparent Lock/RLock proxy: same acquire/release surface,
+    plus edge + hold bookkeeping and the starvation watchdog on
+    indefinite blocking acquires."""
+
+    __slots__ = ("_inner", "name")
+
+    def __init__(self, name: str, inner):
+        self._inner = inner
+        self.name = name
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not blocking or (timeout is not None and timeout >= 0):
+            ok = self._inner.acquire(blocking, -1 if timeout is None
+                                     else timeout)
+            if ok:
+                _WITNESS.note_acquired(self.name)
+            return ok
+        patience = watchdog_timeout_s()
+        t0 = time.perf_counter()
+        while True:
+            if self._inner.acquire(True, patience):
+                _WITNESS.note_acquired(self.name)
+                return True
+            _WITNESS.note_trip(self.name, time.perf_counter() - t0)
+
+    def release(self):
+        _WITNESS.note_released(self.name)
+        self._inner.release()
+
+    def locked(self):
+        return self._inner.locked()
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self.release()
+        return False
+
+    # RLock internals (Condition's _release_save/_acquire_restore
+    # protocol) pass through to the raw primitive — a Condition built
+    # directly on a proxy still works, its wait instrumented only when
+    # it is a _WitnessCondition
+    def __getattr__(self, attr):
+        return getattr(self._inner, attr)
+
+
+class _WitnessCondition:
+    """Condition proxy sharing a :class:`_WitnessLock` for its lock
+    surface: ``with cond:`` acquisitions are witnessed under the
+    LOCK's canonical name (a Condition on the module's RLock IS that
+    lock), and ``wait`` suspends the hold bookkeeping for its
+    duration — wait time must not pollute the hold histogram, and the
+    wakeup re-acquisition is not a fresh ordering edge."""
+
+    __slots__ = ("_cond", "_proxy")
+
+    def __init__(self, cond: "threading.Condition", proxy: _WitnessLock):
+        self._cond = cond
+        self._proxy = proxy
+
+    @property
+    def name(self) -> str:
+        return self._proxy.name
+
+    def acquire(self, *a, **kw):
+        return self._proxy.acquire(*a, **kw)
+
+    def release(self):
+        self._proxy.release()
+
+    def __enter__(self):
+        self._proxy.acquire()
+        return self
+
+    def __exit__(self, *exc):
+        self._proxy.release()
+        return False
+
+    def wait(self, timeout: Optional[float] = None):
+        depth = _WITNESS.suspend_for_wait(self._proxy.name)
+        try:
+            return self._cond.wait(timeout)
+        finally:
+            _WITNESS.resume_after_wait(self._proxy.name, depth)
+
+    def wait_for(self, predicate, timeout: Optional[float] = None):
+        depth = _WITNESS.suspend_for_wait(self._proxy.name)
+        try:
+            return self._cond.wait_for(predicate, timeout)
+        finally:
+            _WITNESS.resume_after_wait(self._proxy.name, depth)
+
+    def notify(self, n: int = 1):
+        self._cond.notify(n)
+
+    def notify_all(self):
+        self._cond.notify_all()
+
+    def __getattr__(self, attr):
+        return getattr(self._cond, attr)
+
+
+# ---------------------------------------------------------------------------
+# wrapping seams
+# ---------------------------------------------------------------------------
+
+def maybe_wrap(name: str, lock):
+    """Module-level seam: ``_lock = maybe_wrap("flight._lock",
+    threading.Lock())``. Identity when the witness is off (the
+    decision is frozen at import/construction time — the chaos runner
+    sets the env before anything imports)."""
+    if not enabled():
+        return lock
+    if isinstance(lock, _LOCK_TYPES):
+        return _WitnessLock(name, lock)
+    if isinstance(lock, threading.Condition):
+        proxy = _WitnessLock(name, lock._lock)
+        return _WitnessCondition(lock, proxy)
+    return lock
+
+
+def maybe_instrument(obj, prefix: str) -> None:
+    """Constructor seam: replace every ``threading`` lock/condition in
+    ``obj.__dict__`` with a witnessed proxy named
+    ``<prefix>.<attr>``. A Condition whose lock IS one of the object's
+    own locks shares that lock's proxy (and its canonical name) — the
+    ``_mem_cond``-rides-``_mem_lock`` idiom. No-op when the witness is
+    off."""
+    if not enabled():
+        return
+    lock_proxies: Dict[int, _WitnessLock] = {}
+    items = list(vars(obj).items())
+    for attr, val in items:
+        if isinstance(val, _LOCK_TYPES):
+            proxy = _WitnessLock("%s.%s" % (prefix, attr), val)
+            lock_proxies[id(val)] = proxy
+            setattr(obj, attr, proxy)
+    for attr, val in items:
+        if isinstance(val, threading.Condition):
+            raw = val._lock
+            proxy = lock_proxies.get(id(raw))
+            if proxy is None:
+                proxy = _WitnessLock("%s.%s" % (prefix, attr), raw)
+            setattr(obj, attr, _WitnessCondition(val, proxy))
+
+
+# ---------------------------------------------------------------------------
+# reporting + the witnessed-⊆-static check
+# ---------------------------------------------------------------------------
+
+def report() -> Dict[str, Any]:
+    """Snapshot of everything witnessed so far (JSON-clean)."""
+    out = _WITNESS.snapshot()
+    out["enabled"] = enabled()
+    return out
+
+
+def check_witness(graph: Optional[Dict[str, Any]] = None,
+                  snapshot: Optional[Dict[str, Any]] = None
+                  ) -> Dict[str, Any]:
+    """Witnessed ⊆ static: every witnessed edge must be in the static
+    graph's allowed set (declared LOCK_ORDER closure + statically
+    observed edges) or point INTO a leaf lock of another module (the
+    utility-lock allowance the static side grants too). Returns
+    {ok, violations, edges_total, watchdog_trips, max_hold_ms}."""
+    if graph is None:
+        from amgcl_tpu.analysis import concurrency as _conc
+        graph = _conc.static_lock_graph()
+    snap = snapshot or report()
+    allowed = {tuple(e) for e in graph.get("allowed", ())}
+    leaves = set(graph.get("leaves", ()))
+    violations = []
+    for row in snap["edges"]:
+        src, dst = row["src"], row["dst"]
+        if (src, dst) in allowed:
+            continue
+        if dst in leaves and dst.split(".")[0] != src.split(".")[0]:
+            continue
+        violations.append(dict(row, reason="edge not in the static "
+                               "lock graph"))
+    ok = not violations and snap["watchdog_trips"] == 0
+    return {"ok": ok, "violations": violations,
+            "edges_total": snap["edges_total"],
+            "watchdog_trips": snap["watchdog_trips"],
+            "max_hold_ms": snap["max_hold_ms"]}
+
+
+def publish_gauges(registry, snapshot: Optional[Dict[str, Any]] = None
+                   ) -> None:
+    """Publish the witness gauges onto a live registry
+    (telemetry/live.py METRICS declares the names — the
+    metric-name-literal contract)."""
+    snap = snapshot or report()
+    registry.set_gauge("lock_witness_edges", snap["edges_total"])
+    registry.set_gauge("lock_witness_max_hold_ms", snap["max_hold_ms"])
+    registry.set_gauge("lock_witness_watchdog_trips",
+                       snap["watchdog_trips"])
+
+
+def validate(emit: bool = False, registry=None) -> Dict[str, Any]:
+    """The one-call verdict: subset check + zero watchdog trips, with
+    the witnessed edges attached. ``emit=True`` writes one
+    ``lock_witness`` JSONL event (the metrics.EVENT_FIELDS rollup
+    spec aggregates it); ``registry`` additionally receives the
+    ``lock_witness_*`` gauges."""
+    snap = report()
+    out = check_witness(snapshot=snap)
+    out["edges"] = snap["edges"]
+    if snap["trips"]:
+        out["trips"] = snap["trips"]
+    if registry is not None:
+        try:
+            publish_gauges(registry, snap)
+        except Exception:          # noqa: BLE001 — a gauge publish
+            pass                   # must not fail the verdict
+    if emit:
+        try:
+            from amgcl_tpu.telemetry import sink as _sink
+            _sink.emit({"event": "lock_witness", "ok": out["ok"],
+                        "edges_total": out["edges_total"],
+                        "max_hold_ms": out["max_hold_ms"],
+                        "watchdog_trips": out["watchdog_trips"],
+                        "edges": snap["edges"],
+                        "violations": out["violations"]})
+        except Exception:          # noqa: BLE001 — best-effort emit
+            pass
+    return out

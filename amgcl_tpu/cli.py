@@ -605,6 +605,26 @@ def main(argv=None):
         print(_ja.format_report(result))
         telemetry.emit(event="audit", ok=result["ok"],
                        records=audit_recs, findings=audit_findings)
+        # the host-side leg of the audit: concurrency contracts over
+        # the serve/farm control plane (analysis/concurrency.py),
+        # against the same committed findings budget the CLI gate uses
+        from amgcl_tpu import analysis as _an
+        conc_findings = _an.run_concurrency()
+        conc_split = _an.apply_baseline(conc_findings,
+                                        _an.load_baseline())
+        conc_new = [f for f in conc_split["new"]
+                    if f["rule"] in _an.CONCURRENCY_RULES]
+        print()
+        print("Concurrency contracts (%d declared module(s)): "
+              "%d finding(s), %d suppressed with reasons, %d new"
+              % (len(_an.CONCURRENT_MODULES), len(conc_findings),
+                 len(conc_split["suppressed"]), len(conc_new)))
+        if conc_new:
+            print(_an.format_findings(conc_new))
+        telemetry.emit(event="audit_concurrency",
+                       ok=not conc_new, total=len(conc_findings),
+                       new=len(conc_new),
+                       modules=list(_an.CONCURRENT_MODULES))
 
     if args.telemetry:
         # structured duplicates of the text report, one JSONL record each

@@ -480,6 +480,19 @@ def run_chaos(names: Optional[List[str]] = None,
         "wall_s": round(time.monotonic() - t_start, 2),
         "workdir": workdir,
     }
+    try:
+        from amgcl_tpu.analysis import lockwitness as _lockwitness
+        if _lockwitness.enabled():
+            # runtime validation of the static concurrency analyzer:
+            # every lock-order edge the scenarios actually took must
+            # be in the static graph (witnessed ⊆ static), and the
+            # starvation watchdog must not have tripped — a witness
+            # failure fails the matrix like a hang would
+            out["lock_witness"] = _lockwitness.validate(emit=True)
+            out["ok"] = out["ok"] and out["lock_witness"]["ok"]
+    except Exception as e:               # noqa: BLE001 — verdict row
+        out["lock_witness"] = {"ok": False, "error": repr(e)[:200]}
+        out["ok"] = False
     return out
 
 

@@ -74,7 +74,10 @@ SITES = (
 
 NUMERIC_SITES = ("numeric.nan", "numeric.inf", "numeric.breakdown")
 
-_lock = threading.Lock()
+# runtime lock witness seam (identity when the knob is off)
+from amgcl_tpu.analysis.lockwitness import maybe_wrap as _wit_wrap
+
+_lock = _wit_wrap("inject._lock", threading.Lock())
 _state: Dict[str, Any] = {
     "raw": None,        # env value the parse below corresponds to
     "rules": [],        # parsed rules

@@ -115,7 +115,10 @@ class RecoveryPolicy:
 
 # -- module counters (chaos-matrix + gauge sources) -------------------------
 
-_lock = threading.Lock()
+# runtime lock witness seam (identity when the knob is off)
+from amgcl_tpu.analysis.lockwitness import maybe_wrap as _wit_wrap
+
+_lock = _wit_wrap("recovery._lock", threading.Lock())
 _recoveries = 0
 _ladder_runs = 0
 _last_ckpt_ts: Optional[float] = None

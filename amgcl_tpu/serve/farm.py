@@ -64,6 +64,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from amgcl_tpu.analysis import lockwitness as _lockwitness
 from amgcl_tpu.faults import (AdmissionError, LoadShedError,
                               WorkerDiedError)
 from amgcl_tpu.faults import recovery as _frecovery
@@ -74,6 +75,43 @@ from amgcl_tpu.serve.service import (SolverService, _Request, _env_float,
                                      _env_int, _sink_attached)
 from amgcl_tpu.telemetry.live import (LiveRegistry, MetricsServer,
                                       metrics_port_from_env)
+
+
+#: declared lock partial order for the farm control plane (DESIGN
+#: §18), checked statically by ``analysis/concurrency.py`` and at
+#: runtime by the lock witness: an edge ``(A, B)`` permits acquiring B
+#: while A is held; any nested acquisition outside the transitive
+#: closure of this order (leaf utility locks like the live registry's
+#: excepted) is a finding. ``_mem_cond`` rides ``_mem_lock`` itself
+#: (same underlying RLock) and needs no edge. The cross-module rows
+#: cover the runtime edges the witness sees: admission/registration
+#: calls into the operator registry under ``_mem_lock``, and the
+#: registry invokes the farm's ``rebuild_ok`` guard (which reads the
+#: tenant table under ``_cond``) while holding its own lock.
+LOCK_ORDER = (
+    ("_mem_lock", "_cond"),
+    ("_mem_lock", "registry._lock"),
+    ("registry._lock", "_cond"),
+)
+
+#: fields deliberately accessed outside their inferred guard, with the
+#: reason each pattern is safe — the ``guarded-by`` analysis accepts
+#: exactly these; anything else bypassing its guard is a finding.
+UNGUARDED_OK = {
+    "_thread": "liveness-probe reads (healthz, submit revive check); "
+               "every mutation runs under _cond",
+    "_stop": "the dispatch thread polls the flag at loop exits; every "
+             "write runs under _cond, a stale read costs one extra "
+             "0.1 s pick tick",
+    "_n_evictions": "monotonic int scraped by /healthz; increments "
+                    "run under _mem_lock, a torn read is impossible "
+                    "for a CPython int",
+    "tenants": "point reads of an atomically-replaced dict row on the "
+               "dispatch/accounting path; per-batch consistency is "
+               "re-validated under _mem_lock "
+               "(_validate_batch_locked), and all mutations run "
+               "under _cond",
+}
 
 
 class _NeedsBuild(Exception):
@@ -226,6 +264,12 @@ class SolverFarm:
         #: batch popped off the tenant queues but not yet accounted —
         #: what the supervisor fails if the dispatch thread dies
         self._inflight_reqs: List[_FarmRequest] = []
+        # runtime lock witness seam (analysis/lockwitness.py, opt-in
+        # AMGCL_TPU_LOCK_WITNESS=1): wraps _cond/_mem_lock/_mem_cond —
+        # the condition sharing _mem_lock canonicalizes onto the same
+        # witnessed name, exactly like the static model; identity
+        # no-op when the knob is off
+        _lockwitness.maybe_instrument(self, "farm")
 
     # -- registration --------------------------------------------------------
 
@@ -263,7 +307,7 @@ class SolverFarm:
         if self._closed:            # early, re-checked under the lock
             raise RuntimeError("SolverFarm is closed")
         rebuild_ok = self._rebuild_guard(tenant)
-        prebuilt = None
+        prebuilt: List[Any] = [None]     # cell shared with build_fn
 
         def build_fn(Ah):
             # acquire calls this only on a MISS; the first attempt
@@ -273,10 +317,36 @@ class SolverFarm:
             # stalls other tenants' dispatch, and (unlike an advisory
             # probe) a racing registration can never flip the outcome
             # into an under-lock build
-            if prebuilt is None:
+            if prebuilt[0] is None:
                 raise _NeedsBuild
-            return prebuilt
+            return prebuilt[0]
 
+        #: (public future, exception) rows the locked paths below WANT
+        #: to fail — resolved only in the ``finally`` after every lock
+        #: dropped (handoff-discipline: a done-callback must never run
+        #: under the farm's control-plane locks)
+        deferred: List[Any] = []
+        try:
+            return self._register_inner(tenant, A, cfg_key, build,
+                                        build_fn, rebuild_ok, prebuilt,
+                                        slo, slo_window, queue_max,
+                                        deferred)
+        finally:
+            for fut, err in deferred:
+                if not fut.done():
+                    fut.set_exception(err)
+
+    def _register_inner(self, tenant, A, cfg_key, build, build_fn,
+                        rebuild_ok, prebuilt, slo, slo_window,
+                        queue_max, deferred) -> Dict[str, Any]:
+        """The lock-taking half of :meth:`register`: the
+        acquire-retry loop. ``prebuilt`` is the one-element cell
+        ``build_fn`` (from the register() frame) reads — the MISS
+        path's out-of-lock build publishes the bundle through it
+        before retrying the acquire. Futures to fail land on
+        ``deferred`` and resolve in register()'s finally, outside
+        every lock."""
+        from amgcl_tpu.ops.csr import CSR
         while True:
             with self._mem_lock:
                 if self._closed:
@@ -336,7 +406,7 @@ class SolverFarm:
                 else:
                     return self._install_tenant_locked(
                         tenant, entry, outcome, slo, slo_window,
-                        queue_max, revert_csr)
+                        queue_max, revert_csr, deferred)
             # the MISS path pays the full symbolic setup here, outside
             # the locks (the fresh bundle is private until the retried
             # acquire publishes it). The build materializes device
@@ -344,17 +414,20 @@ class SolverFarm:
             # operator's footprint is unknowable until built, so that
             # transient overshoot is accepted; READMISSION pre-evicts
             # to the last charged footprint instead (_readmit_locked).
-            prebuilt = build(A)
+            prebuilt[0] = build(A)
 
     def _install_tenant_locked(self, tenant: str, entry: RegistryEntry,
                                outcome: str,
                                slo: Optional[Dict[str, float]],
                                slo_window: Optional[int],
                                queue_max: Optional[int],
-                               revert_csr=None) -> Dict[str, Any]:
+                               revert_csr, deferred: List[Any]
+                               ) -> Dict[str, Any]:
         """The under-lock tail of :meth:`register`: admit the acquired
         entry against the byte budget, install the tenant row, release
-        the previous entry's ownership, and publish counters/gauges."""
+        the previous entry's ownership, and publish counters/gauges.
+        Futures to fail are appended to ``deferred`` (resolved by
+        register() after the locks drop), never resolved here."""
         if "service" not in entry.payload:
             # per-operator resident program: the farm drives
             # _run_batch directly from its own dispatch thread, so
@@ -382,7 +455,7 @@ class SolverFarm:
                 raise RuntimeError("SolverFarm is closed")
         except Exception:
             self._rollback_admission_locked(tenant, entry, outcome,
-                                            revert_csr)
+                                            revert_csr, deferred)
             raise
         merged_slo = dict(self.slo_defaults, **(slo or {}))
         t = _Tenant(tenant, entry, queue_max or self.queue_max,
@@ -434,11 +507,13 @@ class SolverFarm:
             self.live.set_gauge("farm_resident_operators",
                                 len(self.pool.resident()))
         for req in stranded:
-            if not req.public.done():
-                req.public.set_exception(RuntimeError(
-                    "tenant %r re-registered with a different "
-                    "system size (%d -> %d) while this request "
-                    "was queued" % (tenant, old_n, new_n)))
+            # deferred, not resolved here: this method runs under
+            # _mem_lock, and a done-callback on the public future
+            # must never execute under the control-plane lock
+            deferred.append((req.public, RuntimeError(
+                "tenant %r re-registered with a different "
+                "system size (%d -> %d) while this request "
+                "was queued" % (tenant, old_n, new_n))))
         if outcome == "hit":
             self.live.inc("farm_registry_hits_total")
         elif outcome == "miss":
@@ -603,7 +678,8 @@ class SolverFarm:
     def _rollback_admission_locked(self, tenant: str,
                                    entry: RegistryEntry,
                                    outcome: str,
-                                   revert_csr=None) -> None:
+                                   revert_csr, deferred: List[Any]
+                                   ) -> None:
         """Undo a register() whose admission step failed (or that lost
         a race with close()): if acquire REBUILT the tenant's live
         entry in place, revert it to the snapshotted pre-register
@@ -632,7 +708,8 @@ class SolverFarm:
                         # same pressured device): the hierarchy's
                         # values are indeterminate — strand the tenant
                         # rather than let it silently serve them
-                        self._strand_tenant_locked(tenant, entry)
+                        self._strand_tenant_locked(tenant, entry,
+                                                   deferred)
                         raise
                 if entry.uid not in self.pool.resident() \
                         and getattr(entry.obj, "A_dev", None) \
@@ -665,15 +742,17 @@ class SolverFarm:
             traceback.print_exc()
 
     def _strand_tenant_locked(self, tenant: str,
-                              entry: RegistryEntry) -> None:
+                              entry: RegistryEntry,
+                              deferred: List[Any]) -> None:
         """Last-resort teardown when a rollback could not restore a
         coherent operator: remove the tenant row (submits raise
         KeyError until an explicit re-register), fail its queued
-        requests, and drop the entry's ownership, charge and device
-        buffers. The entry's value snapshot is poisoned so a future
-        bit-equal registration can never \"hit\" the broken hierarchy
-        (it remains a legal rebuild target — a rebuild recomputes
-        every value)."""
+        requests (via ``deferred`` — this method runs under _mem_lock,
+        and futures resolve only after the locks drop), and drop the
+        entry's ownership, charge and device buffers. The entry's
+        value snapshot is poisoned so a future bit-equal registration
+        can never \"hit\" the broken hierarchy (it remains a legal
+        rebuild target — a rebuild recomputes every value)."""
         stranded: List[_FarmRequest] = []
         with self._cond:
             row = self.tenants.get(tenant)
@@ -683,10 +762,9 @@ class SolverFarm:
                     stranded.append(row.q.popleft())
             self._cond.notify_all()
         for req in stranded:
-            if not req.public.done():
-                req.public.set_exception(RuntimeError(
-                    "tenant %r was stranded by a failed registration "
-                    "rollback — re-register it" % (tenant,)))
+            deferred.append((req.public, RuntimeError(
+                "tenant %r was stranded by a failed registration "
+                "rollback — re-register it" % (tenant,))))
         self.registry.disown(tenant, entry)
         entry.A_val = np.empty(0)      # never value-matches again
         self.pool.release(entry.uid)
@@ -1040,20 +1118,23 @@ class SolverFarm:
         the displaced request stays in the accounting batch, so
         per-tenant counters/windows/metrics book it like every other
         failed request — and only the returned still-live sublist goes
-        to the solve."""
+        to the solve. Returns ``(live, displaced)`` with the displaced
+        requests paired with their error: the CALLER resolves them
+        after _mem_lock drops (handoff-discipline — this method runs
+        under it)."""
         with self._cond:
             current = {name: t.entry
                        for name, t in self.tenants.items()}
-        live = []
+        live, displaced = [], []
         for req in batch:
             if current.get(req.tenant) is entry:
                 live.append(req)
-            elif not req.future.done():
-                req.future.set_exception(RuntimeError(
+            else:
+                displaced.append((req, RuntimeError(
                     "tenant %r re-registered with a different "
                     "operator while request %d was in flight"
-                    % (req.tenant, req.rid)))
-        return live
+                    % (req.tenant, req.rid))))
+        return live, displaced
 
     def _loop(self):
         """Dispatch-thread entry: the inner loop under a supervisor —
@@ -1082,9 +1163,11 @@ class SolverFarm:
                     "injected farm dispatch-worker death")
             svc = None
             live: List[_FarmRequest] = []
+            displaced: List[Any] = []
             try:
                 with self._mem_lock:
-                    live = self._validate_batch_locked(batch, entry)
+                    live, displaced = self._validate_batch_locked(
+                        batch, entry)
                     if live:
                         svc = self._ensure_resident_locked(entry)
                         # pin, then solve OUTSIDE _mem_lock: eviction,
@@ -1093,6 +1176,12 @@ class SolverFarm:
                         # calls never serialize behind this batch
                         self._pins[entry.uid] = \
                             self._pins.get(entry.uid, 0) + 1
+                # displaced requests fail on their inner future OUTSIDE
+                # _mem_lock (handoff-discipline); they stay in the
+                # accounting batch below like every other failure
+                for req, err in displaced:
+                    if not req.future.done():
+                        req.future.set_exception(err)
                 if svc is not None:
                     try:
                         svc._run_batch(live)
@@ -1105,6 +1194,9 @@ class SolverFarm:
                                 self._pins.pop(entry.uid, None)
                             self._mem_cond.notify_all()
             except Exception as e:     # noqa: BLE001 — a failed batch
+                for req, err in displaced:    # displaced keep their
+                    if not req.future.done():     # own re-register
+                        req.future.set_exception(err)    # error
                 for req in batch:      # fails ITS futures, not the farm
                     if not req.future.done():
                         req.future.set_exception(e)
@@ -1474,7 +1566,10 @@ class SolverFarm:
         if _sink_attached():
             from amgcl_tpu import telemetry
             telemetry.emit(event="farm", final=True, **self.stats())
-        server, self.metrics_server = self.metrics_server, None
+        with self._cond:
+            # under the lock like start()'s bind — the guarded-by
+            # contract keeps every metrics_server mutation guarded
+            server, self.metrics_server = self.metrics_server, None
         if server is not None:
             server.close()
 

@@ -45,6 +45,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from amgcl_tpu.analysis import lockwitness as _lockwitness
+
 
 def sparsity_fingerprint(A) -> str:
     """Hex digest of a CSR matrix's sparsity PATTERN — shape, block
@@ -164,7 +166,12 @@ class OperatorRegistry:
     byte budget through the HBM pool instead."""
 
     def __init__(self, max_orphans: Optional[int] = None):
-        self._lock = threading.RLock()
+        # runtime lock witness seam (analysis/lockwitness.py,
+        # identity when the knob is off): the registry lock
+        # participates in the farm's declared order
+        # (_mem_lock -> registry._lock -> _cond)
+        self._lock = _lockwitness.maybe_wrap("registry._lock",
+                                             threading.RLock())
         #: (fingerprint, config_key) -> [RegistryEntry, ...] (a bucket:
         #: same-pattern different-value operators coexist)
         self._buckets: Dict[Tuple[str, str], List[RegistryEntry]] = {}

@@ -106,6 +106,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from amgcl_tpu.analysis import lockwitness as _lockwitness
 from amgcl_tpu.telemetry import compile_watch as _cwatch
 from amgcl_tpu.telemetry.live import (LiveRegistry, MetricsServer,
                                       metrics_port_from_env)
@@ -115,6 +116,35 @@ from amgcl_tpu.telemetry.tracing import RequestSpans
 #: ``compile_watch.DECLARED_ENTRY_POINTS`` and keyed in
 #: ``ledger.DONATION_CONTRACTS`` (the auditor checks both).
 _SERVE_STEP = "serve.solve_step"
+
+#: declared lock partial order for this module (DESIGN §18), checked
+#: statically by ``analysis/concurrency.py`` and at runtime by the
+#: lock witness: the service has exactly ONE control-plane lock, so
+#: the order is EMPTY — any statically nested acquisition inside this
+#: module is a finding by construction.
+LOCK_ORDER = ()
+
+#: fields deliberately accessed outside their inferred guard, with the
+#: reason each access pattern is safe — the ``guarded-by`` analysis
+#: (analysis/concurrency.py) accepts exactly these; anything else
+#: bypassing its guard is a finding.
+UNGUARDED_OK = {
+    "_thread": "double-checked fast paths + liveness probes: every "
+               "MUTATION runs under _lock and re-checks first; a "
+               "stale read only costs one redundant start()/revive "
+               "round trip",
+    "_closed": "advisory early reads (submit/start fast paths); every "
+               "decision point re-checks under _lock before acting",
+    "_stop": "the worker polls the flag between queue gets; every "
+             "write runs under _lock, and a stale read delays "
+             "shutdown by at most one 0.1 s queue tick",
+    "_n_batches": "worker-serial ordinal: only the dispatch thread "
+                  "reads it pre-commit (batch span labeling); the "
+                  "increment itself stays under _lock",
+    "metrics_server": "write-once-then-None handoff under _lock; a "
+                      "lock-free read sees either the live server or "
+                      "None (no port, no torn state)",
+}
 
 
 def _env_int(name: str, default: int) -> int:
@@ -269,6 +299,11 @@ class SolverService:
         #: requests popped off the queue but not yet resolved — what
         #: the supervisor fails if the worker dies mid-assembly
         self._inflight_reqs: List[_Request] = []
+        # runtime lock witness seam (analysis/lockwitness.py, opt-in
+        # AMGCL_TPU_LOCK_WITNESS=1): wraps this service's lock so the
+        # witnessed-edge / hold-time / watchdog record covers the
+        # serve control plane; identity no-op when the knob is off
+        _lockwitness.maybe_instrument(self, "service")
 
     # -- sizing ---------------------------------------------------------------
 
@@ -597,7 +632,11 @@ class SolverService:
                 except queue.Empty:
                     break
                 if got is _SENTINEL:
-                    self._stop = True
+                    # under the lock like every other _stop write: the
+                    # flag is read by close()'s state handoff and the
+                    # contract (guarded-by) keeps all mutations guarded
+                    with self._lock:
+                        self._stop = True
                     break
                 batch.append(got)
                 self._inflight_reqs = batch
@@ -675,20 +714,18 @@ class SolverService:
                 req.future.set_exception(e)  # strand
 
     def _fail_batch(self, batch, e):
-        """Terminal batch failure: fail the futures, keep the error
-        visible to the observability surface (unhealthy counts, SLO
-        window, flight bundle)."""
-        failed = 0
-        for req in batch:
-            if not req.future.done():
-                req.future.set_exception(e)
-                failed += 1
-        if not failed:
+        """Terminal batch failure: commit the error to the
+        observability surface (unhealthy counts, SLO window, flight
+        bundle), THEN fail the futures — resolve-last, so a caller who
+        saw its future fail reads stats that already book it."""
+        pending = [req for req in batch if not req.future.done()]
+        if not pending:
             # every future already resolved: nothing to attach
             # the error to — print it or it vanishes entirely
             import traceback
             traceback.print_exc()
             return
+        failed = len(pending)
         # the error must stay visible to the observability
         # surface too: the batch is over (in-flight back to
         # 0), and error-failed requests count as unhealthy
@@ -702,6 +739,12 @@ class SolverService:
             self._win.extend(
                 {"timeout": False, "unhealthy": True,
                  "error": True} for _ in range(failed))
+        for req in pending:
+            # re-checked: a caller may have cancel()ed since the
+            # snapshot above — the count drift of that narrow race is
+            # bounded at one window row
+            if not req.future.done():
+                req.future.set_exception(e)
         # flight recorder: a failed batch is an incident —
         # dump a replay bundle of its first request, tagged
         # with every failed request id + the exception
@@ -785,13 +828,34 @@ class SolverService:
             except Exception:                    # noqa: BLE001
                 traceback.print_exc()
 
+    def _fail_timeouts(self, timed_out, t_start):
+        """Queue-expired requests: commit the timeout accounting
+        (lifetime counters, SLO window, live metrics) FIRST, then
+        resolve the futures — the resolve-last discipline, so a caller
+        who saw its future fail reads stats()/the window already
+        carrying its timeout."""
+        self.live.inc("serve_timeouts_total", len(timed_out))
+        with self._lock:
+            self._n_timeouts += len(timed_out)
+            self._win.extend({"timeout": True, "unhealthy": False}
+                             for _ in timed_out)
+        for req in timed_out:
+            # done() guard: a caller may have cancel()ed a still-
+            # PENDING future — set_exception would then raise
+            # InvalidStateError and fail the whole batch
+            if not req.future.done():
+                req.future.set_exception(TimeoutError(
+                    "request waited %.2fs in the serve queue "
+                    "(timeout %.2fs)" % (t_start - req.t_submit,
+                                         req.timeout_s)))
+
     def _run_batch(self, batch):
         import jax.numpy as jnp
         from amgcl_tpu.faults import inject as _inject
         from amgcl_tpu.serve.batched import STACKED_LOWERING
         t_start = time.perf_counter()
         live = []
-        timeouts = 0
+        timed_out: List[_Request] = []
         injecting = _inject.enabled()
         for req in batch:
             expired = t_start - req.t_submit > req.timeout_s
@@ -803,25 +867,14 @@ class SolverService:
                               site="serve.timeout")
                 expired = True
             if expired:
-                # done() guard: a caller may have cancel()ed a still-
-                # PENDING future — set_exception would then raise
-                # InvalidStateError and fail the whole batch
-                if not req.future.done():
-                    req.future.set_exception(TimeoutError(
-                        "request waited %.2fs in the serve queue "
-                        "(timeout %.2fs)" % (t_start - req.t_submit,
-                                             req.timeout_s)))
-                timeouts += 1
+                timed_out.append(req)
             elif req.started \
                     or req.future.set_running_or_notify_cancel():
                 req.started = True
                 live.append(req)
-        if timeouts:
-            self.live.inc("serve_timeouts_total", timeouts)
-            with self._lock:
-                self._n_timeouts += timeouts
-                self._win.extend({"timeout": True, "unhealthy": False}
-                                 for _ in range(timeouts))
+        timeouts = len(timed_out)
+        if timed_out:
+            self._fail_timeouts(timed_out, t_start)
         self.live.set_gauge("serve_queue_depth", self.queue.qsize())
         if not live:
             if timeouts:

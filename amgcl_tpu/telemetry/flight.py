@@ -69,7 +69,12 @@ RING_CAPACITY = 8
 #: manifest schema version
 BUNDLE_SCHEMA = 1
 
-_lock = threading.Lock()
+# runtime lock witness seam (analysis/lockwitness.py, identity when
+# the knob is off): frozen at import time — the chaos runner exports
+# the knob before importing
+from amgcl_tpu.analysis.lockwitness import maybe_wrap as _wit_wrap
+
+_lock = _wit_wrap("flight._lock", threading.Lock())
 _ring: deque = deque(maxlen=RING_CAPACITY)
 _dumps_total = 0
 _dump_seq = 0
@@ -127,8 +132,12 @@ def record_solve(bundle, rhs, x0, report) -> None:
         ref = weakref.ref(bundle)
     except TypeError:
         ref = (lambda b: (lambda: b))(bundle)
-    _ring.append({"ts": time.time(), "bundle": ref, "rhs": rhs,
-                  "x0": x0, "report": report})
+    with _lock:
+        # same guard as _reset_for_tests/dump: solves record from any
+        # thread (the serve worker included), and the ring's guard
+        # contract is enforced by the guarded-by analysis
+        _ring.append({"ts": time.time(), "bundle": ref, "rhs": rhs,
+                      "x0": x0, "report": report})
 
 
 def last_capsule() -> Optional[Dict[str, Any]]:

@@ -162,6 +162,18 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "farm_load_shed_total": (
         "counter", "load-shedding episodes per tenant under sustained "
                    "SLO breach (label: tenant)"),
+    # -- runtime lock witness (analysis/lockwitness.py) -------------------
+    "lock_witness_edges": (
+        "gauge", "distinct witnessed lock-acquisition-order edges "
+                 "(AMGCL_TPU_LOCK_WITNESS=1; must stay a subset of "
+                 "the static lock graph)"),
+    "lock_witness_max_hold_ms": (
+        "gauge", "longest witnessed lock hold in milliseconds "
+                 "(condition waits excluded)"),
+    "lock_witness_watchdog_trips": (
+        "gauge", "starvation-watchdog trips: blocking acquires that "
+                 "exceeded AMGCL_TPU_LOCK_WITNESS_TIMEOUT_S (zero is "
+                 "the chaos-matrix acceptance bar)"),
     # -- operator X-ray (telemetry/structure.py) --------------------------
     "xray_padding_waste_frac": (
         "gauge", "finest-level ELL lane-padding waste fraction from "
@@ -221,6 +233,14 @@ class LiveRegistry:
                                 else labels_spec)
         self.hist_cap = int(hist_cap)
         self._lock = threading.Lock()
+        # runtime lock witness seam (identity when the knob is
+        # off); the registry lock is a LEAF of the static graph —
+        # holding it must acquire nothing else
+        try:
+            from amgcl_tpu.analysis import lockwitness as _lw
+            _lw.maybe_instrument(self, "live")
+        except ImportError:       # file-path load (sink.py
+            pass                  # discipline): coverage skipped
         #: (name, labels-tuple) -> float, labels sorted for identity
         self._counters: Dict[Tuple[str, Tuple], float] = {}
         #: (name, labels-tuple) -> float — unlabeled gauges key on

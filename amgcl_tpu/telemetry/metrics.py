@@ -103,6 +103,13 @@ EVENT_FIELDS = {
     "bench_xray": [("predicted_gain", "join.predicted_gain"),
                    ("measured_gain", "join.measured_gain"),
                    ("gain_ratio", "join.ratio")],
+    # runtime lock witness (analysis/lockwitness.py): the per-run
+    # witnessed-edge / hold-time / watchdog record the chaos matrix
+    # emits under AMGCL_TPU_LOCK_WITNESS=1 — declared here so
+    # rollup_events / --trend aggregate it instead of skipping it
+    "lock_witness": [("witness_edges", "edges_total"),
+                     ("witness_max_hold_ms", "max_hold_ms"),
+                     ("witness_watchdog_trips", "watchdog_trips")],
 }
 
 

@@ -29,6 +29,8 @@ import time
 from contextlib import contextmanager, nullcontext
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from amgcl_tpu.analysis import lockwitness as _lockwitness
+
 PREFIX = "amgcl/"
 
 
@@ -65,6 +67,8 @@ class RequestSpans:
     def __init__(self, max_events: int = 100_000):
         self.max_events = int(max_events)
         self._lock = threading.Lock()
+        # runtime lock witness seam (identity when the knob is off)
+        _lockwitness.maybe_instrument(self, "tracing")
         #: (path, start_s, end_s) — the Profiler.events triple
         self.events: List[Tuple[str, float, float]] = []
         self.dropped = 0

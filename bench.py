@@ -2901,9 +2901,22 @@ def main_check(targets=None):
                 "audit_records": len(audit.get("records", [])),
                 "audit_errors": audit.get("errors", 0),
             }
+            conc = arec.get("concurrency") or {}
+            if conc:
+                # concurrency contract analyzer counts (lock-order /
+                # guarded-by / cv- / handoff-discipline) — new findings
+                # fail the round through the shared arec["ok"] gate
+                rec["analysis"]["concurrency"] = {
+                    "total": conc.get("total", 0),
+                    "new": len(conc.get("new", [])),
+                    "suppressed": conc.get("suppressed", 0),
+                    "modules": len(conc.get("modules", [])),
+                    "rules": conc.get("rules", []),
+                }
             if not analysis_ok:
                 # the actionable payload rides the CI record
-                rec["analysis"]["new_findings"] = arec["lint"]["new"]
+                rec["analysis"]["new_findings"] = (
+                    arec["lint"]["new"] + list(conc.get("new", [])))
                 rec["analysis"]["audit_findings"] = [
                     f for f in audit.get("findings", [])
                     if f.get("severity") == "error"]

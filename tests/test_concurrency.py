@@ -1,0 +1,723 @@
+"""Concurrency contract analyzer + runtime lock witness (ISSUE 15):
+the four negative-injection fixtures (each rule must FIRE on an
+injected bug and stay quiet on the disciplined variant), the repo's
+own clean bill against the committed baseline, the witnessed-⊆-static
+validation loop, and targeted regressions for the true positives the
+analyzer surfaced in-tree (stranded futures resolved under _mem_lock,
+timeout/failed-batch stats committed after the futures resolved, the
+flight-ring append outside its lock)."""
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import textwrap
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from amgcl_tpu.analysis import concurrency
+from amgcl_tpu.analysis import lockwitness as lw
+from amgcl_tpu.analysis.lint import format_findings
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _fixture(tmp_path, src, name="mod.py"):
+    pkg = tmp_path / "pkg"
+    pkg.mkdir(exist_ok=True)
+    (pkg / name).write_text(textwrap.dedent(src))
+    return concurrency.run_concurrency(root=str(pkg), modules=(name,))
+
+
+def _rules(findings):
+    return sorted({f["rule"] for f in findings})
+
+
+# ===========================================================================
+# negative-injection fixtures — one per analysis
+# ===========================================================================
+
+def test_lock_order_inversion_fires(tmp_path):
+    """An acquisition order inverted against the declared LOCK_ORDER
+    is a finding (and the union graph reports the cycle)."""
+    fs = _fixture(tmp_path, """
+        import threading
+
+        LOCK_ORDER = (("_a", "_b"),)
+
+        class S:
+            def __init__(self):
+                self._a = threading.Lock()
+                self._b = threading.Lock()
+
+            def good(self):
+                with self._a:
+                    with self._b:
+                        pass
+
+            def bad(self):
+                with self._b:
+                    with self._a:
+                        pass
+    """)
+    hits = [f for f in fs if f["rule"] == "lock-order"]
+    assert any(f["symbol"] == "mod._b->mod._a" for f in hits), \
+        format_findings(fs)
+    # the declared direction stays quiet
+    assert not any(f["symbol"] == "mod._a->mod._b" for f in hits)
+    # both directions observed = a reachable deadlock cycle
+    assert any("cycle" in f["message"] for f in fs)
+
+
+def test_guarded_by_unguarded_thread_write_fires(tmp_path):
+    """A field dominantly written under a lock, written lock-free from
+    a Thread-target call tree — the PR-8/PR-13 race shape."""
+    fs = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def start(self):
+                threading.Thread(target=self._work).start()
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+
+            def drain(self):
+                with self._lock:
+                    self._count = 0
+
+            def _work(self):
+                self._count += 1
+    """)
+    hits = [f for f in fs if f["rule"] == "guarded-by"]
+    assert len(hits) == 1 and hits[0]["symbol"] == "S._count", \
+        format_findings(fs)
+    assert "mod._lock" in hits[0]["message"]
+
+
+def test_guarded_by_respects_declared_allowlist(tmp_path):
+    """The same bug with the field declared UNGUARDED_OK (with a
+    reason) is accepted — the allowlist is the contract seam."""
+    fs = _fixture(tmp_path, """
+        import threading
+
+        UNGUARDED_OK = {"_count": "single-writer probe counter"}
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._count = 0
+
+            def start(self):
+                threading.Thread(target=self._work).start()
+
+            def bump(self):
+                with self._lock:
+                    self._count += 1
+
+            def drain(self):
+                with self._lock:
+                    self._count = 0
+
+            def _work(self):
+                self._count += 1
+    """)
+    assert [f for f in fs if f["rule"] == "guarded-by"] == []
+
+
+def test_cv_wait_without_predicate_loop_fires(tmp_path):
+    fs = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cond = threading.Condition()
+                self._ready = False
+
+            def consume_bad(self):
+                with self._cond:
+                    self._cond.wait(timeout=1.0)
+                    return self._ready
+
+            def consume_good(self):
+                with self._cond:
+                    while not self._ready:
+                        self._cond.wait(timeout=1.0)
+                    return self._ready
+
+            def consume_wait_for(self):
+                with self._cond:
+                    self._cond.wait_for(lambda: self._ready)
+                    return self._ready
+    """)
+    hits = [f for f in fs if f["rule"] == "cv-discipline"]
+    assert {f["symbol"] for f in hits} == {"S.consume_bad"}, \
+        format_findings(fs)
+
+
+def test_cv_notify_on_lock_free_path_fires(tmp_path):
+    fs = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._cond = threading.Condition()
+
+            def wake_bad(self):
+                self._cond.notify_all()
+
+            def wake_good(self):
+                with self._cond:
+                    self._cond.notify_all()
+
+            def _wake_locked(self):
+                # lexically lock-free but only ever CALLED under the
+                # lock — the propagated held-set accepts it
+                self._cond.notify_all()
+
+            def wake_via_helper(self):
+                with self._cond:
+                    self._wake_locked()
+    """)
+    hits = [f for f in fs if f["rule"] == "cv-discipline"]
+    assert {f["symbol"] for f in hits} == {"S.wake_bad"}, \
+        format_findings(fs)
+
+
+def test_handoff_set_result_under_lock_fires(tmp_path):
+    fs = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def finish_bad(self, fut, value):
+                with self._lock:
+                    fut.set_result(value)
+
+            def finish_good(self, fut, value):
+                with self._lock:
+                    pass
+                fut.set_result(value)
+    """)
+    hits = [f for f in fs if f["rule"] == "handoff-discipline"]
+    assert {f["symbol"] for f in hits} == {"S.finish_bad"}, \
+        format_findings(fs)
+
+
+def test_handoff_resolve_before_locked_commit_fires(tmp_path):
+    """The resolve-last discipline: a future resolved before a later
+    locked stats commit in the same function is the PR-11 bug shape."""
+    fs = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._n = 0
+
+            def complete_bad(self, fut, value):
+                fut.set_result(value)
+                with self._lock:
+                    self._n += 1
+
+            def complete_good(self, fut, value):
+                with self._lock:
+                    self._n += 1
+                fut.set_result(value)
+    """)
+    hits = [f for f in fs if f["rule"] == "handoff-discipline"]
+    assert {f["symbol"] for f in hits} == {"S.complete_bad"}, \
+        format_findings(fs)
+
+
+def test_blocking_call_under_lock_fires(tmp_path):
+    """Rule 4's blocking leg: a sleep / timeout-less queue get inside
+    a lock-held region (Condition.wait stays exempt)."""
+    fs = _fixture(tmp_path, """
+        import queue
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.queue = queue.Queue()
+
+            def bad_sleep(self):
+                with self._lock:
+                    time.sleep(0.5)
+
+            def bad_get(self):
+                with self._lock:
+                    return self.queue.get()
+
+            def good_get(self):
+                with self._lock:
+                    return self.queue.get(timeout=0.1)
+    """)
+    hits = [f for f in fs if f["rule"] == "handoff-discipline"]
+    assert {f["symbol"] for f in hits} == {"S.bad_sleep", "S.bad_get"}, \
+        format_findings(fs)
+
+
+def test_reacquire_plain_lock_is_self_deadlock(tmp_path):
+    fs = _fixture(tmp_path, """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._rlock = threading.RLock()
+
+            def bad(self):
+                with self._lock:
+                    with self._lock:
+                        pass
+
+            def fine(self):
+                with self._rlock:
+                    with self._rlock:
+                        pass
+    """)
+    hits = [f for f in fs if f["rule"] == "lock-order"]
+    assert len(hits) == 1 and "self-deadlock" in hits[0]["message"], \
+        format_findings(fs)
+    assert hits[0]["symbol"] == "S.bad"
+
+
+def test_flight_ring_regression_shape_fires(tmp_path):
+    """The exact in-tree bug the analyzer surfaced (flight.py ring
+    append outside the module lock) stays detectable on module-global
+    state."""
+    fs = _fixture(tmp_path, """
+        import threading
+        from collections import deque
+
+        _lock = threading.Lock()
+        _ring: deque = deque(maxlen=8)
+
+        def record(item):
+            _ring.append(item)
+
+        def reset():
+            with _lock:
+                _ring.clear()
+    """)
+    hits = [f for f in fs if f["rule"] == "guarded-by"]
+    assert len(hits) == 1 and hits[0]["symbol"] == "<module>._ring", \
+        format_findings(fs)
+
+
+# ===========================================================================
+# the repo's own clean bill + the exit-1 flip
+# ===========================================================================
+
+def test_repo_concurrency_clean_against_committed_baseline():
+    """Acceptance: the analyzer runs over the declared module set with
+    zero NEW findings against ANALYSIS_BASELINE.json, and every
+    suppression carries a non-empty reason."""
+    from amgcl_tpu import analysis
+    split = analysis.apply_baseline(
+        analysis.run_lint() + concurrency.run_concurrency(),
+        analysis.load_baseline())
+    assert split["new"] == [], format_findings(split["new"])
+    assert split["stale"] == [], split["stale"]
+    for s in (analysis.load_baseline() or {}).get("suppressions", []):
+        assert s.get("reason", "").strip(), \
+            "unexplained suppression: %r" % (s,)
+
+
+def test_declared_contracts_live_next_to_the_code():
+    """LOCK_ORDER / UNGUARDED_OK are declared in serve/farm.py and
+    serve/service.py and the analyzer parses them."""
+    from amgcl_tpu.serve import farm, service
+    assert ("_mem_lock", "_cond") in farm.LOCK_ORDER
+    assert service.LOCK_ORDER == ()
+    assert "_thread" in service.UNGUARDED_OK
+    assert all(v.strip() for v in farm.UNGUARDED_OK.values())
+    assert all(v.strip() for v in service.UNGUARDED_OK.values())
+    graph = concurrency.static_lock_graph()
+    assert ["farm._mem_lock", "farm._cond"] in \
+        [list(e) for e in graph["allowed"]]
+    # every utility lock the witness can see must derive as a leaf —
+    # losing one (e.g. a seam-wrapped constructor the discovery stops
+    # recognizing) turns legal runtime edges into violations
+    for leaf in ("live._lock", "sink._lock", "tracing._lock",
+                 "flight._lock", "recovery._lock", "inject._lock",
+                 "service._lock"):
+        assert leaf in graph["leaves"], (leaf, graph["leaves"])
+
+
+def test_negative_injections_flip_gate_to_exit_1(tmp_path):
+    """Acceptance: each of the four negative injections — a lock-order
+    inversion, an unguarded field write, a bare wait() outside a
+    predicate loop, a set_result under a lock — planted in a copy of
+    the tree flips `python -m amgcl_tpu.analysis` to exit 1 with the
+    expected (rule, file, symbol) finding."""
+    dst = tmp_path / "amgcl_tpu"
+    shutil.copytree(os.path.join(_REPO, "amgcl_tpu"), dst,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    farm = dst / "serve" / "farm.py"
+    farm.write_text(farm.read_text() + textwrap.dedent("""
+
+    def _injected_inversion(self):
+        with self._cond:
+            with self._mem_lock:
+                pass
+
+
+    def _injected_bare_wait(self):
+        with self._mem_lock:
+            self._mem_cond.wait(timeout=0.1)
+
+
+    def _injected_resolve_under_lock(self, fut):
+        with self._mem_lock:
+            fut.set_result(None)
+    """))
+    service = dst / "serve" / "service.py"
+    service.write_text(service.read_text() + textwrap.dedent("""
+
+    def _injected_unguarded_write(self):
+        self._n_timeouts += 1
+    """))
+    r = subprocess.run(
+        [sys.executable, "-m", "amgcl_tpu.analysis", "--no-audit",
+         "--json", "--root", str(dst)],
+        capture_output=True, text=True, timeout=300, cwd=_REPO,
+        env=dict(os.environ))
+    assert r.returncode == 1, r.stdout + r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    keys = {(f["rule"], f["file"], f["symbol"])
+            for f in rec["concurrency"]["new"]}
+    farm_rel = "amgcl_tpu/serve/farm.py"
+    service_rel = "amgcl_tpu/serve/service.py"
+    assert ("lock-order", farm_rel,
+            "farm._cond->farm._mem_lock") in keys, keys
+    assert ("cv-discipline", farm_rel, "_injected_bare_wait") in keys
+    assert ("handoff-discipline", farm_rel,
+            "_injected_resolve_under_lock") in keys
+    assert ("guarded-by", service_rel,
+            "SolverService._n_timeouts") in keys
+
+
+# ===========================================================================
+# runtime lock witness
+# ===========================================================================
+
+@pytest.fixture
+def witness(monkeypatch):
+    monkeypatch.setenv("AMGCL_TPU_LOCK_WITNESS", "1")
+    lw._reset_for_tests()
+    yield lw
+    lw._reset_for_tests()
+
+
+def test_witness_off_is_identity(monkeypatch):
+    monkeypatch.delenv("AMGCL_TPU_LOCK_WITNESS", raising=False)
+    raw = threading.Lock()
+    assert lw.maybe_wrap("x._l", raw) is raw
+
+
+def test_witness_records_edges_and_checks_subset(witness):
+    a = lw.maybe_wrap("wt._a", threading.Lock())
+    b = lw.maybe_wrap("wt._b", threading.Lock())
+    with a:
+        with b:
+            pass
+    with a:        # second visit: count bumps, edge set stays 1
+        with b:
+            pass
+    snap = lw.report()
+    assert snap["edges"] == [
+        {"src": "wt._a", "dst": "wt._b", "count": 2}]
+    assert snap["holds"]["wt._a"]["count"] == 2
+    ok = lw.check_witness(
+        graph={"allowed": [("wt._a", "wt._b")], "leaves": []},
+        snapshot=snap)
+    assert ok["ok"] and ok["violations"] == []
+    bad = lw.check_witness(graph={"allowed": [], "leaves": []},
+                           snapshot=snap)
+    assert not bad["ok"]
+    assert bad["violations"][0]["src"] == "wt._a"
+    # the cross-module leaf allowance (but not same-module)
+    leafy = lw.check_witness(
+        graph={"allowed": [], "leaves": ["wt._b"]}, snapshot=snap)
+    assert not leafy["ok"]        # same module: leaf does not excuse
+    cross = lw.check_witness(
+        graph={"allowed": [], "leaves": ["other._b"]},
+        snapshot={"edges": [{"src": "wt._a", "dst": "other._b",
+                             "count": 1}],
+                  "edges_total": 1, "watchdog_trips": 0,
+                  "max_hold_ms": 0.0})
+    assert cross["ok"]
+
+
+def test_witness_condition_canonicalizes_onto_its_lock(witness):
+    class Obj:
+        pass
+
+    o = Obj()
+    o._mem_lock = threading.RLock()
+    o._mem_cond = threading.Condition(o._mem_lock)
+    o._cond = threading.Condition()
+    lw.maybe_instrument(o, "fx")
+    assert o._mem_cond.name == "fx._mem_lock"
+    with o._mem_lock:
+        with o._mem_cond:            # re-entry, not an edge
+            o._mem_cond.wait(timeout=0.01)
+        with o._cond:
+            pass
+    snap = lw.report()
+    edges = {(e["src"], e["dst"]) for e in snap["edges"]}
+    assert ("fx._mem_lock", "fx._cond") in edges
+    assert all(src != dst for src, dst in edges)
+    # wait released the lock: the recorded hold must be far below the
+    # wall the wait would have added had it been counted
+    assert "fx._mem_lock" in snap["holds"]
+
+
+def test_witness_watchdog_trips_on_starved_acquire(witness,
+                                                   monkeypatch):
+    monkeypatch.setenv("AMGCL_TPU_LOCK_WITNESS_TIMEOUT_S", "0.1")
+    lock = lw.maybe_wrap("wt._wd", threading.Lock())
+    lock.acquire()
+    landed = []
+
+    def worker():
+        lock.acquire()
+        landed.append(True)
+        lock.release()
+
+    th = threading.Thread(target=worker, daemon=True)
+    th.start()
+    time.sleep(0.35)
+    lock.release()
+    th.join(5)
+    assert landed, "starved acquire never landed after release"
+    snap = lw.report()
+    assert snap["watchdog_trips"] >= 1
+    assert snap["trips"][0]["lock"] == "wt._wd"
+    # trips fail the verdict even when every edge is legal
+    out = lw.check_witness(graph={"allowed": [], "leaves": []},
+                           snapshot=snap)
+    assert not out["ok"]
+
+
+def test_witness_gauges_ride_the_declared_metric_table(witness):
+    from amgcl_tpu.telemetry.live import LiveRegistry
+    a = lw.maybe_wrap("wt._a", threading.Lock())
+    b = lw.maybe_wrap("wt._b", threading.Lock())
+    with a:
+        with b:
+            pass
+    reg = LiveRegistry()
+    lw.publish_gauges(reg)
+    assert reg.get("lock_witness_edges") == 1
+    assert reg.get("lock_witness_watchdog_trips") == 0
+    assert reg.get("lock_witness_max_hold_ms") is not None
+
+
+def test_witness_instruments_real_service_and_farm(witness):
+    """The constructor seams wrap the real classes' locks when the
+    knob is on (no solve needed — construction is enough)."""
+    from amgcl_tpu.serve.registry import OperatorRegistry
+    from amgcl_tpu.telemetry.live import LiveRegistry
+    from amgcl_tpu.telemetry.tracing import RequestSpans
+    reg = OperatorRegistry()
+    assert isinstance(reg._lock, lw._WitnessLock)
+    assert reg._lock.name == "registry._lock"
+    live = LiveRegistry()
+    assert isinstance(live._lock, lw._WitnessLock)
+    spans = RequestSpans()
+    assert isinstance(spans._lock, lw._WitnessLock)
+    spans.add(1, [("queue", 0.0, 1.0)])        # still functional
+    assert spans.events
+
+
+# ===========================================================================
+# chaos matrix under the witness (witnessed ⊆ static, zero trips)
+# ===========================================================================
+
+def test_chaos_subset_under_lock_witness():
+    """Acceptance: a chaos run with AMGCL_TPU_LOCK_WITNESS=1 passes
+    with witnessed edges ⊆ the static graph and zero watchdog trips
+    (two concurrency-heavy scenarios keep the tier-1 cost bounded;
+    the full matrix rides bench.py --check)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu",
+               AMGCL_TPU_LOCK_WITNESS="1")
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=8"
+                        ).strip()
+    env.pop("AMGCL_TPU_FAULT_PLAN", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "amgcl_tpu.faults", "--selftest",
+         "serve_worker_death", "farm_admission_retry"],
+        capture_output=True, text=True, timeout=420, cwd=_REPO,
+        env=env)
+    assert r.returncode == 0, r.stdout[-3000:] + r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["ok"] and rec["hangs"] == 0
+    witness = rec.get("lock_witness")
+    assert witness and witness["ok"], witness
+    assert witness["watchdog_trips"] == 0
+    assert witness["edges_total"] >= 1          # real nesting observed
+    assert witness["violations"] == []
+
+
+# ===========================================================================
+# regressions for the true positives fixed in-tree
+# ===========================================================================
+
+@pytest.fixture(scope="module")
+def small_bundle():
+    import jax.numpy as jnp
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.models.make_solver import make_solver
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A, rhs = poisson3d(6)
+    bundle = make_solver(A, AMGParams(dtype=jnp.float32,
+                                      coarse_enough=200),
+                         CG(maxiter=50, tol=1e-6))
+    return A, bundle, rhs.astype(np.float32)
+
+
+def test_serve_timeout_stats_commit_before_future_resolves(
+        small_bundle):
+    """Regression (handoff-discipline): a queue-expired request's
+    done-callback must already see the timeout in stats() — the
+    resolve-last ordering _run_batch previously violated."""
+    from amgcl_tpu.serve.service import SolverService
+    _A, bundle, rhs = small_bundle
+    svc = SolverService(bundle, batch=2, flush_ms=10, metrics_port=-1)
+    try:
+        seen = []
+        done = threading.Event()
+        fut = svc.submit(rhs, timeout_s=0.0)
+        fut.add_done_callback(
+            lambda f: (seen.append(svc.stats()["timeouts"]),
+                       done.set()))
+        assert done.wait(60), "timeout future never resolved"
+        assert isinstance(fut.exception(), TimeoutError)
+        assert seen and seen[0] >= 1, \
+            "future resolved before its timeout was booked"
+    finally:
+        svc.close()
+
+
+def test_serve_failed_batch_stats_commit_before_future_resolves(
+        small_bundle, monkeypatch):
+    """Regression (handoff-discipline): a failed batch's done-callback
+    must already see the failure in stats()["unhealthy"]."""
+    from amgcl_tpu.faults import inject
+    from amgcl_tpu.serve.service import SolverService
+    _A, bundle, rhs = small_bundle
+    monkeypatch.setenv("AMGCL_TPU_FAULT_PLAN", json.dumps(
+        {"site": "serve.poison", "rid": 1, "count": -1}))
+    inject._reset_for_tests()
+    svc = SolverService(bundle, batch=2, flush_ms=10, metrics_port=-1)
+    try:
+        seen = []
+        done = threading.Event()
+        fut = svc.submit(rhs)
+        fut.add_done_callback(
+            lambda f: (seen.append(svc.stats()["unhealthy"]),
+                       done.set()))
+        assert done.wait(60), "poisoned future never resolved"
+        assert fut.exception() is not None
+        assert seen and seen[0] >= 1, \
+            "future resolved before its failure was booked"
+    finally:
+        svc.close()
+        inject._reset_for_tests()
+
+
+def test_farm_stranded_future_resolves_outside_mem_lock(small_bundle):
+    """Regression (handoff-discipline): a request stranded by a
+    different-size re-register resolves AFTER _mem_lock drops — its
+    done-callback can coordinate with a thread that needs the farm's
+    control plane (the old in-lock resolution deadlocked this)."""
+    from amgcl_tpu.serve.farm import SolverFarm, _FarmRequest
+    from amgcl_tpu.utils.sample_problem import poisson3d
+    A1, _bundle, rhs1 = small_bundle
+    A2, _rhs2 = poisson3d(7)
+    farm = SolverFarm(max_bytes=0, metrics_port=-1)
+    try:
+        farm.register("t", A1)
+        req = _FarmRequest(rhs1, 30.0, rid=77, tenant="t")
+        with farm._cond:
+            farm.tenants["t"].q.append(req)
+        lock_free = []
+        cb_done = threading.Event()
+
+        def cb(_fut):
+            probe = threading.Event()
+            res = []
+
+            def helper():
+                got = farm._mem_lock.acquire(timeout=2.0)
+                res.append(got)
+                if got:
+                    farm._mem_lock.release()
+                probe.set()
+
+            threading.Thread(target=helper, daemon=True).start()
+            probe.wait(5.0)
+            lock_free.append(bool(res and res[0]))
+            cb_done.set()
+
+        req.public.add_done_callback(cb)
+        farm.register("t", A2)            # different n: strands req
+        assert cb_done.wait(10.0), "stranded future never resolved"
+        assert isinstance(req.public.exception(), RuntimeError)
+        assert "system size" in str(req.public.exception())
+        assert lock_free == [True], \
+            "public future resolved while _mem_lock was held"
+    finally:
+        farm.close()
+
+
+def test_flight_record_solve_is_lock_guarded(tmp_path, monkeypatch):
+    """Regression (guarded-by): concurrent record_solve against
+    _reset_for_tests keeps the ring consistent (the append now runs
+    under the module lock, like every other ring access)."""
+    from amgcl_tpu.telemetry import flight
+    monkeypatch.setenv("AMGCL_TPU_FLIGHT_DIR", str(tmp_path))
+    flight._reset_for_tests()
+    errs = []
+
+    def writer():
+        try:
+            for i in range(200):
+                flight.record_solve(None, np.zeros(3), None, None)
+        except Exception as e:           # noqa: BLE001
+            errs.append(e)
+
+    def resetter():
+        try:
+            for _ in range(50):
+                flight._reset_for_tests()
+        except Exception as e:           # noqa: BLE001
+            errs.append(e)
+
+    threads = [threading.Thread(target=writer) for _ in range(4)] \
+        + [threading.Thread(target=resetter)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs
+    assert len(flight._ring) <= flight.RING_CAPACITY
+    flight._reset_for_tests()
