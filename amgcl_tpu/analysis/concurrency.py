@@ -78,6 +78,7 @@ CONCURRENT_MODULES: Tuple[str, ...] = (
     "serve/service.py",
     "serve/farm.py",
     "serve/registry.py",
+    "serve/storm.py",
     "telemetry/flight.py",
     "telemetry/live.py",
     "telemetry/sink.py",

@@ -19,6 +19,11 @@ Four legs:
   admission/eviction, cross-tenant (n, B) bucket packing behind a
   fair-share dispatch loop, per-tenant SLO watchdogs and labeled
   ``/metrics``.
+* ``serve.storm`` — the seeded OPEN-LOOP load generator
+  (:func:`run_storm` / :func:`run_ladder`): Poisson/burst/ramp arrival
+  schedules driving a farm or service with latency measured from the
+  SCHEDULED arrival, feeding ``telemetry/load.py``'s saturation
+  analytics and ``bench --storm``.
 """
 
 from amgcl_tpu.serve.batched import (BlockCG, STACKED_LOWERING,
@@ -28,7 +33,12 @@ from amgcl_tpu.serve.farm import SolverFarm
 from amgcl_tpu.serve.registry import (OperatorRegistry,
                                       sparsity_fingerprint)
 from amgcl_tpu.serve.service import SolverService
+from amgcl_tpu.serve.storm import (build_schedule, burst_phase,
+                                   poisson_phase, ramp_phase,
+                                   run_ladder, run_storm)
 
 __all__ = ["BlockCG", "OperatorRegistry", "STACKED_LOWERING",
-           "SolverFarm", "SolverService", "decode_batched_health",
-           "lowering_kind", "sparsity_fingerprint", "vmap_solve"]
+           "SolverFarm", "SolverService", "build_schedule",
+           "burst_phase", "decode_batched_health", "lowering_kind",
+           "poisson_phase", "ramp_phase", "run_ladder", "run_storm",
+           "sparsity_fingerprint", "vmap_solve"]

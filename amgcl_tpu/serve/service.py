@@ -1294,6 +1294,11 @@ class SolverService:
             out["recovery"] = rec
         if self.metrics_server is not None:
             out["metrics_port"] = self.metrics_server.port
+        # the live-registry histograms behind /metrics are ROLLING
+        # windows (deque maxlen) — surface the capacity so readers know
+        # their quantiles cover at most the last N observations, not
+        # the lifetime (the lifetime percentiles are latency_s above)
+        out["histogram_window"] = self.live.hist_cap
         return out
 
     def _fail_stragglers(self):

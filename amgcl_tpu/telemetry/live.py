@@ -174,6 +174,21 @@ METRICS: Dict[str, Tuple[str, str]] = {
         "gauge", "starvation-watchdog trips: blocking acquires that "
                  "exceeded AMGCL_TPU_LOCK_WITNESS_TIMEOUT_S (zero is "
                  "the chaos-matrix acceptance bar)"),
+    # -- open-loop storm harness (serve/storm.py) -------------------------
+    "storm_offered_rps": (
+        "gauge", "offered arrival rate of the storm rung currently "
+                 "driving this target (open-loop schedule)"),
+    "storm_submitted_total": (
+        "counter", "storm requests submitted (every scheduled arrival, "
+                   "whether accepted or shed)"),
+    "storm_shed_total": (
+        "counter", "storm requests rejected at submit (queue.Full / "
+                   "load shed) — excluded from goodput"),
+    "storm_sched_lag_ms": (
+        "histogram", "generator lag: actual submit minus scheduled "
+                     "arrival (a loaded generator under-drives the "
+                     "target; large lag invalidates the open-loop "
+                     "contract)"),
     # -- operator X-ray (telemetry/structure.py) --------------------------
     "xray_padding_waste_frac": (
         "gauge", "finest-level ELL lane-padding waste fraction from "
@@ -310,7 +325,11 @@ class LiveRegistry:
     def snapshot(self) -> Dict[str, Any]:
         """JSON-clean copy: counters and gauges (labels flattened into
         the key), and histogram rollups ({count, min, p50, p90, p99,
-        max, mean, last} via the fleet percentile helpers)."""
+        max, mean, last} via the fleet percentile helpers). Each rollup
+        carries ``window``: the deque capacity — histogram percentiles
+        cover AT MOST the last ``window`` observations; under sustained
+        load older samples have been dropped, so a lifetime p99 is not
+        recoverable from this surface (by design: bounded memory)."""
         with self._lock:
             counters = {name + _prom_labels(labels): v
                         for (name, labels), v in self._counters.items()}
@@ -318,7 +337,8 @@ class LiveRegistry:
                       for (name, labels), v in self._gauges.items()}
             hists = {name: list(h) for name, h in self._hists.items()}
         return {"counters": counters, "gauges": gauges,
-                "histograms": {name: _metrics.rollup(vals)
+                "histograms": {name: dict(_metrics.rollup(vals),
+                                          window=self.hist_cap)
                                for name, vals in hists.items()
                                if vals}}
 
@@ -352,6 +372,15 @@ class LiveRegistry:
                    ((name, _metrics.rollup(vals))
                     for name, vals in sorted(hists.items()))
                    if r is not None}
+        for name in rollups:
+            # histogram HELP carries the WINDOW: the backing deque keeps
+            # only the last hist_cap observations, so the quantile
+            # gauges below are rolling-window, not lifetime
+            lines.append(
+                "# HELP %s %s (rolling window: last %d observations)"
+                % (_prom_name(prefix, name),
+                   self.spec.get(name, ("", "histogram"))[1],
+                   self.hist_cap))
         text = "\n".join(lines) + ("\n" if lines else "")
         if rollups:
             text += _metrics.prometheus_text(rollups, prefix=prefix)

@@ -68,6 +68,17 @@ MULTICHIP_TREND_FIELDS = [
     ("iters", "headline.iters"),
 ]
 
+#: storm trend fields (the structured ``STORM_r*.json`` schema emitted
+#: by ``bench.py --storm`` — the open-loop saturation record)
+STORM_TREND_FIELDS = [
+    ("max_rps", "record.knee.max_sustainable_rps"),
+    ("knee_rps", "record.knee.knee_offered_rps"),
+    ("ref_p99_ms", "record.reference.p99_ms"),
+    ("ref_rps", "record.reference.offered_rps"),
+    ("good_frac", "record.goodput.good_frac"),
+    ("requests", "record.goodput.requests"),
+]
+
 #: sink-event rollup spec: {event: [(metric, dotted path)]}
 EVENT_FIELDS = {
     "solve": [("iters", "iters"), ("solve_time_s", "wall_time_s"),
@@ -103,6 +114,20 @@ EVENT_FIELDS = {
     "bench_xray": [("predicted_gain", "join.predicted_gain"),
                    ("measured_gain", "join.measured_gain"),
                    ("gain_ratio", "join.ratio")],
+    # open-loop storm harness (serve/storm.py + bench.py --storm): the
+    # per-storm traffic record and the assembled saturation record —
+    # declared here so rollup_events / --trend aggregate them
+    "storm": [("offered_rps", "offered_rps"),
+              ("achieved_rps", "achieved_rps"),
+              ("goodput_rps", "goodput_rps"),
+              ("p99_ms", "p99_ms"),
+              ("shed_rate", "shed_rate"),
+              ("timeout_rate", "timeout_rate")],
+    "bench_storm": [("max_sustainable_rps",
+                     "record.knee.max_sustainable_rps"),
+                    ("knee_offered_rps", "record.knee.knee_offered_rps"),
+                    ("ref_p99_ms", "record.reference.p99_ms"),
+                    ("good_frac", "record.goodput.good_frac")],
     # runtime lock witness (analysis/lockwitness.py): the per-run
     # witnessed-edge / hold-time / watchdog record the chaos matrix
     # emits under AMGCL_TPU_LOCK_WITNESS=1 — declared here so
@@ -240,6 +265,33 @@ def multichip_history(repo: str) -> List[Dict[str, Any]]:
             # structured records use so the trend column joins
             row = {"legacy_dryrun": True, "ok": rec.get("ok"),
                    "headline": {"devices": rec.get("n_devices")}}
+        row["round"] = int(m.group(1))
+        row["path"] = os.path.basename(path)
+        rows.append(row)
+    rows.sort(key=lambda r: r["round"])
+    return rows
+
+
+_STORM_ROUND_RE = re.compile(r"STORM_r(\d+)\.json$")
+
+
+def storm_history(repo: str) -> List[Dict[str, Any]]:
+    """The committed per-round storm records, sorted by round — same
+    shape discipline as :func:`multichip_history` (records are always
+    structured; there is no legacy storm format)."""
+    rows = []
+    for path in glob.glob(os.path.join(repo, "STORM_r*.json")):
+        m = _STORM_ROUND_RE.search(path)
+        if not m:
+            continue
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if not isinstance(rec, dict):
+            continue
+        row = dict(rec)
         row["round"] = int(m.group(1))
         row["path"] = os.path.basename(path)
         rows.append(row)

@@ -91,6 +91,24 @@ BENCH_LAST_GOOD.json, and embeds the last-good result in any failure JSON.
                                     # emitted as a bench_farm JSONL record
                                     # and gated round-over-round via
                                     # AMGCL_TPU_GATE_FARM
+    python bench.py --storm [--smoke] [--trace PATH]
+                                    # OPEN-LOOP load harness
+                                    # (serve/storm.py): a seeded Poisson
+                                    # offered-load ladder + a mixed
+                                    # poisson/burst/ramp profile storm
+                                    # through a multi-tenant SolverFarm,
+                                    # latency measured from SCHEDULED
+                                    # arrival (no coordinated omission);
+                                    # emits ONE bench_storm record with
+                                    # the latency-vs-load curve, the
+                                    # saturation knee, goodput accounting
+                                    # and per-phase span attribution,
+                                    # writes STORM_LATEST.json, gated
+                                    # round-over-round via
+                                    # AMGCL_TPU_GATE_STORM. --smoke is
+                                    # the seeded ~10 s CI variant;
+                                    # --trace PATH writes the Perfetto
+                                    # storm timeline
 
 All JSON emission routes through the telemetry sink
 (amgcl_tpu/telemetry/sink.py) — loaded by FILE PATH below because the sink
@@ -1364,7 +1382,18 @@ def _serve_latency(slv, rhs_dev, B, factor=2):
     bucket ``B`` — the serving numbers (queue wait + padding + solve +
     sync), not the bare stacked-dispatch rate. ``factor * B`` requests
     give the bucket at least two full batches. Never fails the bench:
-    errors come back as ``latency_error``."""
+    errors come back as ``latency_error``.
+
+    This harness is CLOSED-LOOP (submit blocks when the queue fills, so
+    the arrival process slows down with the server — coordinated
+    omission), and its rows say so: ``closed_loop``/``latency_basis``
+    label the service-measured ``latency_ms`` percentiles, and
+    ``open_loop_latency_ms`` carries the honest companion derived from
+    INTENDED arrivals — every request here is intended at t0 (a burst
+    the loop would fire instantly if never blocked), so its open-loop
+    latency is completion minus t0, queueing included. The open-loop
+    storm harness (``bench --storm``) measures the same quantity under
+    a real arrival process."""
     import numpy as np
     try:
         from amgcl_tpu.serve import SolverService
@@ -1384,18 +1413,33 @@ def _serve_latency(slv, rhs_dev, B, factor=2):
                     for _ in range(max(B, 1))]
             for f in warm:
                 f.result(timeout=600)
+            done_t = []          # completion stamps (done callbacks —
+            #                      list.append is atomic under the GIL)
             t0 = _time.perf_counter()
-            futs = [svc.submit(rhs_host * (1.0 + 0.1 * (k % max(B, 1))),
-                               block=True) for k in range(reqs)]
+            futs = []
+            for k in range(reqs):
+                fut = svc.submit(
+                    rhs_host * (1.0 + 0.1 * (k % max(B, 1))),
+                    block=True)
+                fut.add_done_callback(
+                    lambda f: done_t.append(_time.perf_counter()))
+                futs.append(fut)
             lats = [f.result(timeout=600)[1].serve["latency_ms"]
                     for f in futs]
             wall = _time.perf_counter() - t0
-        out = {}
+        out = {"closed_loop": True, "latency_basis": "submit"}
         if lats:
             out["latency_ms"] = {
                 "p50": round(_metrics.percentile(lats, 50), 3),
                 "p99": round(_metrics.percentile(lats, 99), 3),
                 "max": round(max(lats), 3)}
+        open_lats = [(t - t0) * 1e3 for t in done_t]
+        if open_lats:
+            out["open_loop_latency_ms"] = {
+                "basis": "intended_arrival_t0",
+                "p50": round(_metrics.percentile(open_lats, 50), 3),
+                "p99": round(_metrics.percentile(open_lats, 99), 3),
+                "max": round(max(open_lats), 3)}
         if wall > 0:
             out["service_sps"] = round(reqs / wall, 3)
         return out
@@ -1948,6 +1992,315 @@ def multichip_gate_record():
 
 
 # ===========================================================================
+# storm: open-loop load harness + saturation record, gated round-over-round
+# ===========================================================================
+
+_STORM_LATEST = os.path.join(_REPO, "STORM_LATEST.json")
+
+
+def _storm_env_f(name, default):
+    try:
+        return float(os.environ.get(name, default))
+    except ValueError:
+        return float(default)
+
+
+def main_storm(args=None):
+    """``bench.py --storm [--smoke] [--trace PATH]``: the OPEN-LOOP
+    load harness. Builds a small multi-tenant SolverFarm, runs a seeded
+    Poisson offered-load ladder (rates from ``AMGCL_TPU_STORM_RATES``
+    or auto-calibrated from a quick closed-loop warm burst), then one
+    mixed poisson/burst/ramp profile storm near the sustainable rate —
+    every request timestamped at its SCHEDULED arrival so latency
+    includes the queueing a closed-loop harness hides. Emits ONE
+    schema-versioned ``bench_storm`` record (latency-vs-offered-load
+    curve, saturation knee, goodput accounting, per-phase span
+    attribution, scraped gauge series) and writes ``STORM_LATEST.json``
+    — the ``AMGCL_TPU_GATE_STORM`` candidate. ``--smoke`` is the seeded
+    ~10 s CI variant ``--check`` runs."""
+    from amgcl_tpu.utils.axon_guard import apply_if_cpu_requested
+    apply_if_cpu_requested()
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.serve import storm as S
+    from amgcl_tpu.serve.farm import SolverFarm
+    from amgcl_tpu.solver.cg import CG
+    from amgcl_tpu.telemetry import load as L
+    from amgcl_tpu.telemetry.comm import hw_provenance
+    from amgcl_tpu.utils.sample_problem import poisson3d
+
+    args = list(args or [])
+    smoke = "--smoke" in args
+    trace_path = os.environ.get("AMGCL_TPU_STORM_TRACE")
+    if "--trace" in args:
+        i = args.index("--trace")
+        trace_path = args[i + 1] if i + 1 < len(args) else trace_path
+    on_tpu = jax.default_backend() == "tpu"
+    seed = int(os.environ.get("AMGCL_TPU_STORM_SEED", "0"))
+    base = int(os.environ.get("AMGCL_TPU_STORM_N", "0")) \
+        or (24 if on_tpu else 8)
+    dur = _storm_env_f("AMGCL_TPU_STORM_DURATION_S", 0) \
+        or (1.5 if smoke else 6.0)
+    drain = _storm_env_f("AMGCL_TPU_STORM_DRAIN_S", 30.0)
+    slo_ms = _storm_env_f("AMGCL_TPU_STORM_SLO_MS", 0) or None
+    fault_plan = os.environ.get("AMGCL_TPU_STORM_FAULT_PLAN")
+    n_tenants = 2
+
+    with SolverFarm(metrics_port=0, flush_ms=5.0) as farm:
+        rhs_by = {}
+        for k in range(n_tenants):
+            A, rhs = poisson3d(base + 2 * k)
+            name = "t%d" % k
+            farm.register(name, A, solver=CG(maxiter=100, tol=1e-6),
+                          precond=AMGParams(dtype=jnp.float32,
+                                            coarse_enough=200))
+            rhs_by[name] = np.asarray(rhs)
+        tenants = tuple(sorted(rhs_by))
+
+        def rhs_for(tenant, rid):
+            # mixed-content requests without a per-submit device trip
+            return rhs_by[tenant] * (1.0 + 0.01 * (rid % 17))
+
+        # warm EVERY tenant and every power-of-two bucket width the
+        # storm can pack (1..batch) outside the measured window — an
+        # open-loop storm against cold XLA compiles measures the
+        # compiler, and ONE mid-rung bucket compile stalls the queue
+        # long enough to poison the whole rung's percentiles
+        for name, rhs in rhs_by.items():
+            b = 1
+            while b <= farm.batch:
+                futs = [farm.submit(name, rhs, block=True)
+                        for _ in range(b)]
+                for f in futs:
+                    f.result(timeout=600)
+                b *= 2
+        rates_env = os.environ.get("AMGCL_TPU_STORM_RATES")
+        if rates_env:
+            rates = [float(x) for x in rates_env.split(",")
+                     if x.strip()]
+        else:
+            # auto-calibrate: the warm closed-loop service rate of a
+            # short burst anchors the ladder so the top rung sits past
+            # saturation on any hardware. TWO bursts: the first pays
+            # the partial-bucket compiles its batch widths trigger,
+            # only the second (warm) one is the measurement
+            t0 = time.perf_counter()
+            for _ in range(2):
+                t0 = time.perf_counter()
+                futs = [farm.submit(name, rhs, block=True)
+                        for name, rhs in rhs_by.items()
+                        for _ in range(3)]
+                for f in futs:
+                    f.result(timeout=600)
+            closed_sps = (3 * n_tenants) \
+                / max(time.perf_counter() - t0, 1e-6)
+            anchor = max(closed_sps, 0.5)
+            mult = (0.5, 1.0, 2.0) if smoke \
+                else (0.4, 0.8, 1.2, 1.8, 2.5)
+            rates = [round(anchor * m, 3) for m in mult]
+        rungs = S.run_ladder(farm, rates, dur, rhs_for,
+                             tenants=tenants, seed=seed,
+                             drain_timeout_s=drain,
+                             scrape_every_s=0.2,
+                             fault_plan=fault_plan)
+        # the mixed-phase profile storm near the sustainable rate:
+        # per-phase span attribution + the Perfetto timeline source
+        curve = L.ladder_curve(rungs)
+        knee = L.detect_knee(curve, slo_p99_ms=slo_ms)
+        ms_rate = knee.get("max_sustainable_rps") \
+            or (rates[len(rates) // 2] if rates else 1.0)
+        pdur = dur * (0.7 if smoke else 1.0)
+        phases = [S.poisson_phase(0.8 * ms_rate, pdur),
+                  S.burst_phase(0.5 * ms_rate, pdur,
+                                burst_every_s=max(pdur / 3, 0.4),
+                                burst_len=4),
+                  S.ramp_phase(0.5 * ms_rate, 1.5 * ms_rate, pdur)]
+        sched = S.build_schedule(phases, tenants=tenants, seed=seed)
+        prof = S.run_storm(farm, sched, rhs_for,
+                           drain_timeout_s=drain, scrape_every_s=0.2,
+                           label="profile", fault_plan=fault_plan)
+    by_phase = {}
+    for s in prof["samples"]:
+        by_phase.setdefault(s["phase"], []).append(s)
+    prof_summary = {
+        "phases": [{"kind": p["kind"], "rate_rps": p["rate_rps"],
+                    "duration_s": p["duration_s"]} for p in phases],
+        "summary": prof["summary"],
+        "per_phase": {ph: L.summarize_samples(rows)
+                      for ph, rows in sorted(by_phase.items())},
+    }
+    record = L.build_record(rungs, slo_p99_ms=slo_ms,
+                            profile=prof_summary)
+    # the concurrently scraped /metrics gauge time-series rides the
+    # record (bounded), not just its rollup — queue-depth divergence is
+    # visible in the raw series
+    record["gauge_series"] = prof["gauges"][:400]
+    if trace_path:
+        trace = L.storm_timeline_trace(prof["samples"], prof["gauges"])
+        with open(trace_path, "w") as f:
+            json.dump(trace, f)
+        print("storm timeline written to %s" % trace_path)
+    dev0 = jax.devices()[0]
+    kn = record["knee"]
+    print("storm (%d tenant(s), base n=%d^3, %s, seed %d): "
+          "%d request(s) over %d rung(s) + profile"
+          % (len(tenants), base, dev0.platform, seed,
+             record["goodput"]["requests"], len(rates)))
+    for row in record["curve"]:
+        print("  offered %8.2f rps  goodput %8s rps  p99 %8s ms  "
+              "shed %s" % (row["offered_rps"],
+                           row.get("goodput_rps"), row.get("p99_ms"),
+                           row.get("shed_rate")))
+    print("  knee: %s (max sustainable %s rps%s)"
+          % (kn.get("reason") or "not reached",
+             kn.get("max_sustainable_rps"),
+             ", knee at %s rps" % kn["knee_offered_rps"]
+             if kn.get("knee_offered_rps") else ""))
+    out = {"event": "bench_storm", "record": record,
+           "rates": rates, "duration_s": dur, "seed": seed,
+           "smoke": smoke, "tenants": list(tenants), "n_base": base,
+           "fault_plan": fault_plan,
+           "device": str(dev0), "device_platform": dev0.platform,
+           "device_kind": getattr(dev0, "device_kind", None),
+           "provenance": hw_provenance(), "commit": _git_head()}
+    _stdout_sink.emit(out)
+    _sink.emit(dict(out))
+    with open(_STORM_LATEST, "w") as f:
+        json.dump(out, f, indent=1)
+    return 0
+
+
+def storm_tolerances():
+    """Storm gate tolerances:
+
+      AMGCL_TPU_GATE_STORM — minimum allowed fraction of the baseline's
+                          max sustainable rate (default 0.7: the
+                          candidate regresses when the rate its goodput
+                          sustains below the knee drops under 70% of
+                          the previous round's); 0 disables every storm
+                          check
+      AMGCL_TPU_GATE_STORM_P99 — maximum allowed ratio of the
+                          baseline's p99 latency at the REFERENCE
+                          offered load (the lowest ladder rung; default
+                          1.5). Skipped when the two rounds' reference
+                          rates differ by more than 25% — a ladder
+                          recalibration changes the question, not the
+                          answer.
+    """
+    return {"rate": _storm_env_f("AMGCL_TPU_GATE_STORM", 0.7),
+            "p99": _storm_env_f("AMGCL_TPU_GATE_STORM_P99", 1.5)}
+
+
+def run_storm_gate(candidate, baseline, tol=None):
+    """Compare two ``bench_storm`` records round-over-round: max
+    sustainable rate (higher is better, min-fraction floor) and p99 at
+    the reference offered load (lower is better, max-ratio ceiling,
+    comparability-gated on the reference rate). Platform-mismatched
+    pairs skip every ratio via ``hw_provenance``/``device_platform`` —
+    the multichip-gate rule."""
+    tol = tol or storm_tolerances()
+    if tol["rate"] <= 0:
+        return True, [{"check": "storm", "status": "skipped",
+                       "reason": "disabled (AMGCL_TPU_GATE_STORM=0)"}]
+    checks = []
+    plat_c = _record_platform(candidate)
+    plat_b = _record_platform(baseline)
+    plat_skip = None
+    if plat_c is not None and plat_b is not None and plat_c != plat_b:
+        plat_skip = "platform_mismatch: candidate=%s baseline=%s" \
+            % (plat_c, plat_b)
+    rc = candidate.get("record") or {}
+    rb = baseline.get("record") or {}
+    mc = (rc.get("knee") or {}).get("max_sustainable_rps")
+    mb = (rb.get("knee") or {}).get("max_sustainable_rps")
+    if plat_skip is not None:
+        checks.append({"check": "storm_max_rps", "status": "skipped",
+                       "reason": plat_skip, "candidate": mc,
+                       "last_good": mb})
+    elif mc is None or mb is None:
+        checks.append({"check": "storm_max_rps", "status": "skipped",
+                       "candidate": mc, "last_good": mb})
+    else:
+        floor = mb * tol["rate"]
+        checks.append({"check": "storm_max_rps", "candidate": mc,
+                       "last_good": mb, "limit": round(floor, 6),
+                       "status": "ok" if mc >= floor
+                       else "regression"})
+    refc = rc.get("reference") or {}
+    refb = rb.get("reference") or {}
+    pc, pb = refc.get("p99_ms"), refb.get("p99_ms")
+    ratec, rateb = refc.get("offered_rps"), refb.get("offered_rps")
+    if plat_skip is not None:
+        checks.append({"check": "storm_ref_p99", "status": "skipped",
+                       "reason": plat_skip, "candidate": pc,
+                       "last_good": pb})
+    elif pc is None or pb is None or not ratec or not rateb:
+        checks.append({"check": "storm_ref_p99", "status": "skipped",
+                       "candidate": pc, "last_good": pb})
+    elif abs(ratec - rateb) > 0.25 * max(ratec, rateb):
+        checks.append({"check": "storm_ref_p99", "status": "skipped",
+                       "reason": "reference_rate_mismatch: "
+                                 "candidate=%s baseline=%s rps"
+                                 % (ratec, rateb),
+                       "candidate": pc, "last_good": pb})
+    else:
+        limit = pb * tol["p99"]
+        checks.append({"check": "storm_ref_p99", "candidate": pc,
+                       "last_good": pb, "limit": round(limit, 6),
+                       "status": "ok" if pc <= limit
+                       else "regression"})
+    ok = not any(c["status"] == "regression" for c in checks)
+    return ok, checks
+
+
+def _storm_candidate():
+    """This round's storm record (``--storm`` writes it):
+    ``AMGCL_TPU_GATE_STORM_CANDIDATE`` path override, else
+    ``STORM_LATEST.json``. (None, src) when unreadable/absent."""
+    path = os.environ.get("AMGCL_TPU_GATE_STORM_CANDIDATE",
+                          _STORM_LATEST)
+    try:
+        with open(path) as f:
+            return json.load(f), path
+    except Exception:
+        return None, path
+
+
+def _storm_baseline():
+    """The previous round's committed storm record — the newest
+    ``STORM_r*.json``."""
+    m = _load_metrics()
+    rows = m.storm_history(_REPO)
+    return rows[-1] if rows else None
+
+
+def storm_gate_record():
+    """The storm arm of ``--gate``/``--check``: None when the feature
+    is unused (no candidate AND no baseline), a gate sub-record
+    otherwise — the multichip-arm contract."""
+    tol = storm_tolerances()
+    cand, src = _storm_candidate()
+    base = _storm_baseline()
+    if cand is None and base is None:
+        return None
+    if cand is None:
+        return {"ok": True, "status": "no_candidate",
+                "candidate_src": src, "tolerances": tol}
+    if base is None:
+        return {"ok": True, "status": "no_baseline",
+                "candidate_src": src, "tolerances": tol}
+    ok, checks = run_storm_gate(cand, base, tol)
+    out = {"ok": ok, "candidate_src": src,
+           "baseline": base.get("path"), "tolerances": tol,
+           "checks": checks}
+    if not ok:
+        out["failed"] = gate_failures(checks)
+    return out
+
+
+# ===========================================================================
 # regression gate: compare a candidate bench record against the last-good
 # ===========================================================================
 
@@ -2238,6 +2591,13 @@ def main_gate(args=None):
         rec["multichip"] = mc
         ok = ok and mc["ok"]
         rec["ok"] = ok
+    # storm arm: this round's --storm record vs the previous round's
+    # committed STORM_r*.json (AMGCL_TPU_GATE_STORM)
+    st = storm_gate_record()
+    if st is not None:
+        rec["storm"] = st
+        ok = ok and st["ok"]
+        rec["ok"] = ok
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0 if ok else 1
@@ -2379,6 +2739,17 @@ def main_trend(args=None):
         mc_roll = m.trend_rollups(mc_rows, m.MULTICHIP_TREND_FIELDS)
         for name, r in mc_roll.items():
             rollups["multichip_" + name] = r
+    # storm trajectory: max sustainable rate + reference-load p99 per
+    # committed STORM_r*.json round
+    st_hist = m.storm_history(_REPO)
+    if st_hist:
+        st_rows = m.trend(st_hist, m.STORM_TREND_FIELDS)
+        print("\nstorm trajectory (STORM_r*.json):")
+        print(m.format_trend(st_rows, m.STORM_TREND_FIELDS))
+        rec["storm_rows"] = st_rows
+        st_roll = m.trend_rollups(st_rows, m.STORM_TREND_FIELDS)
+        for name, r in st_roll.items():
+            rollups["storm_" + name] = r
     if args:
         sink_records = m.iter_jsonl(args[0])
         ev_roll = m.rollup_events(sink_records)
@@ -2801,6 +3172,12 @@ def main_check(targets=None):
         if mc is not None:
             rec["multichip"] = mc
             gate_ok = gate_ok and mc["ok"]
+        # storm arm rides --check the same way: a max-sustainable-rate
+        # or reference-p99 regression (AMGCL_TPU_GATE_STORM) fails CI
+        st = storm_gate_record()
+        if st is not None:
+            rec["storm_gate"] = st
+            gate_ok = gate_ok and st["ok"]
     replay_ok = True
     if os.environ.get("AMGCL_TPU_FLIGHT", "1") != "0":
         # determinism self-check (telemetry/flight.py): dump a replay
@@ -2873,6 +3250,38 @@ def main_check(targets=None):
         except Exception as e:
             recovery_ok = False
             rec["recovery"] = {"ok": False, "error": repr(e)[:300]}
+    storm_ok = True
+    if os.environ.get("AMGCL_TPU_STORM_IN_CHECK", "1") != "0":
+        # seeded storm smoke (serve/storm.py): a ~10 s open-loop load
+        # pass on the CPU mesh, so every round carries a measured
+        # load-under-traffic datapoint (curve + knee + goodput). The
+        # subprocess's last stdout line is the bench_storm record; it
+        # also refreshes STORM_LATEST.json for the storm gate arm.
+        s_timeout = _storm_env_f("AMGCL_TPU_STORM_TIMEOUT", 600.0)
+        try:
+            sr = subprocess.run(
+                [sys.executable, os.path.join(_REPO, "bench.py"),
+                 "--storm", "--smoke"],
+                capture_output=True, text=True, timeout=s_timeout,
+                cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            srec = json.loads(sr.stdout.strip().splitlines()[-1])
+            body = srec.get("record") or {}
+            knee = body.get("knee") or {}
+            storm_ok = sr.returncode == 0 and bool(body.get("curve"))
+            rec["storm"] = {
+                "ok": storm_ok,
+                "requests": (body.get("goodput") or {}).get("requests"),
+                "good_frac": (body.get("goodput") or {}).get(
+                    "good_frac"),
+                "max_sustainable_rps": knee.get("max_sustainable_rps"),
+                "saturated": knee.get("saturated"),
+                "knee_reason": knee.get("reason"),
+                "ref_p99_ms": (body.get("reference") or {}).get(
+                    "p99_ms"),
+            }
+        except Exception as e:
+            storm_ok = False
+            rec["storm"] = {"ok": False, "error": repr(e)[:300]}
     analysis_ok = True
     if os.environ.get("AMGCL_TPU_ANALYSIS_IN_CHECK", "1") != "0":
         # static-analysis gate (amgcl_tpu/analysis): AST lint vs the
@@ -2930,7 +3339,7 @@ def main_check(targets=None):
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0 if (rc == 0 and gate_ok and analysis_ok
-                 and replay_ok and recovery_ok) else 1
+                 and replay_ok and recovery_ok and storm_ok) else 1
 
 
 if __name__ == "__main__":
@@ -2962,6 +3371,9 @@ if __name__ == "__main__":
     elif "--farm" in sys.argv:
         extra = sys.argv[sys.argv.index("--farm") + 1:]
         sys.exit(main_farm(extra))
+    elif "--storm" in sys.argv:
+        extra = sys.argv[sys.argv.index("--storm") + 1:]
+        sys.exit(main_storm(extra))
     elif "--scaling" in sys.argv:
         extra = sys.argv[sys.argv.index("--scaling") + 1:]
         sys.exit(main_scaling(extra))

@@ -46,5 +46,12 @@ def test_table_covers_new_knobs():
                 "AMGCL_TPU_FLIGHT", "AMGCL_TPU_FLIGHT_DIR",
                 "AMGCL_TPU_FLIGHT_MAX_DUMPS", "AMGCL_TPU_XRAY",
                 "AMGCL_TPU_XRAY_VARIANTS",
-                "AMGCL_TPU_XRAY_MAX_ADVISE_NNZ"):
+                "AMGCL_TPU_XRAY_MAX_ADVISE_NNZ",
+                "AMGCL_TPU_STORM_SEED", "AMGCL_TPU_STORM_N",
+                "AMGCL_TPU_STORM_DURATION_S", "AMGCL_TPU_STORM_DRAIN_S",
+                "AMGCL_TPU_STORM_SLO_MS", "AMGCL_TPU_STORM_RATES",
+                "AMGCL_TPU_STORM_FAULT_PLAN", "AMGCL_TPU_STORM_TRACE",
+                "AMGCL_TPU_STORM_IN_CHECK", "AMGCL_TPU_STORM_TIMEOUT",
+                "AMGCL_TPU_GATE_STORM", "AMGCL_TPU_GATE_STORM_P99",
+                "AMGCL_TPU_GATE_STORM_CANDIDATE"):
         assert var in documented, var
