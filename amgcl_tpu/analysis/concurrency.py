@@ -81,6 +81,7 @@ CONCURRENT_MODULES: Tuple[str, ...] = (
     "serve/storm.py",
     "telemetry/flight.py",
     "telemetry/live.py",
+    "telemetry/memwatch.py",
     "telemetry/sink.py",
     "telemetry/tracing.py",
     "faults/recovery.py",

@@ -213,6 +213,12 @@ def main(argv=None):
     # dispatch time (utils/profiler.py)
     prof = Profiler.device()
 
+    # memory observatory (telemetry/memwatch.py): the low-overhead
+    # background sampler — only when AMGCL_TPU_MEMWATCH_INTERVAL_MS is
+    # set; phase snapshots fire regardless. Its counter track merges
+    # into --trace below on the profiler's epoch.
+    telemetry.memwatch.start_sampler()
+
     if args.replay:
         return _run_replay(args, prof)
 
@@ -563,7 +569,10 @@ def main(argv=None):
                             comm=dist_comm_rec,
                             # structure leg: --xray's decision ledger +
                             # advisor findings (joined vs --roofline)
-                            structure=xray_rec)
+                            structure=xray_rec,
+                            # memory leg: the measured-vs-ledger join —
+                            # drift/leak findings from the observatory
+                            memory=_doctor_memory_rec(inner))
         print()
         print(format_findings(findings))
         telemetry.emit(event="doctor", findings=findings,
@@ -682,6 +691,12 @@ def main(argv=None):
                 "_prof"].to_chrome_trace(
                 tid=5, tid_name="dist shards",
                 epoch=prof._t0)["traceEvents"]
+        # measured device-memory counter track (memwatch timeline):
+        # bytes_in_use stepping under the flame graph, with instant
+        # markers at the named phases (setup / solve / farm events)
+        trace["traceEvents"] += telemetry.memwatch.to_chrome_trace(
+            tid=6, tid_name="memwatch",
+            epoch=prof._t0)["traceEvents"]
         with open(args.trace, "w") as f:
             _json.dump(trace, f)
         print("trace written to %s (open in ui.perfetto.dev)" % args.trace)
@@ -704,6 +719,21 @@ def main(argv=None):
             pass
         dist_metrics_srv.close()
     return 0
+
+
+def _doctor_memory_rec(bundle):
+    """The doctor's memory leg: the bundle preconditioner's
+    ``memory_report()`` (the measured-vs-ledger join) when the
+    observatory is on; None silences the leg, never an error."""
+    try:
+        from amgcl_tpu.telemetry import memwatch as _mw
+        if not _mw.enabled():
+            return None
+        fn = getattr(getattr(bundle, "precond", None),
+                     "memory_report", None)
+        return fn() if callable(fn) else None
+    except Exception:
+        return None
 
 
 def _run_replay(args, prof):

@@ -58,10 +58,23 @@ class LoadShedError(FaultError):
     another replica."""
 
 
-class AdmissionError(FaultError):
+class AllocationError(FaultError):
+    """Device memory allocation failed — a real backend
+    ``RESOURCE_EXHAUSTED`` caught at a solve/serve/farm seam (see
+    :func:`is_resource_exhausted`), or an injected ``alloc.*`` refusal.
+    Admission-class, NOT a worker death: the farm's recovery response
+    is evict-and-retry, and every raise site first trips the memwatch
+    OOM forensics (flight bundle with the memory timeline and
+    top-owner table). The message carries the pool/budget state known
+    at the seam."""
+
+
+class AdmissionError(AllocationError):
     """HBM admission failed after eviction attempts and backoff — the
     farm budget cannot fit the operator. The message names
-    AMGCL_TPU_FARM_MAX_BYTES (the existing test contract)."""
+    AMGCL_TPU_FARM_MAX_BYTES (the existing test contract). A subclass
+    of :class:`AllocationError`: the ``alloc.farm`` injection and the
+    modeled budget path share the typed taxonomy with real OOMs."""
 
 
 class RecoveryExhausted(FaultError):
@@ -75,8 +88,31 @@ class RecoveryExhausted(FaultError):
         self.report = report
 
 
+def is_resource_exhausted(exc) -> bool:
+    """Conservatively classify a backend exception as a device
+    allocation failure: XLA surfaces OOM as ``XlaRuntimeError`` (or a
+    jaxlib status error) whose message leads with RESOURCE_EXHAUSTED /
+    an out-of-memory phrase. String-match on purpose — the exception
+    TYPES are private to jaxlib and have moved across releases, the
+    status words are the stable API. Never raises."""
+    if exc is None or isinstance(exc, FaultError):
+        return False
+    try:
+        msg = str(exc)
+    except Exception:
+        return False
+    name = type(exc).__name__
+    if "RESOURCE_EXHAUSTED" in msg or "RESOURCE_EXHAUSTED" in name:
+        return True
+    low = msg.lower()
+    return ("xlaruntimeerror" in name.lower()
+            or "status" in name.lower()) and (
+        "out of memory" in low or "oom" in low
+        or "failed to allocate" in low)
+
+
 __all__ = [
     "FaultError", "DeviceLostError", "WorkerDiedError",
-    "PoisonRequestError", "LoadShedError", "AdmissionError",
-    "RecoveryExhausted",
+    "PoisonRequestError", "LoadShedError", "AllocationError",
+    "AdmissionError", "RecoveryExhausted", "is_resource_exhausted",
 ]

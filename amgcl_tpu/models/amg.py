@@ -254,6 +254,7 @@ class AMG:
                         self.host_levels = meta_rows
                         self._setup_wall_s = \
                             time.perf_counter() - self._setup_t0
+                        self._memwatch_built()
                         return
                     # hybrid: SA stencil growth moved past the
                     # diagonal-pair regime — continue with the classic
@@ -521,6 +522,21 @@ class AMG:
         self.hierarchy = Hierarchy(
             dev_levels, coarse, prm.npre, prm.npost, prm.ncycle,
             prm.pre_cycles)
+        self._memwatch_built()
+
+    def _memwatch_built(self):
+        # measured-memory attribution (telemetry/memwatch.py): own this
+        # hierarchy's live device buffers in the weakref registry and
+        # drop a setup-phase point on the memory timeline; no-op when
+        # the observatory is off, never fails the build
+        try:
+            from amgcl_tpu.telemetry import memwatch as _mw
+            if _mw.enabled():
+                _mw.register_owner("hierarchy", self)
+                _mw.snapshot("amg.setup",
+                             levels=len(self.hierarchy.levels))
+        except Exception:
+            pass
 
     @property
     def dtype(self):
@@ -551,6 +567,11 @@ class AMG:
         self._probe_cache = None
         self._roofline_cache = None
         self._structure_cache = None
+        try:
+            from amgcl_tpu.telemetry import memwatch as _mw
+            _mw.snapshot("amg.release")
+        except Exception:
+            pass
 
     @property
     def device_resident(self) -> bool:
@@ -567,6 +588,11 @@ class AMG:
             else:
                 self.rebuild(A0.val)   # values-only: skip the pattern
                 #                        comparison against itself
+            try:
+                from amgcl_tpu.telemetry import memwatch as _mw
+                _mw.snapshot("amg.readmit")
+            except Exception:
+                pass
 
     # -- observability (reference: amgcl/amg.hpp:560-598) -------------------
 
@@ -584,6 +610,16 @@ class AMG:
                 setup_profile=getattr(self, "setup_profile", None))
             self._ledger_cache = cached
         return cached
+
+    def memory_report(self):
+        """Measured-vs-model memory join (telemetry/memwatch.py §DESIGN
+        20): live device bytes per level and slot — what the runtime
+        actually holds — joined against the analytic resource ledger,
+        with a ``provenance: model|measured`` tag and the headline
+        ``drift_ratio``. Works evicted (all zeros); feed the result to
+        ``telemetry.diagnose(memory=...)`` for drift findings."""
+        from amgcl_tpu.telemetry import memwatch
+        return memwatch.hierarchy_report(self)
 
     def setup_report(self):
         """Stage-by-stage attribution of the last build/rebuild

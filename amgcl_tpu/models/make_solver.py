@@ -163,6 +163,15 @@ class make_solver:
                 self.A_dev64 = dev.to_device(A, matrix_format,
                                              self._wide_dtype())
         self._compiled = None
+        try:
+            # measured-memory attribution (telemetry/memwatch.py): the
+            # Krylov-side system operator(s) get their own owner row,
+            # separate from the hierarchy the AMG registers itself
+            from amgcl_tpu.telemetry import memwatch as _mw
+            if _mw.enabled():
+                _mw.register_owner("operator", self)
+        except Exception:
+            pass
 
     def _build_lo_operator(self, A):
         """DIA matrix of the f32 rounding remainders: A ≈ A_hi + A_lo
@@ -515,6 +524,26 @@ class make_solver:
         try:
             got = entry(self.A_dev, self.A_dev64,
                         self.precond.hierarchy, rhs, x0)
+        except Exception as e:
+            # OOM seam (ISSUE 18): a backend RESOURCE_EXHAUSTED used to
+            # escape as a raw XlaRuntimeError — classify, trip the
+            # memwatch forensics (flight bundle with the memory
+            # timeline + top-owner table), and re-raise typed so the
+            # serve/farm layers treat it admission-class
+            from amgcl_tpu import faults as _faults
+            if not _faults.is_resource_exhausted(e):
+                raise
+            from amgcl_tpu.telemetry import memwatch as _mw
+            _mw.record_allocation_failure("solve.dispatch", e,
+                                          bundle=self, rhs=rhs, x0=x0)
+            raise _faults.AllocationError(
+                "device allocation failed dispatching the solve: "
+                "hierarchy holds %d measured bytes, system operator %d"
+                " — evict a resident operator or lower the problem "
+                "size (%s)"
+                % (_mw.measured_tree_bytes(self.precond.hierarchy),
+                   _mw.measured_tree_bytes(self.A_dev),
+                   str(e)[:200])) from e
         finally:
             if nspec is not None:
                 from amgcl_tpu.faults import inject as _inject
@@ -652,6 +681,17 @@ class make_solver:
                     resources["roofline"] = rf
         except Exception:
             pass                 # roofline must never fail a solve
+        try:
+            # measured memory join (telemetry/memwatch.py): what the
+            # device ACTUALLY holds for this bundle, with provenance —
+            # in place on the cached dict, same contract as roofline
+            from amgcl_tpu.telemetry import memwatch as _mw
+            if resources is not None and _mw.enabled():
+                bm = _mw.solve_resources(self)
+                if bm is not None:
+                    resources["bytes_measured"] = bm
+        except Exception:
+            pass                 # measurement must never fail a solve
         report = SolveReport(
             int(iters), float(resid), hist, wall_time_s=wall,
             solves_per_sec=round(shp[1] / wall, 3)

@@ -56,6 +56,7 @@ Env knobs (read at construction; constructor args win):
 from __future__ import annotations
 
 import itertools
+import os
 import queue as _queue
 import threading
 import time
@@ -65,8 +66,8 @@ from typing import Any, Dict, List, Optional
 import numpy as np
 
 from amgcl_tpu.analysis import lockwitness as _lockwitness
-from amgcl_tpu.faults import (AdmissionError, LoadShedError,
-                              WorkerDiedError)
+from amgcl_tpu.faults import (AdmissionError, AllocationError,
+                              LoadShedError, WorkerDiedError)
 from amgcl_tpu.faults import recovery as _frecovery
 from amgcl_tpu.serve.registry import (OperatorRegistry, RegistryEntry,
                                       sparsity_fingerprint,
@@ -254,6 +255,15 @@ class SolverFarm:
         # -- fault tolerance (faults/): admission retry budget, load
         #    shedding thresholds, dispatch-worker supervisor state
         self._retry_max = _frecovery.retry_max()
+        #: admission headroom source (ISSUE 18,
+        #: AMGCL_TPU_FARM_HEADROOM): "model" trusts the analytic
+        #: AMG.bytes() charge alone (the historical behavior);
+        #: "measured" cross-checks every charge against the memwatch
+        #: live-buffer truth — the pool charges the larger of the two
+        #: and a >10% divergence emits a ``mem_drift`` event instead
+        #: of silently over-admitting on a drifted model
+        self._headroom_mode = os.environ.get(
+            "AMGCL_TPU_FARM_HEADROOM", "model").strip().lower()
         self._shed_breaches = _env_int("AMGCL_TPU_SHED_BREACHES", 0)
         self._shed_cooldown = _env_float("AMGCL_TPU_SHED_COOLDOWN_S",
                                          5.0)
@@ -327,14 +337,37 @@ class SolverFarm:
         #: under the farm's control-plane locks)
         deferred: List[Any] = []
         try:
-            return self._register_inner(tenant, A, cfg_key, build,
-                                        build_fn, rebuild_ok, prebuilt,
-                                        slo, slo_window, queue_max,
-                                        deferred)
+            out = self._register_inner(tenant, A, cfg_key, build,
+                                       build_fn, rebuild_ok, prebuilt,
+                                       slo, slo_window, queue_max,
+                                       deferred)
+        except AllocationError as e:
+            # OOM forensics (ISSUE 18): admission refused — typed
+            # AllocationError (the alloc.farm injection and the real
+            # budget path both land here) trips a flight bundle whose
+            # manifest embeds the memory timeline and top-owner table.
+            # Every lock is already released on this path.
+            try:
+                from amgcl_tpu.telemetry import memwatch as _mw
+                _mw.record_allocation_failure(
+                    "farm.register", e,
+                    extra={"tenant": tenant,
+                           "pool_used": self.pool.used,
+                           "pool_total": self.pool.total})
+            except Exception:        # noqa: BLE001 — forensics must
+                pass                 # never mask the admission error
+            raise
         finally:
             for fut, err in deferred:
                 if not fut.done():
                     fut.set_exception(err)
+        try:
+            from amgcl_tpu.telemetry import memwatch as _mw
+            _mw.snapshot("farm.register", tenant=tenant,
+                         outcome=out.get("outcome"))
+        except Exception:            # noqa: BLE001
+            pass
+        return out
 
     def _register_inner(self, tenant, A, cfg_key, build, build_fn,
                         rebuild_ok, prebuilt, slo, slo_window,
@@ -637,6 +670,8 @@ class SolverFarm:
         :class:`AdmissionError` (a ``RuntimeError``, so the historical
         handlers keep working)."""
         nbytes = self._entry_bytes(entry)
+        if self._headroom_mode == "measured":
+            nbytes = self._measured_charge_locked(entry, nbytes)
         self._bytes_hint[entry.uid] = nbytes
         self._admit_begin_locked(entry.uid)
         tries = 0
@@ -663,6 +698,68 @@ class SolverFarm:
             self._admit_end_locked(entry.uid)
         self._residency_gauges_locked(entry, resident=True,
                                       nbytes=nbytes)
+        self._sweep_hint_locked(entry)
+
+    def _measured_charge_locked(self, entry: RegistryEntry,
+                                model_bytes: int) -> int:
+        """``AMGCL_TPU_FARM_HEADROOM=measured``: charge the pool with
+        the measured live-buffer footprint when it exceeds the
+        analytic model — the pool then reflects real headroom — and
+        surface any >10% divergence as a ``mem_drift`` event instead
+        of silently over-admitting on a drifted model. Measurement is
+        lock-free (memwatch takes no lock here) and never blocks
+        admission."""
+        try:
+            from amgcl_tpu.telemetry import memwatch as _mw
+            if not _mw.enabled():
+                return model_bytes
+            amg = getattr(entry.obj, "precond", None)
+            measured = _mw.measured_tree_bytes(
+                getattr(amg, "hierarchy", None))
+        except Exception:            # noqa: BLE001 — measurement must
+            return model_bytes       # never block admission
+        if measured <= 0:
+            return model_bytes
+        if model_bytes > 0 and abs(measured - model_bytes) \
+                > 0.10 * model_bytes:
+            self.live.inc("memwatch_drift_total")
+            if _sink_attached():
+                from amgcl_tpu import telemetry
+                telemetry.emit(event="mem_drift", kind="headroom",
+                               uid=entry.uid,
+                               model_bytes=int(model_bytes),
+                               measured_bytes=int(measured),
+                               ratio=round(measured / model_bytes, 4))
+        return max(int(measured), int(model_bytes))
+
+    def _sweep_hint_locked(self, entry: RegistryEntry) -> None:
+        """ISSUE-18 satellite: ``_bytes_hint`` is the MODELED
+        last-charged footprint that readmission pre-evicts by — swept
+        here (post-charge and pre-eviction) against the measured
+        per-owner bytes, so a drifted hint cannot under-reserve before
+        re-materialization. A >10% divergence warns via ``mem_drift``
+        and the hint is corrected to the measured truth."""
+        try:
+            from amgcl_tpu.telemetry import memwatch as _mw
+            if not _mw.enabled():
+                return
+            amg = getattr(entry.obj, "precond", None)
+            measured = _mw.measured_tree_bytes(
+                getattr(amg, "hierarchy", None))
+        except Exception:            # noqa: BLE001 — a sweep must
+            return                   # never fail the residency change
+        hint = self._bytes_hint.get(entry.uid, 0)
+        if measured <= 0 or hint <= 0 \
+                or abs(measured - hint) <= 0.10 * hint:
+            return
+        self._bytes_hint[entry.uid] = int(measured)
+        self.live.inc("memwatch_drift_total")
+        if _sink_attached():
+            from amgcl_tpu import telemetry
+            telemetry.emit(event="mem_drift", kind="bytes_hint",
+                           uid=entry.uid, hint_bytes=int(hint),
+                           measured_bytes=int(measured),
+                           ratio=round(measured / hint, 4))
 
     def _make_room_locked(self, need: int, exclude=()) -> None:
         """Evict coldest victims until ``need`` bytes fit — BEFORE the
@@ -828,6 +925,10 @@ class SolverFarm:
     def _evict_uid_locked(self, uid: str) -> None:
         entry = self._entry_by_uid(uid)
         if entry is not None:
+            # sweep the readmission hint against measured truth while
+            # the buffers are still alive — after release_device()
+            # there is nothing left to measure
+            self._sweep_hint_locked(entry)
             svc = entry.payload.get("service")
             if svc is not None:
                 svc.release_device()
@@ -897,7 +998,12 @@ class SolverFarm:
             if uid not in self.pool.resident():
                 return False
             self._evict_uid_locked(uid)
-            return True
+        try:
+            from amgcl_tpu.telemetry import memwatch as _mw
+            _mw.snapshot("farm.evict", tenant=tenant, uid=uid)
+        except Exception:            # noqa: BLE001
+            pass
+        return True
 
     def set_max_bytes(self, max_bytes: int) -> None:
         """Re-arm the byte budget in place (the CLI/bench demos size
@@ -1201,22 +1307,44 @@ class SolverFarm:
                     if not req.future.done():
                         req.future.set_exception(e)
                 # flight recorder: dump the failed batch's first
-                # request as a tenant-tagged replay bundle
+                # request as a tenant-tagged replay bundle; a typed
+                # AllocationError additionally embeds the memwatch
+                # forensics (memory timeline + top-owner table)
+                alloc_failed = isinstance(e, AllocationError)
                 try:
                     from amgcl_tpu.telemetry import flight as _fl
                     if _fl.enabled() and batch:
                         bundle = svc.solver if svc is not None else None
+                        tags = {"tenant": batch[0].tenant,
+                                "request_ids": [r.rid for r in batch],
+                                "exception": repr(e)[:200]}
+                        if alloc_failed:
+                            from amgcl_tpu.telemetry import \
+                                memwatch as _mw
+                            tags.update(_mw.forensics_tags())
                         if _fl.dump(
                                 "farm_batch_failed", bundle=bundle,
                                 rhs=batch[0].rhs, x0=batch[0].x0,
-                                tags={"tenant": batch[0].tenant,
-                                      "request_ids":
-                                      [r.rid for r in batch],
-                                      "exception": repr(e)[:200]}) \
-                                is not None:
+                                tags=tags) is not None:
                             self.live.inc("flight_dumps_total")
                 except Exception:                # noqa: BLE001
                     pass
+                # admission-class recovery (retry-after-eviction): an
+                # AllocationError means the device is out of room, not
+                # that the worker is sick — free the coldest OTHER
+                # operator now so the tenant's next submit readmits
+                # into real headroom instead of failing identically
+                if alloc_failed and entry is not None:
+                    try:
+                        with self._mem_lock:
+                            victim = self.pool.coldest(
+                                exclude=(entry.uid,)
+                                + tuple(self._pins)
+                                + tuple(self._admitting))
+                            if victim is not None:
+                                self._evict_uid_locked(victim)
+                    except Exception:            # noqa: BLE001
+                        pass
             try:
                 # the FULL batch: displaced requests carry their inner
                 # exception into the per-tenant books + public futures

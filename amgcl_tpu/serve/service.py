@@ -421,11 +421,35 @@ class SolverService:
                     "injected device loss at serve.solve_step")
         cw0 = _cwatch.snapshot(_SERVE_STEP) if _cwatch.enabled() else None
         t0 = time.perf_counter()
-        got = self._ensure_entry()(
-            self.solver.A_dev, self.solver.A_dev64,
-            self.solver.precond.hierarchy, rhs, x0)
-        x = got[0]
-        jax.block_until_ready(x)         # the ONLY device sync
+        try:
+            got = self._ensure_entry()(
+                self.solver.A_dev, self.solver.A_dev64,
+                self.solver.precond.hierarchy, rhs, x0)
+            x = got[0]
+            jax.block_until_ready(x)     # the ONLY device sync
+        except Exception as e:
+            # OOM seam (ISSUE 18): RESOURCE_EXHAUSTED from the bucket
+            # executable (allocation happens at dispatch AND inside the
+            # sync) escaped as a raw XlaRuntimeError. Typed
+            # AllocationError is admission-class for the layers above
+            # (retry-after-eviction), never a worker death; forensics
+            # (memory timeline + top-owner table) ride a flight bundle
+            from amgcl_tpu import faults as _faults
+            if not _faults.is_resource_exhausted(e):
+                raise
+            from amgcl_tpu.telemetry import memwatch as _mw
+            _mw.record_allocation_failure(
+                "serve.dispatch", e, bundle=self.solver,
+                rhs=rhs, x0=x0,
+                extra={"batch": int(getattr(rhs, "shape", [0, 0])[-1])
+                       if getattr(rhs, "ndim", 1) > 1 else 1})
+            raise _faults.AllocationError(
+                "device allocation failed in the serve dispatch: "
+                "hierarchy holds %d measured bytes — evict a resident "
+                "tenant or shrink AMGCL_TPU_SERVE_BATCH (%s)"
+                % (_mw.measured_tree_bytes(
+                    self.solver.precond.hierarchy),
+                   str(e)[:200])) from e
         t_solved = time.perf_counter()
         iters, resid, _hist, _hn, hstate = jax.device_get(got[1:6])
         t_fetched = time.perf_counter()
@@ -907,6 +931,11 @@ class SolverService:
             x0cols += [np.zeros(self.n, cols[0].dtype)] * pad
         x0 = jnp.asarray(np.stack(x0cols, axis=1),
                          self.solver.solver_dtype)
+        # memory truth at batch dispatch (ISSUE 18) — snapshot() is
+        # internally guarded (never raises), so no swallow here: a
+        # truly broken memwatch routes to the batch-failure handler
+        from amgcl_tpu.telemetry import memwatch as _mw
+        _mw.snapshot("serve.batch", batch=len(live), bucket=bucket)
         x, iters, resid, hstate, timing = self._dispatch(rhs, x0)
         xs = np.asarray(x)
         from amgcl_tpu.telemetry import SolveReport

@@ -76,6 +76,10 @@ from amgcl_tpu.telemetry import flight
 # analytics, the to_device('auto') format-decision ledger, and the
 # predict-only reorder-gain advisor (host-side, never imports jax)
 from amgcl_tpu.telemetry import structure
+# memory observatory (PR 18): measured device-memory truth — sampling
+# timeline, weakref ownership attribution, measured-vs-ledger joins,
+# leak gate and OOM forensics (stdlib at module level, jax lazy)
+from amgcl_tpu.telemetry import memwatch
 
 __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "setup_scope", "RequestSpans", "JsonlSink", "NullSink",
@@ -92,4 +96,4 @@ __all__ = ["SolveReport", "HistoryMixin", "phase", "annotate",
            "solve_roofline", "counter_map", "xla_stage_check",
            "watched_jit", "compile_snapshot", "global_watch", "metrics",
            "live", "LiveRegistry", "MetricsServer", "diff", "flight",
-           "structure"]
+           "structure", "memwatch"]

@@ -556,7 +556,8 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
              farm: Optional[Dict[str, Any]] = None,
              diff: Optional[Dict[str, Any]] = None,
              recovery: Optional[Dict[str, Any]] = None,
-             structure: Optional[Dict[str, Any]] = None
+             structure: Optional[Dict[str, Any]] = None,
+             memory: Optional[Dict[str, Any]] = None
              ) -> List[Dict[str, Any]]:
     """Rank-ordered findings from one solve: report (+ its ``health``
     guard decode), the resource ledger, the per-level probe rows, and —
@@ -579,8 +580,12 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
     (``AMG.structure_report()``) and folds in the structure findings —
     advisor reorder gains, budget-starved format decisions, padding
     waste, and (when ``roofline`` rode along too) the
-    predicted-vs-achieved divergence per format. Each finding:
-    {severity, code, message, suggestion}. Pure host-side
+    predicted-vs-achieved divergence per format. ``memory`` takes a
+    measured-vs-model memory join (``AMG.memory_report()`` or a
+    memwatch selftest record) and folds in the drift / leak /
+    unattributed-footprint findings
+    (:func:`~amgcl_tpu.telemetry.memwatch.memory_findings`). Each
+    finding: {severity, code, message, suggestion}. Pure host-side
     dict-crunching — never raises on missing pieces."""
     out: List[Dict[str, Any]] = []
     health = getattr(report, "health", None) or {}
@@ -765,6 +770,14 @@ def diagnose(report, ledger: Optional[Dict[str, Any]] = None,
         out.extend(f for f in structure_findings(
             structure, roofline=roofline if isinstance(roofline, dict)
             else None) if isinstance(f, dict) and "severity" in f)
+    if isinstance(memory, dict):
+        # memory leg (ISSUE 18): the measured-vs-model join from
+        # AMG.memory_report() / the memwatch selftest — drift past the
+        # declared tolerance, leaked cycle bytes, unattributed
+        # footprint
+        from amgcl_tpu.telemetry.memwatch import memory_findings
+        out.extend(f for f in memory_findings(memory)
+                   if isinstance(f, dict) and "severity" in f)
     if isinstance(compile_stats, dict):
         from amgcl_tpu.telemetry import compile_watch as _cw
         out.extend(_cw.findings(compile_stats))

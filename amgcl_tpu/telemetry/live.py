@@ -41,7 +41,7 @@ import json
 import os
 import threading
 from collections import deque
-from typing import Any, Callable, Dict, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 try:
     from amgcl_tpu.telemetry import metrics as _metrics
@@ -199,6 +199,24 @@ METRICS: Dict[str, Tuple[str, str]] = {
     "xray_dia_fill": (
         "gauge", "finest-level DIA fill ratio (stored slots / nnz) "
                  "from the operator X-ray"),
+    # -- memory observatory (telemetry/memwatch.py) -----------------------
+    "memwatch_bytes_in_use": (
+        "gauge", "measured device bytes in use at the last memwatch "
+                 "sample (allocator stats, or the live-array census "
+                 "on backends without memory_stats)"),
+    "memwatch_peak_bytes_in_use": (
+        "gauge", "measured peak device bytes (allocator peak, or the "
+                 "census high-water mark)"),
+    "memwatch_owner_bytes": (
+        "gauge", "measured live-buffer bytes attributed to one "
+                 "registered owner (label: owner)"),
+    "memwatch_unattributed_bytes": (
+        "gauge", "census remainder belonging to no registered owner "
+                 "(workspaces, donated buffers, foreign arrays)"),
+    "memwatch_drift_total": (
+        "counter", "measured-vs-model divergences surfaced as "
+                   "mem_drift events (bytes-hint sweeps, measured-"
+                   "headroom admission cross-checks)"),
 }
 
 #: THE declared label-key table: metric name -> allowed label keys.
@@ -220,6 +238,7 @@ METRIC_LABELS: Dict[str, Tuple[str, ...]] = {
     "farm_tenant_p99_ms": ("tenant",),
     "faults_injected_total": ("site",),
     "farm_load_shed_total": ("tenant",),
+    "memwatch_owner_bytes": ("owner",),
 }
 
 # the ONE name-mangling rule, shared with the rollup exposition so the
@@ -422,6 +441,40 @@ def publish_xray_gauges(registry: "LiveRegistry",
     v = summary.get("dia_fill")
     if v is not None:
         registry.set_gauge("xray_dia_fill", float(v))
+
+
+def publish_memwatch_gauges(registry: "LiveRegistry",
+                            sample: Optional[Dict[str, Any]] = None,
+                            owners: Optional[List[Dict[str, Any]]]
+                            = None) -> None:
+    """Publish the memory-observatory gauges onto a live registry:
+    the measured device sample (``memwatch.device_sample()`` — taken
+    here when not passed) and the per-owner attribution table
+    (``memwatch.owner_table()``). Names are literals from
+    :data:`METRICS` — the metric-name-literal contract (this module is
+    the declaring site)."""
+    from amgcl_tpu.telemetry import memwatch as _mw
+    if not _mw.enabled():
+        return
+    if sample is None:
+        sample = _mw.device_sample()
+    v = sample.get("bytes_in_use")
+    if v is not None:
+        registry.set_gauge("memwatch_bytes_in_use", float(v))
+    v = sample.get("peak_bytes_in_use")
+    if v is not None:
+        registry.set_gauge("memwatch_peak_bytes_in_use", float(v))
+    if owners is None:
+        owners = _mw.owner_table(sample)
+    for row in owners or []:
+        b = row.get("bytes_measured")
+        if b is None:
+            continue
+        if row.get("owner") == "unattributed":
+            registry.set_gauge("memwatch_unattributed_bytes", float(b))
+        else:
+            registry.set_gauge("memwatch_owner_bytes", float(b),
+                               owner=row["owner"])
 
 
 def metrics_port_from_env(

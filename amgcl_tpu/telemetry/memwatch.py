@@ -1,0 +1,903 @@
+"""Memory observatory — measured device-memory truth, ownership
+attribution, leak gates and OOM forensics.
+
+Every memory decision made so far — farm admission/eviction
+(``telemetry.ledger.LruMemoryPool``), dense-window budgets, the gate's
+bytes ratios — trusts the ANALYTIC ledger (``AMG.bytes()`` and
+``hierarchy_ledger``'s size*itemsize sums). Nothing ever measured what
+the device actually holds, so model drift, transient workspace peaks,
+buffers leaked across register/evict/rebuild cycles and allocator
+fragmentation were all invisible until an opaque ``RESOURCE_EXHAUSTED``
+killed a tenant. This module closes the loop with four pieces:
+
+* **Measured sampling** — :func:`device_sample` reads the backend
+  allocator (``device.memory_stats()``: ``bytes_in_use`` /
+  ``peak_bytes_in_use`` on TPU/GPU) and falls back to a live-array
+  census (``jax.live_arrays()``) on backends that expose no stats (the
+  CPU mesh every test runs on). Samples land on a bounded timeline:
+  event-driven via :func:`snapshot` at named phases (setup, solve
+  dispatch, serve batch, farm register/evict/rebuild, allocation
+  failures) plus an optional low-overhead daemon sampler thread
+  (:func:`start_sampler`, paced by ``AMGCL_TPU_MEMWATCH_INTERVAL_MS``).
+  The timeline exports as a Perfetto counter track
+  (:func:`to_chrome_trace`) that ``cli --trace`` merges onto the shared
+  epoch, and each phase snapshot emits one ``memory`` JSONL event when
+  a sink is attached.
+* **Ownership attribution** — a weakref registry
+  (:func:`register_owner`) maps live device buffers to their owners
+  (hierarchy pytrees, solver-bundle operators); :func:`owner_table`
+  joins each owner's MEASURED bytes (live buffer ``nbytes``) against
+  the ledger's analytic model with a ``provenance: model|measured``
+  tag and computes the "unattributed" remainder of the census.
+  ``AMG.memory_report()`` (:func:`hierarchy_report`) does the same
+  join per level and slot, and ``SolveReport.resources
+  ["bytes_measured"]`` (:func:`solve_resources`) carries it on every
+  solve. Drift feeds ``telemetry.diagnose(memory=...)`` through
+  :func:`memory_findings`.
+* **Leak gate** — :func:`selftest` drives register -> evict ->
+  re-register cycles through a real :class:`SolverFarm` on the
+  8-virtual-device CPU mesh and asserts measured bytes return to
+  baseline each cycle; ``bench.py --check`` wires it in as the
+  ``memwatch`` record (``AMGCL_TPU_MEMWATCH_IN_CHECK``), and the
+  ``AMGCL_TPU_GATE_MEMDRIFT`` ratio gates the join's drift against
+  BENCH_LAST_GOOD. ``AMGCL_TPU_MEMWATCH_LEAK_BYTES`` deliberately
+  plants a leak per cycle — the negative injection that proves the
+  gate trips.
+* **OOM forensics** — :func:`record_allocation_failure` is the shared
+  tail of every typed :class:`~amgcl_tpu.faults.AllocationError` seam
+  (make_solver dispatch, ``SolverService._dispatch``, farm admission):
+  one ``memory`` JSONL event plus a flight-recorder bundle whose
+  manifest embeds the memory timeline and the top-owner table
+  (:func:`forensics_tags`).
+
+Knobs (README env table):
+
+  AMGCL_TPU_MEMWATCH              0 disables the observatory entirely
+                                  (no snapshots, no joins, no sampler)
+  AMGCL_TPU_MEMWATCH_INTERVAL_MS  daemon sampler period; unset/0 = no
+                                  sampler thread (snapshots still fire)
+  AMGCL_TPU_MEMWATCH_TIMELINE     bounded timeline capacity (def 512)
+  AMGCL_TPU_MEMWATCH_TOL          declared measured-vs-model join
+                                  tolerance as a relative fraction
+                                  (def 0.25)
+  AMGCL_TPU_MEMWATCH_IN_CHECK     0 skips the leak-cycle selftest arm
+                                  in ``bench.py --check`` (default on)
+  AMGCL_TPU_MEMWATCH_LEAK_BYTES   selftest negative injection: leak
+                                  this many device bytes per cycle so
+                                  the gate MUST trip (tests only)
+  AMGCL_TPU_MEMWATCH_TIMEOUT      ``--check`` subprocess bound (def
+                                  600 s)
+
+Module level stays stdlib-only (jax is imported lazily inside the
+measuring paths, flight/sink inside the emitting paths) so the bench
+supervisor and the analysis layer can load it without a device
+runtime. Thread contract (DESIGN §20, analysis/concurrency.py): ONE
+module lock guarding the timeline/owner/peak state; the sampler thread
+paces on a ``threading.Event`` and never measures or emits while
+holding the lock.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional
+
+# runtime lock witness seam (analysis/lockwitness.py, identity when
+# the knob is off) — same discipline as telemetry/flight.py
+from amgcl_tpu.analysis.lockwitness import maybe_wrap as _wit_wrap
+
+#: default bounded-timeline capacity (AMGCL_TPU_MEMWATCH_TIMELINE)
+TIMELINE_CAPACITY = 512
+
+#: declared lock partial order (analysis/concurrency.py): the module
+#: lock below is a LEAF — nothing else is ever acquired while it is
+#: held (measuring, emitting and flight dumps all run lock-free), so
+#: the order has no edges. Declared explicitly so the analyzer and the
+#: runtime witness share the contract with the other concurrent
+#: modules rather than inferring an absence.
+LOCK_ORDER = ()
+
+_lock = _wit_wrap("memwatch._lock", threading.Lock())
+_timeline: deque = deque(maxlen=TIMELINE_CAPACITY)
+_owners: Dict[str, "_Owner"] = {}
+_peak_seen = 0            # census high-water (allocator-less backends)
+_drift_events = 0
+_sampler: Optional[threading.Thread] = None
+#: sampler pace-maker AND stop signal in one — waited on LOCK-FREE
+#: (an Event, not a Condition: no lock to hold, no predicate to loop)
+_sampler_stop = threading.Event()
+#: last census result (t, total_bytes, skipped) — written by a single
+#: tuple assignment and read into a local before use, so concurrent
+#: snapshots race benignly (worst case: two fresh censuses, never a
+#: torn read); deliberately NOT under _lock to keep device_sample
+#: lock-free per its contract
+_census_cache: Optional[tuple] = None
+
+
+# ---------------------------------------------------------------------------
+# knobs
+# ---------------------------------------------------------------------------
+
+def enabled() -> bool:
+    """Kill switch: ``AMGCL_TPU_MEMWATCH=0`` disables snapshots, joins
+    and the sampler (read per call — tests flip it)."""
+    return os.environ.get("AMGCL_TPU_MEMWATCH", "1") != "0"
+
+
+def declared_tolerance() -> float:
+    """The DECLARED measured-vs-model join tolerance (relative): a
+    per-owner disagreement beyond it is a drift finding, within it the
+    model is considered truthful (``AMGCL_TPU_MEMWATCH_TOL``)."""
+    try:
+        return float(os.environ.get("AMGCL_TPU_MEMWATCH_TOL", "0.25"))
+    except ValueError:
+        return 0.25
+
+
+def _interval_s() -> float:
+    try:
+        return float(os.environ.get("AMGCL_TPU_MEMWATCH_INTERVAL_MS",
+                                    "0")) / 1e3
+    except ValueError:
+        return 0.0
+
+
+def _census_max_age_s() -> float:
+    """How stale a live-array census may be before a PHASE SNAPSHOT
+    re-walks ``jax.live_arrays()`` (``AMGCL_TPU_MEMWATCH_CENSUS_MS``,
+    default 100 ms). The census is O(live arrays) and snapshots ride
+    hot paths (every serve batch, every solve), so the walk is paced;
+    direct :func:`device_sample` calls and the sampler thread always
+    measure fresh, as does the ``allocation_failure`` forensics
+    snapshot. 0 disables the cache entirely."""
+    try:
+        return float(os.environ.get("AMGCL_TPU_MEMWATCH_CENSUS_MS",
+                                    "100")) / 1e3
+    except ValueError:
+        return 0.1
+
+
+def _timeline_cap() -> int:
+    try:
+        cap = int(os.environ.get("AMGCL_TPU_MEMWATCH_TIMELINE",
+                                 str(TIMELINE_CAPACITY)))
+        return cap if cap > 0 else TIMELINE_CAPACITY
+    except ValueError:
+        return TIMELINE_CAPACITY
+
+
+def _reset_for_tests() -> None:
+    global _peak_seen, _drift_events, _census_cache
+    stop_sampler()
+    _census_cache = None
+    with _lock:
+        _timeline.clear()
+        _owners.clear()
+        _peak_seen = 0
+        _drift_events = 0
+
+
+# ---------------------------------------------------------------------------
+# measured sampling
+# ---------------------------------------------------------------------------
+
+def measured_tree_bytes(tree) -> int:
+    """MEASURED device bytes of every array leaf in a pytree: the live
+    buffer's ``nbytes`` (what the runtime actually reports for the
+    allocation), falling back to size*itemsize — the analytic number —
+    for leaves that expose no ``nbytes``. 0 for None (an evicted
+    hierarchy)."""
+    if tree is None:
+        return 0
+    import jax
+    total = 0
+    for leaf in jax.tree.leaves(tree):
+        nb = getattr(leaf, "nbytes", None)
+        if nb is not None:
+            total += int(nb)
+        elif hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total
+
+
+def device_sample(max_age_s: float = 0.0) -> Dict[str, Any]:
+    """One measured point from the default device: backend allocator
+    stats when the platform exposes them (``source: memory_stats`` —
+    TPU/GPU ``bytes_in_use`` / ``peak_bytes_in_use``), else a live-array
+    census (``source: census`` — the CPU fallback: the sum of every
+    live jax array's ``nbytes``; the census peak is this module's own
+    high-water across samples). ``source: none`` with None bytes when
+    no runtime is importable. Never raises, never takes the module
+    lock.
+
+    ``max_age_s`` > 0 lets the CENSUS branch reuse the previous walk
+    when it is at most that old (the allocator-stats branch is cheap
+    and never cached) — phase snapshots pass
+    :func:`_census_max_age_s` so hot paths pay O(live arrays) at a
+    bounded rate; the default 0 always measures fresh."""
+    global _census_cache
+    out: Dict[str, Any] = {"t": time.perf_counter(), "ts": time.time(),
+                           "source": "none", "bytes_in_use": None,
+                           "peak_bytes_in_use": None}
+    try:
+        import jax
+        dev = jax.devices()[0]
+        stats = None
+        ms = getattr(dev, "memory_stats", None)
+        if callable(ms):
+            try:
+                stats = ms()
+            except Exception:        # noqa: BLE001 — backend-optional
+                stats = None
+        if stats:
+            out["source"] = "memory_stats"
+            out["bytes_in_use"] = int(stats.get("bytes_in_use", 0))
+            out["peak_bytes_in_use"] = int(
+                stats.get("peak_bytes_in_use",
+                          stats.get("bytes_in_use", 0)))
+        else:
+            cache = _census_cache    # local read: benign race, see decl
+            if (cache is not None and max_age_s > 0
+                    and 0 <= out["t"] - cache[0] <= max_age_s):
+                total, skipped = cache[1], cache[2]
+                out["census_age_s"] = round(out["t"] - cache[0], 4)
+            else:
+                total = 0
+                skipped = 0
+                for arr in jax.live_arrays():
+                    try:
+                        total += int(getattr(arr, "nbytes", 0) or 0)
+                    except Exception:  # noqa: BLE001 — a buffer deleted
+                        skipped += 1   # mid-census is not an error
+                _census_cache = (out["t"], total, skipped)
+            out["source"] = "census"
+            out["bytes_in_use"] = total
+            if skipped:
+                out["skipped_arrays"] = skipped
+    except Exception as e:           # noqa: BLE001 — measurement must
+        out["error"] = repr(e)[:120]  # never fail the caller
+    return out
+
+
+def snapshot(phase: str, *, fresh: bool = False,
+             **tags) -> Optional[Dict[str, Any]]:
+    """Event-driven sample at a named phase (``amg.setup``, ``solve``,
+    ``serve.batch``, ``farm.register``, ...): measures OUTSIDE the
+    lock, appends to the bounded timeline, and emits one ``memory``
+    JSONL event when a sink is attached. Returns the sample (None when
+    disabled). Extra keyword tags ride both the timeline row and the
+    event. The CPU census may be paced (:func:`_census_max_age_s`);
+    ``fresh=True`` forces a new walk — forensics snapshots use it so
+    an OOM bundle never reports a pre-failure number."""
+    if not enabled():
+        return None
+    global _peak_seen, _timeline
+    s = device_sample(0.0 if fresh else _census_max_age_s())
+    s["phase"] = str(phase)
+    for k, v in tags.items():
+        if v is not None:
+            s[k] = v
+    with _lock:
+        if _timeline.maxlen != _timeline_cap():
+            # capacity knob changed since import: rebind (clear+extend
+            # would keep the OLD maxlen — deques cannot be resized)
+            _timeline = deque(_timeline, maxlen=_timeline_cap())
+        if s["bytes_in_use"] is not None:
+            if s["bytes_in_use"] > _peak_seen:
+                _peak_seen = s["bytes_in_use"]
+            if s["peak_bytes_in_use"] is None:
+                s["peak_bytes_in_use"] = _peak_seen
+        _timeline.append(s)
+    _maybe_emit(s)
+    return s
+
+
+def timeline(last: Optional[int] = None) -> List[Dict[str, Any]]:
+    """Copy of the bounded timeline (newest-last); ``last`` bounds the
+    tail returned."""
+    with _lock:
+        rows = list(_timeline)
+    return rows[-int(last):] if last else rows
+
+
+def _maybe_emit(row: Dict[str, Any]) -> None:
+    """One ``memory`` JSONL event per phase snapshot — only when the
+    operator attached a sink (the serve/farm convention), and never
+    for sampler ticks (the timeline is their record; a 100 ms sampler
+    would spam every stream)."""
+    if row.get("phase") == "sampler":
+        return
+    try:
+        from amgcl_tpu.telemetry.sink import (NullSink, emit,
+                                              get_default_sink)
+        if isinstance(get_default_sink(), NullSink):
+            return
+        emit({k: v for k, v in row.items() if k != "t"},
+             event="memory")
+    except Exception:                # noqa: BLE001
+        pass
+
+
+# ---------------------------------------------------------------------------
+# daemon sampler thread
+# ---------------------------------------------------------------------------
+
+def _sampler_loop(interval_s: float) -> None:
+    # Event.wait is the pace maker and the stop signal in one; the
+    # measure/append split keeps the lock hold O(append) — the census
+    # itself (which can briefly hold the GIL over many buffers) runs
+    # lock-free
+    global _peak_seen
+    while not _sampler_stop.wait(interval_s):
+        if not enabled():
+            continue
+        s = device_sample()
+        s["phase"] = "sampler"
+        with _lock:
+            if s["bytes_in_use"] is not None:
+                if s["bytes_in_use"] > _peak_seen:
+                    _peak_seen = s["bytes_in_use"]
+                if s["peak_bytes_in_use"] is None:
+                    s["peak_bytes_in_use"] = _peak_seen
+            _timeline.append(s)
+
+
+def start_sampler(interval_s: Optional[float] = None) -> bool:
+    """Start the daemon sampling thread (idempotent): one
+    :func:`device_sample` per period onto the timeline. Period from
+    ``AMGCL_TPU_MEMWATCH_INTERVAL_MS`` when not given; <= 0 (the
+    default) starts nothing — phase snapshots alone cost nothing
+    between events. Returns whether a sampler is running."""
+    global _sampler
+    if not enabled():
+        return False
+    if interval_s is None:
+        interval_s = _interval_s()
+    if interval_s <= 0:
+        return False
+    with _lock:
+        if _sampler is not None and _sampler.is_alive():
+            return True
+        _sampler_stop.clear()
+        t = threading.Thread(target=_sampler_loop,
+                             args=(float(interval_s),),
+                             name="memwatch-sampler", daemon=True)
+        _sampler = t
+    t.start()
+    return True
+
+
+def stop_sampler() -> None:
+    """Stop the sampler thread (no-op when none runs). The join is
+    bounded and runs outside the module lock."""
+    global _sampler
+    with _lock:
+        t = _sampler
+        _sampler = None
+    _sampler_stop.set()
+    if t is not None and t.is_alive():
+        t.join(timeout=2.0)
+
+
+# ---------------------------------------------------------------------------
+# ownership attribution
+# ---------------------------------------------------------------------------
+
+class _Owner:
+    """One attributed owner: a weakref to the owning object plus the
+    measure/model callables resolved per kind. Dies with its object
+    (the weakref callback unregisters it)."""
+
+    __slots__ = ("name", "kind", "ref", "measure_fn", "model_fn")
+
+    def __init__(self, name: str, kind: str, ref,
+                 measure_fn: Callable[[Any], int],
+                 model_fn: Optional[Callable[[Any], Optional[int]]]):
+        self.name = name
+        self.kind = kind
+        self.ref = ref
+        self.measure_fn = measure_fn
+        self.model_fn = model_fn
+
+
+def _measure_hierarchy(amg) -> int:
+    return measured_tree_bytes(getattr(amg, "hierarchy", None))
+
+
+def _model_hierarchy(amg) -> Optional[int]:
+    """Analytic model bytes of a hierarchy — the PR-2 ledger total
+    (size*itemsize over the declared Level slots), 0 while evicted."""
+    if not getattr(amg, "device_resident", False):
+        return 0
+    try:
+        led = amg.resource_ledger()
+        return int(led["totals"]["bytes"])
+    except Exception:                # noqa: BLE001
+        return None
+
+
+def _measure_operator(bundle) -> int:
+    # when the bundle reuses the hierarchy's finest-level operator as
+    # its Krylov system matrix (make_solver's shared fast path), those
+    # buffers already belong to the hierarchy owner — charging them
+    # here would double-count against the census
+    hier = getattr(getattr(bundle, "precond", None), "hierarchy", None)
+    shared = getattr(hier, "system_matrix", None)
+    A_dev = getattr(bundle, "A_dev", None)
+    total = measured_tree_bytes(getattr(bundle, "A_dev64", None))
+    if A_dev is not None and A_dev is not shared:
+        total += measured_tree_bytes(A_dev)
+    return total
+
+
+def register_owner(kind: str, obj, name: Optional[str] = None,
+                   measure_fn: Optional[Callable[[Any], int]] = None,
+                   model_fn: Optional[Callable[[Any], Optional[int]]]
+                   = None) -> Optional[str]:
+    """Attribute ``obj``'s live device buffers to a named owner row.
+
+    ``kind`` selects the default measure/model pair: ``hierarchy`` (an
+    AMG: measured = live hierarchy-leaf ``nbytes``, model = the ledger
+    total), ``operator`` (a make_solver bundle: the device system
+    operators), anything else must pass ``measure_fn``. The registry
+    holds only a weakref — an owner dies with its object and its row
+    disappears. Returns the owner name (``kind:<id>`` by default), or
+    None when disabled/unmeasurable."""
+    if not enabled():
+        return None
+    if measure_fn is None:
+        measure_fn = {"hierarchy": _measure_hierarchy,
+                      "operator": _measure_operator}.get(kind)
+        if measure_fn is None:
+            return None
+    if model_fn is None and kind == "hierarchy":
+        model_fn = _model_hierarchy
+    name = name or "%s:%x" % (kind, id(obj))
+
+    def _gone(_ref, _name=name):
+        with _lock:
+            _owners.pop(_name, None)
+
+    try:
+        ref = weakref.ref(obj, _gone)
+    except TypeError:
+        return None                  # unweakrefable: no attribution
+    ow = _Owner(name, kind, ref, measure_fn, model_fn)
+    with _lock:
+        _owners[name] = ow
+    return name
+
+
+def unregister_owner(name: str) -> None:
+    with _lock:
+        _owners.pop(name, None)
+
+
+def owner_table(sample: Optional[Dict[str, Any]] = None
+                ) -> List[Dict[str, Any]]:
+    """The measured-vs-model join per owner, plus the census
+    remainder: one row per live owner with ``bytes_measured``,
+    ``bytes_model`` (None when the owner has no analytic model),
+    ``drift_ratio`` (measured/model) and ``provenance``; when the
+    sample came from a census, a final ``unattributed`` row carries
+    census-total minus everything attributed (workspaces, donated
+    iterate buffers, foreign arrays). Rows sort largest-measured
+    first — the "top owner table" the OOM bundles embed."""
+    with _lock:
+        owners = list(_owners.values())
+    rows: List[Dict[str, Any]] = []
+    attributed = 0
+    for ow in owners:
+        obj = ow.ref()
+        if obj is None:
+            continue
+        try:
+            measured = int(ow.measure_fn(obj))
+        except Exception:            # noqa: BLE001
+            continue
+        model = None
+        if ow.model_fn is not None:
+            try:
+                model = ow.model_fn(obj)
+            except Exception:        # noqa: BLE001
+                model = None
+        row: Dict[str, Any] = {"owner": ow.name, "kind": ow.kind,
+                               "bytes_measured": measured,
+                               "bytes_model": model,
+                               "provenance": "measured"}
+        if model:
+            row["drift_ratio"] = round(measured / model, 6)
+        rows.append(row)
+        attributed += measured
+    sample = sample or device_sample()
+    if sample.get("source") == "census" \
+            and sample.get("bytes_in_use") is not None:
+        rows.append({"owner": "unattributed", "kind": "remainder",
+                     "bytes_measured": max(
+                         int(sample["bytes_in_use"]) - attributed, 0),
+                     "bytes_model": None, "provenance": "measured"})
+    rows.sort(key=lambda r: -r["bytes_measured"])
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# joins: hierarchy report, per-solve resources, doctor findings
+# ---------------------------------------------------------------------------
+
+_SLOTS = ("A", "relax", "P", "R", "down", "up")
+
+
+def hierarchy_report(amg) -> Dict[str, Any]:
+    """``AMG.memory_report()``: the per-level, per-slot join of
+    measured live-buffer bytes against the analytic ledger model, with
+    a ``provenance`` tag and the headline ``drift_ratio``
+    (measured/model over the whole hierarchy). Works evicted (all
+    zeros, ``resident: False``) and never raises past a malformed
+    hierarchy (``error`` field instead)."""
+    out: Dict[str, Any] = {
+        "provenance": "measured",
+        "resident": bool(getattr(amg, "device_resident", False)),
+        "tolerance": declared_tolerance(),
+    }
+    try:
+        hier = getattr(amg, "hierarchy", None)
+        levels = []
+        total_meas = 0
+        for i, lv in enumerate(getattr(hier, "levels", []) or []):
+            slots = {}
+            lv_meas = 0
+            for slot in _SLOTS:
+                b = measured_tree_bytes(getattr(lv, slot, None))
+                if b:
+                    slots[slot] = b
+                lv_meas += b
+            A = getattr(lv, "A", None)
+            levels.append({"level": i,
+                           "format": type(A).__name__ if A is not None
+                           else None,
+                           "bytes_measured": lv_meas,
+                           "slots": slots})
+            total_meas += lv_meas
+        coarse_meas = measured_tree_bytes(getattr(hier, "coarse", None))
+        total_meas += coarse_meas
+        model_total = None
+        if out["resident"]:
+            try:
+                led = amg.resource_ledger()
+                model_total = int(led["totals"]["bytes"])
+                for row, lrow in zip(levels, led.get("levels", [])):
+                    row["bytes_model"] = lrow["bytes"]["total"]
+                    if row["bytes_model"]:
+                        row["drift_ratio"] = round(
+                            row["bytes_measured"] / row["bytes_model"],
+                            6)
+            except Exception:        # noqa: BLE001
+                model_total = None
+        if model_total is None:
+            out["provenance"] = "model"
+        out["levels"] = levels
+        out["coarse_bytes_measured"] = coarse_meas
+        out["total_measured"] = total_meas
+        out["total_model"] = model_total
+        if model_total:
+            out["drift_ratio"] = round(total_meas / model_total, 6)
+        out["device"] = {k: v for k, v in device_sample().items()
+                         if k != "t"}
+    except Exception as e:           # noqa: BLE001
+        out["error"] = repr(e)[:200]
+    return out
+
+
+def solve_resources(bundle) -> Optional[Dict[str, Any]]:
+    """The per-solve measured record ``SolveReport.resources
+    ["bytes_measured"]`` carries: live hierarchy + operator bytes with
+    their provenance, plus the device-level sample. Also drops a
+    ``solve`` phase point on the timeline. None when disabled."""
+    if not enabled():
+        return None
+    try:
+        hier_b = measured_tree_bytes(
+            getattr(getattr(bundle, "precond", None), "hierarchy",
+                    None))
+        op_b = _measure_operator(bundle)
+        s = snapshot("solve") or device_sample()
+        return {"provenance": "measured",
+                "hierarchy": hier_b, "operator": op_b,
+                "total": hier_b + op_b,
+                "device": {"source": s.get("source"),
+                           "bytes_in_use": s.get("bytes_in_use"),
+                           "peak_bytes_in_use":
+                           s.get("peak_bytes_in_use")}}
+    except Exception:                # noqa: BLE001
+        return None
+
+
+def memory_findings(mem: Optional[Dict[str, Any]]
+                    ) -> List[Dict[str, Any]]:
+    """Doctor findings from a memory join record (an
+    ``AMG.memory_report()``, a ``bytes_measured`` record or a selftest
+    record) — the ``telemetry.diagnose(memory=...)`` fold. Pure dict
+    crunching, never raises."""
+    out: List[Dict[str, Any]] = []
+    if not isinstance(mem, dict):
+        return out
+
+    def finding(sev, code, message, suggestion=None):
+        f = {"severity": sev, "code": code, "message": message}
+        if suggestion:
+            f["suggestion"] = suggestion
+        return f
+
+    tol = mem.get("tolerance")
+    tol = declared_tolerance() if not isinstance(tol, (int, float)) \
+        else float(tol)
+    dr = mem.get("drift_ratio")
+    if isinstance(dr, (int, float)) and abs(dr - 1.0) > tol:
+        out.append(finding(
+            "warning", "mem_drift",
+            "measured device bytes diverge from the analytic ledger "
+            "model by %.1f%% (ratio %.3f, declared tolerance "
+            "%.0f%%) — every admission/eviction decision trusting "
+            "AMG.bytes() is off by that much"
+            % (100 * abs(dr - 1.0), dr, 100 * tol),
+            "inspect AMG.memory_report() for the drifting level/slot; "
+            "on TPU, padding and layout make measured the truth — "
+            "consider AMGCL_TPU_FARM_HEADROOM=measured"))
+    leaked = mem.get("leaked_bytes")
+    if isinstance(leaked, (int, float)) and leaked > 0:
+        out.append(finding(
+            "critical", "mem_leak",
+            "register/evict/rebuild cycles leaked %d device bytes — "
+            "measured memory did not return to baseline" % int(leaked),
+            "a buffer survives eviction: check release_device() drops "
+            "every cache and the flight/capsule ring is not pinning "
+            "rhs/x0 arrays (AMGCL_TPU_FLIGHT_DIR unset disables the "
+            "ring)"))
+    owners = mem.get("owners") or []
+    if isinstance(owners, list):
+        total = sum(o.get("bytes_measured", 0) or 0 for o in owners
+                    if isinstance(o, dict))
+        un = next((o for o in owners if isinstance(o, dict)
+                   and o.get("owner") == "unattributed"), None)
+        if un and total > 0 and un.get("bytes_measured", 0) > 0.5 * total:
+            out.append(finding(
+                "info", "mem_unattributed",
+                "%.0f%% of measured device bytes belong to no "
+                "registered owner — workspaces, donated buffers or "
+                "foreign arrays dominate the footprint"
+                % (100 * un["bytes_measured"] / total),
+                None))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics
+# ---------------------------------------------------------------------------
+
+def forensics_tags(max_timeline: int = 64, max_owners: int = 8
+                   ) -> Dict[str, Any]:
+    """The forensic payload an allocation-failure flight bundle embeds
+    in its manifest: the memory timeline tail (``t`` stripped —
+    perf_counter references mean nothing post-mortem) and the
+    top-owner table."""
+    rows = [{k: v for k, v in r.items() if k != "t"}
+            for r in timeline(last=max_timeline)]
+    return {"memory_timeline": rows,
+            "memory_owners": owner_table()[:max_owners]}
+
+
+def record_allocation_failure(seam: str, exc=None, bundle=None,
+                              rhs=None, x0=None,
+                              extra: Optional[Dict[str, Any]] = None
+                              ) -> Optional[str]:
+    """The shared tail of every typed ``AllocationError`` seam: drop an
+    ``allocation_failure`` phase point on the timeline (emitting the
+    ``memory`` event), then dump a flight bundle whose manifest embeds
+    the timeline and top-owner table. Returns the bundle path (None
+    when the recorder is off / unwritable). Never raises — forensics
+    must not mask the allocation error itself."""
+    try:
+        snapshot("allocation_failure", fresh=True, seam=seam,
+                 error=repr(exc)[:200] if exc is not None else None)
+    except Exception:                # noqa: BLE001
+        pass
+    try:
+        from amgcl_tpu.telemetry import flight as _flight
+        if not _flight.enabled():
+            return None
+        tags: Dict[str, Any] = {"seam": seam}
+        if exc is not None:
+            tags["exception"] = repr(exc)[:200]
+        if extra:
+            tags.update(extra)
+        tags.update(forensics_tags())
+        return _flight.dump("allocation_failure", bundle=bundle,
+                            rhs=rhs, x0=x0, tags=tags)
+    except Exception:                # noqa: BLE001
+        return None
+
+
+# ---------------------------------------------------------------------------
+# Perfetto export
+# ---------------------------------------------------------------------------
+
+def to_chrome_trace(tid: int = 6, tid_name: str = "memwatch",
+                    pid: int = 0,
+                    epoch: Optional[float] = None) -> Dict[str, Any]:
+    """Chrome/Perfetto counter-track export of the timeline
+    (``ph:'C'`` events, microseconds relative to ``epoch`` — pass the
+    CLI profiler's ``_t0`` so the memory curve lines up under the
+    flame graph; default epoch is the first sample). Phase snapshots
+    additionally drop instant events so 'farm.evict' is visible AT the
+    bytes step it caused."""
+    rows = timeline()
+    events: List[Dict[str, Any]] = []
+    if not rows:
+        return {"traceEvents": events}
+    t0 = rows[0]["t"] if epoch is None else epoch
+    events.append({"ph": "M", "name": "thread_name", "pid": pid,
+                   "tid": tid, "args": {"name": tid_name}})
+    for r in rows:
+        ts = round((r["t"] - t0) * 1e6, 3)
+        if r.get("bytes_in_use") is not None:
+            events.append({"name": "memwatch bytes_in_use",
+                           "cat": "amgcl", "ph": "C", "ts": ts,
+                           "pid": pid,
+                           "args": {"bytes_in_use":
+                                    r["bytes_in_use"]}})
+        if r.get("peak_bytes_in_use") is not None:
+            events.append({"name": "memwatch peak_bytes",
+                           "cat": "amgcl", "ph": "C", "ts": ts,
+                           "pid": pid,
+                           "args": {"peak_bytes":
+                                    r["peak_bytes_in_use"]}})
+        if r.get("phase") not in (None, "sampler"):
+            events.append({"name": r["phase"], "cat": "amgcl",
+                           "ph": "i", "s": "t", "ts": ts,
+                           "pid": pid, "tid": tid,
+                           "args": {k: v for k, v in r.items()
+                                    if k in ("seam", "tenant",
+                                             "outcome", "error")}})
+    return {"traceEvents": events}
+
+
+# ---------------------------------------------------------------------------
+# leak-cycle selftest (bench.py --check `memwatch` record)
+# ---------------------------------------------------------------------------
+
+def selftest(cycles: int = 3, n: int = 8,
+             leak_bytes: Optional[int] = None) -> Dict[str, Any]:
+    """Register -> evict -> re-register x ``cycles`` through a real
+    :class:`SolverFarm` on a small Poisson operator, asserting (1) the
+    measured-vs-ledger join agrees per owner within the declared
+    tolerance for a multi-level hierarchy, (2) eviction returns the
+    hierarchy owner's measured bytes to 0, and (3) the process census
+    returns to baseline every cycle — leaked owner bytes fail the
+    record. ``leak_bytes`` (or ``AMGCL_TPU_MEMWATCH_LEAK_BYTES``)
+    deliberately pins one device buffer per cycle: the negative
+    injection that proves the gate trips."""
+    import numpy as np
+    import jax.numpy as jnp
+    from amgcl_tpu.models.amg import AMGParams
+    from amgcl_tpu.serve.farm import SolverFarm
+    from amgcl_tpu.utils.sample_problem import poisson3d
+
+    if leak_bytes is None:
+        try:
+            leak_bytes = int(os.environ.get(
+                "AMGCL_TPU_MEMWATCH_LEAK_BYTES", "0"))
+        except ValueError:
+            leak_bytes = 0
+    rec: Dict[str, Any] = {"ok": False, "cycles": int(cycles),
+                           "n": int(n),
+                           "leak_injected_bytes": int(leak_bytes),
+                           "tolerance": declared_tolerance(),
+                           "checks": []}
+    A, rhs = poisson3d(int(n))
+    t0 = time.perf_counter()
+    leaked_refs: List[Any] = []      # the deliberate leak (negative
+    #                                  injection) — pins device buffers
+    farm = SolverFarm(max_bytes=0, metrics_port=-1)
+    try:
+        prm = AMGParams(dtype=jnp.float32, coarse_enough=10,
+                        max_levels=4)
+        farm.register("leakcheck", A, precond=prm)
+        entry = farm.tenants["leakcheck"].entry
+        amg = entry.obj.precond
+
+        # -- join check: measured vs ledger per level+slot ---------------
+        report = hierarchy_report(amg)
+        tol = declared_tolerance()
+        join_ok = report.get("drift_ratio") is not None \
+            and abs(report["drift_ratio"] - 1.0) <= tol \
+            and len(report.get("levels", [])) >= 2
+        for row in report.get("levels", []):
+            r = row.get("drift_ratio")
+            if r is not None and abs(r - 1.0) > tol:
+                join_ok = False
+        rec["checks"].append({"check": "join_within_tolerance",
+                              "ok": join_ok,
+                              "levels": len(report.get("levels", [])),
+                              "drift_ratio":
+                              report.get("drift_ratio")})
+        rec["drift_ratio"] = report.get("drift_ratio")
+
+        # -- leak cycle: register -> evict -> re-register ----------------
+        baseline = device_sample().get("bytes_in_use") or 0
+        rec["baseline_bytes"] = int(baseline)
+        slack = max(1 << 16, int(0.02 * baseline))
+        cycle_ok = True
+        evict_ok = True
+        worst_over = 0
+        for c in range(int(cycles)):
+            assert farm.evict("leakcheck")
+            snapshot("memwatch.selftest", outcome="evict")
+            if measured_tree_bytes(getattr(amg, "hierarchy",
+                                           None)) != 0:
+                evict_ok = False
+            if leak_bytes > 0:
+                leaked_refs.append(
+                    jnp.zeros(max(leak_bytes // 4, 1),
+                              dtype=jnp.float32))
+            # bit-identical re-register: the registry HIT path
+            # readmits via the numeric rebuild — the farm's
+            # register/evict/rebuild residency machinery end to end
+            farm.register("leakcheck", A, precond=prm)
+            snapshot("memwatch.selftest", outcome="register")
+            now = device_sample().get("bytes_in_use") or 0
+            over = int(now - baseline)
+            worst_over = max(worst_over, over)
+            if over > slack:
+                cycle_ok = False
+        rec["leaked_bytes"] = max(worst_over, 0) \
+            if not cycle_ok else 0
+        rec["checks"].append({"check": "evict_zeroes_owner",
+                              "ok": evict_ok})
+        rec["checks"].append({"check": "cycle_returns_to_baseline",
+                              "ok": cycle_ok, "slack_bytes": slack,
+                              "worst_over_bytes": worst_over})
+        rec["owners"] = owner_table()[:8]
+        rec["findings"] = memory_findings(rec)
+        rec["ok"] = bool(join_ok and evict_ok and cycle_ok)
+    except Exception as e:           # noqa: BLE001
+        rec["error"] = repr(e)[:300]
+    finally:
+        del leaked_refs
+        try:
+            farm.close()
+        except Exception:            # noqa: BLE001
+            pass
+    rec["wall_s"] = round(time.perf_counter() - t0, 3)
+    return rec
+
+
+def _main(argv: Optional[List[str]] = None) -> int:
+    """``python -m amgcl_tpu.telemetry.memwatch --selftest [cycles]``
+    (the ``bench.py --check`` subprocess — forces the 8-virtual-device
+    CPU topology like the analysis arm). Prints ONE JSON line; exit 0
+    when the leak gate holds."""
+    args = list(argv if argv is not None else sys.argv[1:])
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + " --xla_force_host_platform_device_count=8").strip()
+    nums = [a for a in args if a.isdigit()]
+    # runpy executes this file as ``__main__`` — a SECOND module
+    # instance with its own registry/timeline. Route through the
+    # canonical package module so the owners registered by the AMG
+    # builds land in the same state the selftest reads.
+    from amgcl_tpu.telemetry import memwatch as _canon
+    result = _canon.selftest(cycles=int(nums[0]) if nums else 3)
+    from amgcl_tpu.telemetry import sink as _sink
+    print(json.dumps(_sink._clean(result), default=_sink._jsonable))
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

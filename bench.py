@@ -2342,6 +2342,14 @@ def gate_tolerances():
                               fails a candidate whose readmissions left
                               the rebuild path (rebuild_only_readmission
                               false) regardless of speed.
+      AMGCL_TPU_GATE_MEMDRIFT — allowed measured-vs-ledger drift-ratio
+                              growth for the memwatch record (default
+                              1.25: the candidate's |drift−1| may be at
+                              most 1.25× the baseline's, floored at the
+                              declared join tolerance so a clean
+                              baseline does not gate noise); the leak
+                              check itself is absolute — any leaked
+                              owner bytes fail the round regardless.
     """
     def _f(name, default):
         try:
@@ -2354,7 +2362,8 @@ def gate_tolerances():
             "bytes": _f("AMGCL_TPU_GATE_BYTES", 1.10),
             "throughput": _f("AMGCL_TPU_GATE_THROUGHPUT", 0.75),
             "setup": _f("AMGCL_TPU_GATE_SETUP", 0.7),
-            "farm": _f("AMGCL_TPU_GATE_FARM", 0.7)}
+            "farm": _f("AMGCL_TPU_GATE_FARM", 0.7),
+            "memdrift": _f("AMGCL_TPU_GATE_MEMDRIFT", 1.25)}
 
 
 def _record_health_flags(rec):
@@ -2519,6 +2528,37 @@ def run_gate(candidate, last_good, tol=None):
             check("rebuild_s", rb_c, rb_b,
                   rb_b * max(tol["time"], 1.0) if rb_b is not None else 0,
                   skip_reason=plat_skip)
+    # measured-vs-ledger drift (the memwatch record, ISSUE 18):
+    # |drift_ratio − 1| may grow at most tol["memdrift"]× over the
+    # baseline's, floored at the declared join tolerance so a clean
+    # baseline (drift 1.0) does not gate measurement noise. Platform-
+    # skipped: TPU padding/layout legitimately moves measured away
+    # from the analytic model.
+    md_c = (candidate.get("memwatch") or {}).get("drift_ratio")
+    md_b = (last_good.get("memwatch") or {}).get("drift_ratio")
+    if md_c is None and md_b is None:
+        pass          # neither record carries the metric: no check row
+    elif plat_skip is not None:
+        checks.append({"check": "memwatch_drift", "status": "skipped",
+                       "reason": plat_skip,
+                       "candidate": md_c, "last_good": md_b})
+    elif md_c is None or md_b is None:
+        checks.append({"check": "memwatch_drift", "status": "skipped",
+                       "candidate": md_c, "last_good": md_b})
+    else:
+        try:
+            from amgcl_tpu.telemetry.memwatch import declared_tolerance
+            floor_tol = declared_tolerance()
+        except Exception:
+            floor_tol = 0.25
+        limit = max(abs(md_b - 1.0) * tol.get("memdrift", 1.25),
+                    floor_tol)
+        checks.append({"check": "memwatch_drift",
+                       "candidate": round(abs(md_c - 1.0), 6),
+                       "last_good": round(abs(md_b - 1.0), 6),
+                       "limit": round(limit, 6),
+                       "status": "ok" if abs(md_c - 1.0) <= limit
+                       else "regression"})
     if os.environ.get("AMGCL_TPU_GATE_HEALTH", "1") != "0":
         # flag IDENTITIES, not counts: any guard the baseline did not
         # trip is a regression (a candidate swapping a warning-level
@@ -3282,6 +3322,41 @@ def main_check(targets=None):
         except Exception as e:
             storm_ok = False
             rec["storm"] = {"ok": False, "error": repr(e)[:300]}
+    memwatch_ok = True
+    if os.environ.get("AMGCL_TPU_MEMWATCH_IN_CHECK", "1") != "0":
+        # seeded memory-observatory selftest (telemetry/memwatch.py):
+        # builds a small farm tenant on the CPU mesh, joins measured
+        # live-array bytes against the ledger model per level, then
+        # runs register->evict->register cycles and fails on bytes
+        # that do not return to baseline (the leak gate). The record's
+        # drift_ratio also feeds the AMGCL_TPU_GATE_MEMDRIFT gate arm.
+        try:
+            m_timeout = float(os.environ.get(
+                "AMGCL_TPU_MEMWATCH_TIMEOUT", "600"))
+        except ValueError:
+            m_timeout = 600.0
+        try:
+            mr = subprocess.run(
+                [sys.executable, "-m", "amgcl_tpu.telemetry.memwatch",
+                 "--selftest"],
+                capture_output=True, text=True, timeout=m_timeout,
+                cwd=_REPO, env=dict(os.environ, JAX_PLATFORMS="cpu"))
+            mrec = json.loads(mr.stdout.strip().splitlines()[-1])
+            memwatch_ok = bool(mrec.get("ok")) and mr.returncode == 0
+            rec["memwatch"] = {
+                "ok": memwatch_ok,
+                "drift_ratio": mrec.get("drift_ratio"),
+                "baseline_bytes": mrec.get("baseline_bytes"),
+                "leaked_bytes": mrec.get("leaked_bytes"),
+                "checks": mrec.get("checks"),
+                "wall_s": mrec.get("wall_s")}
+            if not memwatch_ok:
+                # the actionable payload: findings + per-owner rows
+                rec["memwatch"]["findings"] = mrec.get("findings")
+                rec["memwatch"]["owners"] = mrec.get("owners")
+        except Exception as e:
+            memwatch_ok = False
+            rec["memwatch"] = {"ok": False, "error": repr(e)[:300]}
     analysis_ok = True
     if os.environ.get("AMGCL_TPU_ANALYSIS_IN_CHECK", "1") != "0":
         # static-analysis gate (amgcl_tpu/analysis): AST lint vs the
@@ -3339,7 +3414,8 @@ def main_check(targets=None):
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
     return 0 if (rc == 0 and gate_ok and analysis_ok
-                 and replay_ok and recovery_ok and storm_ok) else 1
+                 and replay_ok and recovery_ok and storm_ok
+                 and memwatch_ok) else 1
 
 
 if __name__ == "__main__":

@@ -53,5 +53,12 @@ def test_table_covers_new_knobs():
                 "AMGCL_TPU_STORM_FAULT_PLAN", "AMGCL_TPU_STORM_TRACE",
                 "AMGCL_TPU_STORM_IN_CHECK", "AMGCL_TPU_STORM_TIMEOUT",
                 "AMGCL_TPU_GATE_STORM", "AMGCL_TPU_GATE_STORM_P99",
-                "AMGCL_TPU_GATE_STORM_CANDIDATE"):
+                "AMGCL_TPU_GATE_STORM_CANDIDATE",
+                "AMGCL_TPU_MEMWATCH", "AMGCL_TPU_MEMWATCH_INTERVAL_MS",
+                "AMGCL_TPU_MEMWATCH_TIMELINE", "AMGCL_TPU_MEMWATCH_TOL",
+                "AMGCL_TPU_MEMWATCH_CENSUS_MS",
+                "AMGCL_TPU_MEMWATCH_IN_CHECK",
+                "AMGCL_TPU_MEMWATCH_LEAK_BYTES",
+                "AMGCL_TPU_MEMWATCH_TIMEOUT",
+                "AMGCL_TPU_GATE_MEMDRIFT", "AMGCL_TPU_FARM_HEADROOM"):
         assert var in documented, var

@@ -366,11 +366,13 @@ def test_gate_rides_check_record(monkeypatch, tmp_path):
     # subprocess (ISSUE 13) and the storm smoke (ISSUE 16) — opt those
     # gates out here; test_telemetry's bench-check test covers the
     # analysis record, test_flight the replay roundtrip, test_faults
-    # the chaos contract and test_storm the storm smoke end to end
+    # the chaos contract, test_storm the storm smoke and
+    # test_memwatch the leak-cycle selftest end to end
     monkeypatch.setenv("AMGCL_TPU_ANALYSIS_IN_CHECK", "0")
     monkeypatch.setenv("AMGCL_TPU_FLIGHT", "0")
     monkeypatch.setenv("AMGCL_TPU_GATE_RECOVERY", "0")
     monkeypatch.setenv("AMGCL_TPU_STORM_IN_CHECK", "0")
+    monkeypatch.setenv("AMGCL_TPU_MEMWATCH_IN_CHECK", "0")
     recs = []
     monkeypatch.setattr(bench._stdout_sink, "emit",
                         lambda rec=None, **kw: recs.append(dict(rec or {})))

@@ -316,13 +316,15 @@ def test_cli_telemetry_smoke(tmp_path, mesh):
 def test_bench_check_emits_dots():
     """bench.py --check runs the tier-1 pytest line (here narrowed to one
     fast file) and emits a JSONL record carrying DOTS_PASSED."""
-    # the chaos-matrix recovery gate is exercised by tests/test_faults
-    # and the storm smoke by tests/test_storm (both run in the real
-    # --check); skipping them here keeps this smoke inside its
-    # load-tolerant timeout envelope
+    # the chaos-matrix recovery gate is exercised by tests/test_faults,
+    # the storm smoke by tests/test_storm and the memwatch leak cycle
+    # by tests/test_memwatch (all run in the real --check); skipping
+    # them here keeps this smoke inside its load-tolerant timeout
+    # envelope
     env = dict(os.environ, AMGCL_TPU_CHECK_TIMEOUT="480",
                AMGCL_TPU_GATE_RECOVERY="0",
-               AMGCL_TPU_STORM_IN_CHECK="0")
+               AMGCL_TPU_STORM_IN_CHECK="0",
+               AMGCL_TPU_MEMWATCH_IN_CHECK="0")
     r = subprocess.run(
         [sys.executable, "bench.py", "--check",
          "tests/test_telemetry.py::test_jsonl_sink_roundtrip",
