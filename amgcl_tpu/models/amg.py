@@ -221,6 +221,7 @@ class AMG:
         self._roofline_cache = None
         self._structure_cache = None
         self._format_decisions = None
+        self._reorder = None
         # setup-phase profiler (PR 1 instrumented the SOLVE phase only):
         # device-synced tic/toc scopes + amgcl/setup/* host annotations
         # around coarsening / galerkin / device transfer / smoother
@@ -264,6 +265,34 @@ class AMG:
                     n_prefix = len(self._dev_prefix)
                     A = got["leftover"]
                     eps_override = got["eps_next"]
+        if self._device_filter is None and not self._device_built \
+                and not n_prefix and A.block_size == (1, 1):
+            # executed reorder (ISSUE 20): when the structure advisor
+            # predicts the layout wins back >= GAIN_FLOOR of SpMV bytes
+            # (or AMGCL_TPU_REORDER forces a variant), permute the fine
+            # operator HERE, before coarsening — the whole hierarchy,
+            # transfer operators included, is then built in the permuted
+            # frame and the device transfer absorbs the reorder for
+            # free. make_solver permutes rhs/x0 in and un-permutes x
+            # out, so the permutation is invisible at every outer seam.
+            import jax
+            from amgcl_tpu.telemetry import structure as _st
+            with setup_scope(prof, "reorder"):
+                try:
+                    _isz = jnp.dtype(prm.dtype).itemsize
+                except TypeError:
+                    _isz = 4
+                plan = _st.reorder_plan(
+                    A, on_tpu=jax.default_backend() == "tpu",
+                    itemsize=_isz)
+                if plan is not None:
+                    from amgcl_tpu.utils.adapters import permute
+                    A = permute(A, plan["perm"])
+                    A._reorder_prov = {
+                        "variant": plan["variant"],
+                        "fingerprint": plan["fingerprint"],
+                        "predicted_gain": plan["predicted_gain"]}
+                    self._reorder = plan
         coarsening = prm.coarsening
         # per-build state (eps_strong decay, coarse nullspace, grid dims)
         # lives in this context dict, NOT on the policy object — building
@@ -324,13 +353,23 @@ class AMG:
         aggregation, no symbolic SpGEMM, and the device transfer
         operators (frozen by the rebuild contract) are reused as-is."""
         old0 = self.host_levels[0][0]
+        # executed-reorder interplay: when a plan is active, host_levels
+        # holds the PERMUTED operator while callers hand back values in
+        # the ORIGINAL ordering (time-dependent loops never learn about
+        # the permutation). val_perm maps original-order values into the
+        # permuted frame; a caller handing back the permuted pattern
+        # itself (e.g. readmit) passes through untouched.
+        plan = getattr(self, "_reorder", None)
         if isinstance(A, np.ndarray):
             if A.shape != old0.val.shape:
                 raise ValueError(
                     "rebuild(new_vals): value array shape %r does not "
                     "match the operator's %r"
                     % (A.shape, old0.val.shape))
-            A = CSR(old0.ptr, old0.col, np.asarray(A), old0.ncols)
+            vals = np.asarray(A)
+            if plan is not None:
+                vals = vals[plan["val_perm"]]
+            A = CSR(old0.ptr, old0.col, vals, old0.ncols)
             same_pattern = True
         else:
             if not isinstance(A, CSR):
@@ -338,6 +377,15 @@ class AMG:
             if A.shape != old0.shape:
                 raise ValueError(
                     "rebuild requires the same matrix dimensions")
+            if plan is not None and A.nnz == old0.nnz and not (
+                    A.ptr is old0.ptr and A.col is old0.col) and (
+                    (A.ptr is plan["ptr"] and A.col is plan["col"])
+                    or (np.array_equal(A.ptr, plan["ptr"])
+                        and np.array_equal(A.col, plan["col"]))):
+                # original-order CSR: re-permute the values into the
+                # frame the hierarchy lives in (pure O(nnz) take)
+                A = CSR(old0.ptr, old0.col,
+                        np.asarray(A.val)[plan["val_perm"]], old0.ncols)
             same_pattern = A.nnz == old0.nnz and (
                 (A.ptr is old0.ptr and A.col is old0.col)
                 or (np.array_equal(A.ptr, old0.ptr)
@@ -583,7 +631,11 @@ class AMG:
         (no-op when already resident)."""
         if not self.device_resident:
             A0 = self.host_levels[0][0]
-            if getattr(self, "_device_built", False):
+            if getattr(self, "_device_built", False) \
+                    or getattr(self, "_reorder", None) is not None:
+                # reorder-active: A0 is the PERMUTED operator — hand the
+                # CSR back (identity-pattern pass-through) so rebuild's
+                # original-order value mapping never double-permutes
                 self.rebuild(A0)
             else:
                 self.rebuild(A0.val)   # values-only: skip the pattern

@@ -72,6 +72,28 @@ class make_solver:
             raise TypeError(
                 "precond must be AMGParams or an object with .hierarchy, "
                 "got %r" % type(precond))
+        # executed-reorder threading (ISSUE 20): when the hierarchy was
+        # built in a permuted frame (AMG._build applied the structure
+        # advisor's plan), every solver-side device operator must live
+        # in the SAME frame — rhs/x0 are permuted in and x un-permuted
+        # out per solve (_solve_once), so callers never see the layout.
+        self._reorder = plan = getattr(self.precond, "_reorder", None)
+        self._perm_dev = None
+        Ah = A
+        if plan is not None:
+            hl0 = self.precond.host_levels[0][0]
+            if built_from_A:
+                Ah = hl0       # the permuted fine operator, as built
+            else:
+                from amgcl_tpu.telemetry import structure as _st
+                if _st.fingerprint(A) != plan["fingerprint"]:
+                    raise ValueError(
+                        "prebuilt preconditioner was reordered for a "
+                        "different sparsity pattern than the system "
+                        "matrix; rebuild the preconditioner from this "
+                        "matrix or set AMGCL_TPU_REORDER=off")
+                Ah = CSR(hl0.ptr, hl0.col,
+                         np.asarray(A.val)[plan["val_perm"]], A.ncols)
         self.solver = solver or CG()
         self.solver_dtype = solver_dtype or self.precond_dtype
         self.refine = int(refine)
@@ -92,7 +114,7 @@ class make_solver:
             # one — the Krylov-side copy draws from the same pool as the
             # level operators instead of claiming a fresh allowance
             self.A_dev = dev.to_device(
-                A, matrix_format, self.solver_dtype,
+                Ah, matrix_format, self.solver_dtype,
                 budget=getattr(self.precond, "_dwin_budget", None))
         # refinement needs the outer residual b - A x evaluated more
         # accurately than the working precision (the f32 evaluation
@@ -127,8 +149,8 @@ class make_solver:
                         "refine_dtype='df32' needs a float32 DIA system "
                         "matrix; use refine_dtype='float64'")
                 self.refine_mode = "df32"
-                self.A_dev64 = self._build_lo_operator(A)
-                if not self._df32_selfcheck(A):
+                self.A_dev64 = self._build_lo_operator(Ah)
+                if not self._df32_selfcheck(Ah):
                     # error-free transforms assume every f32 op rounds
                     # once — a backend compiling them with excess
                     # precision or reassociation silently degrades the
@@ -148,7 +170,7 @@ class make_solver:
                             "float64 residual silently truncates to "
                             "float32 and refinement gains nothing")
                     self.refine_mode = "float64"
-                    self.A_dev64 = dev.to_device(A, matrix_format,
+                    self.A_dev64 = dev.to_device(Ah, matrix_format,
                                                  self._wide_dtype())
             else:
                 if not _jax.config.jax_enable_x64:
@@ -160,7 +182,7 @@ class make_solver:
                         "gains nothing — enable x64, drop refine, or use "
                         "refine_dtype='df32'")
                 self.refine_mode = "float64"
-                self.A_dev64 = dev.to_device(A, matrix_format,
+                self.A_dev64 = dev.to_device(Ah, matrix_format,
                                              self._wide_dtype())
         self._compiled = None
         try:
@@ -222,6 +244,12 @@ class make_solver:
                             % type(self.precond).__name__)
         self.precond.rebuild(A)
         self.A_host = A
+        # re-read the plan (AMG.rebuild preserves it; a device-built
+        # _build resets it) and refresh the solver-side operators in the
+        # hierarchy's frame — host_levels[0][0] is already permuted
+        self._reorder = plan = getattr(self.precond, "_reorder", None)
+        self._perm_dev = None
+        Ah = self.precond.host_levels[0][0] if plan is not None else A
         hier_A = getattr(getattr(self.precond, "hierarchy", None),
                          "system_matrix", None)
         if (getattr(self, "_built_from_A", False) and hier_A is not None
@@ -238,7 +266,7 @@ class make_solver:
             # fresh hierarchy-wide pool — the Krylov-side copy must draw
             # from it, not claim a second full dense-window allowance
             self.A_dev = dev.to_device(
-                A, self.matrix_format, self.solver_dtype,
+                Ah, self.matrix_format, self.solver_dtype,
                 budget=getattr(self.precond, "_dwin_budget", None))
         if self.refine > 0:
             if self.refine_mode == "df32":
@@ -248,9 +276,9 @@ class make_solver:
                         "df32 refinement needs a DIA system matrix — "
                         "rebuild with matrix_format='dia' or construct "
                         "a new solver with refine_dtype='float64'")
-                self.A_dev64 = self._build_lo_operator(A)
+                self.A_dev64 = self._build_lo_operator(Ah)
             else:
-                self.A_dev64 = dev.to_device(A, self.matrix_format,
+                self.A_dev64 = dev.to_device(Ah, self.matrix_format,
                                              self._wide_dtype())
         self._compiled = None
         self._hier_stats_cache = None
@@ -268,6 +296,7 @@ class make_solver:
         self._compiled = None
         self.A_dev = None
         self.A_dev64 = None
+        self._perm_dev = None
         self._hier_stats_cache = None
         self._resources_cache = None
         rel = getattr(self.precond, "release_device", None)
@@ -281,6 +310,20 @@ class make_solver:
         when already resident."""
         if self.A_dev is None:
             self.rebuild(self.A_host)
+
+    def _perm_pair(self):
+        """Device-resident (perm, iperm) int32 pair for the executed
+        reorder, built lazily and cached (release_device drops it).
+        Applied OUTSIDE the jitted solve program: the program signature
+        stays identical to the identity-layout one, so the jaxpr audit
+        contracts and compile-watch entries are untouched."""
+        pair = self._perm_dev
+        if pair is None:
+            plan = self._reorder
+            pair = (jnp.asarray(plan["perm"], jnp.int32),
+                    jnp.asarray(plan["iperm"], jnp.int32))
+            self._perm_dev = pair
+        return pair
 
     def _wide_dtype(self):
         return jnp.complex128 if jnp.issubdtype(
@@ -488,6 +531,16 @@ class make_solver:
             x0 = jnp.asarray(x0, dtype=self.solver_dtype)
         else:
             x0 = jnp.zeros_like(rhs)
+        # executed-reorder seam: dispatch in the hierarchy's permuted
+        # frame; the ORIGINAL-frame rhs/x0 names stay live for the df32
+        # runtime check and the flight recorder below (both evaluate
+        # against self.A_host, which is original-order). jnp.take with
+        # axis=0 covers the stacked (n, B) case unchanged.
+        rhs_d, x0_d = rhs, x0
+        if getattr(self, "_reorder", None) is not None:
+            perm, _ = self._perm_pair()
+            rhs_d = jnp.take(rhs, perm, axis=0)
+            x0_d = jnp.take(x0, perm, axis=0)
         t0 = time.perf_counter()
         first_call = self._compiled is None
         if first_call:
@@ -523,7 +576,7 @@ class make_solver:
         cw0 = _cwatch.snapshot(_SOLVE_FN) if _cwatch.enabled() else None
         try:
             got = entry(self.A_dev, self.A_dev64,
-                        self.precond.hierarchy, rhs, x0)
+                        self.precond.hierarchy, rhs_d, x0_d)
         except Exception as e:
             # OOM seam (ISSUE 18): a backend RESOURCE_EXHAUSTED used to
             # escape as a raw XlaRuntimeError — classify, trip the
@@ -549,6 +602,9 @@ class make_solver:
                 from amgcl_tpu.faults import inject as _inject
                 _inject.end_numeric_dispatch()
         x = got[0]
+        if getattr(self, "_reorder", None) is not None:
+            _, iperm = self._perm_pair()
+            x = jnp.take(x, iperm, axis=0)   # back to the caller's frame
         # ONE device->host round trip for everything the SolverInfo needs —
         # separate int()/float()/np.asarray() conversions each pay a full
         # device sync, which through a remote-device tunnel costs tens of

@@ -388,10 +388,38 @@ def _decided(M, A: CSR, fmt: str, cands, forced: bool = False):
                               built_bytes=built)
         dec["shape"] = [int(A.shape[0]), int(A.shape[1])]
         dec["nnz"] = int(A.nnz)
+        prov = getattr(A, "_reorder_prov", None)
+        if prov is not None:
+            # executed-reorder provenance (ISSUE 20): this decision was
+            # priced on the PERMUTED pattern — record which plan
+            dec["reorder"] = dict(prov)
         M._format_decision = dec
     except Exception:
         pass
     return M
+
+
+def _ranked_formats(cands):
+    """Ledger-driven attempt order for auto selection (ISSUE 20): the
+    structured candidates, cheapest predicted SpMV bytes first.
+    Prediction-ineligible formats keep the legacy preference order at
+    the tail — the per-format conversion guards remain the ground truth
+    (an attempt can still decline), and ELL stays the unconditional
+    terminal fallback outside this ranking. Falls back to the legacy
+    order when the prediction itself failed."""
+    default = ("dia", "dwin", "well")
+    if not cands:
+        return default
+    priced = {c["format"]: c for c in cands}
+
+    def key(f):
+        c = priced.get(f)
+        if c is None or not c.get("eligible") \
+                or not (c.get("predicted") or {}).get("bytes"):
+            return (1, default.index(f))
+        return (0, c["predicted"]["bytes"])
+
+    return tuple(sorted(default, key=key))
 
 
 def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
@@ -468,14 +496,29 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 "Cuthill-McKee reorder first or raise the budget")
         return _decided(D, A, "dwin", None, forced=True)
     if auto:
-        if not A.is_block:
-            nd, fill = dia_efficiency(A)
-            if (nd <= max_diags and fill <= max_fill
-                    and nd * A.nrows * jnp.dtype(dtype).itemsize < 2 << 30):
-                return _decided(csr_to_dia(A, dtype), A, "dia", cands)
-        if not jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating):
-            if not A.is_block and A.shape[0] == A.shape[1] \
-                    and on_tpu:
+        # ledger-driven selection (ISSUE 20): attempt the structured
+        # candidates cheapest-predicted-first instead of a fixed
+        # preference chain. Each attempt keeps its own eligibility
+        # guards — the prediction proposes, the conversion disposes —
+        # and a decline is marked on the candidate table so the X-ray
+        # distinguishes "lost on cost" from "declined in practice".
+        is_cplx = jnp.issubdtype(jnp.dtype(dtype), jnp.complexfloating)
+        if is_cplx:
+            _mark_candidate(cands, "dwin", {"why": "complex dtype"})
+            _mark_candidate(cands, "well", {"why": "complex dtype"})
+        for f in _ranked_formats(cands):
+            if f == "dia" and not A.is_block:
+                nd, fill = dia_efficiency(A)
+                if (nd <= max_diags and fill <= max_fill
+                        and nd * A.nrows * jnp.dtype(dtype).itemsize
+                        < 2 << 30):
+                    return _decided(csr_to_dia(A, dtype), A, "dia",
+                                    cands)
+                _mark_candidate(cands, "dia", {
+                    "why": "%d diagonals, fill %.2f over the auto "
+                    "thresholds" % (nd, fill)})
+            elif f == "dwin" and not is_cplx and not A.is_block \
+                    and A.shape[0] == A.shape[1] and on_tpu:
                 # gather-free dense-window blocks (ops/densewin.py): on
                 # real TPU the windowed-ELL Pallas gather does not
                 # legalize and the XLA take path runs at gather speed
@@ -497,23 +540,23 @@ def to_device(A: CSR, fmt: str = "auto", dtype=jnp.float32,
                 # prediction — "budget" here is what makes a
                 # budget-starved pick distinguishable in the X-ray
                 _mark_candidate(cands, "dwin", why)
-            # unstructured but banded (e.g. after Cuthill-McKee): windowed
-            # ELL replaces the HBM-serialized gather with per-tile VMEM
-            # windows, for scalar AND block values (the budget scales by
-            # the block column width inside csr_to_windowed_ell).
-            # Auto-selection keeps a tighter VMEM budget than the explicit
-            # 'well' format so the window + pipeline tiles cannot blow
-            # VMEM at solver-jit time
-            from amgcl_tpu.ops.unstructured import csr_to_windowed_ell
-            why = {}
-            W = csr_to_windowed_ell(A, dtype, max_win_bytes=4 << 20,
-                                    why=why)
-            if W is not None:
-                return _decided(W, A, "well", cands)
-            _mark_candidate(cands, "well", why)
-        else:
-            _mark_candidate(cands, "dwin", {"why": "complex dtype"})
-            _mark_candidate(cands, "well", {"why": "complex dtype"})
+            elif f == "well" and not is_cplx:
+                # unstructured but banded (e.g. after Cuthill-McKee or
+                # the executed reorder): windowed ELL replaces the
+                # HBM-serialized gather with per-tile VMEM windows, for
+                # scalar AND block values (the budget scales by the
+                # block column width inside csr_to_windowed_ell).
+                # Auto-selection keeps a tighter VMEM budget than the
+                # explicit 'well' format so the window + pipeline tiles
+                # cannot blow VMEM at solver-jit time
+                from amgcl_tpu.ops.unstructured import \
+                    csr_to_windowed_ell
+                why = {}
+                W = csr_to_windowed_ell(A, dtype, max_win_bytes=4 << 20,
+                                        why=why)
+                if W is not None:
+                    return _decided(W, A, "well", cands)
+                _mark_candidate(cands, "well", why)
     M = csr_to_ell(A, dtype)
     return _decided(M, A, "ell", cands, forced=not auto)
 

@@ -1,0 +1,161 @@
+"""Gather-SpMV: a per-column-slot unrolled Pallas kernel for REORDERED
+windowed-ELL operators.
+
+``ops.windowed_ell_spmv`` gathers its x-window with ONE 2-D
+``jnp.take(xw, cols[tile, K])`` — Mosaic lowers that to a generic
+dynamic-gather whose cost is independent of how well the reorder
+clustered the columns.  After RCM the windows densify (K drops toward
+the true bandwidth and cols_local concentrates near the diagonal), so
+the 2-D gather is overkill: this kernel unrolls the reduction over the
+STATIC column-slot axis instead,
+
+    for k in range(K):   # static Python loop — K is a shape constant
+        acc += vals[:, k] * take(x_window, cols[:, k])
+
+turning the access into K lane-shaped 1-D gathers from VMEM.  Each of
+those is a (tile,)-vector permutation of a resident window — the form
+Mosaic maps onto the VPU's lane crossbar — and the schedule only pays
+for the K the *reordered* pattern actually has.  The window DMA
+machinery (scalar-prefetched start, double-buffered HBM->VMEM copy) is
+imported from ops/unstructured.py: one copy of the race-prone part, and
+any sizing/alignment fix there services this kernel too.
+
+Dispatch contract (mirrors the windowed-ELL seam):
+
+* ``maybe_gather_spmv(M, x)`` is the ONLY entry ``WindowedEllMatrix.mv``
+  calls — returns ``None`` to decline (block values, kill switch, K too
+  wide for the unroll to win, probe failure), at which point ``mv``
+  falls through to the classic kernel / XLA chain unchanged.
+* ``AMGCL_TPU_GATHER_KERNEL``: ``auto`` (default — scalar matrices with
+  K <= 16 after a probe-compile), ``1``/``force`` (any K the probe
+  accepts), ``0``/``off`` (kill switch; the classic chain takes over).
+* ``gather_spmv_xla`` is the take-along fallback (identical math to the
+  windowed-ELL XLA path) and the reference for the agreement tests; the
+  ``interpret=True`` seam runs the real kernel schedule on CPU CI.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from amgcl_tpu.telemetry.compile_watch import watched_jit as _watched_jit
+from amgcl_tpu.ops.unstructured import (
+    _TILE, _double_buffered, _well_dma, _well_geometry)
+
+# Widest K the unrolled schedule is allowed to take in ``auto`` mode:
+# past this the K separate 1-D gathers lose to the single 2-D gather's
+# fixed cost (and the unrolled program body grows linearly in K).
+_AUTO_MAX_K = 16
+
+
+@functools.partial(_watched_jit, name="ops.gather_spmv",
+                   static_argnames=("win", "n_out", "interpret"))
+def gather_spmv(window_starts, cols_local, vals, x, win, n_out,
+                interpret: bool = False):
+    """y = A x, reduction unrolled over the static column-slot axis."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n_tiles, tile, K = cols_local.shape
+    out_dtype = jnp.result_type(vals.dtype, x.dtype)
+    xp, _, grid_spec = _well_geometry(x, win, n_tiles, tile, K, 0, None)
+
+    def kernel(starts_smem, x_hbm, c_ref, v_ref, o_ref, xw, sem):
+        slot = _well_dma(pl, pltpu, starts_smem, x_hbm, xw, sem, win,
+                         n_tiles)
+        xw_slot = xw[slot]
+        acc = jnp.zeros((tile,), v_ref.dtype)
+        for k in range(K):        # static unroll: K 1-D lane gathers
+            xg = jnp.take(xw_slot, c_ref[0, :, k], axis=0)
+            acc = acc + v_ref[0, :, k] * xg.astype(v_ref.dtype)
+        o_ref[0] = acc.astype(o_ref.dtype)
+
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((n_tiles, tile), out_dtype),
+        interpret=interpret,
+    )(window_starts, xp, cols_local, vals)
+    return out.reshape(n_tiles * tile)[:n_out]
+
+
+@functools.partial(_watched_jit, name="ops.gather_spmv_xla",
+                   static_argnames=("n_out",))
+def gather_spmv_xla(window_starts, cols_local, vals, x, n_out):
+    """Take-along fallback: absolute columns, one global gather — the
+    same math as the windowed-ELL XLA path, kept here so the agreement
+    tests pin the kernel against an in-module reference."""
+    n_tiles, tile, K = cols_local.shape
+    out_dtype = jnp.result_type(vals.dtype, x.dtype)
+    cols = cols_local + window_starts[:, None, None]
+    xg = jnp.take(x, cols.reshape(-1), axis=0).reshape(n_tiles, tile, K)
+    y = jnp.einsum("trk,trk->tr", vals, xg.astype(vals.dtype),
+                   preferred_element_type=out_dtype)
+    return y.reshape(n_tiles * tile)[:n_out].astype(out_dtype)
+
+
+_GATHER_OK = {}
+
+
+def gather_kernel_supported(win: int, K: int, dtype=jnp.float32) -> bool:
+    """Probe-compile the unrolled gather schedule on the current backend
+    for THIS matrix's geometry (window size, slot count, value dtype).
+    Same rationale as ``unstructured.kernel_supported``: inside an outer
+    jit a Mosaic legalization failure only surfaces at the OUTER
+    compile, so the path choice must be made eagerly, here.  Verdicts
+    are keyed on the double-buffer flag because it changes the scratch
+    geometry."""
+    key = (int(win), int(K), jnp.dtype(dtype).name, _double_buffered())
+    if key not in _GATHER_OK:
+        try:
+            starts = jnp.zeros(1, jnp.int32)
+            cols = jnp.zeros((1, _TILE, int(K)), jnp.int32)
+            vals = jnp.zeros((1, _TILE, int(K)), dtype)
+            x = jnp.zeros(int(win), jnp.float32)
+            # lower the WATCHED entry itself (no bare jax.jit wrap):
+            # the probe compile lands in the ops.gather_spmv bucket
+            gather_spmv.lower(starts, cols, vals, x, win=int(win),
+                              n_out=_TILE, interpret=False).compile()
+            _GATHER_OK[key] = True
+        except Exception as e:
+            from amgcl_tpu.ops.pallas_spmv import probe_report
+            probe_report("gather_spmv%r" % (key,), e)
+            _GATHER_OK[key] = False
+    return _GATHER_OK[key]
+
+
+def gather_mode() -> str:
+    """AMGCL_TPU_GATHER_KERNEL, normalized: 'auto' | 'force' | 'off'.
+    Read per call (not snapshotted): the kernel geometry does not depend
+    on it, so flight replay's env re-application and per-test
+    monkeypatching both work without stale-trace hazards."""
+    raw = os.environ.get("AMGCL_TPU_GATHER_KERNEL", "auto").strip().lower()
+    if raw in ("0", "off", "no", "false"):
+        return "off"
+    if raw in ("1", "force", "on"):
+        return "force"
+    return "auto"
+
+
+def maybe_gather_spmv(M, x):
+    """Dispatch seam called from ``WindowedEllMatrix.mv``: run the
+    gather kernel when it is preferred for this operator, else return
+    ``None`` and let the classic windowed-ELL chain handle it."""
+    mode = gather_mode()
+    if mode == "off" or M.block != (1, 1):
+        return None
+    K = M.cols_local.shape[2]
+    if mode == "auto" and K > _AUTO_MAX_K:
+        return None
+    ip = M._pallas_mode(x, kernel="spmv")   # shared enable/dtype gates
+    if ip is None:
+        return None
+    if ip is False and not gather_kernel_supported(M.win, K, M.dtype):
+        return None
+    return gather_spmv(M.window_starts, M.cols_local, M.vals, x,
+                       M.win, M.shape[0], interpret=ip)

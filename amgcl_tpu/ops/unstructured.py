@@ -113,6 +113,17 @@ class WindowedEllMatrix:
         return m
 
     def mv(self, x):
+        if self.block == (1, 1):
+            # narrow-K scalar operators (the executed-reorder regime,
+            # ISSUE 20) prefer the per-slot unrolled gather kernel;
+            # maybe_gather_spmv returns None to decline (kill switch,
+            # wide K, probe failure) and the classic chain takes over.
+            # Lazy import: pallas_gather reuses this module's DMA
+            # machinery, so importing it at the top would be circular.
+            from amgcl_tpu.ops import pallas_gather
+            y = pallas_gather.maybe_gather_spmv(self, x)
+            if y is not None:
+                return y
         ip = self._pallas_mode(x)
         if ip is not None:
             if self.block == (1, 1):

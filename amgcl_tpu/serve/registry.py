@@ -222,6 +222,18 @@ class OperatorRegistry:
             obj = build(A)
             e = RegistryEntry(fp, config_key, obj, A.val,
                               time.perf_counter() - t0)
+            plan = getattr(getattr(obj, "precond", obj),
+                           "_reorder", None)
+            if plan is not None:
+                # executed-reorder provenance (ISSUE 20): the plan is
+                # keyed on this entry's sparsity fingerprint, so hits
+                # and rebuilds against this entry reuse the permutation
+                # for free — surface that in the registry payload for
+                # the farm/metrics rollups
+                e.payload["reorder"] = {
+                    "variant": plan["variant"],
+                    "fingerprint": plan["fingerprint"],
+                    "predicted_gain": plan["predicted_gain"]}
             e.owners.add(owner)
             bucket.append(e)
             self.misses += 1

@@ -68,6 +68,8 @@ DECLARED_ENTRY_POINTS = (
     "ops.fused_down_sweep",
     "ops.fused_up_sweep",
     "ops.fused_vec",
+    "ops.gather_spmv",
+    "ops.gather_spmv_xla",
     "ops.level_setup",
     "ops.segment_galerkin",
     "ops.segment_spgemm",

@@ -392,6 +392,20 @@ def dump(reason: str, bundle=None, rhs=None, x0=None, report=None,
                           shape=np.asarray([A.nrows, A.ncols], np.int64))
             manifest["matrix"] = {"rows": int(A.nrows),
                                   "nnz": int(A.nnz)}
+            plan = getattr(getattr(bundle, "precond", None),
+                           "_reorder", None)
+            if plan is not None:
+                # executed-reorder provenance (ISSUE 20): the bundle's
+                # arrays are the ORIGINAL-order system (A_host); replay
+                # rebuilds from them and re-derives the same permutation
+                # because env re-application restores AMGCL_TPU_REORDER
+                # and the plan is a pure function of (pattern, mode) —
+                # the variant/fingerprint here let a parity check assert
+                # the replayed layout matches the recorded one
+                manifest["reorder"] = {
+                    "variant": plan["variant"],
+                    "fingerprint": plan["fingerprint"],
+                    "predicted_gain": plan["predicted_gain"]}
         else:
             manifest["config"] = {"replayable": False,
                                   "notes": ["solver bundle unavailable "

@@ -718,6 +718,120 @@ def advise(A, metrics: Optional[Dict[str, Any]] = None,
 
 
 # ---------------------------------------------------------------------------
+# executed reorder (ISSUE 20): the advisor's prediction, turned into a plan
+# ---------------------------------------------------------------------------
+
+#: fingerprint-keyed plan cache: the permutation is a function of the
+#: sparsity PATTERN only, so PR-9 ``rebuild()`` (same pattern, new
+#: values) and farm re-registrations of the same system reuse the plan
+#: for free instead of re-running scipy's RCM
+_PERM_CACHE: Dict[Tuple[str, str], Optional[Dict[str, Any]]] = {}
+
+
+def reorder_mode() -> str:
+    """``AMGCL_TPU_REORDER``, normalized: ``auto`` (default — engage
+    when the advisor predicts at least :data:`GAIN_FLOOR` byte gain),
+    ``rcm``/``cm`` (force that variant regardless of predicted gain),
+    or ``off``. Read per call so flight replay's env re-application and
+    per-test monkeypatching see the live value."""
+    raw = os.environ.get("AMGCL_TPU_REORDER", "auto").strip().lower()
+    if raw in ("0", "off", "no", "false"):
+        return "off"
+    if raw in ("rcm", "cm"):
+        return raw
+    return "auto"
+
+
+def reorder_plan(A, on_tpu: bool = False, mode: Optional[str] = None,
+                 itemsize: int = 4) -> Optional[Dict[str, Any]]:
+    """Decide whether to EXECUTE a reorder on ``A`` and, if so, return
+    the plan — or ``None`` to keep the identity ordering.
+
+    The plan dict carries everything the build/rebuild/solve seams
+    need to make the permutation invisible:
+
+    * ``perm``/``iperm`` — row/col permutation and its inverse
+      (``A_perm = P A Pᵀ``; solve permutes rhs in by ``perm`` and
+      un-permutes x out by ``iperm``),
+    * ``val_perm`` — nnz-sized index array with
+      ``A_perm.val = A.val[val_perm]``, so a same-pattern ``rebuild``
+      re-permutes values without touching scipy again,
+    * ``variant`` (``rcm``/``cm``), ``fingerprint`` (identity-pattern
+      digest the plan is cached under), ``predicted_gain`` (advisor
+      byte ratio, ``None`` when forced), ``n``, and the ORIGINAL
+      pattern refs ``ptr``/``col`` (so rebuild can recognize a caller
+      handing back an original-order CSR).
+
+    Scalar matrices only (``block_size == (1, 1)``) — the advisor does
+    not price block permutations — and patterns above
+    :func:`max_advise_nnz` are left alone, same ceiling as the X-ray."""
+    md = reorder_mode() if mode is None else str(mode).strip().lower()
+    if md in ("off", "0", "no", "false"):
+        return None
+    if getattr(A, "block_size", (1, 1)) != (1, 1):
+        return None
+    if A.nnz == 0 or A.nrows == 0 or A.nrows != A.ncols:
+        return None
+    if A.nnz > max_advise_nnz():
+        return None
+    fp = fingerprint(A)
+    key = (fp, md)
+    if key in _PERM_CACHE:
+        return _PERM_CACHE[key]
+    plan: Optional[Dict[str, Any]] = None
+    try:
+        if md == "auto":
+            # cheap pre-filter before the full advisor pass: an operator
+            # that already packs into a handful of well-filled diagonals
+            # (3D stencils: 7) is the structured regime the reorder
+            # exists to RECOVER, not improve — RCM cannot beat the
+            # identity there, and every AMG build would otherwise pay an
+            # RCM + candidate-table pass at setup. O(nnz) unique() vs
+            # the advisor's O(nnz log nnz + tables).
+            offs = np.unique(
+                np.repeat(np.arange(A.nrows, dtype=np.int64),
+                          np.diff(A.ptr)) - A.col)
+            if len(offs) <= 16 and \
+                    len(offs) * A.nrows <= 1.5 * A.nnz:
+                _PERM_CACHE[key] = None
+                return None
+            adv = advise(A, itemsize=itemsize, on_tpu=on_tpu)
+            best = adv.get("best")
+            if best is not None and best.get("gain") and \
+                    best["gain"] >= GAIN_FLOOR:
+                variant, gain = best["variant"], float(best["gain"])
+            else:
+                variant, gain = None, None
+        else:
+            variant, gain = md, None
+        if variant is not None:
+            rcm = _rcm_perm(A)
+            perm = rcm if variant == "rcm" else rcm[::-1]
+            perm = np.ascontiguousarray(perm, dtype=np.int64)
+            iperm = np.empty_like(perm)
+            iperm[perm] = np.arange(A.nrows, dtype=np.int64)
+            # value map via a scipy pass whose "values" are positions:
+            # row i of A_perm holds A.val[val_perm[ptr[i]:ptr[i+1]]]
+            import scipy.sparse as sp
+            # 1-based positions: position 0 as a stored value would be
+            # indistinguishable from an explicit zero to scipy's pruning
+            tag = sp.csr_matrix(
+                (np.arange(1, A.nnz + 1, dtype=np.int64), A.col, A.ptr),
+                shape=A.shape)
+            tag = tag[perm][:, perm].tocsr()
+            tag.sort_indices()
+            plan = {"perm": perm, "iperm": iperm,
+                    "val_perm": np.ascontiguousarray(tag.data) - 1,
+                    "variant": variant, "fingerprint": fp,
+                    "predicted_gain": gain, "n": int(A.nrows),
+                    "ptr": A.ptr, "col": A.col}
+    except Exception:
+        plan = None          # scipy missing / degenerate pattern:
+    _PERM_CACHE[key] = plan  # the executed reorder degrades to identity
+    return plan
+
+
+# ---------------------------------------------------------------------------
 # the hierarchy X-ray
 # ---------------------------------------------------------------------------
 

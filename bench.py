@@ -757,6 +757,49 @@ def _bench_unstructured(on_tpu):
     except Exception as e:
         out["dwin_error"] = repr(e)[:200]
 
+    # EXECUTED reorder (ISSUE 20 tentpole attribution): the permuted-
+    # banded fixture through the production seams — reorder_plan()
+    # computes the RCM permutation, to_device('auto') re-prices the
+    # candidate table on each ordering, and the format-decision records
+    # carry the model bytes that explain the wall-time gain. 'rcm' is
+    # forced (not 'auto') so the row is deterministic across hosts even
+    # when the advisor's gain floor would sit right at the threshold.
+    try:
+        from amgcl_tpu.telemetry import structure as _st
+        from amgcl_tpu.utils.adapters import permute as _permute
+        Ax, _A0, _pm = _st.permuted_banded(4096, bw=16, seed=7, local=32)
+        rx = {"n": Ax.nrows, "nnz": Ax.nnz}
+        plan = _st.reorder_plan(Ax, on_tpu=on_tpu, mode="rcm")
+        if plan is None:
+            rx["note"] = "reorder_plan declined"
+        else:
+            rx["variant"] = plan["variant"]
+            rx["predicted_gain"] = plan["predicted_gain"]
+            Bx = _permute(Ax, plan["perm"])
+            xr = jnp.asarray(np.random.RandomState(3).rand(Ax.nrows),
+                             jnp.float32)
+            for tag, mat in (("identity", Ax), ("reordered", Bx)):
+                M = dev.to_device(mat, "auto", jnp.float32)
+                d = getattr(M, "_format_decision", None) or {}
+                rx[tag] = {
+                    "format": d.get("fmt"),
+                    "model_bytes": (d.get("predicted") or {}).get("bytes"),
+                    "stored_bytes": d.get("stored_bytes"),
+                    "spmv_us": round(_diff_timeit(
+                        lambda v, _M=M: dev.spmv(_M, v), xr,
+                        reps=(10, 30), carry_plus_x0=True) * 1e6, 1)}
+            ti = rx["identity"]["spmv_us"]
+            tr = rx["reordered"]["spmv_us"]
+            if ti and tr:
+                rx["measured_gain"] = round(ti / _floor(tr), 3)
+            bi = rx["identity"]["model_bytes"]
+            br = rx["reordered"]["model_bytes"]
+            if bi and br:
+                rx["model_bytes_gain"] = round(bi / br, 3)
+        out["reorder_exec"] = rx
+    except Exception as e:
+        out["reorder_exec"] = {"error": repr(e)[:200]}
+
     # end-to-end SOLVE at the poisson3Db profile (BASELINE tutorial rows:
     # builtin 0.592 s / GTX 1050 Ti CUDA 0.171 s, AMG(SA)+BiCGStab) — a
     # synthetic same-class matrix, so the comparison is indicative of the
@@ -1299,6 +1342,26 @@ def main_worker():
             _PARTIAL["unstructured"] = _bench_unstructured(on_tpu)
         except Exception as e:
             _PARTIAL["unstructured"] = {"error": repr(e)}
+    if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_XRAY") == "1") \
+            and _enough("xray", 150):
+        # the advisor-validation join (--xray) rides the worker record
+        # so the gate's AMGCL_TPU_GATE_XRAY check scores it per round:
+        # predicted reorder gain vs measured, same experiment the CLI
+        # prints, just stored under 'xray' instead of its own record
+        _stage("xray join")
+        try:
+            xrec = _xray_record(
+                n=int(os.environ.get("AMGCL_TPU_XRAY_N", "4096")),
+                bw=int(os.environ.get("AMGCL_TPU_XRAY_BW", "16")),
+                local=int(os.environ.get("AMGCL_TPU_XRAY_LOCAL", "32")),
+                seed=7)
+            _PARTIAL["xray"] = {
+                "value": xrec["value"], "n": xrec["n"], "bw": xrec["bw"],
+                "advisor": xrec["advisor"], "join": xrec["join"],
+                "end_to_end": xrec["end_to_end"],
+                "formats": xrec["formats"]}
+        except Exception as e:
+            _PARTIAL["xray"] = {"error": repr(e)[:200]}
     if (on_tpu or os.environ.get("AMGCL_TPU_BENCH_EXTRA") == "1") \
             and _enough("extra_configs", 300):
         _stage("block + stokes configs")
@@ -2350,6 +2413,15 @@ def gate_tolerances():
                               baseline does not gate noise); the leak
                               check itself is absolute — any leaked
                               owner bytes fail the round regardless.
+      AMGCL_TPU_GATE_XRAY   — allowed predicted-vs-measured divergence
+                              of the executed-reorder gain (the
+                              ``bench --xray`` join the worker's xray
+                              stage records; default 0.25: the
+                              measured/predicted ratio must stay within
+                              25% of 1). Skipped across device_platform
+                              mismatches like the time ratio, and for
+                              CPU-fallback joins that could only match
+                              end-to-end (informational). 0 disables.
     """
     def _f(name, default):
         try:
@@ -2363,7 +2435,8 @@ def gate_tolerances():
             "throughput": _f("AMGCL_TPU_GATE_THROUGHPUT", 0.75),
             "setup": _f("AMGCL_TPU_GATE_SETUP", 0.7),
             "farm": _f("AMGCL_TPU_GATE_FARM", 0.7),
-            "memdrift": _f("AMGCL_TPU_GATE_MEMDRIFT", 1.25)}
+            "memdrift": _f("AMGCL_TPU_GATE_MEMDRIFT", 1.25),
+            "xray": _f("AMGCL_TPU_GATE_XRAY", 0.25)}
 
 
 def _record_health_flags(rec):
@@ -2558,6 +2631,41 @@ def run_gate(candidate, last_good, tol=None):
                        "last_good": round(abs(md_b - 1.0), 6),
                        "limit": round(limit, 6),
                        "status": "ok" if abs(md_c - 1.0) <= limit
+                       else "regression"})
+    # predicted-vs-measured reorder gain (the bench --xray join, ISSUE
+    # 20): the candidate's measured gain must stay within tol["xray"]
+    # of its OWN prediction — a drifting join means the executed
+    # reorder no longer delivers what the advisor priced, i.e. either
+    # the cost model or the execution seam regressed. Checked against
+    # the candidate alone (the ratio is self-relative); the last_good
+    # side only decides whether the metric exists for this trajectory.
+    xtol = tol.get("xray", 0.25)
+    xj_c = (candidate.get("xray") or {}).get("join") or {}
+    xj_b = (last_good.get("xray") or {}).get("join") or {}
+    xr_c, xr_b = xj_c.get("ratio"), xj_b.get("ratio")
+    if (xr_c is None and xr_b is None) or xtol <= 0:
+        pass          # neither record carries the join: no check row
+    elif plat_skip is not None:
+        checks.append({"check": "xray_join", "status": "skipped",
+                       "reason": plat_skip,
+                       "candidate": xr_c, "last_good": xr_b})
+    elif xr_c is None:
+        checks.append({"check": "xray_join", "status": "skipped",
+                       "candidate": xr_c, "last_good": xr_b})
+    elif xj_c.get("informational") and xj_c.get("fallback"):
+        checks.append({"check": "xray_join", "status": "skipped",
+                       "reason": "cpu-fallback end-to-end join is "
+                       "informational (format winners differ between "
+                       "the orderings, so time does not track the "
+                       "byte model off-TPU)",
+                       "candidate": xr_c, "last_good": xr_b})
+    else:
+        checks.append({"check": "xray_join",
+                       "candidate": round(abs(xr_c - 1.0), 6),
+                       "last_good": round(abs(xr_b - 1.0), 6)
+                       if xr_b is not None else None,
+                       "limit": round(xtol, 6),
+                       "status": "ok" if abs(xr_c - 1.0) <= xtol
                        else "regression"})
     if os.environ.get("AMGCL_TPU_GATE_HEALTH", "1") != "0":
         # flag IDENTITIES, not counts: any guard the baseline did not
@@ -2972,23 +3080,12 @@ def count_dots(text: str) -> int:
                if _DOTS_RE.match(line.strip()))
 
 
-def main_xray(args=None):
-    """``bench.py --xray``: the advisor-validation microbenchmark
-    (ISSUE 14 satellite) — ONE unstructured operator (the
-    permuted-banded fixture from telemetry/structure.py: a band
-    scrambled by a block-local symmetric permutation, the matrix class
-    the reorder advisor exists for), SpMV measured per candidate
-    device format under the identity ordering and under RCM, joined
-    against the X-ray's PREDICTED reorder gain. The headline join is
-    MECHANISM-MATCHED: the advisor's winning format measured on both
-    orderings (same packing, so time tracks the byte model on any
-    platform — DIA's shifted multiply-adds scale with ndiags whether
-    the bottleneck is HBM or cache); the cross-format end-to-end gain
-    (best identity format vs best reordered format) rides along as
-    ``end_to_end``. Emits ONE ``bench_xray`` record (platform-stamped
-    via hw_provenance; informational on the CPU fallback — the
-    cross-format mapping is only roofline-faithful where the SpMV is
-    HBM-bound). Exit 1 only when nothing could be measured."""
+def _xray_record(n, bw, local, seed):
+    """Build the ``bench_xray`` record for one permuted-banded operator
+    (the measurement body shared by ``--xray`` and the bench worker's
+    xray stage — one copy of the chained-SpMV protocol, so the gate's
+    ``xray_join`` check always scores the same experiment the CLI
+    prints)."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -2997,16 +3094,6 @@ def main_xray(args=None):
     from amgcl_tpu.ops import device as dev
     from amgcl_tpu.utils.adapters import cuthill_mckee, permute
 
-    n = int(os.environ.get("AMGCL_TPU_XRAY_N", "4096"))
-    # bw 16 keeps the RCM-recovered band at ~33 diagonals — still
-    # inside auto's CPU max_diags=40 so the advisor genuinely picks
-    # DIA, and in the same XLA lowering regime as the scrambled
-    # identity's ~160 (below ~16 diagonals the whole DIA chain fuses
-    # into one pass and the per-diagonal cost drops ~40%, which would
-    # bias the matched join)
-    bw = int(os.environ.get("AMGCL_TPU_XRAY_BW", "16"))
-    local = int(os.environ.get("AMGCL_TPU_XRAY_LOCAL", "32"))
-    seed = 7
     A, _A0, _perm = _structure.permuted_banded(n, bw=bw, seed=seed,
                                                local=local or None)
     rcm = cuthill_mckee(A)
@@ -3122,9 +3209,39 @@ def main_xray(args=None):
            "end_to_end": {"measured_gain": e2e,
                           "predicted_gain": best.get("gain")},
            "formats": rows, "join": join, "commit": _git_head()}
+    return rec
+
+
+def main_xray(args=None):
+    """``bench.py --xray``: the advisor-validation microbenchmark
+    (ISSUE 14 satellite) — ONE unstructured operator (the
+    permuted-banded fixture from telemetry/structure.py: a band
+    scrambled by a block-local symmetric permutation, the matrix class
+    the reorder advisor exists for), SpMV measured per candidate
+    device format under the identity ordering and under RCM, joined
+    against the X-ray's PREDICTED reorder gain. The headline join is
+    MECHANISM-MATCHED: the advisor's winning format measured on both
+    orderings (same packing, so time tracks the byte model on any
+    platform — DIA's shifted multiply-adds scale with ndiags whether
+    the bottleneck is HBM or cache); the cross-format end-to-end gain
+    (best identity format vs best reordered format) rides along as
+    ``end_to_end``. Emits ONE ``bench_xray`` record (platform-stamped
+    via hw_provenance; informational on the CPU fallback — the
+    cross-format mapping is only roofline-faithful where the SpMV is
+    HBM-bound). Exit 1 only when nothing could be measured."""
+    n = int(os.environ.get("AMGCL_TPU_XRAY_N", "4096"))
+    # bw 16 keeps the RCM-recovered band at ~33 diagonals — still
+    # inside auto's CPU max_diags=40 so the advisor genuinely picks
+    # DIA, and in the same XLA lowering regime as the scrambled
+    # identity's ~160 (below ~16 diagonals the whole DIA chain fuses
+    # into one pass and the per-diagonal cost drops ~40%, which would
+    # bias the matched join)
+    bw = int(os.environ.get("AMGCL_TPU_XRAY_BW", "16"))
+    local = int(os.environ.get("AMGCL_TPU_XRAY_LOCAL", "32"))
+    rec = _xray_record(n, bw, local, seed=7)
     _stdout_sink.emit(rec)
     _sink.emit(dict(rec))
-    return 0 if measured is not None else 1
+    return 0 if rec["value"] is not None else 1
 
 
 def main_check(targets=None):
